@@ -1,0 +1,19 @@
+"""tpu-docker-api: TPU-native container-orchestration control plane + JAX workload runtime.
+
+A from-scratch rebuild of the capabilities of XShengTech/gpu-docker-api
+(reference: /root/reference, pure Go, NVIDIA/Docker substrate) designed
+TPU-first:
+
+- the GPU scheduler (reference internal/schedulers/gpuscheduler.go) becomes an
+  ICI-topology-aware TPU chip allocator that grants *contiguous sub-meshes*;
+- the nvidia-container-runtime HostConfig (reference
+  internal/services/replicaset_nomock.go:128-140) becomes /dev/accel*
+  passthrough + libtpu bind mounts + TPU_VISIBLE_CHIPS env plumbing;
+- etcd (reference internal/etcd/) becomes an embedded MVCC store with explicit
+  per-version history keys (compaction-safe, unlike the reference's raw
+  MVCC-revision walk in internal/etcd/revision.go);
+- the scheduled workload is a JAX/XLA training stack (models/, ops/, parallel/)
+  with mesh sharding, ring attention, and pallas kernels.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .mvcc import KeyValue, MVCCStore  # noqa: F401
+from .client import StateClient, ResourcePrefix  # noqa: F401

@@ -1,0 +1,121 @@
+"""High-level state client: key scheme + typed history ops.
+
+Reference parity: internal/etcd/common.go (key scheme `/gpu-docker-api/apis/v1/
+{resource}/{name}` :96-98, Put/GetValue/Del :45-70) and internal/etcd/revision.go
+(GetRevisionRange :18-44, GetRevision :46-66). Here the store is embedded, so
+ops are in-process calls; history rides MVCCStore.history() instead of a
+revision-walk of gRPC gets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import xerrors
+from .mvcc import KeyValue, MVCCStore
+
+
+class ResourcePrefix:
+    """Key-space layout, mirroring the reference's single prefix but versioned
+    for this project."""
+
+    Base = "/tpu-docker-api/apis/v1"
+    Containers = "containers"
+    Volumes = "volumes"
+    Tpus = "tpus"
+    Cpus = "cpus"
+    Ports = "ports"
+    Versions = "versions"
+    Merges = "merges"
+
+
+def resource_key(resource: str, name: str) -> str:
+    return f"{ResourcePrefix.Base}/{resource}/{name}"
+
+
+@dataclass(frozen=True)
+class Combine:
+    """One history entry: per-key version + global revision + raw value
+    (reference internal/etcd/revision.go combine struct)."""
+    version: int
+    revision: int
+    value: str
+
+
+class StateClient:
+    """Typed facade over MVCCStore used by services, schedulers and version maps."""
+
+    def __init__(self, store: MVCCStore):
+        self.store = store
+
+    # ---- basic ops (etcd/common.go parity) ----
+
+    def put(self, resource: str, name: str, value: str) -> int:
+        return self.store.put(resource_key(resource, name), value)
+
+    def get_value(self, resource: str, name: str) -> str:
+        kv = self.store.get(resource_key(resource, name))
+        if kv is None:
+            raise xerrors.NotExistInStoreError(f"{resource}/{name}")
+        return kv.value
+
+    def get(self, resource: str, name: str) -> Optional[KeyValue]:
+        return self.store.get(resource_key(resource, name))
+
+    def delete(self, resource: str, name: str) -> bool:
+        return self.store.delete(resource_key(resource, name))
+
+    def range(self, resource: str) -> list[KeyValue]:
+        return self.store.range(f"{ResourcePrefix.Base}/{resource}/")
+
+    # ---- history (etcd/revision.go parity, compaction-safe) ----
+
+    def get_revision_range(self, resource: str, name: str) -> list[Combine]:
+        """All versions of the key's current lifetime, newest first (the
+        reference walker returns newest-to-oldest, revision.go:18-44)."""
+        hist = self.store.history(resource_key(resource, name))
+        if not hist:
+            raise xerrors.NotExistInStoreError(f"{resource}/{name}")
+        return [Combine(kv.version, kv.mod_revision, kv.value) for kv in reversed(hist)]
+
+    def get_revision(self, resource: str, name: str, version: int) -> Combine:
+        """The value at per-key `version` (revision.go:46-66)."""
+        kv = self.store.get_version(resource_key(resource, name), version)
+        if kv is None:
+            raise xerrors.VersionNotFoundError(f"{resource}/{name}@{version}")
+        return Combine(kv.version, kv.mod_revision, kv.value)
+
+    # ---- explicit per-entity-version keys ----
+    # The reference equates "container version N" with "the Nth etcd write of
+    # the key" — fragile (any incidental rewrite shifts history; compaction
+    # destroys it, SURVEY §2 bug 5). We persist every entity version under its
+    # own key as the durable system of record, and keep the MVCC walk only as
+    # a secondary view.
+
+    def put_entity_version(self, resource: str, name: str, version: int, value: str) -> int:
+        return self.store.put(
+            f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/{version:012d}", value)
+
+    def get_entity_version(self, resource: str, name: str, version: int) -> str:
+        kv = self.store.get(
+            f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/{version:012d}")
+        if kv is None:
+            raise xerrors.VersionNotFoundError(f"{resource}/{name}@{version}")
+        return kv.value
+
+    def entity_versions(self, resource: str, name: str) -> list[tuple[int, str]]:
+        """[(version, value)] ascending."""
+        prefix = f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/"
+        out = []
+        for kv in self.store.range(prefix):
+            out.append((int(kv.key[len(prefix):]), kv.value))
+        return out
+
+    def delete_entity_versions(self, resource: str, name: str) -> int:
+        prefix = f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/"
+        n = 0
+        for kv in self.store.range(prefix):
+            self.store.delete(kv.key)
+            n += 1
+        return n

@@ -1,0 +1,269 @@
+"""Embedded MVCC versioned key-value store — the etcd of this framework.
+
+The reference outsources versioned state to an external etcd 3.x server
+(internal/etcd/client.go:13-24) and implements version history by walking raw
+MVCC revisions one gRPC Get(WithRev) at a time (internal/etcd/revision.go:18-44)
+— O(revisions) round trips, and silently broken by etcd compaction.
+
+This store keeps etcd's data model (global revision counter; per-key
+create_revision / mod_revision / version; tombstoned deletes reset version) but
+is embedded, lock-protected, WAL-persisted, and exposes history as a single
+O(1)-roundtrip call. A C++ core (native/mvcc_store.cc) provides the same API
+via ctypes for the hot path; this file is the always-available reference
+implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+@dataclass(frozen=True)
+class KeyValue:
+    key: str
+    value: str
+    create_revision: int
+    mod_revision: int
+    version: int  # number of writes since the key's current creation (1-based)
+
+
+@dataclass
+class _Rev:
+    mod_revision: int
+    create_revision: int
+    version: int
+    value: str
+    tombstone: bool = False
+
+
+class MVCCStore:
+    """Thread-safe embedded MVCC KV store with optional WAL persistence."""
+
+    def __init__(self, wal_path: Optional[str] = None, fsync: bool = False):
+        self._lock = threading.RLock()
+        self._rev = 0
+        self._compacted = 0
+        self._log: dict[str, list[_Rev]] = {}
+        self._wal_path = wal_path
+        self._fsync = fsync
+        self._wal = None
+        if wal_path:
+            if os.path.exists(wal_path):
+                self._replay(wal_path)
+            os.makedirs(os.path.dirname(os.path.abspath(wal_path)), exist_ok=True)
+            self._wal = open(wal_path, "a", encoding="utf-8")
+
+    # ---- write path ----
+
+    def put(self, key: str, value: str) -> int:
+        """Write value; returns the new global revision."""
+        with self._lock:
+            self._rev += 1
+            self._apply_put(key, value, self._rev)
+            self._wal_append({"op": "put", "k": key, "v": value, "r": self._rev})
+            return self._rev
+
+    def delete(self, key: str) -> bool:
+        """Tombstone the key. Re-creating it later restarts version at 1
+        (etcd semantics). Returns False if the key doesn't exist."""
+        with self._lock:
+            revs = self._log.get(key)
+            if not revs or revs[-1].tombstone:
+                return False
+            self._rev += 1
+            self._apply_delete(key, self._rev)
+            self._wal_append({"op": "del", "k": key, "r": self._rev})
+            return True
+
+    def _apply_put(self, key: str, value: str, rev: int) -> None:
+        revs = self._log.setdefault(key, [])
+        if revs and not revs[-1].tombstone:
+            last = revs[-1]
+            revs.append(_Rev(rev, last.create_revision, last.version + 1, value))
+        else:
+            revs.append(_Rev(rev, rev, 1, value))
+
+    def _apply_delete(self, key: str, rev: int) -> None:
+        revs = self._log.setdefault(key, [])
+        revs.append(_Rev(rev, 0, 0, "", tombstone=True))
+
+    # ---- read path ----
+
+    def get(self, key: str) -> Optional[KeyValue]:
+        with self._lock:
+            revs = self._log.get(key)
+            if not revs or revs[-1].tombstone:
+                return None
+            return self._kv(key, revs[-1])
+
+    def get_at_revision(self, key: str, revision: int) -> Optional[KeyValue]:
+        """State of `key` as of global `revision` (etcd Get WithRev)."""
+        with self._lock:
+            if revision < self._compacted:
+                raise ValueError(f"revision {revision} compacted (< {self._compacted})")
+            revs = self._log.get(key)
+            if not revs:
+                return None
+            best = None
+            for r in revs:
+                if r.mod_revision <= revision:
+                    best = r
+                else:
+                    break
+            if best is None or best.tombstone:
+                return None
+            return self._kv(key, best)
+
+    def range(self, prefix: str) -> list[KeyValue]:
+        """Latest live KVs whose key starts with prefix, sorted by key."""
+        with self._lock:
+            out = []
+            for key in sorted(self._log):
+                if key.startswith(prefix):
+                    revs = self._log[key]
+                    if revs and not revs[-1].tombstone:
+                        out.append(self._kv(key, revs[-1]))
+            return out
+
+    def history(self, key: str, since_create: bool = True) -> list[KeyValue]:
+        """All live revisions of `key` ascending by mod_revision.
+
+        since_create=True limits to the key's current lifetime (everything
+        after the last tombstone) — the semantics of the reference's
+        GetRevisionRange ModRevision→CreateRevision walk
+        (internal/etcd/revision.go:18-44), but as one call instead of
+        O(revisions) gRPC round trips.
+        """
+        with self._lock:
+            revs = self._log.get(key)
+            if not revs:
+                return []
+            live: list[KeyValue] = []
+            for r in revs:
+                if r.tombstone:
+                    if since_create:
+                        live = []
+                else:
+                    live.append(self._kv(key, r))
+            return live
+
+    def get_version(self, key: str, version: int) -> Optional[KeyValue]:
+        """Value at a specific per-key version within the current lifetime
+        (reference GetRevision, internal/etcd/revision.go:46-66)."""
+        for kv in self.history(key):
+            if kv.version == version:
+                return kv
+        return None
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    # ---- maintenance ----
+
+    def compact(self, revision: int, keep_history_prefixes: tuple[str, ...] = ()) -> int:
+        """Drop per-key revisions with mod_revision < revision, keeping each
+        key's latest state. Keys under keep_history_prefixes keep full history
+        (this is how container/volume version history survives compaction —
+        the reference has no answer to this, SURVEY §2 bug 5). Returns the
+        number of revision entries dropped."""
+        dropped = 0
+        with self._lock:
+            for key in list(self._log):
+                revs = self._log[key]
+                if any(key.startswith(p) for p in keep_history_prefixes):
+                    continue
+                # etcd semantics: keep every revision > R, plus the newest
+                # revision <= R (the "floor" — the key's state as of R), so
+                # get_at_revision stays correct for all uncompacted revisions.
+                floor = None
+                for r in revs:
+                    if r.mod_revision <= revision:
+                        floor = r
+                    else:
+                        break
+                keep = [r for r in revs if r.mod_revision > revision]
+                if floor is not None and not floor.tombstone:
+                    keep.insert(0, floor)
+                dropped += len(revs) - len(keep)
+                if keep:
+                    self._log[key] = keep
+                else:
+                    # fully-compacted tombstoned key: reclaim it entirely
+                    del self._log[key]
+            self._compacted = max(self._compacted, revision)
+        return dropped
+
+    # ---- persistence ----
+
+    def _wal_append(self, rec: dict) -> None:
+        if self._wal is not None:
+            self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._wal.flush()
+            if self._fsync:
+                os.fsync(self._wal.fileno())
+
+    def _replay(self, path: str) -> None:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write — stop-the-line would lose the rest
+                rev = rec.get("r", self._rev + 1)
+                self._rev = max(self._rev, rev)
+                if rec["op"] == "put":
+                    self._apply_put(rec["k"], rec["v"], rev)
+                elif rec["op"] == "del":
+                    self._apply_delete(rec["k"], rev)
+                # op == "rev": counter checkpoint only, handled above
+
+    def snapshot(self, path: str) -> None:
+        """Write a compacted replayable WAL to `path` (latest lifetime of each
+        key only), atomically."""
+        tmp = path + ".tmp"
+        with self._lock, open(tmp, "w", encoding="utf-8") as f:
+            # preserve the global revision counter even when the highest
+            # revisions belong to deletes/compacted entries that the snapshot
+            # omits — replaying must never re-mint issued revision numbers
+            f.write(json.dumps({"op": "rev", "r": self._rev},
+                               separators=(",", ":")) + "\n")
+            for key in sorted(self._log):
+                for kv in self.history(key):
+                    f.write(json.dumps(
+                        {"op": "put", "k": key, "v": kv.value, "r": kv.mod_revision},
+                        separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+                self._wal.close()
+                self._wal = None
+
+    def __enter__(self) -> "MVCCStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- helpers ----
+
+    @staticmethod
+    def _kv(key: str, r: _Rev) -> KeyValue:
+        return KeyValue(key, r.value, r.create_revision, r.mod_revision, r.version)
+
+    def keys(self) -> Iterator[str]:
+        with self._lock:
+            live = [k for k, revs in self._log.items() if revs and not revs[-1].tombstone]
+        return iter(sorted(live))

@@ -1,0 +1,1 @@
+from .file import dir_size, to_bytes, from_bytes, is_dir, copy_dir  # noqa: F401

@@ -1,0 +1,138 @@
+"""Async write-behind queue for state persistence.
+
+Reference parity: internal/workQueue/workQueue.go — a buffered channel (cap
+110) drained by SyncLoop, each message dispatched to a goroutine, with
+*infinite re-enqueue* on etcd failure (:29-33) and close-at-Stop.
+
+Differences by design:
+- bounded retries with exponential backoff instead of an unbounded hot loop;
+- a single drainer thread applying ops in order (the reference's
+  goroutine-per-message loses write ordering — SURVEY §2 bug 8);
+- join() for deterministic tests and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = 1024  # reference: 110 (workQueue.go:12)
+
+
+@dataclass
+class PutKeyValue:
+    resource: str
+    name: str
+    value: str
+
+
+@dataclass
+class DelKey:
+    resource: str
+    name: str
+
+
+@dataclass
+class Call:
+    """Escape hatch: run an arbitrary persistence closure on the drainer."""
+    fn: Callable[[], None]
+    describe: str = "call"
+
+
+@dataclass
+class _Envelope:
+    msg: object
+    attempts: int = 0
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class WorkQueue:
+    def __init__(self, client, capacity: int = DEFAULT_CAPACITY,
+                 max_retries: int = 8, base_backoff: float = 0.05):
+        self._client = client
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._max_retries = max_retries
+        self._base_backoff = base_backoff
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dropped: list[object] = []  # messages that exhausted retries
+
+    # ---- producer side ----
+
+    def submit(self, msg) -> None:
+        if self._closed.is_set():
+            raise RuntimeError("work queue closed")
+        self._q.put(_Envelope(msg))
+
+    # ---- consumer side ----
+
+    def start(self) -> None:
+        """Spawn the drainer (reference SyncLoop, workQueue.go:20-54)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, name="workqueue-sync", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                env = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            # Retry inline, blocking the drainer: later writes to the same key
+            # must not overtake a failed earlier one, and join()/close() must
+            # see in-flight retries as unfinished work.
+            try:
+                while True:
+                    try:
+                        self._dispatch(env.msg)
+                        break
+                    except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
+                        env.attempts += 1
+                        if env.attempts > self._max_retries:
+                            log.error("workqueue: dropping %r after %d attempts: %s",
+                                      env.msg, env.attempts, e)
+                            self.dropped.append(env.msg)
+                            break
+                        delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
+                        log.warning("workqueue: retry %d for %r in %.2fs: %s",
+                                    env.attempts, env.msg, delay, e)
+                        time.sleep(delay)
+            finally:
+                self._q.task_done()
+
+    def _dispatch(self, msg) -> None:
+        if isinstance(msg, PutKeyValue):
+            self._client.put(msg.resource, msg.name, msg.value)
+        elif isinstance(msg, DelKey):
+            self._client.delete(msg.resource, msg.name)
+        elif isinstance(msg, Call):
+            msg.fn()
+        else:
+            raise TypeError(f"unknown workqueue message {type(msg)!r}")
+
+    # ---- lifecycle ----
+
+    def join(self, timeout: float = 5.0) -> bool:
+        """Block until all currently-queued work is applied."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self, timeout: float = 5.0) -> None:
+        self.join(timeout)
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

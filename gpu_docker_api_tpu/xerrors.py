@@ -1,0 +1,103 @@
+"""Sentinel error hierarchy.
+
+Reference parity: internal/xerrors/*.go defines sentinel errors matched by
+string comparison of errors.Cause(err).Error() (e.g. xerrors/scheduler.go:13-19).
+We use a real exception hierarchy instead — matching is isinstance(), and every
+class still carries a stable sentinel message for wire-level parity.
+"""
+
+from __future__ import annotations
+
+
+class XError(Exception):
+    """Base class for all tpu-docker-api sentinel errors."""
+
+    sentinel = "tpu-docker-api error"
+
+    def __init__(self, detail: str = ""):
+        self.detail = detail
+        super().__init__(f"{self.sentinel}: {detail}" if detail else self.sentinel)
+
+
+# --- scheduler errors (reference internal/xerrors/scheduler.go) ---
+
+class TpuNotEnoughError(XError):
+    sentinel = "tpu not enough"
+
+
+class CpuNotEnoughError(XError):
+    sentinel = "cpu not enough"
+
+
+class PortNotEnoughError(XError):
+    sentinel = "port not enough"
+
+
+# --- container errors (reference internal/xerrors/container.go) ---
+
+class ContainerExistedError(XError):
+    sentinel = "container already existed"
+
+
+class NoPatchRequiredError(XError):
+    sentinel = "no patch required"
+
+
+class NoRollbackRequiredError(XError):
+    sentinel = "no rollback required"
+
+
+# --- volume errors (reference internal/xerrors/volume.go) ---
+
+class VolumeExistedError(XError):
+    sentinel = "volume already existed"
+
+
+class VolumeSizeUsedGreaterThanReducedError(XError):
+    sentinel = "volume used size greater than reduced size"
+
+
+# --- state-store errors (reference internal/xerrors/etcd.go) ---
+
+class NotExistInStoreError(XError):
+    sentinel = "not exist in store"
+
+
+class VersionNotFoundError(XError):
+    sentinel = "version not found"
+
+
+def is_tpu_not_enough(err: BaseException) -> bool:
+    return isinstance(err, TpuNotEnoughError)
+
+
+def is_cpu_not_enough(err: BaseException) -> bool:
+    return isinstance(err, CpuNotEnoughError)
+
+
+def is_port_not_enough(err: BaseException) -> bool:
+    return isinstance(err, PortNotEnoughError)
+
+
+def is_container_existed(err: BaseException) -> bool:
+    return isinstance(err, ContainerExistedError)
+
+
+def is_no_patch_required(err: BaseException) -> bool:
+    return isinstance(err, NoPatchRequiredError)
+
+
+def is_no_rollback_required(err: BaseException) -> bool:
+    return isinstance(err, NoRollbackRequiredError)
+
+
+def is_volume_existed(err: BaseException) -> bool:
+    return isinstance(err, VolumeExistedError)
+
+
+def is_volume_shrink_error(err: BaseException) -> bool:
+    return isinstance(err, VolumeSizeUsedGreaterThanReducedError)
+
+
+def is_not_exist_in_store(err: BaseException) -> bool:
+    return isinstance(err, NotExistInStoreError)

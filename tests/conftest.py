@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported anywhere,
+so sharding/mesh tests exercise real multi-device code paths without TPU
+hardware (the driver separately dry-runs the multichip path the same way).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def store(tmp_path):
+    from gpu_docker_api_tpu.store import MVCCStore
+    s = MVCCStore(wal_path=str(tmp_path / "wal.jsonl"))
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def client(store):
+    from gpu_docker_api_tpu.store import StateClient
+    return StateClient(store)

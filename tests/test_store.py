@@ -1,0 +1,194 @@
+"""MVCC store + state client semantics (reference: internal/etcd/)."""
+
+import threading
+
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+
+
+def test_put_get_revisions(store):
+    r1 = store.put("a", "1")
+    r2 = store.put("a", "2")
+    r3 = store.put("b", "x")
+    assert (r1, r2, r3) == (1, 2, 3)
+    kv = store.get("a")
+    assert kv.value == "2"
+    assert kv.create_revision == 1
+    assert kv.mod_revision == 2
+    assert kv.version == 2
+    assert store.get("b").version == 1
+    assert store.get("missing") is None
+
+
+def test_delete_resets_version(store):
+    store.put("k", "v1")
+    store.put("k", "v2")
+    assert store.delete("k")
+    assert store.get("k") is None
+    assert not store.delete("k")  # already gone
+    store.put("k", "v3")
+    kv = store.get("k")
+    assert kv.version == 1  # etcd semantics: recreation restarts version
+    assert kv.create_revision == kv.mod_revision
+
+
+def test_get_at_revision(store):
+    store.put("k", "v1")  # rev 1
+    store.put("x", "q")   # rev 2
+    store.put("k", "v2")  # rev 3
+    store.delete("k")     # rev 4
+    store.put("k", "v3")  # rev 5
+    assert store.get_at_revision("k", 1).value == "v1"
+    assert store.get_at_revision("k", 2).value == "v1"
+    assert store.get_at_revision("k", 3).value == "v2"
+    assert store.get_at_revision("k", 4) is None  # tombstoned at rev 4
+    assert store.get_at_revision("k", 5).value == "v3"
+
+
+def test_history_current_lifetime(store):
+    store.put("k", "old1")
+    store.delete("k")
+    store.put("k", "a")
+    store.put("k", "b")
+    hist = store.history("k")
+    assert [kv.value for kv in hist] == ["a", "b"]
+    assert [kv.version for kv in hist] == [1, 2]
+    full = store.history("k", since_create=False)
+    assert [kv.value for kv in full] == ["old1", "a", "b"]
+
+
+def test_range_sorted(store):
+    store.put("/p/b", "2")
+    store.put("/p/a", "1")
+    store.put("/q/c", "3")
+    store.delete("/p/b")
+    kvs = store.range("/p/")
+    assert [(kv.key, kv.value) for kv in kvs] == [("/p/a", "1")]
+
+
+def test_wal_persistence_roundtrip(tmp_path):
+    wal = str(tmp_path / "w.jsonl")
+    s = MVCCStore(wal_path=wal)
+    s.put("k", "v1")
+    s.put("k", "v2")
+    s.delete("k")
+    s.put("k", "v3")
+    rev = s.revision
+    s.close()
+
+    s2 = MVCCStore(wal_path=wal)
+    assert s2.revision == rev
+    kv = s2.get("k")
+    assert kv.value == "v3" and kv.version == 1
+    # continues the revision counter
+    assert s2.put("k", "v4") == rev + 1
+    s2.close()
+
+
+def test_compaction_preserves_kept_prefixes(store):
+    for i in range(5):
+        store.put("/hist/a", f"h{i}")
+        store.put("/scratch/b", f"s{i}")
+    dropped = store.compact(store.revision, keep_history_prefixes=("/hist/",))
+    assert dropped == 4  # scratch history gone, latest kept
+    assert len(store.history("/hist/a")) == 5
+    assert store.get("/scratch/b").value == "s4"
+    with pytest.raises(ValueError):
+        store.get_at_revision("/scratch/b", 1)
+
+
+def test_snapshot_replayable(tmp_path, store):
+    store.put("a", "1")
+    store.put("a", "2")
+    store.put("b", "x")
+    snap = str(tmp_path / "snap.jsonl")
+    store.snapshot(snap)
+    s2 = MVCCStore(wal_path=snap)
+    assert s2.get("a").value == "2"
+    assert [kv.value for kv in s2.history("a")] == ["1", "2"]
+    s2.close()
+
+
+def test_concurrent_puts_unique_revisions(store):
+    revs = []
+    lock = threading.Lock()
+
+    def worker(i):
+        for j in range(50):
+            r = store.put(f"k{i}", str(j))
+            with lock:
+                revs.append(r)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(revs) == len(set(revs)) == 400
+
+
+# ---- client layer ----
+
+def test_client_basic_and_missing(client):
+    client.put("containers", "foo", "{}")
+    assert client.get_value("containers", "foo") == "{}"
+    with pytest.raises(xerrors.NotExistInStoreError):
+        client.get_value("containers", "nope")
+
+
+def test_client_revision_range_newest_first(client):
+    client.put("containers", "foo", "v1")
+    client.put("containers", "foo", "v2")
+    client.put("containers", "foo", "v3")
+    combos = client.get_revision_range("containers", "foo")
+    assert [c.value for c in combos] == ["v3", "v2", "v1"]
+    assert [c.version for c in combos] == [3, 2, 1]
+    assert client.get_revision("containers", "foo", 2).value == "v2"
+    with pytest.raises(xerrors.VersionNotFoundError):
+        client.get_revision("containers", "foo", 9)
+
+
+def test_entity_version_keys(client):
+    for v in (1, 2, 3):
+        client.put_entity_version("containers", "rs", v, f"cfg{v}")
+    assert client.get_entity_version("containers", "rs", 2) == "cfg2"
+    assert client.entity_versions("containers", "rs") == [(1, "cfg1"), (2, "cfg2"), (3, "cfg3")]
+    assert client.delete_entity_versions("containers", "rs") == 3
+    assert client.entity_versions("containers", "rs") == []
+
+
+def test_compaction_keeps_floor_revision(store):
+    # key k at revs 1, 3, 5 with another key advancing the counter between
+    store.put("k", "a")   # rev 1
+    store.put("x", "_")   # rev 2
+    store.put("k", "b")   # rev 3
+    store.put("x", "_")   # rev 4
+    store.put("k", "c")   # rev 5
+    store.compact(4)
+    # rev 4 is not compacted away: k's floor (rev-3 value) must survive
+    assert store.get_at_revision("k", 4).value == "b"
+    assert store.get_at_revision("k", 5).value == "c"
+
+
+def test_compaction_reclaims_tombstoned_keys(store):
+    store.put("dead", "v")
+    store.delete("dead")
+    store.put("alive", "v")
+    store.compact(store.revision)
+    assert "dead" not in list(store.keys())
+    assert store.get("dead") is None
+    assert store.get("alive").value == "v"
+
+
+def test_snapshot_preserves_revision_counter(tmp_path, store):
+    store.put("a", "1")   # rev 1
+    store.put("b", "2")   # rev 2
+    store.delete("b")     # rev 3 — omitted from snapshot
+    snap = str(tmp_path / "s.jsonl")
+    store.snapshot(snap)
+    s2 = MVCCStore(wal_path=snap)
+    assert s2.revision == 3
+    assert s2.put("c", "x") == 4  # never re-mints issued revisions
+    s2.close()

@@ -1,0 +1,67 @@
+import os
+
+import pytest
+
+from gpu_docker_api_tpu.utils.file import (
+    copy_dir, dir_size, from_bytes, move_dir_contents, to_bytes, valid_size_unit,
+)
+
+
+def test_to_bytes():
+    assert to_bytes("1KB") == 1024
+    assert to_bytes("30GB") == 30 * 1024 ** 3
+    assert to_bytes("2TB") == 2 * 1024 ** 4
+    assert to_bytes("1.5MB") == int(1.5 * 1024 ** 2)
+    assert to_bytes(" 10mb ") == 10 * 1024 ** 2
+
+
+def test_to_bytes_rejects_garbage():
+    # the reference's ToBytes silently returns 0 here (utils/file.go:23-46)
+    for bad in ("10XB", "GB", "", "10", "xGB"):
+        with pytest.raises(ValueError):
+            to_bytes(bad)
+
+
+def test_from_bytes_roundtrip():
+    # regression for reference bug 2 (SURVEY): rollback labelled MB counts as GB
+    for s in ("1KB", "512MB", "30GB", "2TB"):
+        assert to_bytes(from_bytes(to_bytes(s))) == to_bytes(s)
+    assert from_bytes(30 * 1024 ** 3) == "30GB"
+
+
+def test_valid_size_unit():
+    assert valid_size_unit("20GB")
+    assert valid_size_unit("1.5tb")
+    assert not valid_size_unit("20G")
+    assert not valid_size_unit("GB")
+
+
+def test_dir_size_and_copy(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.bin").write_bytes(b"x" * 1000)
+    (src / "sub" / "b.bin").write_bytes(b"y" * 500)
+    os.symlink("a.bin", src / "link")
+    assert dir_size(str(src)) == 1500
+
+    dest = tmp_path / "dest"
+    copy_dir(str(src), str(dest))
+    assert (dest / "a.bin").read_bytes() == b"x" * 1000
+    assert (dest / "sub" / "b.bin").read_bytes() == b"y" * 500
+    assert os.path.islink(dest / "link")
+
+
+def test_move_dir_contents(tmp_path):
+    src = tmp_path / "old"
+    src.mkdir()
+    (src / "data.txt").write_text("hello")
+    dest = tmp_path / "new"
+    move_dir_contents(str(src), str(dest))
+    assert (dest / "data.txt").read_text() == "hello"
+    assert not any(src.iterdir())
+
+
+def test_from_bytes_exact_roundtrip_odd_sizes():
+    # non-unit-aligned byte counts must still round-trip exactly
+    for n in (1535450955, 1023, 1025, 7 * 1024 ** 3 + 13):
+        assert to_bytes(from_bytes(n)) == n

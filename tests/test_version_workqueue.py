@@ -1,0 +1,159 @@
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import Call, DelKey, PutKeyValue, WorkQueue
+
+
+def test_version_map_bump_and_persist(client):
+    vm = VersionMap("containerVersionMap", client)
+    assert vm.get("foo") is None
+    assert vm.bump("foo") == 1
+    assert vm.bump("foo") == 2
+    assert vm.bump("bar") == 1
+    vm.rollback_bump("foo", 1)
+    assert vm.get("foo") == 1
+    vm.rollback_bump("bar", 0)
+    assert not vm.exist("bar")
+    # reload from store sees the same state
+    vm2 = VersionMap("containerVersionMap", client)
+    assert vm2.items() == {"foo": 1}
+
+
+def test_version_map_concurrent_bumps(client):
+    vm = VersionMap("containerVersionMap", client)
+    out = []
+    lock = threading.Lock()
+
+    def w():
+        for _ in range(100):
+            v = vm.bump("rs")
+            with lock:
+                out.append(v)
+
+    ts = [threading.Thread(target=w) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(out) == list(range(1, 801))  # no duplicate versions minted
+
+
+def test_merge_map(client):
+    mm = MergeMap(client)
+    mm.set("rs-1", "/merges/rs/rs-1")
+    mm.set("rs-2", "/merges/rs/rs-2")
+    mm.set("other-1", "/merges/other/other-1")
+    gone = mm.remove_replicaset("rs")
+    assert sorted(gone) == ["/merges/rs/rs-1", "/merges/rs/rs-2"]
+    assert mm.items() == {"other-1": "/merges/other/other-1"}
+    mm2 = MergeMap(client)
+    assert mm2.items() == {"other-1": "/merges/other/other-1"}
+
+
+def test_workqueue_applies_in_order(client):
+    wq = WorkQueue(client)
+    wq.start()
+    for i in range(20):
+        wq.submit(PutKeyValue("containers", "k", f"v{i}"))
+    assert wq.join()
+    assert client.get_value("containers", "k") == "v19"
+    wq.submit(DelKey("containers", "k"))
+    assert wq.join()
+    assert client.get("containers", "k") is None
+    wq.close()
+
+
+def test_workqueue_retries_then_succeeds(client):
+    fails = {"n": 3}
+
+    def flaky():
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient")
+        client.put("containers", "done", "yes")
+
+    wq = WorkQueue(client, base_backoff=0.01)
+    wq.start()
+    wq.submit(Call(flaky))
+    deadline = 100
+    while client.get("containers", "done") is None and deadline:
+        time.sleep(0.05)
+        deadline -= 1
+    assert client.get_value("containers", "done") == "yes"
+    wq.close()
+
+
+def test_workqueue_drops_after_max_retries(client):
+    def always_fails():
+        raise OSError("permanent")
+
+    wq = WorkQueue(client, max_retries=2, base_backoff=0.001)
+    wq.start()
+    wq.submit(Call(always_fails, "doomed"))
+    deadline = 100
+    while not wq.dropped and deadline:
+        time.sleep(0.05)
+        deadline -= 1
+    assert len(wq.dropped) == 1
+    wq.close()
+
+
+def test_workqueue_rejects_after_close(client):
+    wq = WorkQueue(client)
+    wq.start()
+    wq.close()
+    with pytest.raises(RuntimeError):
+        wq.submit(PutKeyValue("a", "b", "c"))
+
+
+def test_version_map_via_workqueue(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    vm = VersionMap("volumeVersionMap", client, wq)
+    vm.bump("vol")
+    vm.bump("vol")
+    assert wq.join()
+    vm2 = VersionMap("volumeVersionMap", client)
+    assert vm2.get("vol") == 2
+    wq.close()
+
+
+def test_workqueue_retry_preserves_key_order(client):
+    """A transiently-failing write must not be overtaken by a later write."""
+    fails = {"n": 2}
+    applied = []
+
+    def first():
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient")
+        applied.append("old")
+        client.put("containers", "ordered", "old")
+
+    def second():
+        applied.append("new")
+        client.put("containers", "ordered", "new")
+
+    wq = WorkQueue(client, base_backoff=0.01)
+    wq.start()
+    wq.submit(Call(first))
+    wq.submit(Call(second))
+    assert wq.join(10)
+    assert applied == ["old", "new"]
+    assert client.get_value("containers", "ordered") == "new"
+    wq.close()
+
+
+def test_merge_map_prefix_no_cross_replicaset(client):
+    mm = MergeMap(client)
+    mm.set("app-1", "/m/app/app-1")
+    mm.set("app-1-1", "/m/app-1/app-1-1")  # replicaSet literally named "app-1"
+    gone = mm.remove_replicaset("app")
+    assert gone == ["/m/app/app-1"]
+    assert "app-1-1" in mm.items()
