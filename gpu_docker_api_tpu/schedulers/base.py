@@ -1,0 +1,98 @@
+"""Scheduler contract.
+
+The reference documents this shape but never actually uses it as an
+interface — its Apply return types diverge (internal/schedulers/
+scheduler.go:3-9, SURVEY §2). Here it is a real ABC: every scheduler
+serializes itself, persists asynchronously under ITS OWN store key (the
+reference's port scheduler accidentally persisted the GPU map under the gpus
+key — portscheduler.go:163-169, SURVEY §2 bug 1), and restores from the
+store at boot.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import threading
+from typing import Optional
+
+from ..store.client import StateClient
+from ..workqueue import PutKeyValue, WorkQueue
+
+FREE, USED = 0, 1
+
+
+def merge_stored_status(stored: Optional[dict], fresh: dict[int, int]) -> dict[int, int]:
+    """Overlay a stored {index: state} map onto a freshly-probed one, keeping
+    only indices that still exist on this host (shared by the TPU and CPU
+    scheduler boot paths)."""
+    if stored:
+        for k, v in stored.items():
+            ik = int(k)
+            if ik in fresh:
+                fresh[ik] = v
+    return fresh
+
+
+class Scheduler(abc.ABC):
+    """Common machinery: lock, store-backed boot, async persist."""
+
+    #: resource segment in the store key space — unique per scheduler
+    resource: str = ""
+    #: key under that segment holding the serialized state
+    state_key: str = ""
+
+    def __init__(self, client: Optional[StateClient] = None,
+                 wq: Optional[WorkQueue] = None):
+        self._client = client
+        self._wq = wq
+        self._lock = threading.RLock()
+
+    # ---- persistence ----
+
+    def _load_state(self) -> Optional[dict]:
+        if self._client is None:
+            return None
+        kv = self._client.get(self.resource, self.state_key)
+        if kv is None:
+            return None
+        try:
+            return json.loads(kv.value)
+        except json.JSONDecodeError:
+            return None
+
+    def _persist(self) -> None:
+        """Queue a write of the current serialized state. Called with the
+        scheduler lock held so snapshot order == persist order."""
+        if self._client is None:
+            return
+        payload = json.dumps(self.serialize(), sort_keys=True)
+        if self._wq is not None:
+            self._wq.submit(PutKeyValue(self.resource, self.state_key, payload))
+        else:
+            self._client.put(self.resource, self.state_key, payload)
+
+    def flush(self) -> None:
+        """Synchronous persist for graceful shutdown (reference Stop flush,
+        cmd/gpu-docker-api/main.go:139-154). The put happens under the lock —
+        releasing first would let a concurrent mutation's persist be
+        overwritten by this (then-stale) snapshot."""
+        if self._client is None:
+            return
+        with self._lock:
+            self._client.put(self.resource, self.state_key,
+                             json.dumps(self.serialize(), sort_keys=True))
+
+    # ---- contract ----
+
+    @abc.abstractmethod
+    def serialize(self) -> dict:
+        """JSON-able state snapshot."""
+
+    @abc.abstractmethod
+    def apply(self, n: int):
+        """Grant n resources; raises *NotEnoughError on shortage."""
+
+    @abc.abstractmethod
+    def restore(self, grant) -> None:
+        """Return a grant to the pool."""

@@ -1,0 +1,87 @@
+"""CPU core scheduler.
+
+Reference parity: internal/schedulers/cpuscheduler.go — logical core count
+from /proc/cpuinfo (:18, :169-186), Apply returns a sorted comma cpuset
+string for HostConfig.CpusetCpus (:77-116). Fixes SURVEY §2 bug 4: restore
+of an empty cpuset is a no-op instead of polluting the map with "".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Union
+
+from .. import xerrors
+from ..store.client import StateClient
+from ..workqueue import WorkQueue
+from .base import FREE, USED, Scheduler, merge_stored_status
+
+
+def _probe_core_count() -> int:
+    try:
+        with open("/proc/cpuinfo", "r", encoding="utf-8") as f:
+            n = sum(1 for line in f if line.startswith("processor"))
+        if n:
+            return n
+    except OSError:
+        pass
+    return os.cpu_count() or 1
+
+
+class CpuScheduler(Scheduler):
+    resource = "cpus"
+    state_key = "cpuStatusMap"
+
+    def __init__(self, client: Optional[StateClient] = None,
+                 wq: Optional[WorkQueue] = None,
+                 core_count: Optional[int] = None):
+        super().__init__(client, wq)
+        state = self._load_state()
+        if state is not None and core_count is None:
+            self.status = {int(k): v for k, v in state.items()}
+        else:
+            n = core_count if core_count is not None else _probe_core_count()
+            self.status = merge_stored_status(state, {i: FREE for i in range(n)})
+        with self._lock:
+            self._persist()
+
+    def apply(self, n: int) -> str:
+        """Grant n cores; returns a cpuset string "0,1,5" (sorted)."""
+        if n <= 0:
+            return ""
+        with self._lock:
+            free = sorted(i for i, s in self.status.items() if s == FREE)
+            if len(free) < n:
+                raise xerrors.CpuNotEnoughError(
+                    f"want {n}, only {len(free)} of {len(self.status)} free")
+            grant = free[:n]
+            for i in grant:
+                self.status[i] = USED
+            self._persist()
+            return ",".join(str(i) for i in grant)
+
+    def restore(self, grant: Union[str, list[int], None]) -> None:
+        """Free a cpuset string or core list. Empty/None is a no-op
+        (reference splits "" into [""] and corrupts the map —
+        cpuscheduler.go:132-138 via replicaset.go:145)."""
+        if not grant:
+            return
+        cores = ([int(x) for x in grant.split(",") if x.strip() != ""]
+                 if isinstance(grant, str) else list(grant))
+        with self._lock:
+            for i in cores:
+                if i in self.status:
+                    self.status[i] = FREE
+            self._persist()
+
+    def get_status(self) -> dict:
+        with self._lock:
+            used = sorted(i for i, s in self.status.items() if s == USED)
+            return {
+                "totalCount": len(self.status),
+                "usedCount": len(used),
+                "usedCores": used,
+            }
+
+    def serialize(self) -> dict:
+        return {str(k): v for k, v in self.status.items()}

@@ -1,0 +1,199 @@
+"""ICI-topology-aware TPU chip allocator.
+
+Replaces the reference GPU scheduler (internal/schedulers/gpuscheduler.go):
+same Apply/Restore/GetStatus/persist surface, but where the reference grants
+the first N free UUIDs in arbitrary Go map order (:85-113), this allocator
+grants *contiguous sub-meshes* of the slice's ICI topology:
+
+1. exact axis-aligned box of N chips when one is free (best ICI bisection
+   bandwidth for the workload's collectives), choosing among free boxes the
+   most "packed" placement (max contact with used/boundary chips) to fight
+   fragmentation;
+2. else a connected free set of N chips (BFS over ICI links) minimizing
+   bounding-box volume;
+3. else — only when allow_fragmented — any N free chips, like the reference.
+
+A C++ core (native/topology_alloc.cc) accelerates the box search for large
+slices; this Python implementation is the always-available fallback and the
+semantics reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import xerrors
+from ..store.client import StateClient
+from ..topology import TpuTopology, discover_topology
+from ..workqueue import WorkQueue
+from .base import FREE, USED, Scheduler, merge_stored_status
+
+
+class TpuScheduler(Scheduler):
+    resource = "tpus"
+    state_key = "tpuStatusMap"
+
+    def __init__(self, client: Optional[StateClient] = None,
+                 wq: Optional[WorkQueue] = None,
+                 topology: Optional[TpuTopology] = None,
+                 allow_fragmented: bool = True):
+        super().__init__(client, wq)
+        self.allow_fragmented = allow_fragmented
+        state = self._load_state()
+        if state is not None and topology is None:
+            self.topology = TpuTopology(
+                accelerator_type=state["topology"]["acceleratorType"],
+                generation=state["topology"]["generation"],
+                shape=tuple(state["topology"]["shape"]),  # type: ignore[arg-type]
+                wraparound=state["topology"].get("wraparound", False),
+                worker_id=state["topology"].get("workerId", 0),
+                num_workers=state["topology"].get("numWorkers", 1),
+            )
+            self.status = {int(k): v for k, v in state["status"].items()}
+        else:
+            self.topology = topology or discover_topology()
+            # explicit topology overrides the stored one; stored chip states
+            # carry over where indices still exist
+            self.status = merge_stored_status(
+                state["status"] if state is not None else None,
+                {c.index: FREE for c in self.topology.chips})
+        with self._lock:
+            self._persist()
+
+    # ---- allocation ----
+
+    def apply(self, n: int) -> list[int]:
+        """Grant n chips as an ICI-contiguous set; returns chip indices."""
+        if n <= 0:
+            return []
+        with self._lock:
+            free = [i for i, s in self.status.items() if s == FREE]
+            if len(free) < n:
+                raise xerrors.TpuNotEnoughError(
+                    f"want {n}, only {len(free)} of {len(self.status)} free")
+            grant = self._find_box(n, set(free))
+            if grant is None:
+                grant = self._find_connected(n, set(free))
+            if grant is None:
+                if not self.allow_fragmented:
+                    raise xerrors.TpuNotEnoughError(
+                        f"no ICI-contiguous placement for {n} chips")
+                grant = sorted(free)[:n]
+            for i in grant:
+                self.status[i] = USED
+            self._persist()
+            return sorted(grant)
+
+    def restore(self, grant: list[int]) -> None:
+        """Free a grant. Unknown/already-free chips are ignored (idempotent —
+        the reference double-frees on its Stop error path, SURVEY §2 bug 3;
+        idempotent restore makes that class of bug harmless)."""
+        if not grant:
+            return
+        with self._lock:
+            for i in grant:
+                if i in self.status:
+                    self.status[i] = FREE
+            self._persist()
+
+    # ---- placement search ----
+
+    def _find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
+        """Best free axis-aligned box of volume n: compact dims first, then
+        the most packed placement (fewest free ICI neighbors outside the box
+        — keeps the remaining free space contiguous)."""
+        best: Optional[list[int]] = None
+        best_key: Optional[tuple] = None
+        topo = self.topology
+        for origin, dims in topo.sub_boxes(n):
+            idx = topo.box_indices(origin, dims)
+            if not all(i in free for i in idx):
+                continue
+            box = set(idx)
+            # exterior free links = fragmentation damage; fewer is better
+            ext_free = 0
+            for i in idx:
+                for nb in topo.neighbors(topo.chip(i)):
+                    if nb.index not in box and nb.index in free:
+                        ext_free += 1
+            sa = dims[0] * dims[1] + dims[1] * dims[2] + dims[0] * dims[2]
+            key = (sa, ext_free, origin[2], origin[1], origin[0])
+            if best_key is None or key < best_key:
+                best_key = key
+                best = idx
+        return best
+
+    def _find_connected(self, n: int, free: set[int]) -> Optional[list[int]]:
+        """Connected free set of n chips via greedy BFS from each free seed,
+        preferring tight bounding boxes."""
+        topo = self.topology
+        best: Optional[list[int]] = None
+        best_vol: Optional[int] = None
+        for seed in sorted(free):
+            picked = [seed]
+            frontier = [nb.index for nb in topo.neighbors(topo.chip(seed))
+                        if nb.index in free]
+            seen = {seed}
+            while len(picked) < n and frontier:
+                # pick the frontier chip keeping the bounding box tightest
+                def vol_with(i: int) -> int:
+                    coords = [topo.chip(p).coord for p in picked] + [topo.chip(i).coord]
+                    return _bbox_volume(coords)
+                frontier.sort(key=vol_with)
+                nxt = frontier.pop(0)
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                picked.append(nxt)
+                for nb in topo.neighbors(topo.chip(nxt)):
+                    if nb.index in free and nb.index not in seen:
+                        frontier.append(nb.index)
+            if len(picked) == n:
+                vol = _bbox_volume([topo.chip(p).coord for p in picked])
+                if best_vol is None or vol < best_vol:
+                    best_vol = vol
+                    best = picked
+                if best_vol == n:  # can't do better than a perfect box
+                    break
+        return best
+
+    # ---- status / env ----
+
+    def get_status(self) -> dict:
+        """Copy of chip status + topology, for GET /resources/tpus
+        (reference GetGpuStatus, gpuscheduler.go:147-157)."""
+        with self._lock:
+            chips = [{
+                "index": c.index,
+                "id": c.id,
+                "device": c.device_path,
+                "coord": list(c.coord),
+                "used": self.status[c.index] == USED,
+            } for c in self.topology.chips]
+            return {
+                "topology": self.topology.serialize(),
+                "chips": chips,
+                "freeCount": sum(1 for s in self.status.values() if s == FREE),
+            }
+
+    def env_for(self, grant: list[int]) -> dict[str, str]:
+        """TPU env plumbing for a grant (SURVEY §5.7)."""
+        return self.topology.visible_chips_env(grant)
+
+    def device_paths(self, grant: list[int]) -> list[str]:
+        return [self.topology.chip(i).device_path for i in grant]
+
+    def serialize(self) -> dict:
+        return {
+            "topology": self.topology.serialize(),
+            "status": {str(k): v for k, v in self.status.items()},
+        }
+
+
+def _bbox_volume(coords: list[tuple[int, int, int]]) -> int:
+    vol = 1
+    for a in range(3):
+        vals = [c[a] for c in coords]
+        vol *= max(vals) - min(vals) + 1
+    return vol

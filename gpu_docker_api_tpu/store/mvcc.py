@@ -172,32 +172,45 @@ class MVCCStore:
         (this is how container/volume version history survives compaction —
         the reference has no answer to this, SURVEY §2 bug 5). Returns the
         number of revision entries dropped."""
-        dropped = 0
         with self._lock:
-            for key in list(self._log):
-                revs = self._log[key]
-                if any(key.startswith(p) for p in keep_history_prefixes):
-                    continue
-                # etcd semantics: keep every revision > R, plus the newest
-                # revision <= R (the "floor" — the key's state as of R), so
-                # get_at_revision stays correct for all uncompacted revisions.
-                floor = None
-                for r in revs:
-                    if r.mod_revision <= revision:
-                        floor = r
-                    else:
-                        break
-                keep = [r for r in revs if r.mod_revision > revision]
-                if floor is not None and not floor.tombstone:
-                    keep.insert(0, floor)
-                dropped += len(revs) - len(keep)
-                if keep:
-                    self._log[key] = keep
-                else:
-                    # fully-compacted tombstoned key: reclaim it entirely
-                    del self._log[key]
-            self._compacted = max(self._compacted, revision)
+            dropped = self._compact_locked(revision, keep_history_prefixes)
+            # durable: replay must re-apply the compaction, or a restart
+            # would resurrect compacted revisions and reset _compacted
+            self._wal_append({"op": "compact", "r": revision,
+                              "keep": list(keep_history_prefixes)})
         return dropped
+
+    def _compact_locked(self, revision: int,
+                        keep_history_prefixes: tuple[str, ...]) -> int:
+        dropped = 0
+        for key in list(self._log):
+            revs = self._log[key]
+            if any(key.startswith(p) for p in keep_history_prefixes):
+                continue
+            # etcd semantics: keep every revision > R, plus the newest
+            # revision <= R (the "floor" — the key's state as of R), so
+            # get_at_revision stays correct for all uncompacted revisions.
+            floor = None
+            for r in revs:
+                if r.mod_revision <= revision:
+                    floor = r
+                else:
+                    break
+            keep = [r for r in revs if r.mod_revision > revision]
+            if floor is not None and not floor.tombstone:
+                keep.insert(0, floor)
+            dropped += len(revs) - len(keep)
+            if keep:
+                self._log[key] = keep
+            else:
+                # fully-compacted tombstoned key: reclaim it entirely
+                del self._log[key]
+        self._compacted = max(self._compacted, revision)
+        return dropped
+
+    def _replaying_compact(self, revision: int,
+                           keep_history_prefixes: tuple[str, ...]) -> None:
+        self._compact_locked(revision, keep_history_prefixes)
 
     # ---- persistence ----
 
@@ -224,6 +237,8 @@ class MVCCStore:
                     self._apply_put(rec["k"], rec["v"], rev)
                 elif rec["op"] == "del":
                     self._apply_delete(rec["k"], rev)
+                elif rec["op"] == "compact":
+                    self._replaying_compact(rev, tuple(rec.get("keep", ())))
                 # op == "rev": counter checkpoint only, handled above
 
     def snapshot(self, path: str) -> None:

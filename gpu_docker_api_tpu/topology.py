@@ -1,0 +1,269 @@
+"""TPU slice topology model: chips, ICI adjacency, sub-mesh geometry.
+
+The reference has no topology concept at all — its GPU scheduler grants the
+first N free UUIDs in Go map iteration order (internal/schedulers/
+gpuscheduler.go:85-113), which is fine for PCIe GPUs but wrong for TPUs:
+chips are wired into an ICI mesh/torus, and a JAX workload granted N chips
+only gets full-bandwidth collectives if those chips form a contiguous
+sub-mesh. This module gives the allocator the geometry to reason about.
+
+Supported generations model real Cloud TPU shapes: v4/v5p are 3D tori (4
+chips per host, slices in 4-chip increments), v5e/v6e are 2D meshes (up to
+8 chips per host). Single-host slices (the parity target — the reference is
+single-node) are modeled exactly; the topology also carries host/worker
+identity so a later multi-host mode can place one container per TPU VM
+worker (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+Coord = tuple[int, int, int]
+
+
+@dataclass(frozen=True)
+class Chip:
+    """One TPU chip: its accelerator device node and mesh coordinate."""
+    index: int                  # local chip index == /dev/accel{index}
+    coord: Coord                # (x, y, z) in the slice mesh
+    device_path: str            # e.g. /dev/accel0
+
+    @property
+    def id(self) -> str:
+        return f"tpu-{self.index}"
+
+
+# generation -> (mesh is a torus per axis when the axis is "wrapped")
+_GEN_3D = {"v4", "v5p"}
+_GEN_2D = {"v2", "v3", "v5e", "v5litepod", "v6e"}
+
+# accelerator-type name -> mesh shape, e.g. v5p-8 -> (2,2,1) chips (8 = cores)
+_KNOWN_SHAPES: dict[str, tuple[str, Coord]] = {
+    # name: (generation, chip mesh shape). vN-K names count cores for v2-v4/v5p
+    # (2 cores/chip) and chips for v5e/v6e.
+    "v2-8": ("v2", (2, 2, 1)),
+    "v3-8": ("v3", (2, 2, 1)),
+    "v4-8": ("v4", (2, 2, 1)),
+    "v4-16": ("v4", (2, 2, 2)),
+    "v4-32": ("v4", (2, 2, 4)),
+    "v5p-8": ("v5p", (2, 2, 1)),
+    "v5p-16": ("v5p", (2, 2, 2)),
+    "v5p-32": ("v5p", (2, 2, 4)),
+    "v5e-1": ("v5e", (1, 1, 1)),
+    "v5e-4": ("v5e", (2, 2, 1)),
+    "v5e-8": ("v5e", (2, 4, 1)),
+    "v6e-8": ("v6e", (2, 4, 1)),
+}
+
+
+@dataclass
+class TpuTopology:
+    """A (single- or multi-host) TPU slice as a 3D chip mesh."""
+
+    accelerator_type: str
+    generation: str
+    shape: Coord                       # chips per axis (x, y, z)
+    chips: list[Chip] = field(default_factory=list)
+    wraparound: bool = False           # torus links (true for full-cube v4/v5p pods)
+    chips_per_host: int = 4
+    worker_id: int = 0                 # TPU VM worker identity (multi-host)
+    num_workers: int = 1
+
+    def __post_init__(self) -> None:
+        if not self.chips:
+            self.chips = [
+                Chip(i, c, f"/dev/accel{i}")
+                for i, c in enumerate(self._iter_coords())
+            ]
+        self._by_coord = {c.coord: c for c in self.chips}
+        self._by_index = {c.index: c for c in self.chips}
+
+    def _iter_coords(self) -> Iterator[Coord]:
+        # x fastest: matches libtpu's row-major chip numbering on a host
+        sx, sy, sz = self.shape
+        for z in range(sz):
+            for y in range(sy):
+                for x in range(sx):
+                    yield (x, y, z)
+
+    # ---- lookups ----
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+    def chip(self, index: int) -> Chip:
+        return self._by_index[index]
+
+    def at(self, coord: Coord) -> Optional[Chip]:
+        return self._by_coord.get(coord)
+
+    def neighbors(self, chip: Chip) -> list[Chip]:
+        """ICI neighbors: ±1 along each axis, wrapping when the slice is a
+        torus on that axis (axis size > 2 required for a distinct wrap link)."""
+        out = []
+        for axis in range(3):
+            for d in (-1, 1):
+                cc = list(chip.coord)
+                cc[axis] += d
+                size = self.shape[axis]
+                if self.wraparound and size > 2:
+                    cc[axis] %= size
+                if 0 <= cc[axis] < size:
+                    n = self._by_coord.get((cc[0], cc[1], cc[2]))
+                    if n is not None and n.index != chip.index:
+                        out.append(n)
+        # dedupe (wrap on size-2 axes folds onto the same neighbor)
+        seen: set[int] = set()
+        uniq = []
+        for n in out:
+            if n.index not in seen:
+                seen.add(n.index)
+                uniq.append(n)
+        return uniq
+
+    def is_connected(self, indices: list[int]) -> bool:
+        """True when the chip set is ICI-connected (one component)."""
+        if not indices:
+            return True
+        want = set(indices)
+        stack = [indices[0]]
+        seen = {indices[0]}
+        while stack:
+            c = self.chip(stack.pop())
+            for n in self.neighbors(c):
+                if n.index in want and n.index not in seen:
+                    seen.add(n.index)
+                    stack.append(n.index)
+        return seen == want
+
+    def sub_boxes(self, volume: int) -> Iterator[tuple[Coord, Coord]]:
+        """All axis-aligned boxes (origin, dims) with exactly `volume` chips
+        that fit in the mesh. Yields larger-extent-last so callers preferring
+        compactness can take the first fits."""
+        sx, sy, sz = self.shape
+        dims: list[Coord] = []
+        for a in range(1, sx + 1):
+            if volume % a:
+                continue
+            for b in range(1, sy + 1):
+                if (volume // a) % b:
+                    continue
+                c = volume // a // b
+                if c <= sz:
+                    dims.append((a, b, c))
+        # prefer compact boxes: minimize surface area (max ICI bisection)
+        dims.sort(key=lambda d: (d[0] * d[1] + d[1] * d[2] + d[0] * d[2], d))
+        for (a, b, c) in dims:
+            for oz in range(sz - c + 1):
+                for oy in range(sy - b + 1):
+                    for ox in range(sx - a + 1):
+                        yield ((ox, oy, oz), (a, b, c))
+
+    def box_indices(self, origin: Coord, dims: Coord) -> list[int]:
+        ox, oy, oz = origin
+        a, b, c = dims
+        out = []
+        for z in range(oz, oz + c):
+            for y in range(oy, oy + b):
+                for x in range(ox, ox + a):
+                    out.append(self._by_coord[(x, y, z)].index)
+        return out
+
+    # ---- env plumbing for the scheduled workload ----
+
+    def visible_chips_env(self, indices: list[int]) -> dict[str, str]:
+        """Env a container/process needs so JAX sees exactly these chips as a
+        well-formed mesh: TPU_VISIBLE_CHIPS + per-process bounds (SURVEY §5.7).
+        """
+        idx = sorted(indices)
+        env = {
+            "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in idx),
+            "TPU_WORKER_ID": str(self.worker_id),
+            "TPU_WORKER_HOSTNAMES": "localhost",
+            "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+            "TPU_SKIP_MDS_QUERY": "true",
+        }
+        coords = [self.chip(i).coord for i in idx]
+        if coords:
+            mins = tuple(min(c[a] for c in coords) for a in range(3))
+            maxs = tuple(max(c[a] for c in coords) for a in range(3))
+            bounds = tuple(maxs[a] - mins[a] + 1 for a in range(3))
+            # Declare per-process bounds only when the grant exactly fills its
+            # bounding box — for L-shaped/fragmented grants a box declaration
+            # would claim chips the process can't see and libtpu mesh init
+            # would fail; with VISIBLE_CHIPS alone libtpu infers the layout.
+            if bounds[0] * bounds[1] * bounds[2] == len(idx):
+                env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{bounds[0]},{bounds[1]},{bounds[2]}"
+                env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        return env
+
+    def serialize(self) -> dict:
+        return {
+            "acceleratorType": self.accelerator_type,
+            "generation": self.generation,
+            "shape": list(self.shape),
+            "wraparound": self.wraparound,
+            "workerId": self.worker_id,
+            "numWorkers": self.num_workers,
+        }
+
+
+def make_topology(accelerator_type: str, worker_id: int = 0) -> TpuTopology:
+    """Build a topology for a known accelerator type, e.g. "v5p-8"."""
+    if accelerator_type in _KNOWN_SHAPES:
+        gen, shape = _KNOWN_SHAPES[accelerator_type]
+    else:
+        m = re.fullmatch(r"(v\d+[a-z]*)-(\d+)", accelerator_type)
+        if not m:
+            raise ValueError(f"unknown accelerator type {accelerator_type!r}")
+        gen, count = m.group(1), int(m.group(2))
+        chips = count // 2 if gen in _GEN_3D or gen in {"v2", "v3"} else count
+        chips = max(chips, 1)
+        # factor into the most cubic box available
+        shape = _most_cubic_shape(chips)
+    return TpuTopology(accelerator_type, gen, shape)
+
+
+def _most_cubic_shape(n: int) -> Coord:
+    best: Coord = (n, 1, 1)
+    best_sa = None
+    for a in range(1, n + 1):
+        if n % a:
+            continue
+        for b in range(1, n // a + 1):
+            if (n // a) % b:
+                continue
+            c = n // a // b
+            dims = tuple(sorted((a, b, c), reverse=True))
+            sa = dims[0] * dims[1] + dims[1] * dims[2] + dims[0] * dims[2]
+            if best_sa is None or sa < best_sa:
+                best_sa = sa
+                best = dims  # type: ignore[assignment]
+    return best  # type: ignore[return-value]
+
+
+def discover_topology(mock_accelerator_type: Optional[str] = None) -> TpuTopology:
+    """Probe the host for TPU chips.
+
+    Replaces the reference's `nvidia-smi --query-gpu=index,uuid` shell-out
+    (gpuscheduler.go:167-205): we read TPU_ACCELERATOR_TYPE (set on Cloud TPU
+    VMs / by the operator) and count /dev/accel* device nodes. With neither
+    present, falls back to the mock type (default v5p-8) so the control plane
+    runs on TPU-less machines — the reference's `-tags mock` trick as a
+    runtime decision.
+    """
+    acc_type = os.environ.get("TPU_ACCELERATOR_TYPE")
+    accel_nodes = sorted(glob.glob("/dev/accel[0-9]*"))
+    if acc_type:
+        topo = make_topology(acc_type)
+        return topo
+    if accel_nodes:
+        return make_topology(f"v5e-{len(accel_nodes)}") if len(accel_nodes) in (1, 4, 8) \
+            else TpuTopology("unknown", "v5e", _most_cubic_shape(len(accel_nodes)))
+    return make_topology(mock_accelerator_type or "v5p-8")

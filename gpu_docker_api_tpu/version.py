@@ -102,8 +102,8 @@ class VersionMap:
 
     def flush(self) -> None:
         with self._lock:
-            snapshot = dict(self._m)
-        self._client.put(_MAPS_RESOURCE, self._key, json.dumps(snapshot, sort_keys=True))
+            self._client.put(_MAPS_RESOURCE, self._key,
+                             json.dumps(self._m, sort_keys=True))
 
 
 class MergeMap:
@@ -158,5 +158,5 @@ class MergeMap:
 
     def flush(self) -> None:
         with self._lock:
-            snapshot = dict(self._m)
-        self._client.put(_MAPS_RESOURCE, MERGE_MAP_KEY, json.dumps(snapshot, sort_keys=True))
+            self._client.put(_MAPS_RESOURCE, MERGE_MAP_KEY,
+                             json.dumps(self._m, sort_keys=True))

@@ -1,0 +1,186 @@
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from gpu_docker_api_tpu.topology import make_topology
+
+
+# ---- TPU scheduler ----
+
+def test_tpu_apply_contiguous_box(client):
+    s = TpuScheduler(client, topology=make_topology("v4-32"))  # 2x2x4 = 16 chips
+    grant = s.apply(4)
+    assert len(grant) == 4
+    assert s.topology.is_connected(grant)
+    # a 4-grant on 2x2x4 should be a 2x2x1 slab, not a line
+    coords = [s.topology.chip(i).coord for i in grant]
+    zs = {c[2] for c in coords}
+    assert len(zs) == 1
+
+
+def test_tpu_apply_exhaustion_and_restore(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))  # 4 chips
+    g1 = s.apply(4)
+    assert sorted(g1) == [0, 1, 2, 3]
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        s.apply(1)
+    s.restore(g1)
+    assert len(s.apply(2)) == 2
+
+
+def test_tpu_restore_idempotent(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    g = s.apply(2)
+    s.restore(g)
+    s.restore(g)  # double-free must be harmless (reference bug 3)
+    assert s.get_status()["freeCount"] == 4
+
+
+def test_tpu_grants_disjoint_and_connected(client):
+    s = TpuScheduler(client, topology=make_topology("v4-32"))
+    grants = [s.apply(4) for _ in range(4)]  # fill all 16 chips
+    seen = set()
+    for g in grants:
+        assert not (seen & set(g))
+        seen |= set(g)
+        assert s.topology.is_connected(g)
+    assert len(seen) == 16
+
+
+def test_tpu_fallback_connected_nonbox(client):
+    s = TpuScheduler(client, topology=make_topology("v4-32"))
+    g3 = s.apply(3)  # no 3-volume box in 2x2x4 with compactness -> 1x1x3 line fits
+    assert s.topology.is_connected(g3)
+
+
+def test_tpu_fragmented_fallback_toggle(client):
+    topo = make_topology("v4-32")
+    s = TpuScheduler(client, topology=topo, allow_fragmented=False)
+    # fragment the free space: use 2x2x1 slabs at z=0 and z=2 manually
+    for idx, st in s.status.items():
+        z = topo.chip(idx).coord[2]
+        if z in (1, 3):
+            s.status[idx] = 1
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        s.apply(8)  # 8 free chips exist but in two disconnected slabs
+    s2 = TpuScheduler(None, topology=make_topology("v4-32"), allow_fragmented=True)
+    for idx in list(s2.status):
+        if topo.chip(idx).coord[2] in (1, 3):
+            s2.status[idx] = 1
+    assert len(s2.apply(8)) == 8  # reference-style any-N-free fallback
+
+
+def test_tpu_state_persists_and_reboots(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    g = s.apply(2)
+    s.flush()
+    s2 = TpuScheduler(client)  # boots from store, no topology given
+    assert s2.get_status()["freeCount"] == 2
+    assert s2.topology.accelerator_type == "v5p-8"
+    s2.restore(g)
+    assert s2.get_status()["freeCount"] == 4
+
+
+def test_tpu_env_and_devices(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    g = s.apply(4)
+    env = s.env_for(g)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert s.device_paths(g) == [f"/dev/accel{i}" for i in range(4)]
+
+
+# ---- CPU scheduler ----
+
+def test_cpu_apply_cpuset_string(client):
+    s = CpuScheduler(client, core_count=8)
+    assert s.apply(3) == "0,1,2"
+    assert s.apply(2) == "3,4"
+    s.restore("1,3")
+    assert s.apply(2) == "1,3"
+
+
+def test_cpu_exhaustion(client):
+    s = CpuScheduler(client, core_count=2)
+    s.apply(2)
+    with pytest.raises(xerrors.CpuNotEnoughError):
+        s.apply(1)
+
+
+def test_cpu_restore_empty_noop(client):
+    # reference bug 4: Split("", ",") -> [""] pollutes the status map
+    s = CpuScheduler(client, core_count=4)
+    s.restore("")
+    s.restore(None)
+    assert s.get_status() == {"totalCount": 4, "usedCount": 0, "usedCores": []}
+
+
+def test_cpu_reboot_from_store(client):
+    s = CpuScheduler(client, core_count=4)
+    s.apply(2)
+    s.flush()
+    s2 = CpuScheduler(client)
+    assert s2.get_status()["usedCores"] == [0, 1]
+
+
+# ---- Port scheduler ----
+
+def test_port_apply_in_range_unique(client):
+    s = PortScheduler(client, port_range=(42000, 42100), seed=7)
+    grant = s.apply(20)
+    assert len(set(grant)) == 20
+    assert all(42000 <= p <= 42100 for p in grant)
+    st = s.get_status()
+    assert st["availableCount"] == 101 - 20
+    assert st["usedPortSet"] == sorted(grant)
+
+
+def test_port_exhaustion_and_restore(client):
+    s = PortScheduler(client, port_range=(42000, 42004), seed=1)
+    g = s.apply(5)
+    with pytest.raises(xerrors.PortNotEnoughError):
+        s.apply(1)
+    s.restore(g[:2])
+    assert len(s.apply(2)) == 2
+
+
+def test_port_dense_fallback_sweep(client):
+    s = PortScheduler(client, port_range=(42000, 42009), seed=3)
+    assert sorted(s.apply(10)) == list(range(42000, 42010))
+
+
+def test_port_persists_under_own_key(client, store):
+    # reference bug 1: port state was persisted under the GPUs key
+    s = PortScheduler(client, port_range=(42000, 42010), seed=2)
+    s.apply(3)
+    s.flush()
+    kv = client.get("ports", "portStatusMap")
+    assert kv is not None
+    assert client.get("tpus", "portStatusMap") is None
+    s2 = PortScheduler(client)
+    assert s2.get_status()["usedPortSet"] == s.get_status()["usedPortSet"]
+
+
+def test_port_explicit_range_overrides_store(client):
+    s = PortScheduler(client, port_range=(42000, 42010), seed=2)
+    s.apply(3)
+    s.flush()
+    s2 = PortScheduler(client, port_range=(50000, 50100))
+    st = s2.get_status()
+    assert st["range"] == [50000, 50100]
+    assert all(50000 <= p <= 50100 for p in s2.apply(5))
+
+
+def test_tpu_env_omits_bounds_for_nonbox_grant(client):
+    topo = make_topology("v4-32")
+    s = TpuScheduler(client, topology=topo)
+    # fragment: use z=1 and z=3 slabs, leaving two disconnected 2x2 slabs
+    for idx in list(s.status):
+        if topo.chip(idx).coord[2] in (1, 3):
+            s.status[idx] = 1
+    g = s.apply(8)  # fragmented fallback grant
+    env = s.env_for(g)
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in env  # would over-claim chips
+    # a clean box grant still declares bounds
+    s2 = TpuScheduler(None, topology=make_topology("v5p-8"))
+    env2 = s2.env_for(s2.apply(4))
+    assert env2["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"

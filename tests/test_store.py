@@ -192,3 +192,17 @@ def test_snapshot_preserves_revision_counter(tmp_path, store):
     assert s2.revision == 3
     assert s2.put("c", "x") == 4  # never re-mints issued revisions
     s2.close()
+
+
+def test_compaction_durable_across_restart(tmp_path):
+    wal = str(tmp_path / "c.jsonl")
+    s = MVCCStore(wal_path=wal)
+    for i in range(5):
+        s.put("k", f"v{i}")
+    s.compact(s.revision)
+    s.close()
+    s2 = MVCCStore(wal_path=wal)
+    with pytest.raises(ValueError):
+        s2.get_at_revision("k", 1)  # compaction survives restart
+    assert s2.get("k").value == "v4"
+    s2.close()

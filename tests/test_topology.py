@@ -1,0 +1,65 @@
+from gpu_docker_api_tpu.topology import Chip, TpuTopology, make_topology
+
+
+def test_known_shapes():
+    t = make_topology("v5p-8")
+    assert t.shape == (2, 2, 1)
+    assert t.num_chips == 4
+    assert [c.device_path for c in t.chips] == [f"/dev/accel{i}" for i in range(4)]
+
+    t8 = make_topology("v5e-8")
+    assert t8.shape == (2, 4, 1)
+    assert t8.num_chips == 8
+
+
+def test_unknown_type_most_cubic():
+    t = make_topology("v5p-64")  # 32 chips
+    assert t.num_chips == 32
+    x, y, z = t.shape
+    assert x * y * z == 32
+    assert max(t.shape) <= 8  # cubic-ish, not a line
+
+
+def test_neighbors_mesh():
+    t = make_topology("v4-32")  # 2x2x4
+    corner = t.at((0, 0, 0))
+    assert sorted(n.coord for n in t.neighbors(corner)) == [(0, 0, 1), (0, 1, 0), (1, 0, 0)]
+    mid = t.at((0, 0, 2))
+    assert len(t.neighbors(mid)) == 4
+
+
+def test_neighbors_torus_wrap():
+    t = TpuTopology("v4-32", "v4", (2, 2, 4), wraparound=True)
+    corner = t.at((0, 0, 0))
+    coords = sorted(n.coord for n in t.neighbors(corner))
+    assert (0, 0, 3) in coords  # wrap along z (size 4 > 2)
+    # size-2 axes don't produce duplicate wrap links
+    assert len(coords) == len(set(coords))
+
+
+def test_connectivity():
+    t = make_topology("v4-32")
+    assert t.is_connected([0, 1])          # (0,0,0)-(1,0,0)
+    assert not t.is_connected([0, 3])      # (0,0,0) vs (1,1,0): diagonal
+    assert t.is_connected([0, 1, 3])       # path through (1,0,0)
+
+
+def test_sub_boxes_prefers_compact():
+    t = make_topology("v4-32")  # 2x2x4
+    first_dims = next(iter(t.sub_boxes(4)))[1]
+    # any surface-area-8 slab (2x2 in some plane) beats the 1x1x4 line (SA 9)
+    a, b, c = first_dims
+    assert a * b + b * c + a * c == 8
+    dims_order = [d for _, d in t.sub_boxes(4)]
+    assert dims_order[-1] == (1, 1, 4) or (1, 1, 4) not in dims_order[:1]
+
+
+def test_visible_chips_env():
+    t = make_topology("v5p-8")
+    env = t.visible_chips_env([0, 1])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-8"
+    env4 = t.visible_chips_env([0, 1, 2, 3])
+    assert env4["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
