@@ -1,0 +1,17 @@
+from .base import Backend, ContainerState, VolumeState  # noqa: F401
+from .mock import MockBackend  # noqa: F401
+from .process import ProcessBackend  # noqa: F401
+
+
+def make_backend(kind: str, state_dir: str) -> Backend:
+    """Runtime backend selection — the reference does this at compile time
+    with Go build tags (`-tags mock` vs `-tags nvidia`, Makefile:25-47);
+    a runtime seam keeps one binary and makes CI trivial."""
+    if kind == "mock":
+        return MockBackend(state_dir)
+    if kind == "process":
+        return ProcessBackend(state_dir)
+    if kind == "docker":
+        from .docker import DockerBackend
+        return DockerBackend(state_dir)
+    raise ValueError(f"unknown backend {kind!r} (mock|process|docker)")

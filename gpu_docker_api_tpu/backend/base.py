@@ -1,0 +1,102 @@
+"""Backend seam: the substrate interface the services layer drives.
+
+The reference talks straight to a global moby client (internal/docker/
+client.go) and swaps behavior via build-tag file pairs (replicaset_nomock.go /
+replicaset_mock.go). Here the seam is an explicit interface with three
+implementations:
+
+- MockBackend   — in-memory, instant; unit/CI substrate (reference `-tags mock`)
+- ProcessBackend— containers are real host processes with TPU env injection;
+                  the TPU-VM-native substrate (Cloud TPU VMs run workloads as
+                  processes; docker is optional there) and the bench path
+- DockerBackend — dockerd over its Unix socket with /dev/accel* device
+                  passthrough (reference `-tags nvidia` equivalent)
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..dtos import ContainerSpec
+
+
+@dataclass
+class ContainerState:
+    name: str
+    exists: bool = False
+    running: bool = False
+    paused: bool = False
+    exit_code: Optional[int] = None
+    spec: Optional[ContainerSpec] = None
+    upper_dir: str = ""            # writable-layer dir (overlay2 UpperDir analog)
+    started_at: float = 0.0
+    pid: Optional[int] = None
+
+
+@dataclass
+class VolumeState:
+    name: str
+    exists: bool = False
+    mountpoint: str = ""
+    size_limit_bytes: int = 0
+    used_bytes: int = 0
+    driver_opts: dict = field(default_factory=dict)
+
+
+class Backend(abc.ABC):
+    """Substrate operations (container + volume CRUD + exec)."""
+
+    # ---- containers ----
+
+    @abc.abstractmethod
+    def create(self, name: str, spec: ContainerSpec) -> str:
+        """Create (not start) a container; returns its id."""
+
+    @abc.abstractmethod
+    def start(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def stop(self, name: str, timeout: float = 10.0) -> None: ...
+
+    @abc.abstractmethod
+    def pause(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def restart_inplace(self, name: str) -> None:
+        """docker-restart semantics (reference Continue/StartupContainer,
+        services/replicaset.go:717-732)."""
+
+    @abc.abstractmethod
+    def remove(self, name: str, force: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def execute(self, name: str, cmd: list[str], workdir: str = "") -> tuple[int, str]:
+        """Run cmd inside the container; returns (exit_code, combined output)."""
+
+    @abc.abstractmethod
+    def inspect(self, name: str) -> ContainerState: ...
+
+    @abc.abstractmethod
+    def commit(self, name: str, new_image: str) -> str:
+        """Snapshot the container as a new image; returns image id."""
+
+    @abc.abstractmethod
+    def list_names(self, prefix: str = "") -> list[str]: ...
+
+    # ---- volumes ----
+
+    @abc.abstractmethod
+    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState: ...
+
+    @abc.abstractmethod
+    def volume_remove(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def volume_inspect(self, name: str) -> VolumeState: ...
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:  # noqa: B027 — optional hook
+        pass

@@ -1,0 +1,245 @@
+"""Docker substrate over the dockerd Unix socket — TPU device passthrough.
+
+Reference parity: internal/docker/client.go (moby client) + the HostConfig
+construction in internal/services/replicaset_nomock.go:128-140, which uses
+CDI DeviceRequests (`nvidia.com/gpu=UUID`) and the `nvidia` runtime. The TPU
+equivalent needs no special runtime: chips pass through as plain device
+nodes (/dev/accel*, plus /dev/vfio/* on v5p) with the libtpu shared object
+bind-mounted and the TPU_* env injected (BASELINE.json north star; SURVEY
+§1 layer-7 mapping).
+
+Implemented with stdlib http.client over the UDS (no docker SDK in the
+image). Exec output is demuxed from docker's 8-byte-header stream format —
+the stdcopy.StdCopy equivalent (reference services/replicaset.go:225-265).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import struct
+from typing import Optional
+
+from ..dtos import ContainerSpec
+from .base import Backend, ContainerState, VolumeState
+
+DOCKER_SOCKET = "/var/run/docker.sock"
+API = "/v1.41"
+
+# host paths libtpu might live at; the first that exists is bind-mounted
+LIBTPU_CANDIDATES = (
+    "/usr/lib/libtpu.so",
+    "/lib/libtpu.so",
+    "/usr/local/lib/python3.10/dist-packages/libtpu/libtpu.so",
+)
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 60.0):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class DockerError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(f"docker API {status}: {message}")
+
+
+class DockerBackend(Backend):
+    def __init__(self, state_dir: str, socket_path: str = DOCKER_SOCKET):
+        self.state_dir = state_dir
+        self.socket_path = socket_path
+        # fail fast like the reference's 2s blocking dial (etcd/client.go:17)
+        self._request("GET", "/_ping", raw=True)
+
+    # ---- HTTP plumbing ----
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 raw: bool = False, timeout: float = 120.0):
+        conn = _UnixHTTPConnection(self.socket_path, timeout=timeout)
+        try:
+            payload = None
+            headers = {}
+            if body is not None:
+                payload = json.dumps(body)
+                headers["Content-Type"] = "application/json"
+            conn.request(method, (API + path) if not raw else path, payload, headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                try:
+                    msg = json.loads(data).get("message", data.decode("utf-8", "replace"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    msg = data.decode("utf-8", "replace")
+                raise DockerError(resp.status, msg)
+            if raw or not data:
+                return data
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    # ---- container spec rendering ----
+
+    def _host_config(self, spec: ContainerSpec) -> dict:
+        import glob
+        import os
+        devices = [{"PathOnHost": d, "PathInContainer": d, "CgroupPermissions": "rwm"}
+                   for d in spec.devices]
+        # v5p chips ride vfio; pass the whole group through when present
+        for vfio in sorted(glob.glob("/dev/vfio/*")):
+            devices.append({"PathOnHost": vfio, "PathInContainer": vfio,
+                            "CgroupPermissions": "rwm"})
+        binds = list(spec.binds)
+        for lib in LIBTPU_CANDIDATES:
+            if os.path.exists(lib):
+                binds.append(f"{lib}:{lib}:ro")
+                break
+        hc: dict = {
+            "Binds": binds,
+            "Devices": devices,
+            "ShmSize": spec.shm_bytes,
+            "RestartPolicy": {"Name": spec.restart_policy},
+            "PortBindings": {
+                f"{cport}/tcp": [{"HostPort": str(hport)}]
+                for cport, hport in spec.port_bindings.items()},
+            # rootfs quota (overlay2 on xfs; reference replicaset.go:67-71)
+            "StorageOpt": {"size": spec.rootfs_quota} if spec.rootfs_quota else {},
+        }
+        if spec.cpuset:
+            hc["CpusetCpus"] = spec.cpuset
+        if spec.memory_bytes:
+            hc["Memory"] = spec.memory_bytes
+        return hc
+
+    # ---- containers ----
+
+    def create(self, name: str, spec: ContainerSpec) -> str:
+        body = {
+            "Image": spec.image,
+            "Env": list(spec.env) + [f"{k}={v}" for k, v in spec.tpu_env.items()],
+            "Cmd": spec.cmd or None,
+            "ExposedPorts": {f"{p}/tcp": {} for p in spec.port_bindings},
+            "HostConfig": self._host_config(spec),
+        }
+        out = self._request("POST", f"/containers/create?name={name}", body)
+        return out["Id"]
+
+    def start(self, name: str) -> None:
+        self._request("POST", f"/containers/{name}/start")
+
+    def stop(self, name: str, timeout: float = 10.0) -> None:
+        self._request("POST", f"/containers/{name}/stop?t={int(timeout)}")
+
+    def pause(self, name: str) -> None:
+        self._request("POST", f"/containers/{name}/pause")
+
+    def restart_inplace(self, name: str) -> None:
+        self._request("POST", f"/containers/{name}/restart")
+
+    def remove(self, name: str, force: bool = False) -> None:
+        self._request("DELETE", f"/containers/{name}?force={'true' if force else 'false'}")
+
+    def execute(self, name: str, cmd: list[str], workdir: str = "") -> tuple[int, str]:
+        body: dict = {"AttachStdout": True, "AttachStderr": True, "Cmd": cmd}
+        if workdir:
+            body["WorkingDir"] = workdir
+        exec_id = self._request("POST", f"/containers/{name}/exec", body)["Id"]
+        raw = self._request("POST", f"/exec/{exec_id}/start",
+                            {"Detach": False, "Tty": False}, raw=True)
+        output = _demux_stream(raw)
+        code = self._request("GET", f"/exec/{exec_id}/json").get("ExitCode", 0)
+        return code, output
+
+    def inspect(self, name: str) -> ContainerState:
+        try:
+            d = self._request("GET", f"/containers/{name}/json")
+        except DockerError as e:
+            if e.status == 404:
+                return ContainerState(name=name, exists=False)
+            raise
+        state = d.get("State", {})
+        graph = d.get("GraphDriver", {}).get("Data", {}) or {}
+        return ContainerState(
+            name=name, exists=True,
+            running=bool(state.get("Running")),
+            paused=bool(state.get("Paused")),
+            exit_code=state.get("ExitCode"),
+            spec=None,  # services keep the authoritative spec in the store
+            upper_dir=graph.get("UpperDir", ""),
+            pid=state.get("Pid"))
+
+    def commit(self, name: str, new_image: str) -> str:
+        repo, _, tag = new_image.partition(":")
+        out = self._request("POST",
+                            f"/commit?container={name}&repo={repo}&tag={tag or 'latest'}")
+        return out.get("Id", "")
+
+    def list_names(self, prefix: str = "") -> list[str]:
+        out = self._request("GET", "/containers/json?all=true")
+        names = []
+        for c in out:
+            for n in c.get("Names", []):
+                n = n.lstrip("/")
+                if n.startswith(prefix):
+                    names.append(n)
+        return sorted(names)
+
+    # ---- volumes ----
+
+    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
+        opts = {}
+        if size_bytes:
+            # overlay2/XFS project quota (reference volume.go:36-38)
+            opts = {"size": str(size_bytes)}
+        out = self._request("POST", "/volumes/create",
+                            {"Name": name, "DriverOpts": opts})
+        return VolumeState(name=name, exists=True,
+                           mountpoint=out.get("Mountpoint", ""),
+                           size_limit_bytes=size_bytes, driver_opts=opts)
+
+    def volume_remove(self, name: str) -> None:
+        self._request("DELETE", f"/volumes/{name}")
+
+    def volume_inspect(self, name: str) -> VolumeState:
+        try:
+            out = self._request("GET", f"/volumes/{name}")
+        except DockerError as e:
+            if e.status == 404:
+                return VolumeState(name=name, exists=False)
+            raise
+        opts = out.get("Options") or {}
+        from ..utils.file import dir_size
+        mp = out.get("Mountpoint", "")
+        used = dir_size(mp) if mp else 0
+        return VolumeState(name=name, exists=True, mountpoint=mp,
+                           size_limit_bytes=int(opts.get("size", 0) or 0),
+                           used_bytes=used, driver_opts=opts)
+
+
+def _demux_stream(raw: bytes) -> str:
+    """Demux docker's multiplexed stdout/stderr stream (8-byte frame headers:
+    [stream_type, 0,0,0, len_be32]) into one string — stdcopy equivalent."""
+    out = []
+    i = 0
+    n = len(raw)
+    while i + 8 <= n:
+        stype = raw[i]
+        # a real frame header is {0|1|2} followed by three zero bytes; anything
+        # else means TTY mode (unframed) — bail to the raw decode below
+        if stype not in (0, 1, 2) or raw[i + 1:i + 4] != b"\x00\x00\x00":
+            return raw.decode("utf-8", "replace")
+        (length,) = struct.unpack(">I", raw[i + 4:i + 8])
+        frame = raw[i + 8:i + 8 + length]
+        out.append(frame.decode("utf-8", "replace"))
+        i += 8 + length
+    if not out and raw:  # short unframed output
+        return raw.decode("utf-8", "replace")
+    return "".join(out)

@@ -1,0 +1,269 @@
+"""Process substrate: containers are real host processes.
+
+This is the TPU-VM-native backend. On Cloud TPU VMs the accelerator is bound
+to the host (libtpu owns /dev/accel* via a per-process lockfile), and
+workloads commonly run as plain processes; docker is an option, not a
+requirement. So where the reference's real backend shells containers into
+dockerd (internal/services/replicaset_nomock.go), this backend launches the
+workload command directly with:
+
+- the TPU env grant (TPU_VISIBLE_CHIPS etc.) from the chip allocator,
+- a private rootfs dir per container version (the overlay2 upper-dir analog
+  that rolling replacement copies forward),
+- bind "mounts" realized as symlinks inside the rootfs,
+- stdout/stderr captured to a per-container log.
+
+CPU pinning uses `taskset` when available; memory limits are recorded in the
+spec (enforced by the container substrate in the docker backend; advisory
+here). Pause/continue are SIGSTOP/SIGCONT — the exact process-level analog of
+docker pause (which freezes the cgroup).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import tarfile
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ..dtos import ContainerSpec
+from .base import Backend, ContainerState, VolumeState
+
+
+class _Proc:
+    def __init__(self, name: str, spec: ContainerSpec, rootfs: str, log_path: str):
+        self.id = uuid.uuid4().hex[:12]
+        self.name = name
+        self.spec = spec
+        self.rootfs = rootfs
+        self.log_path = log_path
+        self.popen: Optional[subprocess.Popen] = None
+        self.paused = False
+        self.started_at = 0.0
+        self.exit_code: Optional[int] = None
+
+
+class ProcessBackend(Backend):
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self._lock = threading.RLock()
+        self._procs: dict[str, _Proc] = {}
+        for sub in ("rootfs", "volumes", "images", "logs"):
+            os.makedirs(os.path.join(state_dir, sub), exist_ok=True)
+
+    # ---- containers ----
+
+    def create(self, name: str, spec: ContainerSpec) -> str:
+        with self._lock:
+            if name in self._procs:
+                raise RuntimeError(f"container {name} already exists")
+            rootfs = os.path.join(self.state_dir, "rootfs", name)
+            os.makedirs(rootfs, exist_ok=True)
+            # "image": a committed tarball seeds the rootfs (commit/run cycle)
+            img_tar = self._image_path(spec.image)
+            if img_tar and os.path.exists(img_tar):
+                with tarfile.open(img_tar) as t:
+                    t.extractall(rootfs, filter="data")
+            self._materialize_binds(rootfs, spec.binds)
+            p = _Proc(name, spec, rootfs,
+                      os.path.join(self.state_dir, "logs", f"{name}.log"))
+            self._procs[name] = p
+            return p.id
+
+    def _materialize_binds(self, rootfs: str, binds: list[str]) -> None:
+        """Bind "mounts": symlink rootfs/{dest} -> src. Workloads address
+        their data at {rootfs}{dest} (or via $CONTAINER_ROOT)."""
+        for b in binds:
+            src, _, dest = b.partition(":")
+            if not src or not dest:
+                continue
+            link = os.path.join(rootfs, dest.lstrip("/"))
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if os.path.islink(link) or os.path.exists(link):
+                if os.path.islink(link):
+                    os.unlink(link)
+                else:
+                    continue
+            os.symlink(os.path.abspath(src), link)
+
+    def start(self, name: str) -> None:
+        with self._lock:
+            p = self._get(name)
+            if p.popen is not None and p.popen.poll() is None:
+                return
+            env = dict(os.environ)
+            for kv in p.spec.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            env.update(p.spec.tpu_env)
+            env["CONTAINER_ROOT"] = p.rootfs
+            cmd = list(p.spec.cmd) or ["sleep", "infinity"]
+            if p.spec.cpuset and shutil.which("taskset"):
+                cmd = ["taskset", "-c", p.spec.cpuset] + cmd
+            logf = open(p.log_path, "ab")
+            p.popen = subprocess.Popen(
+                cmd, cwd=p.rootfs, env=env, stdout=logf, stderr=subprocess.STDOUT,
+                start_new_session=True)  # own process group for clean signaling
+            logf.close()
+            p.started_at = time.time()
+            p.paused = False
+            p.exit_code = None
+
+    def stop(self, name: str, timeout: float = 10.0) -> None:
+        with self._lock:
+            p = self._get(name)
+            po = p.popen
+        if po is None or po.poll() is not None:
+            if po is not None:
+                p.exit_code = po.returncode
+            return
+        try:
+            os.killpg(po.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            po.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(po.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            po.wait(timeout=5)
+        p.exit_code = po.returncode
+
+    def pause(self, name: str) -> None:
+        with self._lock:
+            p = self._get(name)
+            if p.popen is not None and p.popen.poll() is None:
+                os.killpg(p.popen.pid, signal.SIGSTOP)
+                p.paused = True
+
+    def restart_inplace(self, name: str) -> None:
+        """Reference Continue = `docker restart` (replicaset.go:717-732):
+        resume if paused, else stop+start the same container."""
+        with self._lock:
+            p = self._get(name)
+            if p.paused and p.popen is not None and p.popen.poll() is None:
+                os.killpg(p.popen.pid, signal.SIGCONT)
+                p.paused = False
+                return
+        self.stop(name, timeout=5)
+        self.start(name)
+
+    def remove(self, name: str, force: bool = False) -> None:
+        with self._lock:
+            p = self._procs.get(name)
+            if p is None:
+                return
+            running = p.popen is not None and p.popen.poll() is None
+            if running and not force:
+                raise RuntimeError(f"container {name} is running")
+        if p.popen is not None and p.popen.poll() is None:
+            self.stop(name, timeout=2)
+        with self._lock:
+            shutil.rmtree(p.rootfs, ignore_errors=True)
+            if os.path.exists(p.log_path):
+                os.unlink(p.log_path)
+            self._procs.pop(name, None)
+
+    def execute(self, name: str, cmd: list[str], workdir: str = "") -> tuple[int, str]:
+        with self._lock:
+            p = self._get(name)
+            running = p.popen is not None and p.popen.poll() is None
+            if not running:
+                return 1, "container not running"
+            env = dict(os.environ)
+            for kv in p.spec.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            env.update(p.spec.tpu_env)
+            env["CONTAINER_ROOT"] = p.rootfs
+            cwd = os.path.join(p.rootfs, workdir.lstrip("/")) if workdir else p.rootfs
+        try:
+            out = subprocess.run(
+                cmd, cwd=cwd, env=env, capture_output=True, text=True, timeout=300)
+            return out.returncode, (out.stdout or "") + (out.stderr or "")
+        except subprocess.TimeoutExpired:
+            return 124, "exec timed out"
+        except OSError as e:
+            return 127, str(e)
+
+    def inspect(self, name: str) -> ContainerState:
+        with self._lock:
+            p = self._procs.get(name)
+            if p is None:
+                return ContainerState(name=name, exists=False)
+            running = p.popen is not None and p.popen.poll() is None
+            if p.popen is not None and not running:
+                p.exit_code = p.popen.returncode
+            return ContainerState(
+                name=name, exists=True, running=running, paused=p.paused,
+                exit_code=p.exit_code, spec=p.spec, upper_dir=p.rootfs,
+                started_at=p.started_at,
+                pid=p.popen.pid if running else None)
+
+    def commit(self, name: str, new_image: str) -> str:
+        with self._lock:
+            p = self._get(name)
+            tar_path = self._image_path(new_image, create_dirs=True)
+            with tarfile.open(tar_path, "w") as t:
+                t.add(p.rootfs, arcname=".")
+            return "sha256:" + uuid.uuid4().hex
+
+    def list_names(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(n for n in self._procs if n.startswith(prefix))
+
+    # ---- volumes ----
+
+    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
+        mp = os.path.join(self.state_dir, "volumes", name)
+        if os.path.exists(mp):
+            raise RuntimeError(f"volume {name} already exists")
+        os.makedirs(mp)
+        return VolumeState(name=name, exists=True, mountpoint=mp,
+                           size_limit_bytes=size_bytes,
+                           driver_opts={"size": size_bytes})
+
+    def volume_remove(self, name: str) -> None:
+        shutil.rmtree(os.path.join(self.state_dir, "volumes", name),
+                      ignore_errors=True)
+
+    def volume_inspect(self, name: str) -> VolumeState:
+        from ..utils.file import dir_size
+        mp = os.path.join(self.state_dir, "volumes", name)
+        if not os.path.isdir(mp):
+            return VolumeState(name=name, exists=False)
+        return VolumeState(name=name, exists=True, mountpoint=mp,
+                           used_bytes=dir_size(mp))
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        for name in self.list_names():
+            try:
+                self.stop(name, timeout=2)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    # ---- helpers ----
+
+    def _image_path(self, image: str, create_dirs: bool = False) -> str:
+        if not image:
+            return ""
+        safe = image.replace("/", "_").replace(":", "_")
+        path = os.path.join(self.state_dir, "images", f"{safe}.tar")
+        if create_dirs:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        return path
+
+    def _get(self, name: str) -> _Proc:
+        p = self._procs.get(name)
+        if p is None:
+            raise RuntimeError(f"no such container {name}")
+        return p

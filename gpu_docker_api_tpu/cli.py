@@ -1,0 +1,83 @@
+"""Process bootstrap.
+
+Reference parity: cmd/gpu-docker-api/main.go — flags --addr/-a, --etcd/-e
+(here --state-dir: the store is embedded, no external etcd), --portRange/-p,
+--logLevel/-l (:33-38), banner of chip/port inventory (:107-112), SIGINT/
+SIGTERM graceful stop with full state flush (:139-154).
+
+Run: python -m gpu_docker_api_tpu.cli --addr 0.0.0.0:2378 --backend process
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from .server.app import App
+from .topology import make_topology
+
+log = logging.getLogger("tpu-docker-api")
+
+
+def parse_port_range(s: str) -> tuple[int, int]:
+    lo, _, hi = s.partition("-")
+    return int(lo), int(hi)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-docker-api",
+        description="TPU-native container-orchestration REST service")
+    p.add_argument("-a", "--addr", default="0.0.0.0:2378",
+                   help="listen address (default 0.0.0.0:2378)")
+    p.add_argument("-s", "--state-dir", default="./tpu-docker-api-state",
+                   help="embedded state store + backend working dir")
+    p.add_argument("-p", "--portRange", default="40000-65535",
+                   help="host port pool, e.g. 40000-65535")
+    p.add_argument("-l", "--logLevel", default="info",
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("-b", "--backend", default="process",
+                   choices=["mock", "process", "docker"],
+                   help="substrate (default: process; mock needs no hardware)")
+    p.add_argument("-t", "--topology", default=None,
+                   help="force accelerator type (e.g. v5p-8); default: probe")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.logLevel.upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    topology = make_topology(args.topology) if args.topology else None
+    app = App(state_dir=args.state_dir, backend=args.backend, addr=args.addr,
+              port_range=parse_port_range(args.portRange), topology=topology)
+    app.start()
+
+    status = app.tpu.get_status()
+    log.info("topology: %s (%d chips, %d free)",
+             status["topology"]["acceleratorType"], len(status["chips"]),
+             status["freeCount"])
+    log.info("port pool: %s", app.ports.get_status()["range"])
+    log.info("listening on %s — Ctrl-C to stop", app.address)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    app.stop()
+    log.info("state flushed; bye")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

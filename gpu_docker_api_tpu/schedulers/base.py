@@ -19,18 +19,34 @@ from typing import Optional
 from ..store.client import StateClient
 from ..workqueue import PutKeyValue, WorkQueue
 
-FREE, USED = 0, 1
+# Status maps are {index: owner}: None = free, "" = anonymous grant, any
+# other string = the replicaSet that holds the resource. Ownership makes
+# restore() safe against double-frees ACROSS owners: you can only free what
+# you hold (the reference's byte-map can't tell whose resource it frees —
+# the root of SURVEY §2 bug 3's whole class).
+FREE = None
 
 
-def merge_stored_status(stored: Optional[dict], fresh: dict[int, int]) -> dict[int, int]:
-    """Overlay a stored {index: state} map onto a freshly-probed one, keeping
+def _norm_owner(v) -> Optional[str]:
+    """Normalize a stored status value: legacy ints (0 free / 1 used) from
+    the byte-map format, or owner strings."""
+    if v in (0, None):
+        return None
+    if v == 1:
+        return ""
+    return str(v)
+
+
+def merge_stored_status(stored: Optional[dict],
+                        fresh: dict[int, Optional[str]]) -> dict[int, Optional[str]]:
+    """Overlay a stored {index: owner} map onto a freshly-probed one, keeping
     only indices that still exist on this host (shared by the TPU and CPU
     scheduler boot paths)."""
     if stored:
         for k, v in stored.items():
             ik = int(k)
             if ik in fresh:
-                fresh[ik] = v
+                fresh[ik] = _norm_owner(v)
     return fresh
 
 

@@ -42,7 +42,11 @@ class PortScheduler(Scheduler):
             self.start, self.end = self.DEFAULT_RANGE
         if self.start > self.end:
             raise ValueError(f"invalid port range ({self.start}, {self.end})")
-        self.used: set[int] = set(state["used"]) if state is not None else set()
+        # {port: owner} — legacy stored lists become anonymous grants
+        raw_used = state["used"] if state is not None else {}
+        if isinstance(raw_used, list):
+            raw_used = {p: "" for p in raw_used}
+        self.used: dict[int, str] = {int(p): o for p, o in raw_used.items()}
         # ports outside a narrowed range stay tracked as used until restored
         with self._lock:
             self._persist()
@@ -51,8 +55,8 @@ class PortScheduler(Scheduler):
     def available_count(self) -> int:
         return self.end - self.start + 1
 
-    def apply(self, n: int) -> list[int]:
-        """Grant n random free ports in range."""
+    def apply(self, n: int, owner: str = "") -> list[int]:
+        """Grant n random free ports in range, owned by `owner`."""
         if n <= 0:
             return []
         with self._lock:
@@ -68,24 +72,28 @@ class PortScheduler(Scheduler):
                 p = self._rng.randint(self.start, self.end)
                 attempts += 1
                 if p not in self.used:
-                    self.used.add(p)
+                    self.used[p] = owner
                     grant.append(p)
             if len(grant) < n:
                 for p in range(self.start, self.end + 1):
                     if p not in self.used:
-                        self.used.add(p)
+                        self.used[p] = owner
                         grant.append(p)
                         if len(grant) == n:
                             break
             self._persist()
             return grant
 
-    def restore(self, grant: Optional[list[int]]) -> None:
+    def restore(self, grant: Optional[list[int]],
+                owner: Optional[str] = None) -> None:
+        """Owner-checked free (see TpuScheduler.restore)."""
         if not grant:
             return
         with self._lock:
             for p in grant:
-                self.used.discard(int(p))
+                p = int(p)
+                if p in self.used and (owner is None or self.used[p] == owner):
+                    del self.used[p]
             self._persist()
 
     def get_status(self) -> dict:
@@ -100,4 +108,5 @@ class PortScheduler(Scheduler):
             }
 
     def serialize(self) -> dict:
-        return {"range": [self.start, self.end], "used": sorted(self.used)}
+        return {"range": [self.start, self.end],
+                "used": {str(p): o for p, o in sorted(self.used.items())}}

@@ -27,7 +27,7 @@ from .. import xerrors
 from ..store.client import StateClient
 from ..topology import TpuTopology, discover_topology
 from ..workqueue import WorkQueue
-from .base import FREE, USED, Scheduler, merge_stored_status
+from .base import FREE, Scheduler, _norm_owner, merge_stored_status
 
 
 class TpuScheduler(Scheduler):
@@ -50,7 +50,8 @@ class TpuScheduler(Scheduler):
                 worker_id=state["topology"].get("workerId", 0),
                 num_workers=state["topology"].get("numWorkers", 1),
             )
-            self.status = {int(k): v for k, v in state["status"].items()}
+            self.status = {int(k): _norm_owner(v)
+                           for k, v in state["status"].items()}
         else:
             self.topology = topology or discover_topology()
             # explicit topology overrides the stored one; stored chip states
@@ -63,38 +64,63 @@ class TpuScheduler(Scheduler):
 
     # ---- allocation ----
 
-    def apply(self, n: int) -> list[int]:
-        """Grant n chips as an ICI-contiguous set; returns chip indices."""
+    def apply(self, n: int, owner: str = "",
+              reuse: Optional[list[int]] = None) -> list[int]:
+        """Grant n chips as an ICI-contiguous set; returns chip indices.
+
+        owner: who holds the grant (restore is owner-checked).
+        reuse: chips ALREADY owned by `owner` that the placement may re-grant
+        in place — the lift-in-place path for patch/rollback. They are never
+        released to the pool, so no other applicant can grab them between the
+        re-grant and the old container's teardown (chip exclusivity, SURVEY
+        §7 hard part 2). Reused chips not in the new grant stay owned by
+        `owner`; the caller restores them after the old container stops.
+        """
         if n <= 0:
             return []
         with self._lock:
-            free = [i for i, s in self.status.items() if s == FREE]
+            reusable = {i for i in (reuse or [])
+                        if self.status.get(i) == owner}
+            free = {i for i, s in self.status.items() if s is FREE} | reusable
             if len(free) < n:
                 raise xerrors.TpuNotEnoughError(
                     f"want {n}, only {len(free)} of {len(self.status)} free")
-            grant = self._find_box(n, set(free))
+            grant = self._find_box(n, free)
             if grant is None:
-                grant = self._find_connected(n, set(free))
+                grant = self._find_connected(n, free)
             if grant is None:
                 if not self.allow_fragmented:
                     raise xerrors.TpuNotEnoughError(
                         f"no ICI-contiguous placement for {n} chips")
-                grant = sorted(free)[:n]
+                # prefer reused chips first to minimize churn
+                grant = (sorted(reusable) + sorted(free - reusable))[:n]
             for i in grant:
-                self.status[i] = USED
+                self.status[i] = owner
             self._persist()
             return sorted(grant)
 
-    def restore(self, grant: list[int]) -> None:
-        """Free a grant. Unknown/already-free chips are ignored (idempotent —
-        the reference double-frees on its Stop error path, SURVEY §2 bug 3;
-        idempotent restore makes that class of bug harmless)."""
+    def restore(self, grant: list[int], owner: Optional[str] = None) -> None:
+        """Free a grant. With an owner, only chips that owner still holds are
+        freed — a stale restore can never release chips that have since been
+        granted to someone else (the reference's unconditional byte-flip
+        can, SURVEY §2 bug 3). owner=None is the administrative force-free."""
         if not grant:
             return
         with self._lock:
             for i in grant:
-                if i in self.status:
+                if i in self.status and (owner is None or self.status[i] == owner):
                     self.status[i] = FREE
+            self._persist()
+
+    def mark_used(self, grant: list[int], owner: str = "") -> None:
+        """Re-mark chips as held by `owner` — unwind path. Chips currently
+        granted to a DIFFERENT owner are left alone."""
+        if not grant:
+            return
+        with self._lock:
+            for i in grant:
+                if i in self.status and self.status[i] in (FREE, owner):
+                    self.status[i] = owner
             self._persist()
 
     # ---- placement search ----
@@ -169,12 +195,13 @@ class TpuScheduler(Scheduler):
                 "id": c.id,
                 "device": c.device_path,
                 "coord": list(c.coord),
-                "used": self.status[c.index] == USED,
+                "used": self.status[c.index] is not FREE,
+                "owner": self.status[c.index] or "",
             } for c in self.topology.chips]
             return {
                 "topology": self.topology.serialize(),
                 "chips": chips,
-                "freeCount": sum(1 for s in self.status.values() if s == FREE),
+                "freeCount": sum(1 for s in self.status.values() if s is FREE),
             }
 
     def env_for(self, grant: list[int]) -> dict[str, str]:

@@ -1,0 +1,2 @@
+from .app import App  # noqa: F401
+from .codes import ResCode  # noqa: F401

@@ -1,0 +1,360 @@
+"""App: route table + handlers + full process wiring.
+
+Reference parity: the route set of internal/routers/replicaset.go:22-57
+(12 replicaSet endpoints), volume.go:20-26 (5 volume endpoints),
+resource.go:12-16 (3 resource reads) and the /ping health route
+(cmd/gpu-docker-api/main.go:119-123), with the same request validation and
+error-code mapping, served under /api/v1. `/resources/tpus` replaces
+`/resources/gpus` (the old path is kept as an alias).
+
+App also plays the reference's program.Init role (main.go:53-97): it wires
+store -> workqueue -> schedulers -> version maps -> backend -> services.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from .. import xerrors
+from ..backend import make_backend
+from ..dtos import ContainerRun, PatchRequest
+from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from ..services import ReplicaSetService, VolumeService
+from ..store import MVCCStore, StateClient
+from ..topology import TpuTopology, discover_topology
+from ..utils.file import valid_size_unit
+from ..version import (
+    CONTAINER_VERSION_MAP_KEY, VOLUME_VERSION_MAP_KEY, MergeMap, VersionMap,
+)
+from ..workqueue import WorkQueue
+from .codes import ResCode
+from .http import ApiServer, Request, Response, Router, err, ok
+
+log = logging.getLogger(__name__)
+
+
+class App:
+    def __init__(self, state_dir: str = "./tpu-docker-api-state",
+                 backend: str = "mock",
+                 addr: str = "127.0.0.1:2378",
+                 port_range: Optional[tuple[int, int]] = None,
+                 topology: Optional[TpuTopology] = None,
+                 api_key: Optional[str] = None,
+                 cpu_cores: Optional[int] = None):
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        # --- reference Init order: docker -> etcd -> workQueue -> schedulers
+        #     -> version maps (main.go:53-97) ---
+        self.store = MVCCStore(wal_path=os.path.join(state_dir, "state.wal"))
+        self.client = StateClient(self.store)
+        self.wq = WorkQueue(self.client)
+        self.wq.start()
+        self.backend = make_backend(backend, os.path.join(state_dir, "backend"))
+        # an explicit topology overrides the store; otherwise boot from stored
+        # state (crash-resume) and only probe the host on first run
+        if topology is None and self.client.get("tpus", "tpuStatusMap") is None:
+            topology = discover_topology()
+        self.tpu = TpuScheduler(self.client, self.wq, topology=topology)
+        self.cpu = CpuScheduler(self.client, self.wq, core_count=cpu_cores)
+        self.ports = PortScheduler(self.client, self.wq, port_range=port_range)
+        self.container_versions = VersionMap(CONTAINER_VERSION_MAP_KEY,
+                                             self.client, self.wq)
+        self.volume_versions = VersionMap(VOLUME_VERSION_MAP_KEY,
+                                          self.client, self.wq)
+        self.merges = MergeMap(self.client, self.wq)
+        self.replicasets = ReplicaSetService(
+            self.backend, self.client, self.wq, self.tpu, self.cpu, self.ports,
+            self.container_versions, self.merges)
+        self.volumes = VolumeService(self.backend, self.client, self.wq,
+                                     self.volume_versions)
+        self.server = ApiServer(self._router(), addr=addr, api_key=api_key)
+
+    # ------------------------------------------------------------- routes
+
+    def _router(self) -> Router:
+        r = Router()
+        v1 = "/api/v1"
+        r.add("GET", "/ping", lambda req: ok({"status": "pong"}))
+        r.add("POST", f"{v1}/replicaSet", self.h_run)
+        r.add("POST", f"{v1}/replicaSet/:name/commit", self.h_commit)
+        r.add("POST", f"{v1}/replicaSet/:name/execute", self.h_execute)
+        r.add("PATCH", f"{v1}/replicaSet/:name", self.h_patch)
+        r.add("PATCH", f"{v1}/replicaSet/:name/rollback", self.h_rollback)
+        r.add("PATCH", f"{v1}/replicaSet/:name/stop", self.h_stop)
+        r.add("PATCH", f"{v1}/replicaSet/:name/restart", self.h_restart)
+        r.add("PATCH", f"{v1}/replicaSet/:name/pause", self.h_pause)
+        r.add("PATCH", f"{v1}/replicaSet/:name/continue", self.h_continue)
+        r.add("GET", f"{v1}/replicaSet/:name", self.h_info)
+        r.add("GET", f"{v1}/replicaSet/:name/history", self.h_history)
+        r.add("DELETE", f"{v1}/replicaSet/:name", self.h_delete)
+        r.add("POST", f"{v1}/volumes", self.h_vol_create)
+        r.add("PATCH", f"{v1}/volumes/:name/size", self.h_vol_patch)
+        r.add("DELETE", f"{v1}/volumes/:name", self.h_vol_delete)
+        r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
+        r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
+        r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
+        r.add("GET", f"{v1}/resources/gpus", self.h_res_tpus)  # legacy alias
+        r.add("GET", f"{v1}/resources/cpus", self.h_res_cpus)
+        r.add("GET", f"{v1}/resources/ports", self.h_res_ports)
+        return r
+
+    # ------------------------------------------------- replicaSet handlers
+
+    def h_run(self, req: Request) -> Response:
+        spec = ContainerRun.from_json(req.json())
+        if not spec.imageName:
+            return err(ResCode.ImageNameCannotBeEmpty)
+        if not spec.replicaSetName:
+            return err(ResCode.ContainerNameCannotBeEmpty)
+        if "-" in spec.replicaSetName:
+            return err(ResCode.ContainerNameCannotContainDash)
+        if spec.tpuCount < 0:
+            return err(ResCode.TpuCountMustBeGreaterThanOrEqualZero)
+        if spec.cpuCount < 0:
+            return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
+        if spec.memory and not valid_size_unit(spec.memory):
+            return err(ResCode.ContainerMemorySizeNotSupported)
+        try:
+            return ok(self.replicasets.run_container(spec))
+        except xerrors.ContainerExistedError:
+            return err(ResCode.ContainerAlreadyExist)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.CpuNotEnoughError:
+            return err(ResCode.ContainerCpuNotEnough)
+        except xerrors.PortNotEnoughError:
+            return err(ResCode.ContainerPortNotEnough)
+        except Exception:  # noqa: BLE001
+            log.exception("run failed [%s]", req.request_id)
+            return err(ResCode.ContainerRunFailed)
+
+    def h_patch(self, req: Request) -> Response:
+        name = req.params["name"]
+        body = req.json()
+        patch = PatchRequest.from_json(body)
+        tp = patch.tpuPatch
+        if tp is not None and tp.tpuCount < 0:
+            return err(ResCode.TpuCountMustBeGreaterThanOrEqualZero)
+        cp = patch.cpuPatch
+        if cp is not None and cp.cpuCount < 0:
+            return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
+        mp = patch.memoryPatch
+        if mp is not None and not valid_size_unit(mp.memory):
+            return err(ResCode.ContainerMemorySizeNotSupported)
+        try:
+            return ok(self.replicasets.patch_container(name, patch))
+        except xerrors.NoPatchRequiredError:
+            return err(ResCode.ContainerNoNeedPatch)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.CpuNotEnoughError:
+            return err(ResCode.ContainerCpuNotEnough)
+        except xerrors.PortNotEnoughError:
+            return err(ResCode.ContainerPortNotEnough)
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("patch failed [%s]", req.request_id)
+            return err(ResCode.ContainerPatchFailed)
+
+    def h_rollback(self, req: Request) -> Response:
+        name = req.params["name"]
+        version = int(req.json().get("version", -1))
+        if version < 0:
+            return err(ResCode.ContainerVersionMustBeGreaterThanOrEqualZero)
+        try:
+            return ok(self.replicasets.rollback_container(name, version))
+        except xerrors.NoRollbackRequiredError:
+            return err(ResCode.ContainerNoNeedRollback)
+        except (xerrors.NotExistInStoreError, xerrors.VersionNotFoundError):
+            return err(ResCode.ContainerRollbackFailed)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except Exception:  # noqa: BLE001
+            log.exception("rollback failed [%s]", req.request_id)
+            return err(ResCode.ContainerRollbackFailed)
+
+    def h_stop(self, req: Request) -> Response:
+        try:
+            self.replicasets.stop_container(req.params["name"])
+            return ok()
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("stop failed [%s]", req.request_id)
+            return err(ResCode.ContainerStopFailed)
+
+    def h_restart(self, req: Request) -> Response:
+        try:
+            return ok(self.replicasets.restart_container(req.params["name"]))
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except Exception:  # noqa: BLE001
+            log.exception("restart failed [%s]", req.request_id)
+            return err(ResCode.ContainerRestartFailed)
+
+    def h_pause(self, req: Request) -> Response:
+        try:
+            self.replicasets.pause_container(req.params["name"])
+            return ok()
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("pause failed [%s]", req.request_id)
+            return err(ResCode.ContainerShutDownFailed)
+
+    def h_continue(self, req: Request) -> Response:
+        try:
+            self.replicasets.startup_container(req.params["name"])
+            return ok()
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("continue failed [%s]", req.request_id)
+            return err(ResCode.ContainerStartUpFailed)
+
+    def h_execute(self, req: Request) -> Response:
+        body = req.json()
+        cmd = body.get("cmd") or []
+        workdir = body.get("workDir", "")
+        try:
+            out = self.replicasets.execute_container(req.params["name"], cmd, workdir)
+            return ok({"output": out})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("execute failed [%s]", req.request_id)
+            return err(ResCode.ContainerExecuteFailed)
+
+    def h_commit(self, req: Request) -> Response:
+        new_image = req.json().get("newImageName", "")
+        if not new_image:
+            return err(ResCode.InvalidParams)
+        try:
+            image_id = self.replicasets.commit_container(req.params["name"], new_image)
+            return ok({"imageId": image_id, "imageName": new_image})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("commit failed [%s]", req.request_id)
+            return err(ResCode.ContainerCommitFailed)
+
+    def h_info(self, req: Request) -> Response:
+        try:
+            return ok({"info": self.replicasets.get_container_info(req.params["name"])})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetInfoFailed)
+
+    def h_history(self, req: Request) -> Response:
+        try:
+            return ok({"history": self.replicasets.get_container_history(req.params["name"])})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.ContainerGetHistoryFailed)
+
+    def h_delete(self, req: Request) -> Response:
+        try:
+            self.replicasets.delete_container(req.params["name"])
+            return ok()
+        except Exception:  # noqa: BLE001
+            log.exception("delete failed [%s]", req.request_id)
+            return err(ResCode.ContainerDeleteFailed)
+
+    # ----------------------------------------------------- volume handlers
+
+    def h_vol_create(self, req: Request) -> Response:
+        body = req.json()
+        name = body.get("name", "")
+        size = body.get("size", "")
+        if "-" in name:
+            return err(ResCode.VolumeNameNotContainsDash)
+        if name.startswith("/"):
+            return err(ResCode.VolumeNameNotBeginWithForwardSlash)
+        if not name:
+            return err(ResCode.VolumeNameCannotBeEmpty)
+        if size and not valid_size_unit(size):
+            return err(ResCode.VolumeSizeNotSupported)
+        try:
+            return ok(self.volumes.create_volume(name, size))
+        except xerrors.VolumeExistedError:
+            return err(ResCode.VolumeExisted)
+        except Exception:  # noqa: BLE001
+            log.exception("volume create failed [%s]", req.request_id)
+            return err(ResCode.VolumeCreateFailed)
+
+    def h_vol_patch(self, req: Request) -> Response:
+        name = req.params["name"]
+        size = req.json().get("size", "")
+        if not valid_size_unit(size):
+            return err(ResCode.VolumeSizeNotSupported)
+        try:
+            return ok(self.volumes.patch_volume_size(name, size))
+        except xerrors.NoPatchRequiredError:
+            return err(ResCode.VolumeSizeNoNeedPatch)
+        except xerrors.VolumeSizeUsedGreaterThanReducedError:
+            return err(ResCode.VolumeSizeUsedGreaterThanReduce)
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.VolumeGetInfoFailed)
+        except Exception:  # noqa: BLE001
+            log.exception("volume patch failed [%s]", req.request_id)
+            return err(ResCode.VolumePatchFailed)
+
+    def h_vol_delete(self, req: Request) -> Response:
+        # ?noall keeps history (reference routers/volume.go:121-127)
+        try:
+            self.volumes.delete_volume(req.params["name"],
+                                       keep_history=req.query_flag("noall"))
+            return ok()
+        except Exception:  # noqa: BLE001
+            log.exception("volume delete failed [%s]", req.request_id)
+            return err(ResCode.VolumeDeleteFailed)
+
+    def h_vol_info(self, req: Request) -> Response:
+        try:
+            return ok({"info": self.volumes.get_volume_info(req.params["name"])})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.VolumeGetInfoFailed)
+
+    def h_vol_history(self, req: Request) -> Response:
+        try:
+            return ok({"history": self.volumes.get_volume_history(req.params["name"])})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.VolumeGetHistoryFailed)
+
+    # --------------------------------------------------- resource handlers
+
+    def h_res_tpus(self, req: Request) -> Response:
+        return ok({"tpus": self.tpu.get_status()})
+
+    def h_res_cpus(self, req: Request) -> Response:
+        return ok({"cpus": self.cpu.get_status()})
+
+    def h_res_ports(self, req: Request) -> Response:
+        return ok({"ports": self.ports.get_status()})
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.server.start()
+        log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
+                 self.server.host, self.server.port, self.tpu.topology.num_chips)
+
+    def stop(self) -> None:
+        """Graceful shutdown: drain queue, flush all state (reference Stop,
+        main.go:139-154)."""
+        self.server.stop()
+        self.wq.close()
+        for sch in (self.tpu, self.cpu, self.ports):
+            sch.flush()
+        self.container_versions.flush()
+        self.volume_versions.flush()
+        self.merges.flush()
+        self.backend.close()
+        self.store.close()
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"

@@ -1,0 +1,195 @@
+"""Minimal threaded HTTP server + router + middleware.
+
+Reference parity: gin engine + middleware (internal/routers/cors.go:10-32
+permissive reflected-origin CORS with OPTIONS short-circuit; auth.go:11-26
+static bearer token from APIKEY env, no-op when unset) and the uniform
+envelope ResponseData{code,msg,data} with HTTP status always 200
+(response.go:9-29). stdlib only — the image has no web framework, and a
+control plane doesn't need one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .codes import ResCode
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[["Request"], "Response"]
+
+
+class Request:
+    def __init__(self, method: str, path: str, query: dict[str, list[str]],
+                 body: bytes, headers: dict[str, str], params: dict[str, str]):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.body = body
+        self.headers = headers
+        self.params = params
+        self.request_id = uuid.uuid4().hex[:16]
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        return json.loads(self.body)
+
+    def query_flag(self, name: str) -> bool:
+        return name in self.query
+
+
+class Response:
+    def __init__(self, code: ResCode, data: Optional[dict] = None,
+                 msg: Optional[str] = None):
+        self.code = code
+        self.data = data
+        self.msg = msg if msg is not None else code.msg
+
+    def payload(self) -> bytes:
+        return json.dumps(
+            {"code": int(self.code), "msg": self.msg, "data": self.data},
+            default=str).encode("utf-8")
+
+
+def ok(data: Optional[dict] = None) -> Response:
+    return Response(ResCode.Success, data)
+
+
+def err(code: ResCode) -> Response:
+    return Response(code, None)
+
+
+class Router:
+    """(method, /path/with/:params) -> handler."""
+
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = re.compile(
+            "^" + re.sub(r":([a-zA-Z_]+)", r"(?P<\1>[^/]+)", pattern) + "$")
+        self._routes.append((method.upper(), regex, handler))
+
+    def resolve(self, method: str, path: str):
+        path_matched = False
+        for m, regex, handler in self._routes:
+            match = regex.match(path)
+            if match:
+                path_matched = True
+                if m == method.upper():
+                    return handler, match.groupdict()
+        return (None, {"_405": "1"}) if path_matched else (None, {})
+
+
+class ApiServer:
+    def __init__(self, router: Router, addr: str = "127.0.0.1:2378",
+                 api_key: Optional[str] = None):
+        self.router = router
+        host, _, port = addr.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        # reference auth.go:9 — static bearer token from APIKEY env, noop if unset
+        self.api_key = api_key if api_key is not None else os.environ.get("APIKEY", "")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- request pipeline ----
+
+    def _handle(self, method: str, raw_path: str, body: bytes,
+                headers: dict[str, str]) -> tuple[int, dict[str, str], bytes]:
+        cors = {
+            # reflected-origin permissive CORS (reference cors.go:12-20)
+            "Access-Control-Allow-Origin": headers.get("Origin", "*"),
+            "Access-Control-Allow-Methods": "GET, POST, PATCH, DELETE, OPTIONS",
+            "Access-Control-Allow-Headers": "Content-Type, Authorization",
+            "Content-Type": "application/json",
+        }
+        if method == "OPTIONS":  # preflight short-circuit (cors.go:22-29)
+            return 204, cors, b""
+
+        if self.api_key:
+            tok = headers.get("Authorization", "")
+            if tok.removeprefix("Bearer ").strip() != self.api_key:
+                return 200, cors, Response(ResCode.Forbidden).payload()
+
+        parsed = urlparse(raw_path)
+        handler, params = self.router.resolve(method, parsed.path)
+        if handler is None:
+            body_out = json.dumps({"code": 404 if "_405" not in params else 405,
+                                   "msg": "route not found", "data": None}).encode()
+            return 404, cors, body_out
+
+        req = Request(method, parsed.path, parse_qs(parsed.query, keep_blank_values=True),
+                      body, headers, params)
+        try:
+            resp = handler(req)
+        except json.JSONDecodeError:
+            resp = err(ResCode.InvalidParams)
+        except Exception:  # noqa: BLE001 — the envelope absorbs handler crashes
+            log.exception("unhandled error on %s %s [%s]", method, parsed.path,
+                          req.request_id)
+            resp = err(ResCode.ServerBusy)
+        return 200, cors, resp.payload()
+
+    # ---- lifecycle ----
+
+    def _make_handler(self):
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through our logger
+                log.debug("http: " + fmt, *args)
+
+            def _dispatch(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                status, hdrs, payload = server._handle(
+                    self.command, self.path, body, dict(self.headers))
+                self.send_response(status)
+                for k, v in hdrs.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
+            do_GET = do_POST = do_PATCH = do_DELETE = do_OPTIONS = _dispatch
+
+        return _Handler
+
+    def _bind(self) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._bind()
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def start(self) -> None:
+        """Serve on a daemon thread; returns once the socket is bound."""
+        self._bind()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="api-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,2 @@
+from .replicaset import ReplicaSetService  # noqa: F401
+from .volume import VolumeService  # noqa: F401

@@ -1,0 +1,509 @@
+"""ReplicaSet service — the versioned-container state machine.
+
+Reference parity: internal/services/replicaset.go (1047 LoC) + the
+runContainer build-tag pair (replicaset_nomock.go / replicaset_mock.go).
+Same semantics, TPU substrate:
+
+- run      = bump version, grant chips/cores/ports, create+start {rs}-{v}
+             (reference RunGpuContainer :45-155 + runContainer)
+- patch    = rolling replacement: new version with lifted config, old
+             upper-dir copied into new, old deleted (reference :267-363)
+- rollback = forward-write a new version whose config equals a historical
+             one (reference :365-446) — history is append-only
+- restart  = full re-grant + new version (reference :736-864)
+- stop     = release chips/cores/ports, stop container (reference :582-639)
+- pause / continue / execute / commit / info / history / delete
+
+Resource-ownership model (no reference precedent — its byte-map schedulers
+cannot tell WHOSE resource a Restore frees, the root of SURVEY §2 bug 3):
+every grant is owned by the replicaSet name; restores are owner-checked, so
+a stale release can never free another replicaSet's resources. Grant
+lifecycle per replicaSet:
+
+    run: apply(owner=name)                       [held]
+    patch/rollback/restart(running):
+        apply(owner=name, reuse=old_grant)       [held; old chips NEVER
+        ... stop old, start new ...               transit through the free
+        restore(old - new, owner=name)            pool -> no thief window,
+                                                  and chip exclusivity holds]
+    stop: restore(owner=name); resourcesReleased=True persisted
+    delete: restore(owner=name) unless released  [covers crash-exited
+                                                  containers too]
+
+TPU-specific deltas (SURVEY §7 hard parts):
+- chip exclusivity: libtpu owns granted chips via a lockfile, so during
+  replacement the OLD container is stopped BEFORE the new one starts; with
+  in-place reuse the two versions' grants may overlap safely;
+- no "ballast stone": the reference writes a 5MB dd file into each container
+  5s after start (replicaset.go:1013-1032) to pre-fault overlay quota
+  accounting; that trick execs into the container, which on TPU risks
+  touching the accelerator's process lock — our substrate doesn't need it;
+- history durability: every version persists under an explicit per-version
+  key, so rollback survives store compaction (reference relies on raw etcd
+  MVCC revision walks, SURVEY §2 bug 5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .. import xerrors
+from ..backend.base import Backend
+from ..dtos import (
+    ContainerRun, ContainerSpec, HistoryItem, PatchRequest, StoredContainerInfo,
+)
+from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from ..store.client import StateClient
+from ..utils.file import copy_dir, to_bytes
+from ..version import MergeMap, VersionMap
+from ..workqueue import Call, PutKeyValue, WorkQueue
+
+log = logging.getLogger(__name__)
+
+CONTAINERS = "containers"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+
+
+class ReplicaSetService:
+    def __init__(self, backend: Backend, client: StateClient, wq: WorkQueue,
+                 tpu: TpuScheduler, cpu: CpuScheduler, ports: PortScheduler,
+                 version_map: VersionMap, merge_map: MergeMap):
+        self.backend = backend
+        self.client = client
+        self.wq = wq
+        self.tpu = tpu
+        self.cpu = cpu
+        self.ports = ports
+        self.versions = version_map
+        self.merges = merge_map
+        # one mutation at a time per replicaSet; the reference relies on
+        # goroutine luck here (SURVEY §5.2)
+        self._name_locks: dict[str, threading.Lock] = {}
+        self._name_locks_guard = threading.Lock()
+        # authoritative latest-info cache: persistence is write-behind, so a
+        # read hot on the heels of a mutation must not depend on the queue
+        # having drained (the reference reads etcd here and wins by luck)
+        self._latest: dict[str, StoredContainerInfo] = {}
+
+    def _mutex(self, name: str) -> threading.Lock:
+        with self._name_locks_guard:
+            return self._name_locks.setdefault(name, threading.Lock())
+
+    # ------------------------------------------------------------------ run
+
+    def run_container(self, req: ContainerRun) -> dict:
+        """POST /replicaSet (reference RunGpuContainer, replicaset.go:45-155)."""
+        name = req.replicaSetName
+        with self._mutex(name):
+            if self.versions.exist(name) or self.backend.list_names(name + "-"):
+                raise xerrors.ContainerExistedError(name)
+
+            spec = ContainerSpec(
+                image=req.imageName,
+                env=list(req.env),
+                cmd=list(req.cmd),
+                binds=[b.format() for b in req.binds if b.format()],
+            )
+            if req.memory:
+                spec.memory_bytes = to_bytes(req.memory)
+
+            try:
+                if req.tpuCount > 0:
+                    self._grant_tpus(spec, self.tpu.apply(req.tpuCount, name))
+                if req.cpuCount > 0:
+                    spec.cpuset = self.cpu.apply(req.cpuCount, name)
+                    spec.cpu_count = req.cpuCount
+                info = self._create_and_start(name, spec, req.containerPorts)
+            except Exception:
+                # resource rollback on any failure (reference :103-124);
+                # owner-checked so over-release is impossible
+                self.tpu.restore(spec.tpu_chips, name)
+                self.cpu.restore(spec.cpuset, name)
+                raise
+            return self._run_response(info)
+
+    def _grant_tpus(self, spec: ContainerSpec, grant: list[int]) -> None:
+        spec.tpu_chips = grant
+        spec.tpu_env = self.tpu.env_for(grant) if grant else {}
+        spec.devices = self.tpu.device_paths(grant)
+
+    def _create_and_start(self, name: str, spec: ContainerSpec,
+                          container_ports: list[str],
+                          start: bool = True) -> StoredContainerInfo:
+        """The runContainer core (reference replicaset_nomock.go:25-114):
+        version bump -> port grant -> create -> start -> persist."""
+        version = self.versions.bump(name)
+        ctr_name = f"{name}-{version}"
+        port_grant: list[int] = []
+        try:
+            if container_ports:
+                port_grant = self.ports.apply(len(container_ports), name)
+                spec.port_bindings = {
+                    cp: hp for cp, hp in zip(container_ports, port_grant)}
+            spec.env = [e for e in spec.env if not e.startswith("CONTAINER_VERSION=")]
+            spec.env.append(f"CONTAINER_VERSION={version}")
+            self.backend.create(ctr_name, spec)
+            if start:
+                self.backend.start(ctr_name)
+        except Exception:
+            self.ports.restore(port_grant, name)
+            self.versions.rollback_bump(name, version - 1)
+            raise
+
+        info = StoredContainerInfo(
+            version=version, createTime=_now(), containerName=ctr_name, spec=spec)
+        self._persist_latest(name, info)
+        return info
+
+    def _persist_latest(self, name: str, info: StoredContainerInfo,
+                        with_version_key: bool = True) -> None:
+        payload = info.serialize()
+        self._latest[name] = info
+        self.wq.submit(PutKeyValue(CONTAINERS, name, payload))
+        if with_version_key:
+            v = info.version
+            self.wq.submit(Call(
+                lambda: self.client.put_entity_version(CONTAINERS, name, v, payload),
+                describe=f"persist {CONTAINERS}/{name}@{v}"))
+
+    # ---------------------------------------------------------------- patch
+
+    def patch_container(self, name: str, req: PatchRequest) -> dict:
+        """PATCH /replicaSet/{name} (reference PatchContainer :267-363)."""
+        if req.empty:
+            raise xerrors.NoPatchRequiredError(name)
+        with self._mutex(name):
+            old = self._stored_info(name)
+            new_spec = ContainerSpec.from_json(old.spec.to_json())
+            changed = False
+            try:
+                if req.tpuPatch is not None:
+                    changed |= self._patch_tpu(name, new_spec, old,
+                                               req.tpuPatch.tpuCount)
+                if req.cpuPatch is not None:
+                    changed |= self._patch_cpu(name, new_spec, old,
+                                               req.cpuPatch.cpuCount)
+                if req.memoryPatch is not None:
+                    changed |= self._patch_memory(new_spec, req.memoryPatch.memory)
+                if req.volumePatch is not None:
+                    changed |= self._patch_volume(new_spec, req.volumePatch)
+                if not changed:
+                    raise xerrors.NoPatchRequiredError(name)
+                info = self._rolling_replace(name, old, new_spec)
+            except Exception:
+                self._free_new_grants(name, new_spec, old.spec)
+                raise
+            return self._run_response(info)
+
+    def _patch_tpu(self, name: str, spec: ContainerSpec,
+                   old: StoredContainerInfo, count: int) -> bool:
+        """Re-grant chips when the count changes (reference patchGpu
+        :448-495) — in place: the old grant is offered for reuse, never
+        released to the pool mid-patch."""
+        old_grant = list(old.spec.tpu_chips)
+        if count == len(old_grant):
+            return False
+        reuse = old_grant if not old.resourcesReleased else []
+        self._grant_tpus(spec, self.tpu.apply(count, name, reuse=reuse)
+                         if count > 0 else [])
+        return True
+
+    def _patch_cpu(self, name: str, spec: ContainerSpec,
+                   old: StoredContainerInfo, count: int) -> bool:
+        old_count = old.spec.cpu_count or (
+            len(old.spec.cpuset.split(",")) if old.spec.cpuset else 0)
+        if count == old_count:
+            return False
+        reuse = old.spec.cpuset if not old.resourcesReleased else ""
+        spec.cpuset = self.cpu.apply(count, name, reuse=reuse) if count > 0 else ""
+        spec.cpu_count = count
+        return True
+
+    def _patch_memory(self, spec: ContainerSpec, memory: str) -> bool:
+        new_bytes = to_bytes(memory)
+        if new_bytes == spec.memory_bytes:
+            return False
+        spec.memory_bytes = new_bytes
+        return True
+
+    def _patch_volume(self, spec: ContainerSpec, vp) -> bool:
+        if vp.oldBind is None or vp.newBind is None:
+            return False
+        old_s, new_s = vp.oldBind.format(), vp.newBind.format()
+        if not old_s or not new_s or old_s == new_s:
+            return False
+        if old_s not in spec.binds:
+            return False
+        spec.binds = [new_s if b == old_s else b for b in spec.binds]
+        return True
+
+    def _free_new_grants(self, name: str, new_spec: ContainerSpec,
+                         old_spec: ContainerSpec) -> None:
+        """Failed mutation: free only the grants that are NEW in new_spec.
+        The old container's grants were never released (in-place reuse), so
+        there is nothing to re-mark — and owner checks make this safe even
+        if this unwind itself races."""
+        new_tpu = sorted(set(new_spec.tpu_chips) - set(old_spec.tpu_chips))
+        self.tpu.restore(new_tpu, name)
+        old_cores = set(self.cpu._cores(old_spec.cpuset))
+        new_cores = set(self.cpu._cores(new_spec.cpuset)) - old_cores
+        self.cpu.restore(sorted(new_cores), name)
+
+    # ------------------------------------------------------- rolling replace
+
+    def _rolling_replace(self, name: str, old: StoredContainerInfo,
+                         new_spec: ContainerSpec) -> StoredContainerInfo:
+        """create new version -> stop old (chip exclusivity) -> copy writable
+        layer -> start new -> delete old (reference :318-353, reordered).
+
+        On success, resources held by the old version and not reused by the
+        new one are freed. On failure, the world is restored: new container
+        removed, new-only grants freed by the caller, version counter and
+        latest pointer reverted, old container restarted.
+        """
+        old_holds = not old.resourcesReleased
+        old_ports = list(old.spec.port_bindings.values())
+        container_ports = list(new_spec.port_bindings.keys())
+        new_spec.port_bindings = {}
+        info = self._create_and_start(name, new_spec, container_ports, start=False)
+        old_state = self.backend.inspect(old.containerName)
+        try:
+            if old_state.exists and (old_state.running or old_state.paused):
+                self.backend.stop(old.containerName)
+            self._copy_layer(old.containerName, info.containerName)
+            self.backend.start(info.containerName)
+        except Exception:
+            # failed mid-replace: remove the new container, revert latest
+            # pointer + version counter + per-version key, restart the old
+            try:
+                self.backend.remove(info.containerName, force=True)
+            except Exception:  # noqa: BLE001
+                log.exception("cleanup: removing failed new container")
+            self.ports.restore(list(info.spec.port_bindings.values()), name)
+            self.versions.rollback_bump(name, old.version)
+            self._persist_latest(name, old, with_version_key=False)
+            v = info.version
+            self.wq.submit(Call(
+                lambda: self.client.delete_entity_version(CONTAINERS, name, v),
+                describe=f"drop {CONTAINERS}/{name}@{v}"))
+            if old_state.exists and old_state.running:
+                try:
+                    self.backend.start(old.containerName)
+                except Exception:  # noqa: BLE001
+                    log.exception("cleanup: restarting old container")
+            raise
+        self._record_merge(name, info.containerName)
+        # delete-old-for-update (reference :660-679): drop it, free the old
+        # version's resources that the new version did not take over — only
+        # if the old version still held them (not already released by stop)
+        try:
+            self.backend.remove(old.containerName, force=True)
+        except Exception:  # noqa: BLE001
+            log.exception("removing replaced container %s", old.containerName)
+        if old_holds:
+            stale_tpu = sorted(set(old.spec.tpu_chips) - set(new_spec.tpu_chips))
+            self.tpu.restore(stale_tpu, name)
+            stale_cores = sorted(set(self.cpu._cores(old.spec.cpuset)) -
+                                 set(self.cpu._cores(new_spec.cpuset)))
+            self.cpu.restore(stale_cores, name)
+            self.ports.restore(old_ports, name)
+        return info
+
+    def _copy_layer(self, old_name: str, new_name: str) -> None:
+        """Carry the writable layer forward (reference
+        CopyOldMergedToNewContainerMerged, utils/copy.go:31-46)."""
+        old_state = self.backend.inspect(old_name)
+        new_state = self.backend.inspect(new_name)
+        if old_state.upper_dir and new_state.upper_dir:
+            copy_dir(old_state.upper_dir, new_state.upper_dir)
+
+    def _record_merge(self, name: str, ctr_name: str) -> None:
+        """Track the merged-layer path per version (reference setToMergeMap,
+        replicaset.go:681-704)."""
+        state = self.backend.inspect(ctr_name)
+        if state.upper_dir:
+            self.merges.set(ctr_name, state.upper_dir)
+
+    # ------------------------------------------------------------- rollback
+
+    def rollback_container(self, name: str, version: int) -> dict:
+        """PATCH /replicaSet/{name}/rollback (reference :365-446): forward-
+        write a new version with the historical config."""
+        with self._mutex(name):
+            current = self.versions.get(name)
+            if current is None:
+                raise xerrors.NotExistInStoreError(name)
+            if current == version:
+                raise xerrors.NoRollbackRequiredError(name)
+            self.wq.join()  # per-version keys are write-behind; drain first
+            hist = StoredContainerInfo.deserialize(
+                self.client.get_entity_version(CONTAINERS, name, version))
+            old = self._stored_info(name)
+            target_spec = ContainerSpec.from_json(hist.spec.to_json())
+            # resource identities are NOT part of history — keep the grants
+            # the replicaSet holds NOW, re-granting (with in-place reuse)
+            # only where the historical COUNT differs
+            target_spec.tpu_chips = old.spec.tpu_chips
+            target_spec.tpu_env = old.spec.tpu_env
+            target_spec.devices = old.spec.devices
+            target_spec.cpuset = old.spec.cpuset
+            target_spec.cpu_count = old.spec.cpu_count
+            try:
+                self._patch_tpu(name, target_spec, old, len(hist.spec.tpu_chips))
+                self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
+                info = self._rolling_replace(name, old, target_spec)
+            except Exception:
+                self._free_new_grants(name, target_spec, old.spec)
+                raise
+            return self._run_response(info)
+
+    # ---------------------------------------------------- stop / restart etc
+
+    def stop_container(self, name: str) -> None:
+        """PATCH /replicaSet/{name}/stop (reference :582-639): resources are
+        released; container stays stopped. Idempotent: the release is
+        recorded, so a second stop cannot double-free (reference bug —
+        replicaset.go:630-635 Restores again on its error path)."""
+        with self._mutex(name):
+            info = self._stored_info(name)
+            self.backend.stop(info.containerName)
+            if info.resourcesReleased:
+                return
+            spec = info.spec
+            self.tpu.restore(spec.tpu_chips, name)
+            self.cpu.restore(spec.cpuset, name)
+            self.ports.restore(list(spec.port_bindings.values()), name)
+            info.resourcesReleased = True
+            self._persist_latest(name, info, with_version_key=False)
+
+    def restart_container(self, name: str) -> dict:
+        """PATCH /replicaSet/{name}/restart (reference :736-864): a restart
+        is a NEW VERSION with freshly applied resources, not docker restart."""
+        with self._mutex(name):
+            old = self._stored_info(name)
+            new_spec = ContainerSpec.from_json(old.spec.to_json())
+            fresh_tpu: list[int] = []
+            fresh_cpu = ""
+            try:
+                if old.resourcesReleased:
+                    # stopped: grants were returned at stop; re-apply counts
+                    if old.spec.tpu_chips:
+                        fresh_tpu = self.tpu.apply(len(old.spec.tpu_chips), name)
+                        self._grant_tpus(new_spec, fresh_tpu)
+                    if old.spec.cpu_count:
+                        fresh_cpu = self.cpu.apply(old.spec.cpu_count, name)
+                        new_spec.cpuset = fresh_cpu
+                # running: keep the identical grant — same host, same ICI
+                # region, nothing to move (reference Restore-then-Apply
+                # churn, :783-808, buys nothing on a single host)
+                info = self._rolling_replace(name, old, new_spec)
+            except Exception:
+                # free only what THIS restart freshly applied
+                self.tpu.restore(fresh_tpu, name)
+                self.cpu.restore(fresh_cpu, name)
+                raise
+            return self._run_response(info)
+
+    def pause_container(self, name: str) -> None:
+        info = self._stored_info(name)
+        self.backend.pause(info.containerName)
+
+    def startup_container(self, name: str) -> None:
+        """PATCH /replicaSet/{name}/continue (reference StartupContainer
+        :717-732 — `docker restart`, pause's dual)."""
+        info = self._stored_info(name)
+        self.backend.restart_inplace(info.containerName)
+
+    # -------------------------------------------------- exec / commit / info
+
+    def execute_container(self, name: str, cmd: list[str],
+                          workdir: str = "") -> str:
+        """POST /replicaSet/{name}/execute (reference :225-265)."""
+        info = self._stored_info(name)
+        code, output = self.backend.execute(info.containerName, cmd, workdir)
+        if code != 0:
+            raise RuntimeError(f"exec exit {code}: {output.strip()}")
+        return output
+
+    def commit_container(self, name: str, new_image: str) -> str:
+        info = self._stored_info(name)
+        return self.backend.commit(info.containerName, new_image)
+
+    def get_container_info(self, name: str) -> dict:
+        info = self._stored_info(name)
+        state = self.backend.inspect(info.containerName)
+        return {
+            "version": info.version,
+            "createTime": info.createTime,
+            "containerName": info.containerName,
+            "running": state.running,
+            "paused": state.paused,
+            "resourcesReleased": info.resourcesReleased,
+            "spec": info.spec.to_json(),
+        }
+
+    def get_container_history(self, name: str) -> list[dict]:
+        """Reference GetContainerHistory (:908) — newest first."""
+        self.wq.join()  # history reads the store; drain write-behind first
+        versions = self.client.entity_versions(CONTAINERS, name)
+        if not versions:
+            raise xerrors.NotExistInStoreError(name)
+        out = []
+        for v, payload in reversed(versions):
+            info = StoredContainerInfo.deserialize(payload)
+            out.append(HistoryItem(v, info.createTime, info).to_json())
+        return out
+
+    # --------------------------------------------------------------- delete
+
+    def delete_container(self, name: str) -> None:
+        """DELETE /replicaSet/{name} (reference :157-223): remove container,
+        release resources, drop ALL state + history. Resources are released
+        whenever this replicaSet still holds them — including containers
+        that exited on their own (the reference leaks those; its release is
+        keyed on running-state, not grant-state)."""
+        with self._mutex(name):
+            try:
+                info = self._stored_info(name)
+            except xerrors.NotExistInStoreError:
+                info = None
+            if info is not None:
+                state = self.backend.inspect(info.containerName)
+                if state.exists:
+                    self.backend.remove(info.containerName, force=True)
+                if not info.resourcesReleased:
+                    spec = info.spec
+                    self.tpu.restore(spec.tpu_chips, name)
+                    self.cpu.restore(spec.cpuset, name)
+                    self.ports.restore(list(spec.port_bindings.values()), name)
+            self._latest.pop(name, None)
+            self.versions.remove(name)
+            self.merges.remove_replicaset(name)
+            self.wq.join()  # drain queued writes before deleting the keys
+            self.client.delete(CONTAINERS, name)
+            self.client.delete_entity_versions(CONTAINERS, name)
+
+    # -------------------------------------------------------------- helpers
+
+    def _stored_info(self, name: str) -> StoredContainerInfo:
+        cached = self._latest.get(name)
+        if cached is not None:
+            return cached
+        info = StoredContainerInfo.deserialize(self.client.get_value(CONTAINERS, name))
+        self._latest[name] = info
+        return info
+
+    @staticmethod
+    def _run_response(info: StoredContainerInfo) -> dict:
+        return {
+            "name": info.containerName,
+            "version": info.version,
+            "tpuChips": info.spec.tpu_chips,
+            "cpuset": info.spec.cpuset,
+            "portBindings": info.spec.port_bindings,
+        }

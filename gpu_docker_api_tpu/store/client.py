@@ -112,6 +112,10 @@ class StateClient:
             out.append((int(kv.key[len(prefix):]), kv.value))
         return out
 
+    def delete_entity_version(self, resource: str, name: str, version: int) -> bool:
+        return self.store.delete(
+            f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/{version:012d}")
+
     def delete_entity_versions(self, resource: str, name: str) -> int:
         prefix = f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/{resource}/{name}/"
         n = 0

@@ -1,0 +1,245 @@
+"""REST API integration tests: full stack over HTTP against the mock backend
+(the reference's only test story was manual API testing against `-tags mock`;
+this automates it — SURVEY §4)."""
+
+import http.client
+import json
+
+import pytest
+
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.topology import make_topology
+
+
+@pytest.fixture()
+def app(tmp_path):
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43000, 43100),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16)
+    a.start()
+    yield a
+    a.stop()
+
+
+def call(app, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port, timeout=10)
+    payload = json.dumps(body) if body is not None else None
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request(method, path, payload, hdrs)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, json.loads(raw) if raw else None
+
+
+def test_ping(app):
+    status, body = call(app, "GET", "/ping")
+    assert status == 200
+    assert body["code"] == 200
+    assert body["data"]["status"] == "pong"
+
+
+def test_run_patch_rollback_flow(app):
+    # run with 1 chip
+    status, body = call(app, "POST", "/api/v1/replicaSet", {
+        "imageName": "ubuntu:22.04", "replicaSetName": "train",
+        "tpuCount": 1, "cpuCount": 2, "memory": "8GB",
+        "containerPorts": ["8888"]})
+    assert body["code"] == 200, body
+    assert body["data"]["name"] == "train-1"
+    assert len(body["data"]["tpuChips"]) == 1
+
+    # patch 1 -> 4 chips (BASELINE config 3 control-plane path)
+    _, body = call(app, "PATCH", "/api/v1/replicaSet/train",
+                   {"tpuPatch": {"tpuCount": 4}})
+    assert body["code"] == 200, body
+    assert body["data"]["name"] == "train-2"
+    assert len(body["data"]["tpuChips"]) == 4
+
+    # history shows both versions
+    _, body = call(app, "GET", "/api/v1/replicaSet/train/history")
+    assert [h["version"] for h in body["data"]["history"]] == [2, 1]
+
+    # rollback to v1 = forward-write v3
+    _, body = call(app, "PATCH", "/api/v1/replicaSet/train/rollback", {"version": 1})
+    assert body["code"] == 200, body
+    assert body["data"]["version"] == 3
+    assert len(body["data"]["tpuChips"]) == 1
+
+    # info reflects v3
+    _, body = call(app, "GET", "/api/v1/replicaSet/train")
+    assert body["data"]["info"]["version"] == 3
+    assert body["data"]["info"]["running"] is True
+
+
+def test_validation_codes(app):
+    cases = [
+        ({"replicaSetName": "x"}, 1001),                       # no image
+        ({"imageName": "img"}, 1002),                          # no name
+        ({"imageName": "img", "replicaSetName": "a-b"}, 1003), # dash
+        ({"imageName": "img", "replicaSetName": "x", "tpuCount": -1}, 1012),
+        ({"imageName": "img", "replicaSetName": "x", "cpuCount": -1}, 1024),
+        ({"imageName": "img", "replicaSetName": "x", "memory": "8XB"}, 1025),
+    ]
+    for body, code in cases:
+        _, resp = call(app, "POST", "/api/v1/replicaSet", body)
+        assert resp["code"] == code, (body, resp)
+
+
+def test_run_duplicate_and_shortage_codes(app):
+    call(app, "POST", "/api/v1/replicaSet",
+         {"imageName": "i", "replicaSetName": "dup"})
+    _, resp = call(app, "POST", "/api/v1/replicaSet",
+                   {"imageName": "i", "replicaSetName": "dup"})
+    assert resp["code"] == 1008
+    _, resp = call(app, "POST", "/api/v1/replicaSet",
+                   {"imageName": "i", "replicaSetName": "big", "tpuCount": 99})
+    assert resp["code"] == 1013
+
+
+def test_gpu_count_alias(app):
+    # reference clients send gpuCount; accepted as tpuCount
+    _, resp = call(app, "POST", "/api/v1/replicaSet",
+                   {"imageName": "i", "replicaSetName": "legacy", "gpuCount": 2})
+    assert resp["code"] == 200
+    assert len(resp["data"]["tpuChips"]) == 2
+
+
+def test_lifecycle_endpoints(app):
+    call(app, "POST", "/api/v1/replicaSet",
+         {"imageName": "i", "replicaSetName": "lc", "tpuCount": 2})
+    _, resp = call(app, "PATCH", "/api/v1/replicaSet/lc/pause")
+    assert resp["code"] == 200
+    _, resp = call(app, "PATCH", "/api/v1/replicaSet/lc/continue")
+    assert resp["code"] == 200
+    _, resp = call(app, "PATCH", "/api/v1/replicaSet/lc/stop")
+    assert resp["code"] == 200
+    _, resp = call(app, "GET", "/api/v1/resources/tpus")
+    assert resp["data"]["tpus"]["freeCount"] == 16  # released
+    _, resp = call(app, "PATCH", "/api/v1/replicaSet/lc/restart")
+    assert resp["code"] == 200
+    assert resp["data"]["name"] == "lc-2"
+    _, resp = call(app, "DELETE", "/api/v1/replicaSet/lc")
+    assert resp["code"] == 200
+    _, resp = call(app, "GET", "/api/v1/replicaSet/lc")
+    assert resp["code"] == 1016
+
+
+def test_execute_and_commit_endpoints(app):
+    call(app, "POST", "/api/v1/replicaSet",
+         {"imageName": "i", "replicaSetName": "ex"})
+    _, resp = call(app, "POST", "/api/v1/replicaSet/ex/execute",
+                   {"cmd": ["echo", "hello"]})
+    assert resp["code"] == 200
+    assert "echo hello" in resp["data"]["output"]
+    _, resp = call(app, "POST", "/api/v1/replicaSet/ex/commit",
+                   {"newImageName": "snap:v1"})
+    assert resp["code"] == 200
+    assert resp["data"]["imageId"].startswith("sha256:")
+
+
+def test_volume_endpoints(app):
+    _, resp = call(app, "POST", "/api/v1/volumes", {"name": "vol", "size": "1GB"})
+    assert resp["code"] == 200
+    assert resp["data"]["name"] == "vol-1"
+    _, resp = call(app, "POST", "/api/v1/volumes", {"name": "bad-name", "size": "1GB"})
+    assert resp["code"] == 1108
+    _, resp = call(app, "POST", "/api/v1/volumes", {"name": "/abs", "size": "1GB"})
+    assert resp["code"] == 1109
+    _, resp = call(app, "POST", "/api/v1/volumes", {"name": "vol2", "size": "9QB"})
+    assert resp["code"] == 1106
+    _, resp = call(app, "PATCH", "/api/v1/volumes/vol/size", {"size": "2GB"})
+    assert resp["code"] == 200
+    assert resp["data"]["name"] == "vol-2"
+    _, resp = call(app, "PATCH", "/api/v1/volumes/vol/size", {"size": "2GB"})
+    assert resp["code"] == 1105
+    _, resp = call(app, "GET", "/api/v1/volumes/vol")
+    assert resp["data"]["info"]["volumeName"] == "vol-2"
+    _, resp = call(app, "GET", "/api/v1/volumes/vol/history")
+    assert [h["version"] for h in resp["data"]["history"]] == [2, 1]
+    _, resp = call(app, "DELETE", "/api/v1/volumes/vol")
+    assert resp["code"] == 200
+    _, resp = call(app, "GET", "/api/v1/volumes/vol")
+    assert resp["code"] == 1110
+
+
+def test_resources_endpoints(app):
+    _, resp = call(app, "GET", "/api/v1/resources/tpus")
+    tpus = resp["data"]["tpus"]
+    assert tpus["topology"]["acceleratorType"] == "v4-32"
+    assert len(tpus["chips"]) == 16
+    _, resp = call(app, "GET", "/api/v1/resources/gpus")  # legacy alias
+    assert resp["data"]["tpus"]["freeCount"] == 16
+    _, resp = call(app, "GET", "/api/v1/resources/cpus")
+    assert resp["data"]["cpus"]["totalCount"] > 0
+    _, resp = call(app, "GET", "/api/v1/resources/ports")
+    assert resp["data"]["ports"]["range"] == [43000, 43100]
+
+
+def test_unknown_route_404(app):
+    status, body = call(app, "GET", "/api/v1/nope")
+    assert status == 404
+
+
+def test_invalid_json_body(app):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port, timeout=10)
+    conn.request("POST", "/api/v1/replicaSet", "{not json",
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert body["code"] == 1000
+
+
+def test_cors_preflight(app):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port, timeout=10)
+    conn.request("OPTIONS", "/api/v1/replicaSet", headers={"Origin": "http://x"})
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.status == 204
+    assert resp.getheader("Access-Control-Allow-Origin") == "http://x"
+    conn.close()
+
+
+def test_auth_when_key_set(tmp_path):
+    a = App(state_dir=str(tmp_path / "s2"), backend="mock", addr="127.0.0.1:0",
+            topology=make_topology("v5p-8"), api_key="secret")
+    a.start()
+    try:
+        _, resp = call(a, "GET", "/api/v1/resources/tpus")
+        assert resp["code"] == 403
+        _, resp = call(a, "GET", "/api/v1/resources/tpus",
+                       headers={"Authorization": "Bearer secret"})
+        assert resp["code"] == 200
+    finally:
+        a.stop()
+
+
+def test_crash_resume(tmp_path):
+    """Reference §3.4: state recovery = read store, else probe. Kill the app,
+    boot a new one on the same state dir, everything survives."""
+    state = str(tmp_path / "s3")
+    a = App(state_dir=state, backend="mock", addr="127.0.0.1:0",
+            topology=make_topology("v4-32"), api_key="")
+    a.start()
+    call(a, "POST", "/api/v1/replicaSet",
+         {"imageName": "i", "replicaSetName": "persist", "tpuCount": 4})
+    call(a, "PATCH", "/api/v1/replicaSet/persist", {"tpuPatch": {"tpuCount": 2}})
+    a.stop()
+
+    b = App(state_dir=state, backend="mock", addr="127.0.0.1:0", api_key="")
+    b.start()
+    try:
+        _, resp = call(b, "GET", "/api/v1/resources/tpus")
+        st = resp["data"]["tpus"]
+        assert st["topology"]["acceleratorType"] == "v4-32"  # from store
+        assert st["freeCount"] == 14                         # 2 chips still held
+        _, resp = call(b, "GET", "/api/v1/replicaSet/persist/history")
+        assert [h["version"] for h in resp["data"]["history"]] == [2, 1]
+        # version counter continues: next mutation is v3
+        _, resp = call(b, "PATCH", "/api/v1/replicaSet/persist",
+                       {"memoryPatch": {"memory": "1GB"}})
+        assert resp["data"]["version"] == 3
+    finally:
+        b.stop()

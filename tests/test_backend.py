@@ -1,0 +1,160 @@
+"""Backend seam tests: mock and process substrates through the Backend ABC."""
+
+import os
+import time
+
+import pytest
+
+from gpu_docker_api_tpu.backend import MockBackend, ProcessBackend, make_backend
+from gpu_docker_api_tpu.dtos import ContainerSpec
+
+
+@pytest.fixture(params=["mock", "process"])
+def backend(request, tmp_path):
+    b = make_backend(request.param, str(tmp_path / "state"))
+    yield b
+    b.close()
+
+
+def _spec(**kw):
+    d = dict(image="", cmd=["sleep", "30"], env=["FOO=bar"])
+    d.update(kw)
+    return ContainerSpec(**d)
+
+
+def test_create_start_inspect_stop(backend):
+    backend.create("rs-1", _spec())
+    st = backend.inspect("rs-1")
+    assert st.exists and not st.running
+    backend.start("rs-1")
+    st = backend.inspect("rs-1")
+    assert st.running
+    assert st.upper_dir and os.path.isdir(st.upper_dir)
+    backend.stop("rs-1")
+    assert not backend.inspect("rs-1").running
+    backend.remove("rs-1")
+    assert not backend.inspect("rs-1").exists
+
+
+def test_duplicate_create_rejected(backend):
+    backend.create("rs-1", _spec())
+    with pytest.raises(RuntimeError):
+        backend.create("rs-1", _spec())
+
+
+def test_list_names_prefix(backend):
+    backend.create("foo-1", _spec())
+    backend.create("foo-2", _spec())
+    backend.create("bar-1", _spec())
+    assert backend.list_names("foo-") == ["foo-1", "foo-2"]
+
+
+def test_remove_running_requires_force(backend):
+    backend.create("rs-1", _spec())
+    backend.start("rs-1")
+    with pytest.raises(RuntimeError):
+        backend.remove("rs-1", force=False)
+    backend.remove("rs-1", force=True)
+    assert not backend.inspect("rs-1").exists
+
+
+def test_volumes(backend):
+    v = backend.volume_create("vol", size_bytes=1024 ** 2)
+    assert v.exists and os.path.isdir(v.mountpoint)
+    with open(os.path.join(v.mountpoint, "data.bin"), "wb") as f:
+        f.write(b"z" * 2048)
+    got = backend.volume_inspect("vol")
+    assert got.used_bytes == 2048
+    with pytest.raises(RuntimeError):
+        backend.volume_create("vol")
+    backend.volume_remove("vol")
+    assert not backend.volume_inspect("vol").exists
+
+
+# ---- process-backend-specific behavior ----
+
+def test_process_exec_real_output(tmp_path):
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(env=["GREETING=hello"]))
+    b.start("rs-1")
+    code, out = b.execute("rs-1", ["sh", "-c", "echo $GREETING world"])
+    assert code == 0
+    assert "hello world" in out
+    b.close()
+
+
+def test_process_tpu_env_injection(tmp_path):
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(tpu_env={"TPU_VISIBLE_CHIPS": "0,1"}))
+    b.start("rs-1")
+    code, out = b.execute("rs-1", ["sh", "-c", "echo chips=$TPU_VISIBLE_CHIPS"])
+    assert "chips=0,1" in out
+    b.close()
+
+
+def test_process_pause_continue(tmp_path):
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(cmd=["sleep", "30"]))
+    b.start("rs-1")
+    b.pause("rs-1")
+    assert b.inspect("rs-1").paused
+    b.restart_inplace("rs-1")
+    st = b.inspect("rs-1")
+    assert st.running and not st.paused
+    b.close()
+
+
+def test_process_binds_symlinked(tmp_path):
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "weights.bin").write_bytes(b"W" * 10)
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(binds=[f"{data}:/root/foo-tmp"]))
+    b.start("rs-1")
+    code, out = b.execute("rs-1", ["sh", "-c", "cat $CONTAINER_ROOT/root/foo-tmp/weights.bin"])
+    assert code == 0 and "WWWWWWWWWW" in out
+    b.close()
+
+
+def test_process_commit_and_seed(tmp_path):
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec())
+    b.start("rs-1")
+    b.execute("rs-1", ["sh", "-c", "echo state > $CONTAINER_ROOT/file.txt"])
+    b.commit("rs-1", "myimage:v1")
+    b.create("rs-2", _spec(image="myimage:v1", cmd=["sleep", "30"]))
+    b.start("rs-2")
+    code, out = b.execute("rs-2", ["sh", "-c", "cat $CONTAINER_ROOT/file.txt"])
+    assert code == 0 and "state" in out
+    b.close()
+
+
+def test_process_stop_terminates(tmp_path):
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(cmd=["sleep", "300"]))
+    b.start("rs-1")
+    pid = b.inspect("rs-1").pid
+    assert pid is not None
+    t0 = time.time()
+    b.stop("rs-1", timeout=5)
+    assert time.time() - t0 < 5
+    st = b.inspect("rs-1")
+    assert not st.running and st.exit_code is not None
+    b.close()
+
+
+def test_mock_exec_canned(tmp_path):
+    b = MockBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec())
+    code, out = b.execute("rs-1", ["echo", "hi"])
+    assert code == 1  # not running
+    b.start("rs-1")
+    code, out = b.execute("rs-1", ["echo", "hi"])
+    assert code == 0 and "echo hi" in out
+
+
+def test_docker_demux_frames():
+    from gpu_docker_api_tpu.backend.docker import _demux_stream
+    frame = b"\x01\x00\x00\x00\x00\x00\x00\x05hello" + b"\x02\x00\x00\x00\x00\x00\x00\x06 world"
+    assert _demux_stream(frame) == "hello world"
+    assert _demux_stream(b"plain tty output") == "plain tty output"

@@ -1,0 +1,307 @@
+"""ReplicaSet + Volume service state-machine tests over the mock backend."""
+
+import os
+
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import (
+    Bind, ContainerRun, MemoryPatch, PatchRequest, TpuPatch, VolumePatch,
+)
+from gpu_docker_api_tpu.schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from gpu_docker_api_tpu.services import ReplicaSetService, VolumeService
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import WorkQueue
+
+
+@pytest.fixture()
+def world(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    backend = MockBackend(str(tmp_path / "state"))
+    tpu = TpuScheduler(client, wq, topology=make_topology("v4-32"))
+    cpu = CpuScheduler(client, wq, core_count=16)
+    ports = PortScheduler(client, wq, port_range=(42000, 42100), seed=11)
+    rs = ReplicaSetService(backend, client, wq, tpu, cpu, ports,
+                           VersionMap("containerVersionMap", client, wq),
+                           MergeMap(client, wq))
+    vol = VolumeService(backend, client, wq,
+                        VersionMap("volumeVersionMap", client, wq))
+    yield rs, vol, backend, tpu, cpu, ports, wq, client
+    wq.close()
+
+
+def _run(rs, name="demo", tpus=2, cpus=2, ports=1, **kw):
+    return rs.run_container(ContainerRun(
+        imageName="ubuntu:22.04", replicaSetName=name, tpuCount=tpus,
+        cpuCount=cpus, memory="8GB",
+        containerPorts=["8888"] if ports else [], **kw))
+
+
+# ------------------------------------------------------------------- run
+
+def test_run_container(world):
+    rs, _, backend, tpu, cpu, ports, wq, client = world
+    resp = _run(rs)
+    assert resp["name"] == "demo-1"
+    assert len(resp["tpuChips"]) == 2
+    assert resp["cpuset"] == "0,1"
+    assert "8888" in resp["portBindings"]
+    st = backend.inspect("demo-1")
+    assert st.running
+    assert st.spec.tpu_env["TPU_VISIBLE_CHIPS"]
+    assert any(e == "CONTAINER_VERSION=1" for e in st.spec.env)
+    wq.join()
+    assert client.get("containers", "demo") is not None
+
+
+def test_run_duplicate_rejected(world):
+    rs = world[0]
+    _run(rs)
+    with pytest.raises(xerrors.ContainerExistedError):
+        _run(rs)
+
+
+def test_run_resource_rollback_on_shortage(world):
+    rs, _, _, tpu, cpu, ports, _, _ = world
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        _run(rs, name="big", tpus=64)
+    # nothing leaked
+    assert tpu.get_status()["freeCount"] == 16
+    assert cpu.get_status()["usedCount"] == 0
+
+
+def test_run_zero_tpu_smoke(world):
+    # BASELINE config 1: 0-chip container
+    rs, _, backend, tpu, *_ = world
+    resp = _run(rs, name="smoke", tpus=0, cpus=0, ports=0)
+    assert resp["tpuChips"] == []
+    assert tpu.get_status()["freeCount"] == 16
+    assert backend.inspect("smoke-1").running
+
+
+# ----------------------------------------------------------------- patch
+
+def test_patch_tpu_1_to_4(world):
+    # BASELINE config 3: patch 1 -> 4 chips, rolling replacement
+    rs, _, backend, tpu, *_ = world
+    _run(rs, name="train", tpus=1)
+    resp = rs.patch_container("train", PatchRequest(tpuPatch=TpuPatch(4)))
+    assert resp["name"] == "train-2"
+    assert len(resp["tpuChips"]) == 4
+    assert tpu.topology.is_connected(resp["tpuChips"])
+    assert not backend.inspect("train-1").exists       # old deleted
+    assert backend.inspect("train-2").running
+    assert tpu.get_status()["freeCount"] == 12
+
+
+def test_patch_copies_writable_layer(world):
+    rs, _, backend, *_ = world
+    _run(rs, name="data")
+    # simulate workload state in the old container's writable layer
+    upper = backend.inspect("data-1").upper_dir
+    with open(os.path.join(upper, "ckpt.bin"), "w") as f:
+        f.write("step-42")
+    rs.patch_container("data", PatchRequest(memoryPatch=MemoryPatch("16GB")))
+    new_upper = backend.inspect("data-2").upper_dir
+    with open(os.path.join(new_upper, "ckpt.bin")) as f:
+        assert f.read() == "step-42"
+
+
+def test_patch_no_change_raises(world):
+    rs = world[0]
+    _run(rs, tpus=2)
+    with pytest.raises(xerrors.NoPatchRequiredError):
+        rs.patch_container("demo", PatchRequest())
+    with pytest.raises(xerrors.NoPatchRequiredError):
+        rs.patch_container("demo", PatchRequest(tpuPatch=TpuPatch(2)))
+    with pytest.raises(xerrors.NoPatchRequiredError):
+        rs.patch_container("demo", PatchRequest(memoryPatch=MemoryPatch("8GB")))
+
+
+def test_patch_shortage_keeps_old_running(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, name="a", tpus=2)
+    _run(rs, name="b", tpus=12)
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        rs.patch_container("a", PatchRequest(tpuPatch=TpuPatch(8)))
+    # old container untouched, resources re-marked
+    assert backend.inspect("a-1").running
+    assert tpu.get_status()["freeCount"] == 2
+
+
+def test_patch_ports_regranted(world):
+    rs, _, backend, _, _, ports, _, _ = world
+    r1 = _run(rs, name="p")
+    old_port = r1["portBindings"]["8888"]
+    r2 = rs.patch_container("p", PatchRequest(memoryPatch=MemoryPatch("1GB")))
+    assert "8888" in r2["portBindings"]
+    st = ports.get_status()
+    assert old_port not in st["usedPortSet"]  # old port released
+    assert r2["portBindings"]["8888"] in st["usedPortSet"]
+
+
+def test_patch_volume_bind_swap(world):
+    rs, vol, backend, *_ = world
+    v1 = vol.create_volume("data", "1GB")
+    v2 = vol.create_volume("bigdata", "2GB")
+    rs.run_container(ContainerRun(
+        imageName="x", replicaSetName="j",
+        binds=[Bind(v1["name"], "/root/foo-tmp")]))
+    rs.patch_container("j", PatchRequest(volumePatch=VolumePatch(
+        oldBind=Bind(v1["name"], "/root/foo-tmp"),
+        newBind=Bind(v2["name"], "/root/foo-tmp"))))
+    st = backend.inspect("j-2")
+    assert st.spec.binds == [f"{v2['name']}:/root/foo-tmp"]
+
+
+# -------------------------------------------------------------- rollback
+
+def test_rollback_forward_writes(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, name="r", tpus=1)
+    rs.patch_container("r", PatchRequest(tpuPatch=TpuPatch(4)))
+    resp = rs.rollback_container("r", 1)
+    assert resp["version"] == 3            # append-only history
+    assert len(resp["tpuChips"]) == 1      # back to v1 shape
+    assert tpu.get_status()["freeCount"] == 15
+    hist = rs.get_container_history("r")
+    assert [h["version"] for h in hist] == [3, 2, 1]
+
+
+def test_rollback_same_version_rejected(world):
+    rs = world[0]
+    _run(rs, name="r")
+    with pytest.raises(xerrors.NoRollbackRequiredError):
+        rs.rollback_container("r", 1)
+
+
+def test_rollback_missing_version(world):
+    rs = world[0]
+    _run(rs, name="r")
+    rs.patch_container("r", PatchRequest(memoryPatch=MemoryPatch("1GB")))
+    with pytest.raises(xerrors.VersionNotFoundError):
+        rs.rollback_container("r", 99)
+
+
+# ------------------------------------------- stop / restart / pause / exec
+
+def test_stop_releases_resources(world):
+    rs, _, backend, tpu, cpu, ports, _, _ = world
+    _run(rs, name="s", tpus=4, cpus=4)
+    rs.stop_container("s")
+    assert not backend.inspect("s-1").running
+    assert tpu.get_status()["freeCount"] == 16
+    assert cpu.get_status()["usedCount"] == 0
+    assert ports.get_status()["usedPortSet"] == []
+
+
+def test_restart_stopped_is_new_version(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, name="s", tpus=2)
+    rs.stop_container("s")
+    resp = rs.restart_container("s")
+    assert resp["name"] == "s-2"
+    assert len(resp["tpuChips"]) == 2
+    assert backend.inspect("s-2").running
+    assert not backend.inspect("s-1").exists
+    assert tpu.get_status()["freeCount"] == 14
+
+
+def test_restart_running_keeps_grant(world):
+    rs, _, backend, tpu, *_ = world
+    r1 = _run(rs, name="s", tpus=2)
+    resp = rs.restart_container("s")
+    assert resp["tpuChips"] == r1["tpuChips"]  # identical ICI region
+    assert backend.inspect("s-2").running
+
+
+def test_pause_continue(world):
+    rs, _, backend, *_ = world
+    _run(rs, name="pz")
+    rs.pause_container("pz")
+    assert backend.inspect("pz-1").paused
+    rs.startup_container("pz")
+    st = backend.inspect("pz-1")
+    assert st.running and not st.paused
+
+
+def test_execute_and_commit(world):
+    rs, _, backend, *_ = world
+    _run(rs, name="e")
+    out = rs.execute_container("e", ["echo", "hello"])
+    assert "echo hello" in out
+    img = rs.commit_container("e", "snap:v1")
+    assert img.startswith("sha256:")
+
+
+# ---------------------------------------------------------------- delete
+
+def test_delete_clears_everything(world):
+    rs, _, backend, tpu, _, _, wq, client = world
+    _run(rs, name="d", tpus=2)
+    rs.delete_container("d")
+    assert not backend.inspect("d-1").exists
+    assert tpu.get_status()["freeCount"] == 16
+    with pytest.raises(xerrors.NotExistInStoreError):
+        rs.get_container_info("d")
+    with pytest.raises(xerrors.NotExistInStoreError):
+        rs.get_container_history("d")
+    # name is reusable and restarts at version 1
+    resp = _run(rs, name="d")
+    assert resp["name"] == "d-1"
+
+
+# ---------------------------------------------------------------- volumes
+
+def test_volume_create_patch_grow(world):
+    _, vol, backend, *_ = world
+    v = vol.create_volume("vol", "1GB")
+    assert v["name"] == "vol-1"
+    mp = v["mountpoint"]
+    with open(os.path.join(mp, "data.bin"), "wb") as f:
+        f.write(b"d" * 4096)
+    out = vol.patch_volume_size("vol", "2GB")
+    assert out["name"] == "vol-2"
+    # data migrated
+    with open(os.path.join(out["mountpoint"], "data.bin"), "rb") as f:
+        assert len(f.read()) == 4096
+    info = vol.get_volume_info("vol")
+    assert info["volumeName"] == "vol-2" and info["size"] == "2GB"
+    hist = vol.get_volume_history("vol")
+    assert [h["version"] for h in hist] == [2, 1]
+
+
+def test_volume_shrink_guard(world):
+    _, vol, *_ = world
+    v = vol.create_volume("vol", "1GB")
+    with open(os.path.join(v["mountpoint"], "big.bin"), "wb") as f:
+        f.write(b"x" * (2 * 1024))  # 2KB used
+    with pytest.raises(xerrors.VolumeSizeUsedGreaterThanReducedError):
+        vol.patch_volume_size("vol", "1KB")
+    # shrink above used is fine
+    out = vol.patch_volume_size("vol", "500MB")
+    assert out["size"] == "500MB"
+
+
+def test_volume_duplicate_and_delete(world):
+    _, vol, backend, *_ = world
+    vol.create_volume("vol", "1GB")
+    with pytest.raises(xerrors.VolumeExistedError):
+        vol.create_volume("vol", "1GB")
+    vol.delete_volume("vol")
+    with pytest.raises(xerrors.NotExistInStoreError):
+        vol.get_volume_info("vol")
+    vol.create_volume("vol", "1GB")  # name free again
+
+
+def test_volume_same_size_no_patch(world):
+    _, vol, *_ = world
+    vol.create_volume("vol", "1GB")
+    with pytest.raises(xerrors.NoPatchRequiredError):
+        vol.patch_volume_size("vol", "1GB")

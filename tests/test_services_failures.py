@@ -1,0 +1,188 @@
+"""Failure-path and resource-ownership regression tests (code-review round 3
+findings: stale releases, double-frees, cache/store coherence on unwind)."""
+
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import ContainerRun, MemoryPatch, PatchRequest, TpuPatch
+from gpu_docker_api_tpu.schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from gpu_docker_api_tpu.services import ReplicaSetService, VolumeService
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import WorkQueue
+
+
+class FlakyBackend(MockBackend):
+    """Mock backend with one-shot failure injection."""
+
+    def __init__(self, state_dir):
+        super().__init__(state_dir)
+        self.fail_next_start: bool = False
+        self.fail_start_of: str = ""
+
+    def start(self, name):
+        if self.fail_next_start or (self.fail_start_of and name == self.fail_start_of):
+            self.fail_next_start = False
+            self.fail_start_of = ""
+            raise RuntimeError("injected start failure")
+        return super().start(name)
+
+
+@pytest.fixture()
+def world(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    backend = FlakyBackend(str(tmp_path / "state"))
+    tpu = TpuScheduler(client, wq, topology=make_topology("v4-32"))
+    cpu = CpuScheduler(client, wq, core_count=16)
+    ports = PortScheduler(client, wq, port_range=(42000, 42100), seed=11)
+    rs = ReplicaSetService(backend, client, wq, tpu, cpu, ports,
+                           VersionMap("containerVersionMap", client, wq),
+                           MergeMap(client, wq))
+    vol = VolumeService(backend, client, wq,
+                        VersionMap("volumeVersionMap", client, wq))
+    yield rs, vol, backend, tpu, cpu, ports, wq, client
+    wq.close()
+
+
+def _run(rs, name="a", tpus=2, ports=1):
+    return rs.run_container(ContainerRun(
+        imageName="img", replicaSetName=name, tpuCount=tpus,
+        containerPorts=["8888"] if ports else []))
+
+
+# finding 1+7: failed rolling replace must fully revert latest pointer,
+# version counter, and the new version's port grant
+
+def test_failed_replace_reverts_world(world):
+    rs, _, backend, tpu, cpu, ports, wq, client = world
+    _run(rs, "a", tpus=1)
+    ports_before = len(ports.get_status()["usedPortSet"])
+    backend.fail_start_of = "a-2"
+    with pytest.raises(RuntimeError):
+        rs.patch_container("a", PatchRequest(tpuPatch=TpuPatch(4)))
+    # old container restarted and still addressable
+    assert backend.inspect("a-1").running
+    info = rs.get_container_info("a")
+    assert info["version"] == 1 and info["containerName"] == "a-1"
+    # resources: only the original grant remains held
+    assert tpu.get_status()["freeCount"] == 15
+    assert len(ports.get_status()["usedPortSet"]) == ports_before
+    # next mutation mints version 2, not 3
+    resp = rs.patch_container("a", PatchRequest(memoryPatch=MemoryPatch("2GB")))
+    assert resp["version"] == 2
+    # history has no phantom entry for the failed attempt
+    hist = rs.get_container_history("a")
+    assert [h["version"] for h in hist] == [2, 1]
+
+
+# finding 2: double-stop must not free chips now owned by another replicaSet
+
+def test_double_stop_cannot_free_others_chips(world):
+    rs, _, backend, tpu, *_ = world
+    r_a = _run(rs, "a", tpus=4, ports=0)
+    rs.stop_container("a")          # frees a's 4 chips
+    r_b = _run(rs, "b", tpus=4, ports=0)   # b may get the same chips
+    rs.stop_container("a")          # second stop — must be a no-op
+    status = tpu.get_status()
+    owned_b = [c["index"] for c in status["chips"] if c["owner"] == "b"]
+    assert sorted(owned_b) == sorted(r_b["tpuChips"])
+    assert status["freeCount"] == 12
+
+
+# finding 3: in-place reuse — during patch the old grant never transits the
+# free pool, and unwind never clobbers another owner
+
+def test_patch_reuse_keeps_ownership(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, "a", tpus=4, ports=0)
+    _run(rs, "b", tpus=8, ports=0)   # only 4 chips left free
+    # shrink a 4 -> 2: must reuse a's own chips, not fail or steal
+    resp = rs.patch_container("a", PatchRequest(tpuPatch=TpuPatch(2)))
+    assert len(resp["tpuChips"]) == 2
+    status = tpu.get_status()
+    owners = {c["index"]: c["owner"] for c in status["chips"]}
+    assert all(owners[i] == "a" for i in resp["tpuChips"])
+    assert status["freeCount"] == 6  # 16 - 8(b) - 2(a)
+
+
+def test_patch_shortage_unwind_leaves_other_owner_intact(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, "a", tpus=2, ports=0)
+    _run(rs, "b", tpus=12, ports=0)
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        rs.patch_container("a", PatchRequest(tpuPatch=TpuPatch(8)))
+    status = tpu.get_status()
+    assert status["freeCount"] == 2
+    assert sum(1 for c in status["chips"] if c["owner"] == "b") == 12
+    assert backend.inspect("a-1").running
+
+
+# finding 4: stop -> restart must not free the new version's (or another
+# replicaSet's) re-picked port numbers
+
+def test_stop_restart_port_not_stolen(world):
+    rs, _, backend, tpu, cpu, ports, *_ = world
+    _run(rs, "a", tpus=0, ports=1)
+    rs.stop_container("a")
+    assert ports.get_status()["usedPortSet"] == []
+    resp = rs.restart_container("a")
+    new_port = resp["portBindings"]["8888"]
+    assert ports.get_status()["usedPortSet"] == [new_port]  # still held
+
+
+# finding 5: restart-of-stopped shortage must not free stale chip lists
+
+def test_restart_shortage_no_stale_free(world):
+    rs, _, backend, tpu, *_ = world
+    _run(rs, "a", tpus=4, ports=0)
+    rs.stop_container("a")
+    r_b = _run(rs, "b", tpus=14, ports=0)  # occupies most chips incl a's old
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        rs.restart_container("a")
+    status = tpu.get_status()
+    assert sum(1 for c in status["chips"] if c["owner"] == "b") == 14
+    assert status["freeCount"] == 2
+
+
+# finding 6: deleting a replicaSet whose workload exited on its own still
+# releases its grants
+
+def test_delete_exited_container_releases_resources(world):
+    rs, _, backend, tpu, cpu, ports, *_ = world
+    _run(rs, "a", tpus=4)
+    # simulate workload exiting by itself (not via stop_container)
+    backend.stop("a-1")
+    assert not backend.inspect("a-1").running
+    rs.delete_container("a")
+    assert tpu.get_status()["freeCount"] == 16
+    assert ports.get_status()["usedPortSet"] == []
+
+
+# finding 8: volume migration failure leaves reads pointing at the live old
+# volume and no phantom history entry
+
+def test_volume_migration_failure_coherent(world, monkeypatch):
+    _, vol, backend, *_ = world
+    v = vol.create_volume("vol", "1GB")
+    import gpu_docker_api_tpu.services.volume as volmod
+
+    def boom(src, dest):
+        raise OSError("injected migration failure")
+
+    monkeypatch.setattr(volmod, "move_dir_contents", boom)
+    with pytest.raises(OSError):
+        vol.patch_volume_size("vol", "2GB")
+    info = vol.get_volume_info("vol")
+    assert info["volumeName"] == "vol-1"
+    assert info["mountpoint"]  # the old volume is alive and inspectable
+    hist = vol.get_volume_history("vol")
+    assert [h["version"] for h in hist] == [1]
+    # a later patch works and mints version 2
+    monkeypatch.undo()
+    out = vol.patch_volume_size("vol", "2GB")
+    assert out["name"] == "vol-2"
