@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Benchmark: replicaSet p50 cold-start -> first XLA step, end-to-end.
+
+The BASELINE.json north-star metric, measured through the FULL stack on real
+hardware: HTTP POST /api/v1/replicaSet -> chip grant (ICI allocator) -> TPU
+env injection -> process substrate spawn -> JAX import -> jitted matmul on
+the accelerator -> marker write. This is what a user of the reference feels
+when they launch a GPU container and wait for torch to see the device —
+except TPU-native.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline: prior recorded round's value / this value (>1 = faster than
+last round); 1.0 when no prior round exists (the reference publishes no
+numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import glob
+import http.client
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+RUNS = 5
+WORKLOAD = (
+    "import time, os, jax, jax.numpy as jnp\n"
+    "t_import = time.time()\n"
+    "x = jnp.ones((1024, 1024), jnp.bfloat16)\n"
+    "y = (x @ x).block_until_ready()\n"
+    "root = os.environ.get('CONTAINER_ROOT', '.')\n"
+    "open(os.path.join(root, 'xla_done'), 'w').write(repr(time.time()))\n"
+    "time.sleep(600)\n"
+)
+
+
+def call(port: int, method: str, path: str, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, json.dumps(body) if body is not None else None,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    if out.get("code") != 200:
+        raise RuntimeError(f"{method} {path} -> {out}")
+    return out["data"]
+
+
+def one_run(port: int, state_dir: str, idx: int, tpu_count: int) -> float:
+    name = f"bench{idx}"
+    t0 = time.perf_counter()
+    call(port, "POST", "/api/v1/replicaSet", {
+        "imageName": "python", "replicaSetName": name,
+        "tpuCount": tpu_count,
+        "env": [f"JAX_COMPILATION_CACHE_DIR={state_dir}/jax-cache"],
+        "cmd": [sys.executable, "-c", WORKLOAD],
+    })
+    # wait for the workload's first-XLA-step marker
+    marker = os.path.join(state_dir, "backend", "rootfs", f"{name}-1", "xla_done")
+    deadline = time.time() + 300
+    while not os.path.exists(marker):
+        if time.time() > deadline:
+            raise TimeoutError(f"no XLA step marker for {name}")
+        time.sleep(0.01)
+    elapsed = time.perf_counter() - t0
+    call(port, "DELETE", f"/api/v1/replicaSet/{name}")
+    return elapsed
+
+
+def prior_round_value() -> float | None:
+    vals = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            rec = json.loads(open(path).read().strip().splitlines()[-1])
+            if rec.get("unit") == "s" and isinstance(rec.get("value"), (int, float)):
+                vals.append(rec["value"])
+        except (json.JSONDecodeError, OSError, IndexError):
+            continue
+    return vals[-1] if vals else None
+
+
+def main() -> None:
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import discover_topology
+
+    state_dir = tempfile.mkdtemp(prefix="tdapi-bench-")
+    topo = discover_topology()
+    app = App(state_dir=state_dir, backend="process", addr="127.0.0.1:0",
+              topology=topo, api_key="", cpu_cores=max(os.cpu_count() or 1, 4))
+    app.start()
+    try:
+        # one real chip is the axon reality; grant 1 when any exist
+        tpu_count = 1 if topo.num_chips >= 1 else 0
+        times = []
+        for i in range(RUNS):
+            times.append(one_run(app.server.port, state_dir, i, tpu_count))
+        p50 = statistics.median(times)
+        prior = prior_round_value()
+        vs = (prior / p50) if prior else 1.0
+        print(json.dumps({
+            "metric": "replicaSet p50 cold-start->first-XLA-step",
+            "value": round(p50, 3),
+            "unit": "s",
+            "vs_baseline": round(vs, 3),
+        }))
+    finally:
+        app.stop()
+
+
+if __name__ == "__main__":
+    main()

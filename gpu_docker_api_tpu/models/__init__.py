@@ -1,0 +1,1 @@
+from .llama import LlamaConfig, llama_forward, init_params, param_kinds  # noqa: F401

@@ -1,0 +1,205 @@
+"""Llama-3-family transformer, pure JAX, TPU-first.
+
+This is the flagship workload the control plane schedules (BASELINE config 5:
+"MaxText Llama-3-8B training replicaSet on v5p-8, patched 1→4 chips and
+rolled back mid-run"). Design notes, per the TPU execution model:
+
+- all matmuls in bfloat16 with float32 accumulation (MXU-native);
+- RMSNorm/softmax statistics in float32 (VPU) — bf16-safe numerics;
+- static shapes everywhere; the causal mask is an iota comparison fused by
+  XLA, never a materialized [S, S] table at f32;
+- grouped-query attention (Llama-3's 8 KV heads) so the KV cache and the
+  attention einsum stay small;
+- sharding is expressed OUTSIDE the math via PartitionSpec kind-trees
+  (parallel/mesh.py) — the forward is identical on 1 chip or a pod slice,
+  XLA inserts the collectives;
+- attention dispatches to ops/attention.py (pallas flash kernel on TPU,
+  fused XLA reference elsewhere; ring attention over the sp axis for
+  long-context — parallel/ring.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # ---- canned configs ----
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        """Llama-3-8B (the BASELINE config-5 workload)."""
+        return cls()
+
+    @classmethod
+    def llama_mini(cls) -> "LlamaConfig":
+        """~45M-param config: same architecture, laptop/1-chip friendly.
+        head_dim = 128 so the pallas flash path engages on TPU."""
+        return cls(vocab_size=32000, d_model=512, n_layers=4, n_heads=4,
+                   n_kv_heads=2, d_ff=1408, max_seq_len=2048)
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """Unit-test config — small enough for an 8-device CPU mesh."""
+        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=128, max_seq_len=128,
+                   dtype=jnp.float32)
+
+
+# ---- parameters ------------------------------------------------------------
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize the parameter pytree. Layers are stacked along a leading
+    axis so the decoder runs as ONE lax.scan — one XLA compilation of the
+    layer body instead of n_layers copies (compile time and HBM win)."""
+    c = config
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(stddev=0.02)
+    kq = c.n_heads * c.head_dim
+    kv = c.n_kv_heads * c.head_dim
+
+    def layer_params(k) -> dict:
+        ks = jax.random.split(k, 7)
+        return {
+            "attn_norm": jnp.ones((c.d_model,), jnp.float32),
+            "wq": init(ks[0], (c.d_model, kq), c.dtype),
+            "wk": init(ks[1], (c.d_model, kv), c.dtype),
+            "wv": init(ks[2], (c.d_model, kv), c.dtype),
+            "wo": init(ks[3], (kq, c.d_model), c.dtype),
+            "mlp_norm": jnp.ones((c.d_model,), jnp.float32),
+            "w1": init(ks[4], (c.d_model, c.d_ff), c.dtype),  # gate
+            "w3": init(ks[5], (c.d_model, c.d_ff), c.dtype),  # up
+            "w2": init(ks[6], (c.d_ff, c.d_model), c.dtype),  # down
+        }
+
+    layer_keys = jax.random.split(k_layers, c.n_layers)
+    layers = jax.vmap(layer_params)(layer_keys)
+    return {
+        "embed": init(k_embed, (c.vocab_size, c.d_model), c.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((c.d_model,), jnp.float32),
+        "lm_head": init(k_out, (c.d_model, c.vocab_size), c.dtype),
+    }
+
+
+def param_kinds(config: LlamaConfig) -> dict:
+    """Sharding-kind tree matching init_params structure (keys into
+    parallel.mesh.param_sharding_rules)."""
+    return {
+        "embed": "embed",
+        "layers": {
+            "attn_norm": "norm", "mlp_norm": "norm",
+            "wq": "attn_in", "wk": "attn_in", "wv": "attn_in",
+            "wo": "attn_out",
+            "w1": "mlp_in", "w3": "mlp_in", "w2": "mlp_out",
+        },
+        "final_norm": "norm",
+        "lm_head": "lm_head",
+    }
+
+
+# ---- building blocks -------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with f32 statistics regardless of activation dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps) * weight
+    return out.astype(x.dtype)
+
+
+def rope_frequencies(config: LlamaConfig, positions: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [S, head_dim/2] in f32."""
+    d = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta **
+                      (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, Dh]; rotate pairs (split-half convention)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
+                           axis=-1).astype(x.dtype)
+
+
+def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
+                     mesh: Optional[Mesh]):
+    c = config
+    b, s, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # sequence sharded over sp: K/V rotate around the ICI ring instead of
+        # being all-gathered — no device holds full K/V or [S, S] scores
+        from ..parallel.ring import ring_attention
+        out = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        out = attention(q, k, v, causal=True, impl=impl)   # [B, S, H, Dh]
+    out = out.reshape(b, s, c.n_heads * c.head_dim) @ layer["wo"]
+    return x + out
+
+
+def _mlp_block(x, layer, config: LlamaConfig):
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gated = jax.nn.silu(h @ layer["w1"]) * (h @ layer["w3"])  # SwiGLU
+    return x + gated @ layer["w2"]
+
+
+# ---- forward ---------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config", "impl", "mesh"))
+def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
+                  impl: str = "auto",
+                  mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] float32. With a mesh whose
+    sp axis > 1, attention runs as ring attention over the sequence shards."""
+    c = config
+    s = tokens.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_frequencies(c, jnp.arange(s))
+
+    def body(x, layer):
+        x = _attention_block(x, layer, c, cos, sin, impl, mesh)
+        x = _mlp_block(x, layer, c)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    # logits in f32: the loss softmax needs the headroom
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

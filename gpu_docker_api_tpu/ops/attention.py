@@ -1,0 +1,178 @@
+"""Attention ops: pallas flash kernel (TPU) + fused XLA reference.
+
+The hot op of the flagship workload (models/llama.py). Two interchangeable
+implementations behind one dispatcher:
+
+- reference_attention: einsum + softmax, GQA-aware, causal mask as an iota
+  comparison (XLA fuses it; nothing materializes at [S, S] f32 besides the
+  score tile XLA chooses). Runs everywhere — CPU tests, small shapes, and
+  as the numerics oracle for the kernel.
+- flash_attention: blockwise online-softmax pallas kernel (O(S) memory, no
+  [S, S] score tensor in HBM). Grid over (batch*heads, q-blocks); the kv
+  loop lives inside the kernel with running max/sum in VMEM scratch, causal
+  blocks above the diagonal skipped by loop bound. MXU-aligned 128-blocks,
+  f32 accumulation.
+
+Written per /opt/skills/guides/pallas_guide.md (blockwise pattern, 2D iota,
+preferred_element_type, scratch via pltpu.VMEM).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK = 128
+
+
+# ---- reference (XLA) -------------------------------------------------------
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D] -> [B,S,H,D]. f32 softmax."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    # expand kv heads for GQA
+    kf = jnp.repeat(k.astype(jnp.float32), group, axis=2)
+    vf = jnp.repeat(v.astype(jnp.float32), group, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        scores = jnp.where(cols[None, None] <= rows[None, None],
+                           scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)
+
+
+# ---- pallas flash kernel ---------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  blk_q: int, blk_k: int, scale: float, causal: bool,
+                  seq_len: int):
+    i = jax.lax.convert_element_type(_pid(1), jnp.int32)
+    q = q_ref[0].astype(jnp.float32) * scale            # [blk_q, D]
+    m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    n_kv_total = seq_len // blk_k
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        n_kv = jnp.minimum(((i + 1) * blk_q + blk_k - 1) // blk_k, n_kv_total)
+    else:
+        n_kv = n_kv_total
+
+    def body(j, _):
+        import jax.experimental.pallas as pl
+        k = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [blk_q, blk_k]
+        if causal:
+            rows = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            cols = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(cols <= rows, s, -jnp.inf)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # guard the all-masked row case: exp(-inf - -inf) -> use finite m
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_kv, body, 0)
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _pid(axis: int):
+    import jax.experimental.pallas as pl
+    return pl.program_id(axis)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    blk_q: int = DEFAULT_BLOCK,
+                    blk_k: int = DEFAULT_BLOCK,
+                    interpret: bool = False) -> jax.Array:
+    """Pallas TPU flash attention. q [B,S,H,D], k/v [B,S,Hkv,D].
+    interpret=True runs the kernel in the pallas interpreter (CPU tests)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    blk_q = min(blk_q, s)
+    blk_k = min(blk_k, s)
+    assert s % blk_q == 0 and s % blk_k == 0, "seq len must divide block size"
+    scale = 1.0 / math.sqrt(d)
+
+    # [B,S,H,D] -> [B*H, S, D]; expand kv heads for GQA
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = jnp.repeat(k, group, axis=2).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = jnp.repeat(v, group, axis=2).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    grid = (b * h, s // blk_q)
+    kernel = functools.partial(
+        _flash_kernel, blk_q=blk_q, blk_k=blk_k, scale=scale,
+        causal=causal, seq_len=s)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+# ---- dispatcher ------------------------------------------------------------
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True, impl: str = "auto") -> jax.Array:
+    """Dispatch: pallas flash on TPU when shapes are kernel-friendly
+    (128-aligned seq, head_dim a lane multiple), XLA reference otherwise."""
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "xla":
+        return reference_attention(q, k, v, causal=causal)
+    s, d = q.shape[1], q.shape[3]
+    if _on_tpu() and s % DEFAULT_BLOCK == 0 and d % 128 == 0:
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)

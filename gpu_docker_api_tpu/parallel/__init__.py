@@ -1,0 +1,1 @@
+from .mesh import MeshPlan, make_mesh, param_sharding_rules  # noqa: F401

@@ -1,0 +1,143 @@
+"""Device mesh construction + sharding plans for the workload runtime.
+
+This is the workload side of the control plane: the chip allocator grants a
+contiguous sub-mesh and injects TPU_VISIBLE_CHIPS (SURVEY §5.7); the code
+here is what runs INSIDE the scheduled container — it builds a
+jax.sharding.Mesh over the visible chips and shards the model with pjit
+logical rules, letting XLA insert the ICI collectives (the scaling-book
+recipe: pick a mesh, annotate shardings, let XLA do the rest).
+
+Axes:
+  dp    — pure data parallelism (gradient psum over DCN or ICI)
+  fsdp  — data parallelism with fully-sharded parameters (ZeRO-3 style;
+          XLA all-gathers params per layer, reduce-scatters grads)
+  tp    — tensor (megatron) parallelism within attention/MLP blocks
+  sp    — sequence/context parallelism for long sequences (ring attention)
+
+The reference control plane has no parallelism code at all (SURVEY §2:
+"DP, TP, PP, SP ... none exist"); this module is the TPU-native answer to
+what its scheduled workloads (PyTorch+NCCL images) did for themselves.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """How many devices each parallelism axis gets. Product must equal the
+    device count handed to make_mesh."""
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    @classmethod
+    def auto(cls, n_devices: int, tp: int = 1, sp: int = 1) -> "MeshPlan":
+        """Default recipe: give tp/sp what was asked, spend the rest on fsdp
+        (params sharded as wide as possible — the usual memory winner)."""
+        rest = n_devices // (tp * sp)
+        if tp * sp * rest != n_devices:
+            raise ValueError(
+                f"tp({tp}) * sp({sp}) must divide device count {n_devices}")
+        return cls(dp=1, fsdp=rest, tp=tp, sp=sp)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[list] = None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    if plan.size != len(devs):
+        raise ValueError(f"plan {plan} needs {plan.size} devices, have {len(devs)}")
+    arr = np.asarray(devs).reshape(plan.dp, plan.fsdp, plan.tp, plan.sp)
+    return Mesh(arr, AXES)
+
+
+# ---- logical sharding rules -------------------------------------------------
+
+def param_sharding_rules() -> dict[str, P]:
+    """PartitionSpecs per logical parameter kind for the Llama family.
+
+    Megatron-style tp: column-parallel in (wq/wk/wv/w1/w3), row-parallel out
+    (wo/w2) so each block needs one psum on its output; fsdp shards the other
+    axis of every matrix (ZeRO-3).
+    """
+    return {
+        "embed": P("tp", "fsdp"),        # [V, D]
+        "attn_in": P("fsdp", "tp"),      # [D, heads*head_dim] (wq/wk/wv)
+        "attn_out": P("tp", "fsdp"),     # [heads*head_dim, D] (wo)
+        "mlp_in": P("fsdp", "tp"),       # [D, F] (w1, w3)
+        "mlp_out": P("tp", "fsdp"),      # [F, D] (w2)
+        "norm": P(None),                 # [D]
+        "lm_head": P("fsdp", "tp"),      # [D, V]
+    }
+
+
+def activation_spec() -> P:
+    """[batch, seq, d_model]: batch over dp+fsdp, sequence over sp."""
+    return P(("dp", "fsdp"), "sp", None)
+
+
+def logits_spec() -> P:
+    """[batch, seq, vocab]: vocab over tp keeps the big tensor sharded."""
+    return P(("dp", "fsdp"), "sp", "tp")
+
+
+def batch_spec() -> P:
+    """Integer token batches [batch, seq]."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def shard_params(params, mesh: Mesh, kinds) -> dict:
+    """Device_put a param pytree according to its kind tree (same structure,
+    values = keys into param_sharding_rules)."""
+    rules = param_sharding_rules()
+
+    def place(p, kind):
+        return jax.device_put(p, NamedSharding(mesh, rules[kind]))
+
+    return jax.tree.map(place, params, kinds)
+
+
+def constraint(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint that is a no-op outside a mesh context."""
+    if mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def best_tp_for(n_devices: int, max_tp: int = 8) -> int:
+    """Largest power-of-two tp ≤ max_tp dividing n_devices."""
+    tp = 1
+    while tp * 2 <= max_tp and n_devices % (tp * 2) == 0:
+        tp *= 2
+    return tp
+
+
+def validate_plan_for_topology(plan: MeshPlan, shape: tuple[int, int, int]) -> bool:
+    """True when the plan maps onto the physical chip mesh such that tp (the
+    chattiest axis) rides contiguous ICI links: tp must divide one physical
+    axis extent times the next (row-major adjacency)."""
+    n = shape[0] * shape[1] * shape[2]
+    if plan.size != n:
+        return False
+    # row-major device order: x fastest — tp contiguous iff tp <= x extent
+    # or tp a multiple of x that divides x*y
+    x, y, _ = shape
+    return plan.tp <= x or (plan.tp % x == 0 and plan.tp <= x * y) or plan.tp == 1
+
+
+def describe(mesh: Mesh) -> str:
+    sizes = {a: int(math.prod([mesh.shape[a]])) for a in mesh.axis_names}
+    return " × ".join(f"{a}={sizes[a]}" for a in mesh.axis_names)

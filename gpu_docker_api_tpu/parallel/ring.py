@@ -1,0 +1,100 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+Long-context is first-class (SURVEY §5.7): when a sequence is sharded over
+sp devices, no device ever holds the full [S, S] score matrix OR the full
+K/V — each holds its S/n shard and the K/V shards rotate around the ICI
+ring via lax.ppermute, one hop per step, overlapping compute with the
+neighbor exchange (Liu et al.'s Ring Attention, built the XLA way: a
+shard_map region with a ppermute loop, collectives inserted by the
+compiler onto ICI links).
+
+Numerics: the same online-softmax accumulation as the flash kernel
+(running max m, normalizer l, f32 accumulator), so the result is exactly
+blockwise-stable attention regardless of ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import attention as _local_attention
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "sp", causal: bool = True) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over `axis` — returns
+    [B,S,H,D] with the same sharding. Call from OUTSIDE shard_map; global
+    shapes in, global shapes out."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return _local_attention(q, k, v, causal=causal)
+
+    spec_q = P(("dp", "fsdp"), axis, None, None)
+    local = functools.partial(_ring_local, axis=axis, ring=n, causal=causal)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_q, spec_q, spec_q),
+        out_specs=spec_q,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                axis: str, ring: int, causal: bool) -> jax.Array:
+    """Per-device body. q [b, s_loc, H, D]; k/v [b, s_loc, Hkv, D]."""
+    b, s_loc, h, d = q.shape
+    group = h // k.shape[2]
+    kf = jnp.repeat(k, group, axis=2)
+    vf = jnp.repeat(v, group, axis=2)
+    my = jax.lax.axis_index(axis)
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % ring) for i in range(ring)]  # send k/v to next rank
+
+    def accumulate(i, k_cur, v_cur, acc, m, l):
+        src = (my - i) % ring          # whose shard we hold this step
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            rows = (my * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0))
+            cols = (src * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1))
+            s = jnp.where((cols <= rows)[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * _bcast(alpha) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        return acc, m_new, l
+
+    def step(i, carry):
+        k_cur, v_cur, acc, m, l = carry
+        acc, m, l = accumulate(i, k_cur, v_cur, acc, m, l)
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        return k_cur, v_cur, acc, m, l
+
+    acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    # ring-1 (compute, rotate) steps, then a final compute with no rotation —
+    # the last hop's result would be discarded, so don't pay the ICI for it
+    k_cur, v_cur, acc, m, l = jax.lax.fori_loop(
+        0, ring - 1, step, (kf, vf, acc0, m0, l0))
+    acc, m, l = accumulate(ring - 1, k_cur, v_cur, acc, m, l)
+    denom = jnp.maximum(l, 1e-30)                      # [b,h,q,1]
+    out = acc / denom.transpose(0, 2, 1, 3)            # -> [b,q,h,1] broadcast
+    return out.astype(q.dtype)
+
+
+def _bcast(alpha: jax.Array) -> jax.Array:
+    """[b,h,q,1] -> [b,q,h,1] to scale the [b,q,h,d] accumulator."""
+    return alpha.transpose(0, 2, 1, 3)

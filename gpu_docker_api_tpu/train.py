@@ -1,0 +1,214 @@
+"""Training loop for the flagship workload: sharded Llama training.
+
+The MaxText-shaped piece of BASELINE config 5: a training step that jits over
+a (dp, fsdp, tp, sp) mesh with params/optimizer state sharded by the rules in
+parallel/mesh.py, next-token cross-entropy in f32, optax AdamW, and orbax
+checkpointing so a control-plane rollback composes with workload resume
+(SURVEY §5.4: patch/rollback must not corrupt mid-run training — the
+checkpoint lives on the replicaSet's data-disk bind and survives rolling
+replacement via the layer/volume copy).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.llama import LlamaConfig, init_params, llama_forward, param_kinds
+from .parallel.mesh import (
+    MeshPlan, batch_spec, make_mesh, param_sharding_rules,
+)
+
+
+@dataclass
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    remat: bool = True   # jax.checkpoint the layer body: HBM for FLOPs
+
+
+def _pathkey(path) -> str:
+    """Canonical string for a tree path, e.g. "['layers']['wq']"."""
+    return "".join(str(p) for p in path)
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(tc.learning_rate, b1=tc.b1, b2=tc.b2,
+                    weight_decay=tc.weight_decay),
+    )
+
+
+def loss_fn(params, tokens, config: LlamaConfig, impl: str = "auto",
+            mesh=None):
+    """Next-token CE. tokens [B, S]; predicts tokens[:, 1:]."""
+    logits = llama_forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def param_specs(config: LlamaConfig) -> Any:
+    """PartitionSpec pytree matching init_params structure. Layer params are
+    STACKED along a leading n_layers axis (one lax.scan body — llama.py
+    init_params), so their 2-D rules get a leading None: the scan axis is
+    never sharded, fsdp/tp land on the documented matrix axes."""
+    rules = param_sharding_rules()
+    kinds = param_kinds(config)
+
+    def stacked(spec: P) -> P:
+        return P(None, *spec)
+
+    return {
+        "embed": rules[kinds["embed"]],
+        "layers": {k: stacked(rules[v]) for k, v in kinds["layers"].items()},
+        "final_norm": rules[kinds["final_norm"]],
+        "lm_head": rules[kinds["lm_head"]],
+    }
+
+
+@dataclass
+class Trainer:
+    """Builds and owns the sharded train step.
+
+    Usage:
+        trainer = Trainer.create(config, MeshPlan.auto(jax.device_count()))
+        state = trainer.init(jax.random.key(0))
+        state, metrics = trainer.step(state, tokens)
+    """
+    config: LlamaConfig
+    tc: TrainConfig
+    mesh: Mesh
+    optimizer: optax.GradientTransformation
+    _step_fn: Any = None
+
+    @classmethod
+    def create(cls, config: LlamaConfig, plan: Optional[MeshPlan] = None,
+               tc: Optional[TrainConfig] = None,
+               devices: Optional[list] = None) -> "Trainer":
+        plan = plan or MeshPlan.auto(len(devices or jax.devices()))
+        tc = tc or TrainConfig()
+        mesh = make_mesh(plan, devices)
+        t = cls(config=config, tc=tc, mesh=mesh, optimizer=make_optimizer(tc))
+        t._step_fn = t._build_step()
+        return t
+
+    # ---- sharding helpers ----
+
+    def init(self, key: jax.Array) -> dict:
+        """Sharded init: params materialize directly on the mesh (jit with
+        out_shardings — no host-side 8B-param detour)."""
+        params_sh = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), param_specs(self.config))
+
+        def _init(k):
+            params = init_params(self.config, k)
+            opt_state = self.optimizer.init(params)
+            return {"params": params, "opt_state": opt_state,
+                    "step": jnp.zeros((), jnp.int32)}
+
+        out_shape = jax.eval_shape(_init, key)
+        out_sh = self._state_shardings(out_shape, params_sh)
+        with self.mesh:
+            return jax.jit(_init, out_shardings=out_sh)(key)
+
+    def _state_shardings(self, state_shape, params_sh):
+        """Shardings for the whole train state: exact specs for params;
+        optimizer-state leaves matched to their param's sharding by TREE
+        PATH (AdamW's mu/nu mirror the param tree — matching by shape would
+        collide, e.g. wq and wo are both [L, D, D] with transposed specs);
+        scalars replicate."""
+        from jax.tree_util import tree_flatten_with_path
+
+        replicated = NamedSharding(self.mesh, P())
+        by_path = {
+            _pathkey(path): (tuple(leaf.shape), sh)
+            for (path, leaf), (_, sh) in zip(
+                tree_flatten_with_path(state_shape["params"])[0],
+                tree_flatten_with_path(params_sh)[0])
+        }
+
+        def opt_leaf(path, leaf):
+            key = _pathkey(path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            for pkey, (pshape, sh) in by_path.items():
+                if key.endswith(pkey) and shape == pshape:
+                    return sh
+            return replicated
+
+        opt_flat, opt_tree = tree_flatten_with_path(state_shape["opt_state"])
+        opt_sh = jax.tree.unflatten(
+            opt_tree, [opt_leaf(p, leaf) for p, leaf in opt_flat])
+        return {
+            "params": params_sh,
+            "opt_state": opt_sh,
+            "step": replicated,
+        }
+
+    # ---- the step ----
+
+    def _build_step(self):
+        cfg = self.config
+        data_sh = NamedSharding(self.mesh, batch_spec())
+
+        mesh = self.mesh
+
+        def step(state, tokens):
+            def compute_loss(p):
+                return loss_fn(p, tokens, cfg, mesh=mesh)
+            lfn = jax.checkpoint(compute_loss) if self.tc.remat else compute_loss
+            loss, grads = jax.value_and_grad(lfn)(state["params"])
+            updates, new_opt = self.optimizer.update(
+                grads, state["opt_state"], state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            new_state = {"params": new_params, "opt_state": new_opt,
+                         "step": state["step"] + 1}
+            gnorm = optax.global_norm(grads)
+            return new_state, {"loss": loss, "grad_norm": gnorm}
+
+        return jax.jit(step, in_shardings=(None, data_sh),
+                       donate_argnums=(0,))
+
+    def step(self, state, tokens):
+        with self.mesh:
+            return self._step_fn(state, tokens)
+
+    def shard_batch(self, tokens):
+        return jax.device_put(tokens, NamedSharding(self.mesh, batch_spec()))
+
+
+# ---- checkpointing (orbax) -------------------------------------------------
+
+def save_checkpoint(path: str, state, step: int) -> None:
+    """Orbax save — the workload-side checkpoint that makes control-plane
+    rollback resume-safe (BASELINE config 5)."""
+    import orbax.checkpoint as ocp
+    with ocp.CheckpointManager(path) as mngr:
+        mngr.save(step, args=ocp.args.StandardSave(state))
+        mngr.wait_until_finished()
+
+
+def restore_checkpoint(path: str, abstract_state=None) -> tuple[Any, int]:
+    import orbax.checkpoint as ocp
+    with ocp.CheckpointManager(path) as mngr:
+        step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        if abstract_state is not None:
+            state = mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
+        else:
+            state = mngr.restore(step)
+        return state, step

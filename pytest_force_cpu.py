@@ -1,0 +1,30 @@
+"""pytest plugin (loaded via -p before capture starts): force the suite onto
+the 8-device virtual CPU platform. The image's TPU plugin binds the backend
+at interpreter startup, so the env must be set before python launches —
+when it isn't, re-exec pytest with the right environment."""
+import os
+import subprocess
+import sys
+
+_WANT = {
+    "JAX_PLATFORMS": "cpu",
+    "JAX_PLATFORM_NAME": "cpu",
+    "JAX_ENABLE_X64": "0",
+}
+
+def _ensure_env() -> None:
+    need = any(os.environ.get(k) != v for k, v in _WANT.items())
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        need = True
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if need and os.environ.get("_TDAPI_TEST_REEXEC") != "1":
+        env = dict(os.environ)
+        env.update(_WANT)
+        env["XLA_FLAGS"] = flags
+        env["_TDAPI_TEST_REEXEC"] = "1"  # one retry only — never loop
+        ret = subprocess.run(
+            [sys.executable, "-m", "pytest", *sys.argv[1:]], env=env).returncode
+        os._exit(ret)
+
+_ensure_env()

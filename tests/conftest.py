@@ -1,17 +1,5 @@
-"""Test harness config.
-
-Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported anywhere,
-so sharding/mesh tests exercise real multi-device code paths without TPU
-hardware (the driver separately dry-runs the multichip path the same way).
-"""
-
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+"""Test fixtures. Platform forcing lives in pytest_force_cpu.py (loaded
+via pytest.ini addopts before capture starts)."""
 
 import pytest  # noqa: E402
 

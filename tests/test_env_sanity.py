@@ -1,0 +1,6 @@
+def test_jax_on_virtual_cpu_mesh():
+    """The whole suite must run on the 8-device virtual CPU platform —
+    if the axon TPU plugin grabs the backend, sharding tests are meaningless."""
+    import jax
+    assert jax.default_backend() == "cpu"
+    assert jax.device_count() == 8
