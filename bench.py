@@ -20,6 +20,7 @@ import glob
 import http.client
 import json
 import os
+import re
 import statistics
 import sys
 import tempfile
@@ -74,15 +75,19 @@ def one_run(port: int, state_dir: str, idx: int, tpu_count: int) -> float:
 
 
 def prior_round_value() -> float | None:
-    vals = []
-    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+    rounds: list[tuple[int, float]] = []
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
         try:
             rec = json.loads(open(path).read().strip().splitlines()[-1])
             if rec.get("unit") == "s" and isinstance(rec.get("value"), (int, float)):
-                vals.append(rec["value"])
+                rounds.append((int(m.group(1)), rec["value"]))
         except (json.JSONDecodeError, OSError, IndexError):
             continue
-    return vals[-1] if vals else None
+    # numerically latest round (lexicographic sort would put r10 before r2)
+    return max(rounds)[1] if rounds else None
 
 
 def main() -> None:

@@ -126,22 +126,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     assert s % blk_q == 0 and s % blk_k == 0, "seq len must divide block size"
     scale = 1.0 / math.sqrt(d)
 
-    # [B,S,H,D] -> [B*H, S, D]; expand kv heads for GQA
+    # [B,S,H,D] -> [B*H, S, D] for q; K/V stay at their Hkv heads — the grid
+    # index_map routes each q head to its kv head (bh // group), so GQA costs
+    # ZERO extra K/V HBM (no jnp.repeat materialization)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kt = jnp.repeat(k, group, axis=2).transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vt = jnp.repeat(v, group, axis=2).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
 
     grid = (b * h, s // blk_q)
     kernel = functools.partial(
         _flash_kernel, blk_q=blk_q, blk_k=blk_k, scale=scale,
         causal=causal, seq_len=s)
+
+    def kv_index(bh, i):
+        del i
+        # bh = batch * h + head; its kv row is batch * hkv + head // group
+        return ((bh // h) * hkv + (bh % h) // group, 0, 0)
+
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), kv_index),
+            pl.BlockSpec((1, s, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),

@@ -128,7 +128,11 @@ class TpuScheduler(Scheduler):
     def _find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
         """Best free axis-aligned box of volume n: compact dims first, then
         the most packed placement (fewest free ICI neighbors outside the box
-        — keeps the remaining free space contiguous)."""
+        — keeps the remaining free space contiguous). Uses the C++ core
+        (native/topology_alloc.cc) when available on non-torus meshes."""
+        native = self._native_find_box(n, free)
+        if native is not None:
+            return native or None
         best: Optional[list[int]] = None
         best_key: Optional[tuple] = None
         topo = self.topology
@@ -149,6 +153,25 @@ class TpuScheduler(Scheduler):
                 best_key = key
                 best = idx
         return best
+
+    def _native_find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
+        """C++ box search. Returns None when the core doesn't apply (torus,
+        lib missing), [] when it applies but found nothing, else the grant."""
+        if self.topology.wraparound:
+            return None
+        from .._native import load
+        lib = load("topoalloc")
+        if lib is None:
+            return None
+        import ctypes
+        sx, sy, sz = self.topology.shape
+        total = sx * sy * sz
+        status = (ctypes.c_int8 * total)()
+        for i in range(total):
+            status[i] = 0 if i in free else 1
+        out = (ctypes.c_int32 * n)()
+        ok = lib.topo_find_box(sx, sy, sz, status, n, out)
+        return [int(out[i]) for i in range(n)] if ok else []
 
     def _find_connected(self, n: int, free: set[int]) -> Optional[list[int]]:
         """Connected free set of n chips via greedy BFS from each free seed,

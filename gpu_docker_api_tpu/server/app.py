@@ -22,7 +22,7 @@ from ..backend import make_backend
 from ..dtos import ContainerRun, PatchRequest
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
 from ..services import ReplicaSetService, VolumeService
-from ..store import MVCCStore, StateClient
+from ..store import StateClient, open_store
 from ..topology import TpuTopology, discover_topology
 from ..utils.file import valid_size_unit
 from ..version import (
@@ -42,12 +42,14 @@ class App:
                  port_range: Optional[tuple[int, int]] = None,
                  topology: Optional[TpuTopology] = None,
                  api_key: Optional[str] = None,
-                 cpu_cores: Optional[int] = None):
+                 cpu_cores: Optional[int] = None,
+                 store_engine: str = "auto"):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         # --- reference Init order: docker -> etcd -> workQueue -> schedulers
         #     -> version maps (main.go:53-97) ---
-        self.store = MVCCStore(wal_path=os.path.join(state_dir, "state.wal"))
+        self.store = open_store(wal_path=os.path.join(state_dir, "state.wal"),
+                                engine=store_engine)
         self.client = StateClient(self.store)
         self.wq = WorkQueue(self.client)
         self.wq.start()

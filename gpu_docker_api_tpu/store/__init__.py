@@ -1,2 +1,3 @@
 from .mvcc import KeyValue, MVCCStore  # noqa: F401
 from .client import StateClient, ResourcePrefix  # noqa: F401
+from .native import NativeMVCCStore, native_available, open_store  # noqa: F401

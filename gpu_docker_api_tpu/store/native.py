@@ -1,0 +1,141 @@
+"""ctypes front-end for the C++ MVCC store core (native/mvcc_store.cc).
+
+Same API and WAL format as the pure-Python MVCCStore — the two are
+interchangeable engines behind StateClient. `open_store()` is the factory
+the app uses: native when the core is available, Python otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Optional, Union
+
+from .._native import load
+from .mvcc import KeyValue, MVCCStore
+
+
+def native_available() -> bool:
+    return load("mvccstore") is not None
+
+
+class NativeMVCCStore:
+    """Drop-in MVCCStore backed by the C++ core."""
+
+    def __init__(self, wal_path: Optional[str] = None, fsync: bool = False):
+        del fsync  # the core fflushes per record
+        self._lib = load("mvccstore")
+        if self._lib is None:
+            raise RuntimeError("native mvcc core unavailable")
+        if wal_path:
+            os.makedirs(os.path.dirname(os.path.abspath(wal_path)), exist_ok=True)
+        self._h = self._lib.mvcc_open((wal_path or "").encode())
+
+    # ---- helpers ----
+
+    @property
+    def _handle(self):
+        """Guard against use-after-close: a NULL handle would be a hard
+        nullptr dereference in the C++ core (process death, no traceback)."""
+        if self._h is None:
+            raise RuntimeError("store is closed")
+        return self._h
+
+    def _take(self, ptr) -> Optional[str]:
+        if not ptr:
+            return None
+        try:
+            return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.mvcc_free(ptr)
+
+    @staticmethod
+    def _kv(d: dict) -> KeyValue:
+        return KeyValue(d["key"], d["value"], d["create_revision"],
+                        d["mod_revision"], d["version"])
+
+    # ---- MVCCStore API ----
+
+    def put(self, key: str, value: str) -> int:
+        return self._lib.mvcc_put(self._handle, key.encode(), value.encode())
+
+    def delete(self, key: str) -> bool:
+        return bool(self._lib.mvcc_delete(self._handle, key.encode()))
+
+    def get(self, key: str) -> Optional[KeyValue]:
+        raw = self._take(self._lib.mvcc_get(self._handle, key.encode()))
+        d = json.loads(raw) if raw else None
+        return self._kv(d) if d else None
+
+    def get_at_revision(self, key: str, revision: int) -> Optional[KeyValue]:
+        ptr = self._lib.mvcc_get_at(self._handle, key.encode(), revision)
+        if not ptr:
+            raise ValueError(f"revision {revision} compacted")
+        d = json.loads(self._take(ptr))
+        return self._kv(d) if d else None
+
+    def range(self, prefix: str) -> list[KeyValue]:
+        raw = self._take(self._lib.mvcc_range(self._handle, prefix.encode()))
+        return [self._kv(d) for d in json.loads(raw or "[]")]
+
+    def history(self, key: str, since_create: bool = True) -> list[KeyValue]:
+        raw = self._take(self._lib.mvcc_history(
+            self._handle, key.encode(), 1 if since_create else 0))
+        return [self._kv(d) for d in json.loads(raw or "[]")]
+
+    def get_version(self, key: str, version: int) -> Optional[KeyValue]:
+        for kv in self.history(key):
+            if kv.version == version:
+                return kv
+        return None
+
+    @property
+    def revision(self) -> int:
+        return self._lib.mvcc_revision(self._handle)
+
+    def compact(self, revision: int,
+                keep_history_prefixes: tuple[str, ...] = ()) -> int:
+        blob = b"".join(p.encode() + b"\0" for p in keep_history_prefixes) + b"\0"
+        return self._lib.mvcc_compact(self._handle, revision, blob)
+
+    def snapshot(self, path: str) -> None:
+        if not self._lib.mvcc_snapshot(self._handle, path.encode()):
+            raise OSError(f"snapshot to {path} failed")
+
+    def keys(self):
+        return iter(sorted(kv.key for kv in self.range("")))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mvcc_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeMVCCStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # noqa: D105 — last-resort handle cleanup
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+StoreLike = Union[MVCCStore, NativeMVCCStore]
+
+
+def open_store(wal_path: Optional[str] = None,
+               engine: str = "auto") -> StoreLike:
+    """engine: "auto" (native when available), "native", "python"."""
+    if engine == "python":
+        return MVCCStore(wal_path=wal_path)
+    if engine == "native":
+        return NativeMVCCStore(wal_path=wal_path)
+    if engine != "auto":
+        raise ValueError(f"unknown store engine {engine!r} (auto|native|python)")
+    if native_available():
+        return NativeMVCCStore(wal_path=wal_path)
+    return MVCCStore(wal_path=wal_path)

@@ -1,0 +1,528 @@
+// Embedded MVCC store — native core.
+//
+// C++ implementation of the same data model as
+// gpu_docker_api_tpu/store/mvcc.py (etcd-style: global revision counter,
+// per-key create/mod revision + version, tombstoned deletes, WAL
+// persistence, floor-preserving compaction). The WAL format is byte-
+// compatible with the Python implementation (JSONL records
+// {"op":"put","k":...,"v":...,"r":N} / {"op":"del",...} /
+// {"op":"compact","r":N,"keep":[...]} / {"op":"rev","r":N}) so either
+// engine can open the other's state.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image). All returned
+// strings are malloc'd JSON; the caller frees them with mvcc_free().
+//
+// Reference parity note: the reference outsources this entire layer to an
+// external etcd server over gRPC (internal/etcd/). Embedding it natively
+// removes the network hop from every control-plane mutation — the store
+// becomes a library call.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Rev {
+  int64_t mod = 0;
+  int64_t create = 0;
+  int64_t version = 0;
+  bool tombstone = false;
+  std::string value;
+};
+
+// ---------- minimal JSON helpers (records are flat objects) ----------
+
+void json_escape(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void utf8_append(uint32_t cp, std::string* out) {
+  if (cp < 0x80) {
+    out->push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+// Parses a JSON string starting at s[i] == '"'. Returns false on malformed
+// input. Advances i past the closing quote.
+bool parse_json_string(const std::string& s, size_t* i, std::string* out) {
+  if (*i >= s.size() || s[*i] != '"') return false;
+  ++*i;
+  while (*i < s.size()) {
+    char c = s[*i];
+    if (c == '"') {
+      ++*i;
+      return true;
+    }
+    if (c == '\\') {
+      ++*i;
+      if (*i >= s.size()) return false;
+      char e = s[*i];
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          if (*i + 4 >= s.size()) return false;
+          uint32_t cp = static_cast<uint32_t>(
+              std::strtoul(s.substr(*i + 1, 4).c_str(), nullptr, 16));
+          *i += 4;
+          // surrogate pair
+          if (cp >= 0xD800 && cp <= 0xDBFF && *i + 6 < s.size() &&
+              s[*i + 1] == '\\' && s[*i + 2] == 'u') {
+            uint32_t lo = static_cast<uint32_t>(
+                std::strtoul(s.substr(*i + 3, 4).c_str(), nullptr, 16));
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              *i += 6;
+            }
+          }
+          utf8_append(cp, out);
+          break;
+        }
+        default: return false;
+      }
+      ++*i;
+    } else {
+      out->push_back(c);
+      ++*i;
+    }
+  }
+  return false;
+}
+
+void skip_ws(const std::string& s, size_t* i) {
+  while (*i < s.size() && (s[*i] == ' ' || s[*i] == '\t')) ++*i;
+}
+
+// Parses one flat WAL record. Fields: op (string), k (string), v (string),
+// r (int), keep (array of strings). Unknown fields are skipped best-effort.
+struct Record {
+  std::string op, k, v;
+  int64_t r = -1;
+  std::vector<std::string> keep;
+  bool ok = false;
+};
+
+Record parse_record(const std::string& line) {
+  Record rec;
+  size_t i = 0;
+  skip_ws(line, &i);
+  if (i >= line.size() || line[i] != '{') return rec;
+  ++i;
+  while (i < line.size()) {
+    skip_ws(line, &i);
+    if (line[i] == '}') { rec.ok = !rec.op.empty(); return rec; }
+    if (line[i] == ',') { ++i; continue; }
+    std::string key;
+    if (!parse_json_string(line, &i, &key)) return rec;
+    skip_ws(line, &i);
+    if (i >= line.size() || line[i] != ':') return rec;
+    ++i;
+    skip_ws(line, &i);
+    if (line[i] == '"') {
+      std::string val;
+      if (!parse_json_string(line, &i, &val)) return rec;
+      if (key == "op") rec.op = val;
+      else if (key == "k") rec.k = val;
+      else if (key == "v") rec.v = val;
+    } else if (line[i] == '[') {
+      ++i;
+      while (i < line.size() && line[i] != ']') {
+        skip_ws(line, &i);
+        if (line[i] == '"') {
+          std::string item;
+          if (!parse_json_string(line, &i, &item)) return rec;
+          if (key == "keep") rec.keep.push_back(item);
+        } else if (line[i] == ',') {
+          ++i;
+        } else {
+          ++i;
+        }
+      }
+      if (i < line.size()) ++i;  // ']'
+    } else {
+      // number / literal
+      size_t start = i;
+      while (i < line.size() && line[i] != ',' && line[i] != '}') ++i;
+      if (key == "r") rec.r = std::strtoll(line.substr(start, i - start).c_str(), nullptr, 10);
+    }
+  }
+  return rec;
+}
+
+// ---------- the store ----------
+
+class Store {
+ public:
+  explicit Store(const char* wal_path) {
+    if (wal_path && wal_path[0]) {
+      wal_path_ = wal_path;
+      Replay();
+      wal_ = std::fopen(wal_path_.c_str(), "ab");
+    }
+  }
+
+  ~Store() { Close(); }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (wal_) {
+      std::fflush(wal_);
+      std::fclose(wal_);
+      wal_ = nullptr;
+    }
+  }
+
+  int64_t Put(const std::string& key, const std::string& value) {
+    std::lock_guard<std::mutex> g(mu_);
+    ++rev_;
+    ApplyPut(key, value, rev_);
+    if (wal_) {
+      std::string line = "{\"op\":\"put\",\"k\":";
+      json_escape(key, &line);
+      line += ",\"v\":";
+      json_escape(value, &line);
+      line += ",\"r\":" + std::to_string(rev_) + "}\n";
+      std::fwrite(line.data(), 1, line.size(), wal_);
+      std::fflush(wal_);
+    }
+    return rev_;
+  }
+
+  bool Delete(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = log_.find(key);
+    if (it == log_.end() || it->second.empty() || it->second.back().tombstone)
+      return false;
+    ++rev_;
+    ApplyDelete(key, rev_);
+    if (wal_) {
+      std::string line = "{\"op\":\"del\",\"k\":";
+      json_escape(key, &line);
+      line += ",\"r\":" + std::to_string(rev_) + "}\n";
+      std::fwrite(line.data(), 1, line.size(), wal_);
+      std::fflush(wal_);
+    }
+    return true;
+  }
+
+  // Returns JSON {"key","value","create_revision","mod_revision","version"}
+  // or "null".
+  std::string Get(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = log_.find(key);
+    if (it == log_.end() || it->second.empty() || it->second.back().tombstone)
+      return "null";
+    return KvJson(key, it->second.back());
+  }
+
+  std::string GetAt(const std::string& key, int64_t revision, bool* err_compacted) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (revision < compacted_) {
+      *err_compacted = true;
+      return "null";
+    }
+    auto it = log_.find(key);
+    if (it == log_.end()) return "null";
+    const Rev* best = nullptr;
+    for (const auto& r : it->second) {
+      if (r.mod <= revision) best = &r;
+      else break;
+    }
+    if (!best || best->tombstone) return "null";
+    return KvJson(key, *best);
+  }
+
+  std::string Range(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out = "[";
+    bool first = true;
+    for (auto it = log_.lower_bound(prefix); it != log_.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      if (it->second.empty() || it->second.back().tombstone) continue;
+      if (!first) out += ",";
+      first = false;
+      out += KvJson(it->first, it->second.back());
+    }
+    out += "]";
+    return out;
+  }
+
+  std::string History(const std::string& key, bool since_create) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out = "[";
+    auto it = log_.find(key);
+    if (it != log_.end()) {
+      std::vector<const Rev*> live;
+      for (const auto& r : it->second) {
+        if (r.tombstone) {
+          if (since_create) live.clear();
+        } else {
+          live.push_back(&r);
+        }
+      }
+      for (size_t i = 0; i < live.size(); ++i) {
+        if (i) out += ",";
+        out += KvJson(key, *live[i]);
+      }
+    }
+    out += "]";
+    return out;
+  }
+
+  int64_t Compact(int64_t revision, const std::vector<std::string>& keep) {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t dropped = CompactLocked(revision, keep);
+    if (wal_) {
+      std::string line = "{\"op\":\"compact\",\"r\":" + std::to_string(revision) +
+                         ",\"keep\":[";
+      for (size_t i = 0; i < keep.size(); ++i) {
+        if (i) line += ",";
+        json_escape(keep[i], &line);
+      }
+      line += "]}\n";
+      std::fwrite(line.data(), 1, line.size(), wal_);
+      std::fflush(wal_);
+    }
+    return dropped;
+  }
+
+  bool Snapshot(const std::string& path) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    std::string line = "{\"op\":\"rev\",\"r\":" + std::to_string(rev_) + "}\n";
+    std::fwrite(line.data(), 1, line.size(), f);
+    for (const auto& [key, revs] : log_) {
+      std::vector<const Rev*> live;
+      for (const auto& r : revs) {
+        if (r.tombstone) live.clear();
+        else live.push_back(&r);
+      }
+      for (const Rev* r : live) {
+        line = "{\"op\":\"put\",\"k\":";
+        json_escape(key, &line);
+        line += ",\"v\":";
+        json_escape(r->value, &line);
+        line += ",\"r\":" + std::to_string(r->mod) + "}\n";
+        std::fwrite(line.data(), 1, line.size(), f);
+      }
+    }
+    std::fclose(f);
+    return std::rename(tmp.c_str(), path.c_str()) == 0;
+  }
+
+  int64_t revision() {
+    std::lock_guard<std::mutex> g(mu_);
+    return rev_;
+  }
+
+ private:
+  void ApplyPut(const std::string& key, const std::string& value, int64_t rev) {
+    auto& revs = log_[key];
+    Rev r;
+    r.mod = rev;
+    r.value = value;
+    if (!revs.empty() && !revs.back().tombstone) {
+      r.create = revs.back().create;
+      r.version = revs.back().version + 1;
+    } else {
+      r.create = rev;
+      r.version = 1;
+    }
+    revs.push_back(std::move(r));
+  }
+
+  void ApplyDelete(const std::string& key, int64_t rev) {
+    auto& revs = log_[key];
+    Rev r;
+    r.mod = rev;
+    r.tombstone = true;
+    revs.push_back(std::move(r));
+  }
+
+  int64_t CompactLocked(int64_t revision, const std::vector<std::string>& keep) {
+    int64_t dropped = 0;
+    for (auto it = log_.begin(); it != log_.end();) {
+      const std::string& key = it->first;
+      bool kept = false;
+      for (const auto& p : keep) {
+        if (key.compare(0, p.size(), p) == 0) { kept = true; break; }
+      }
+      if (kept) { ++it; continue; }
+      auto& revs = it->second;
+      const Rev* floor = nullptr;
+      for (const auto& r : revs) {
+        if (r.mod <= revision) floor = &r;
+        else break;
+      }
+      std::vector<Rev> next;
+      if (floor && !floor->tombstone) next.push_back(*floor);
+      for (const auto& r : revs) {
+        if (r.mod > revision) next.push_back(r);
+      }
+      dropped += static_cast<int64_t>(revs.size() - next.size());
+      if (next.empty()) {
+        it = log_.erase(it);
+      } else {
+        revs = std::move(next);
+        ++it;
+      }
+    }
+    compacted_ = std::max(compacted_, revision);
+    return dropped;
+  }
+
+  void Replay() {
+    FILE* f = std::fopen(wal_path_.c_str(), "rb");
+    if (!f) return;
+    std::string line;
+    char buf[1 << 16];
+    auto apply_line = [&](const std::string& l) {
+      Record rec = parse_record(l);
+      if (!rec.ok) return;  // torn tail record
+      int64_t rev = rec.r >= 0 ? rec.r : rev_ + 1;
+      rev_ = std::max(rev_, rev);
+      if (rec.op == "put") ApplyPut(rec.k, rec.v, rev);
+      else if (rec.op == "del") ApplyDelete(rec.k, rev);
+      else if (rec.op == "compact") CompactLocked(rev, rec.keep);
+      // "rev": counter checkpoint only
+    };
+    while (std::fgets(buf, sizeof buf, f)) {
+      line += buf;
+      if (line.empty() || line.back() != '\n') continue;  // long line: keep reading
+      apply_line(line);
+      line.clear();
+    }
+    // a crash can flush a complete record without its trailing newline —
+    // the Python engine applies it (json parses after strip), so must we
+    if (!line.empty()) apply_line(line);
+    std::fclose(f);
+  }
+
+  static std::string KvJson(const std::string& key, const Rev& r) {
+    std::string out = "{\"key\":";
+    json_escape(key, &out);
+    out += ",\"value\":";
+    json_escape(r.value, &out);
+    out += ",\"create_revision\":" + std::to_string(r.create);
+    out += ",\"mod_revision\":" + std::to_string(r.mod);
+    out += ",\"version\":" + std::to_string(r.version) + "}";
+    return out;
+  }
+
+  std::mutex mu_;
+  std::map<std::string, std::vector<Rev>> log_;
+  int64_t rev_ = 0;
+  int64_t compacted_ = 0;
+  std::string wal_path_;
+  FILE* wal_ = nullptr;
+};
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mvcc_open(const char* wal_path) { return new Store(wal_path); }
+
+void mvcc_close(void* h) { delete static_cast<Store*>(h); }
+
+int64_t mvcc_put(void* h, const char* key, const char* value) {
+  return static_cast<Store*>(h)->Put(key, value);
+}
+
+int mvcc_delete(void* h, const char* key) {
+  return static_cast<Store*>(h)->Delete(key) ? 1 : 0;
+}
+
+char* mvcc_get(void* h, const char* key) {
+  return dup_string(static_cast<Store*>(h)->Get(key));
+}
+
+// Returns NULL when `revision` is below the compaction floor.
+char* mvcc_get_at(void* h, const char* key, int64_t revision) {
+  bool compacted = false;
+  std::string out = static_cast<Store*>(h)->GetAt(key, revision, &compacted);
+  if (compacted) return nullptr;
+  return dup_string(out);
+}
+
+char* mvcc_range(void* h, const char* prefix) {
+  return dup_string(static_cast<Store*>(h)->Range(prefix));
+}
+
+char* mvcc_history(void* h, const char* key, int since_create) {
+  return dup_string(static_cast<Store*>(h)->History(key, since_create != 0));
+}
+
+// keep_prefixes: NUL-separated list terminated by an empty string, e.g.
+// "a\0b\0\0".
+int64_t mvcc_compact(void* h, int64_t revision, const char* keep_prefixes) {
+  std::vector<std::string> keep;
+  const char* p = keep_prefixes;
+  while (p && *p) {
+    keep.emplace_back(p);
+    p += keep.back().size() + 1;
+  }
+  return static_cast<Store*>(h)->Compact(revision, keep);
+}
+
+int mvcc_snapshot(void* h, const char* path) {
+  return static_cast<Store*>(h)->Snapshot(path) ? 1 : 0;
+}
+
+int64_t mvcc_revision(void* h) { return static_cast<Store*>(h)->revision(); }
+
+void mvcc_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,112 @@
+// ICI sub-mesh placement search — native core.
+//
+// The hot loop of TpuScheduler.apply (gpu_docker_api_tpu/schedulers/tpu.py
+// _find_box): over all axis-aligned boxes of volume n in an (sx, sy, sz)
+// chip mesh, find the best free placement — compactest dims first (max ICI
+// bisection for the workload), then fewest exterior free links (least
+// fragmentation damage), then lowest origin. "TPU chips scheduled/sec" is
+// a headline metric (BASELINE.md); this core keeps the allocator O(boxes)
+// with zero Python overhead per candidate.
+//
+// Non-wraparound single-slice meshes only (the control plane's parity
+// target is single-host); the Python fallback handles torus topologies.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Key {
+  int sa;        // surface area of dims (smaller = more compact)
+  int ext_free;  // free ICI links leaving the box (fragmentation damage)
+  int oz, oy, ox;
+
+  bool operator<(const Key& other) const {
+    if (sa != other.sa) return sa < other.sa;
+    if (ext_free != other.ext_free) return ext_free < other.ext_free;
+    if (oz != other.oz) return oz < other.oz;
+    if (oy != other.oy) return oy < other.oy;
+    return ox < other.ox;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// status: int8[sx*sy*sz], row-major with x fastest (index = x + y*sx +
+// z*sx*sy); 0 = free, nonzero = used. On success writes n chip indices to
+// out and returns 1; returns 0 when no free box of volume n exists.
+int topo_find_box(int sx, int sy, int sz, const int8_t* status, int n,
+                  int32_t* out) {
+  if (n <= 0) return 0;
+  auto idx = [&](int x, int y, int z) { return x + y * sx + z * sx * sy; };
+
+  bool found = false;
+  Key best_key{};
+  int best_origin[3] = {0, 0, 0};
+  int best_dims[3] = {0, 0, 0};
+
+  for (int a = 1; a <= sx; ++a) {
+    if (n % a) continue;
+    for (int b = 1; b <= sy; ++b) {
+      if ((n / a) % b) continue;
+      int c = n / a / b;
+      if (c > sz) continue;
+      int sa = a * b + b * c + a * c;
+      for (int oz = 0; oz + c <= sz; ++oz) {
+        for (int oy = 0; oy + b <= sy; ++oy) {
+          for (int ox = 0; ox + a <= sx; ++ox) {
+            // all chips in the box free?
+            bool free_box = true;
+            for (int z = oz; z < oz + c && free_box; ++z)
+              for (int y = oy; y < oy + b && free_box; ++y)
+                for (int x = ox; x < ox + a; ++x)
+                  if (status[idx(x, y, z)]) { free_box = false; break; }
+            if (!free_box) continue;
+            // exterior free links
+            int ext = 0;
+            auto count_face = [&](int x, int y, int z) {
+              if (x >= 0 && x < sx && y >= 0 && y < sy && z >= 0 && z < sz &&
+                  !status[idx(x, y, z)])
+                ++ext;
+            };
+            for (int z = oz; z < oz + c; ++z)
+              for (int y = oy; y < oy + b; ++y) {
+                count_face(ox - 1, y, z);
+                count_face(ox + a, y, z);
+              }
+            for (int z = oz; z < oz + c; ++z)
+              for (int x = ox; x < ox + a; ++x) {
+                count_face(x, oy - 1, z);
+                count_face(x, oy + b, z);
+              }
+            for (int y = oy; y < oy + b; ++y)
+              for (int x = ox; x < ox + a; ++x) {
+                count_face(x, y, oz - 1);
+                count_face(x, y, oz + c);
+              }
+            Key key{sa, ext, oz, oy, ox};
+            if (!found || key < best_key) {
+              found = true;
+              best_key = key;
+              best_origin[0] = ox; best_origin[1] = oy; best_origin[2] = oz;
+              best_dims[0] = a; best_dims[1] = b; best_dims[2] = c;
+            }
+          }
+        }
+      }
+    }
+  }
+  if (!found) return 0;
+  int k = 0;
+  for (int z = best_origin[2]; z < best_origin[2] + best_dims[2]; ++z)
+    for (int y = best_origin[1]; y < best_origin[1] + best_dims[1]; ++y)
+      for (int x = best_origin[0]; x < best_origin[0] + best_dims[0]; ++x)
+        out[k++] = static_cast<int32_t>(idx(x, y, z));
+  std::sort(out, out + n);
+  return 1;
+}
+
+}  // extern "C"

@@ -4,10 +4,17 @@ via pytest.ini addopts before capture starts)."""
 import pytest  # noqa: E402
 
 
-@pytest.fixture()
-def store(tmp_path):
-    from gpu_docker_api_tpu.store import MVCCStore
-    s = MVCCStore(wal_path=str(tmp_path / "wal.jsonl"))
+def _engines():
+    from gpu_docker_api_tpu.store import native_available
+    return ["python", "native"] if native_available() else ["python"]
+
+
+@pytest.fixture(params=_engines())
+def store(tmp_path, request):
+    """Every store test runs against BOTH engines (pure Python and the C++
+    core) — they share the API and WAL format."""
+    from gpu_docker_api_tpu.store import open_store
+    s = open_store(wal_path=str(tmp_path / "wal.jsonl"), engine=request.param)
     yield s
     s.close()
 
