@@ -1,0 +1,19 @@
+# tpu-docker-api image (reference parity: Dockerfile / Dockerfile.mock — one
+# image here, the backend is a runtime flag). Intended base on a TPU VM is an
+# image with jax[tpu] preinstalled; for the control plane alone, slim works.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY gpu_docker_api_tpu/ gpu_docker_api_tpu/
+COPY native/ native/
+COPY api/ api/
+COPY scripts/ scripts/
+
+RUN make -C native
+
+EXPOSE 2378
+ENTRYPOINT ["python", "-m", "gpu_docker_api_tpu.cli"]
+CMD ["--addr", "0.0.0.0:2378", "--state-dir", "/data/state", "--backend", "docker"]

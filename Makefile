@@ -1,0 +1,44 @@
+# tpu-docker-api — build/test/serve targets (reference parity: Makefile with
+# build-tag matrix; here the mock/real seam is runtime --backend selection).
+
+PY ?= python3
+ADDR ?= 0.0.0.0:2378
+STATE ?= ./tpu-docker-api-state
+
+.PHONY: all native test test-fast bench serve serve-mock dryrun lint clean
+
+all: native
+
+native:                 ## build the C++ cores (MVCC store, topology search)
+	$(MAKE) -C native
+
+test: native            ## full suite on the virtual 8-device CPU mesh
+	$(PY) -m pytest tests/ -q
+
+test-fast: native       ## skip the slow model/e2e tests
+	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \
+	    --ignore=tests/test_parallel.py --ignore=tests/test_e2e_training.py
+
+bench: native           ## north-star metric on real hardware; one JSON line
+	$(PY) bench.py
+
+serve: native           ## real substrate (host processes + TPU env grants)
+	$(PY) -m gpu_docker_api_tpu.cli --addr $(ADDR) --state-dir $(STATE) \
+	    --backend process
+
+serve-mock:             ## no-hardware substrate (reference `-tags mock`)
+	$(PY) -m gpu_docker_api_tpu.cli --addr $(ADDR) --state-dir $(STATE) \
+	    --backend mock --topology v5p-8
+
+serve-docker: native    ## dockerd substrate with /dev/accel* passthrough
+	$(PY) -m gpu_docker_api_tpu.cli --addr $(ADDR) --state-dir $(STATE) \
+	    --backend docker
+
+dryrun:                 ## multi-chip sharding dry-run on 8 virtual devices
+	JAX_PLATFORMS=cpu JAX_PLATFORM_NAME=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf tpu-docker-api-state .pytest_cache

@@ -1,0 +1,61 @@
+"""Operation event log — the observability the reference lacks.
+
+SURVEY §5.1: the reference has no tracing; its only observability is leveled
+logs. The north-star metric (replicaSet cold-start -> first XLA step) needs
+timestamped per-operation events. Every API request is recorded with its
+request id, app code, and latency; events land in a bounded in-memory ring
+(served at GET /api/v1/events) and append to events.jsonl in the state dir
+for offline analysis.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class EventLog:
+    def __init__(self, state_dir: Optional[str] = None, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._f = None
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            self._f = open(os.path.join(state_dir, "events.jsonl"), "a",
+                           encoding="utf-8")
+
+    def record(self, op: str, target: str = "", code: int = 200,
+               duration_ms: float = 0.0, request_id: str = "",
+               **extra) -> None:
+        evt = {
+            "ts": round(time.time(), 4),
+            "op": op,
+            "target": target,
+            "code": code,
+            "durationMs": round(duration_ms, 2),
+            "requestId": request_id,
+        }
+        if extra:
+            evt.update(extra)
+        with self._lock:
+            self._ring.append(evt)
+            if self._f is not None:
+                self._f.write(json.dumps(evt) + "\n")
+                self._f.flush()
+
+    def recent(self, limit: int = 200, target: str = "") -> list[dict]:
+        with self._lock:
+            evts = list(self._ring)
+        if target:
+            evts = [e for e in evts if e.get("target") == target]
+        return evts[-limit:]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

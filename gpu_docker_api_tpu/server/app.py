@@ -20,6 +20,7 @@ from typing import Optional
 from .. import xerrors
 from ..backend import make_backend
 from ..dtos import ContainerRun, PatchRequest
+from ..events import EventLog
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
 from ..services import ReplicaSetService, VolumeService
 from ..store import StateClient, open_store
@@ -71,7 +72,9 @@ class App:
             self.container_versions, self.merges)
         self.volumes = VolumeService(self.backend, self.client, self.wq,
                                      self.volume_versions)
-        self.server = ApiServer(self._router(), addr=addr, api_key=api_key)
+        self.events = EventLog(state_dir)
+        self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
+                                events=self.events)
 
     # ------------------------------------------------------------- routes
 
@@ -96,6 +99,7 @@ class App:
         r.add("DELETE", f"{v1}/volumes/:name", self.h_vol_delete)
         r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
+        r.add("GET", f"{v1}/events", self.h_events)
         r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
         r.add("GET", f"{v1}/resources/gpus", self.h_res_tpus)  # legacy alias
         r.add("GET", f"{v1}/resources/cpus", self.h_res_cpus)
@@ -328,6 +332,11 @@ class App:
 
     # --------------------------------------------------- resource handlers
 
+    def h_events(self, req: Request) -> Response:
+        limit = int(req.query.get("limit", ["200"])[0])
+        target = req.query.get("target", [""])[0]
+        return ok({"events": self.events.recent(limit=limit, target=target)})
+
     def h_res_tpus(self, req: Request) -> Response:
         return ok({"tpus": self.tpu.get_status()})
 
@@ -355,6 +364,7 @@ class App:
         self.volume_versions.flush()
         self.merges.flush()
         self.backend.close()
+        self.events.close()
         self.store.close()
 
     @property

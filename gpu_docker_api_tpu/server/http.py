@@ -15,6 +15,7 @@ import logging
 import os
 import re
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -92,8 +93,9 @@ class Router:
 
 class ApiServer:
     def __init__(self, router: Router, addr: str = "127.0.0.1:2378",
-                 api_key: Optional[str] = None):
+                 api_key: Optional[str] = None, events=None):
         self.router = router
+        self.events = events
         host, _, port = addr.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -130,6 +132,7 @@ class ApiServer:
 
         req = Request(method, parsed.path, parse_qs(parsed.query, keep_blank_values=True),
                       body, headers, params)
+        t0 = time.perf_counter()
         try:
             resp = handler(req)
         except json.JSONDecodeError:
@@ -138,6 +141,13 @@ class ApiServer:
             log.exception("unhandled error on %s %s [%s]", method, parsed.path,
                           req.request_id)
             resp = err(ResCode.ServerBusy)
+        if self.events is not None:
+            self.events.record(
+                op=f"{method} {parsed.path}",
+                target=params.get("name", ""),
+                code=int(resp.code),
+                duration_ms=(time.perf_counter() - t0) * 1000,
+                request_id=req.request_id)
         return 200, cors, resp.payload()
 
     # ---- lifecycle ----

@@ -85,15 +85,23 @@ def is_dir(path: str) -> bool:
 def copy_dir(src: str, dest: str) -> None:
     """Recursively copy src/* into dest (created if missing), preserving
     metadata and symlinks. Replaces the reference's tar-pipe shell-out
-    (utils/copy.go:17-27) with an in-process copy."""
+    (utils/copy.go:17-27) with an in-process copy.
+
+    Existing symlinks in dest are kept (not clobbered): during rolling
+    replacement the NEW container's bind mounts are already materialized as
+    links, and the new spec's binds must win over the old layer's."""
     os.makedirs(dest, exist_ok=True)
-    for entry in os.listdir(src):
-        s = os.path.join(src, entry)
-        d = os.path.join(dest, entry)
-        if os.path.isdir(s) and not os.path.islink(s):
-            shutil.copytree(s, d, symlinks=True, dirs_exist_ok=True)
+    for entry in os.scandir(src):
+        d = os.path.join(dest, entry.name)
+        if entry.is_symlink():
+            if not os.path.lexists(d):
+                os.symlink(os.readlink(entry.path), d)
+        elif entry.is_dir():
+            copy_dir(entry.path, d)
         else:
-            shutil.copy2(s, d, follow_symlinks=False)
+            if os.path.lexists(d) and os.path.islink(d):
+                continue  # bind link in dest wins over a regular file in src
+            shutil.copy2(entry.path, d, follow_symlinks=False)
 
 
 def move_dir_contents(src: str, dest: str) -> None:

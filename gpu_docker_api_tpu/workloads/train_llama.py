@@ -1,0 +1,101 @@
+"""The flagship scheduled workload: resumable Llama training.
+
+This is what runs INSIDE a replicaSet container (BASELINE config 5: a
+MaxText-style Llama training job on a TPU slice, patched and rolled back
+mid-run through the REST API). It is deliberately structured the way the
+control plane expects workloads to behave:
+
+- devices come from the env the chip allocator injected (TPU_VISIBLE_CHIPS
+  et al.) — the script never picks chips itself;
+- ALL durable state (orbax checkpoints, metrics log) lives under --workdir,
+  which the operator binds to a volume / data disk; rolling replacement
+  copies the container's writable layer and volume binds forward, so after
+  a patch or rollback the job RESUMES from the last checkpoint instead of
+  restarting (SURVEY §5.4: control-plane rollback composes with workload
+  checkpointing);
+- metrics stream as JSONL so the control plane (or an operator) can tail
+  progress without attaching.
+
+Run: python -m gpu_docker_api_tpu.workloads.train_llama \
+        --config tiny --steps 100 --workdir /root/foo-tmp/run1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny",
+                   choices=["tiny", "mini", "llama3_8b"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--workdir", default=os.environ.get("CONTAINER_ROOT", "."))
+    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--tp", type=int, default=0, help="0 = auto from devices")
+    p.add_argument("--sp", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig
+    from ..parallel.mesh import MeshPlan, best_tp_for
+    from ..train import Trainer, TrainConfig, restore_checkpoint, save_checkpoint
+
+    os.makedirs(args.workdir, exist_ok=True)
+    ckpt_dir = os.path.abspath(os.path.join(args.workdir, "checkpoints"))
+    metrics_path = os.path.join(args.workdir, "metrics.jsonl")
+
+    config = {
+        "tiny": LlamaConfig.tiny,
+        "mini": LlamaConfig.llama_mini,
+        "llama3_8b": LlamaConfig.llama3_8b,
+    }[args.config]()
+
+    n_dev = jax.device_count()
+    tp = args.tp or best_tp_for(n_dev)
+    plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp)
+    trainer = Trainer.create(config, plan, tc=TrainConfig())
+    state = trainer.init(jax.random.key(0))
+
+    start_step = 0
+    try:
+        abstract = jax.eval_shape(lambda s: s, state)
+        state, start_step = restore_checkpoint(ckpt_dir, abstract)
+        print(f"resumed from checkpoint step {start_step}", flush=True)
+    except Exception:  # noqa: BLE001 — no/unreadable checkpoint: fresh start
+        pass
+
+    metrics_f = open(metrics_path, "a", encoding="utf-8")
+    key = jax.random.key(1234)
+    for step in range(start_step, args.steps):
+        key, sub = jax.random.split(key)
+        tokens = jax.random.randint(
+            sub, (args.batch, args.seq), 0, config.vocab_size, dtype=jnp.int32)
+        tokens = trainer.shard_batch(tokens)
+        t0 = time.perf_counter()
+        state, metrics = trainer.step(state, tokens)
+        loss = float(metrics["loss"])
+        rec = {"step": step + 1, "loss": round(loss, 5),
+               "step_time_s": round(time.perf_counter() - t0, 4),
+               "devices": n_dev, "plan": str(plan), "time": time.time()}
+        metrics_f.write(json.dumps(rec) + "\n")
+        metrics_f.flush()
+        if (step + 1) % args.checkpoint_every == 0 or step + 1 == args.steps:
+            save_checkpoint(ckpt_dir, jax.device_get(state), step + 1)
+            metrics_f.write(json.dumps(
+                {"checkpoint": step + 1, "time": time.time()}) + "\n")
+            metrics_f.flush()
+    metrics_f.close()
+    print(f"done: {args.steps} steps", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Wipe all control-plane state for a clean rerun (reference parity:
+# scripts/reset.sh, which deleted the etcd prefix + ./merges). The store is
+# embedded here, so reset = remove the state dir (WAL, events, backend
+# rootfs/volumes/images/logs).
+set -euo pipefail
+
+STATE_DIR="${1:-./tpu-docker-api-state}"
+
+if pgrep -f "gpu_docker_api_tpu.cli" > /dev/null 2>&1; then
+    echo "refusing to reset while a tpu-docker-api daemon is running" >&2
+    exit 1
+fi
+
+rm -rf "$STATE_DIR"
+echo "reset: removed $STATE_DIR"

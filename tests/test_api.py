@@ -243,3 +243,25 @@ def test_crash_resume(tmp_path):
         assert resp["data"]["version"] == 3
     finally:
         b.stop()
+
+
+def test_events_endpoint(app):
+    call(app, "POST", "/api/v1/replicaSet",
+         {"imageName": "i", "replicaSetName": "evt", "tpuCount": 1})
+    call(app, "PATCH", "/api/v1/replicaSet/evt", {"tpuPatch": {"tpuCount": 2}})
+    _, resp = call(app, "GET", "/api/v1/events")
+    evts = resp["data"]["events"]
+    assert len(evts) >= 2
+    ops = [e["op"] for e in evts]
+    assert any(op.startswith("POST /api/v1/replicaSet") for op in ops)
+    assert any(op.startswith("PATCH") for op in ops)
+    # target filter narrows to the named replicaSet's ops
+    _, resp = call(app, "GET", "/api/v1/events?target=evt")
+    evts_t = resp["data"]["events"]
+    assert evts_t and all(e["target"] == "evt" for e in evts_t)
+    evts = evts_t
+    assert all(e["durationMs"] >= 0 and e["requestId"] for e in evts)
+    assert all(e["code"] == 200 for e in evts)
+    # events.jsonl persisted on disk
+    import os
+    assert os.path.exists(os.path.join(app.state_dir, "events.jsonl"))
