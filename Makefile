@@ -17,7 +17,9 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \
-	    --ignore=tests/test_parallel.py --ignore=tests/test_e2e_training.py
+	    --ignore=tests/test_parallel.py \
+	    --ignore=tests/test_parallel_more.py \
+	    --ignore=tests/test_e2e_training.py
 
 bench: native           ## north-star metric on real hardware; one JSON line
 	$(PY) bench.py

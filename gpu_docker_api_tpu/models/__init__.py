@@ -1,1 +1,53 @@
+"""Model families of the workload runtime.
+
+Each family exposes the same functional surface — ``init_params(config,
+key)``, ``forward(params, tokens, config, ...) -> logits | (logits,
+extra_loss)``, ``param_kinds(config)`` — so the trainer (train.py) is
+family-agnostic: it shards by kind tree and adds whatever extra loss the
+forward returns (MoE router aux) to the CE objective.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
 from .llama import LlamaConfig, llama_forward, init_params, param_kinds  # noqa: F401
+from . import llama as _llama
+from . import moe as _moe
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    init_params: Callable
+    forward: Callable          # (params, tokens, config, *, impl, mesh)
+    param_kinds: Callable
+    config_cls: Any
+    returns_extra_loss: bool = False
+
+
+LLAMA = ModelFamily(
+    name="llama",
+    init_params=_llama.init_params,
+    forward=_llama.llama_forward,
+    param_kinds=_llama.param_kinds,
+    config_cls=_llama.LlamaConfig,
+)
+
+MOE = ModelFamily(
+    name="moe",
+    init_params=_moe.init_params,
+    forward=_moe.moe_forward,
+    param_kinds=_moe.param_kinds,
+    config_cls=_moe.MoEConfig,
+    returns_extra_loss=True,
+)
+
+FAMILIES = {f.name: f for f in (LLAMA, MOE)}
+
+
+def family_for(config) -> ModelFamily:
+    """The family owning a config instance."""
+    for fam in FAMILIES.values():
+        if isinstance(config, fam.config_cls):
+            return fam
+    raise TypeError(f"no model family for config {type(config).__name__}")

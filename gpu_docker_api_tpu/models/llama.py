@@ -43,6 +43,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # long-context strategy when the mesh shards the sequence (sp > 1):
+    # "ring" = K/V rotate around the ICI ring (parallel/ring.py, O(S/n)
+    # memory); "ulysses" = all-to-all head scatter (parallel/ulysses.py,
+    # full-seq flash kernel per head group)
+    sp_attn: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -72,6 +77,31 @@ class LlamaConfig:
 
 # ---- parameters ------------------------------------------------------------
 
+def attention_params(config, key: jax.Array) -> dict:
+    """Attention-side params of one decoder layer (norms + QKV/O projections)
+    — shared by every family that reuses _attention_block (llama, moe)."""
+    c = config
+    init = jax.nn.initializers.normal(stddev=0.02)
+    kq = c.n_heads * c.head_dim
+    kv = c.n_kv_heads * c.head_dim
+    ks = jax.random.split(key, 4)
+    return {
+        "attn_norm": jnp.ones((c.d_model,), jnp.float32),
+        "wq": init(ks[0], (c.d_model, kq), c.dtype),
+        "wk": init(ks[1], (c.d_model, kv), c.dtype),
+        "wv": init(ks[2], (c.d_model, kv), c.dtype),
+        "wo": init(ks[3], (kq, c.d_model), c.dtype),
+        "mlp_norm": jnp.ones((c.d_model,), jnp.float32),
+    }
+
+
+ATTN_PARAM_KINDS = {
+    "attn_norm": "norm", "mlp_norm": "norm",
+    "wq": "attn_in", "wk": "attn_in", "wv": "attn_in",
+    "wo": "attn_out",
+}
+
+
 def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     """Initialize the parameter pytree. Layers are stacked along a leading
     axis so the decoder runs as ONE lax.scan — one XLA compilation of the
@@ -79,21 +109,14 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     c = config
     k_embed, k_layers, k_out = jax.random.split(key, 3)
     init = jax.nn.initializers.normal(stddev=0.02)
-    kq = c.n_heads * c.head_dim
-    kv = c.n_kv_heads * c.head_dim
 
     def layer_params(k) -> dict:
-        ks = jax.random.split(k, 7)
+        k_attn, *ks = jax.random.split(k, 4)
         return {
-            "attn_norm": jnp.ones((c.d_model,), jnp.float32),
-            "wq": init(ks[0], (c.d_model, kq), c.dtype),
-            "wk": init(ks[1], (c.d_model, kv), c.dtype),
-            "wv": init(ks[2], (c.d_model, kv), c.dtype),
-            "wo": init(ks[3], (kq, c.d_model), c.dtype),
-            "mlp_norm": jnp.ones((c.d_model,), jnp.float32),
-            "w1": init(ks[4], (c.d_model, c.d_ff), c.dtype),  # gate
-            "w3": init(ks[5], (c.d_model, c.d_ff), c.dtype),  # up
-            "w2": init(ks[6], (c.d_ff, c.d_model), c.dtype),  # down
+            **attention_params(c, k_attn),
+            "w1": init(ks[0], (c.d_model, c.d_ff), c.dtype),  # gate
+            "w3": init(ks[1], (c.d_model, c.d_ff), c.dtype),  # up
+            "w2": init(ks[2], (c.d_ff, c.d_model), c.dtype),  # down
         }
 
     layer_keys = jax.random.split(k_layers, c.n_layers)
@@ -112,9 +135,7 @@ def param_kinds(config: LlamaConfig) -> dict:
     return {
         "embed": "embed",
         "layers": {
-            "attn_norm": "norm", "mlp_norm": "norm",
-            "wq": "attn_in", "wk": "attn_in", "wv": "attn_in",
-            "wo": "attn_out",
+            **ATTN_PARAM_KINDS,
             "w1": "mlp_in", "w3": "mlp_in", "w2": "mlp_out",
         },
         "final_norm": "norm",
@@ -161,10 +182,15 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        # sequence sharded over sp: K/V rotate around the ICI ring instead of
-        # being all-gathered — no device holds full K/V or [S, S] scores
-        from ..parallel.ring import ring_attention
-        out = ring_attention(q, k, v, mesh, causal=True)
+        if c.sp_attn == "ulysses":
+            # all-to-all head scatter: full-seq kernel on H/sp heads
+            from ..parallel.ulysses import ulysses_attention
+            out = ulysses_attention(q, k, v, mesh, causal=True, impl=impl)
+        else:
+            # K/V rotate around the ICI ring instead of being all-gathered —
+            # no device holds full K/V or [S, S] scores
+            from ..parallel.ring import ring_attention
+            out = ring_attention(q, k, v, mesh, causal=True)
     else:
         out = attention(q, k, v, causal=True, impl=impl)   # [B, S, H, Dh]
     out = out.reshape(b, s, c.n_heads * c.head_dim) @ layer["wo"]

@@ -1,0 +1,237 @@
+"""Mixtral-family sparse Mixture-of-Experts transformer with expert
+parallelism, pure JAX, TPU-first.
+
+Second model family of the workload runtime (the reference schedules opaque
+containers — SURVEY §2 notes DP/TP/EP "none exist" in it; EP is a
+first-class design obligation here per SURVEY §5.7/5.8). Same decoder
+skeleton as models/llama.py (GQA + RoPE + RMSNorm, bf16 matmuls, one
+lax.scan over stacked layers); the dense SwiGLU MLP is replaced by a
+top-k-routed bank of SwiGLU experts.
+
+TPU-first routing design (the GShard/Mesh-TensorFlow dense-dispatch
+formulation, not a torch-style gather/scatter):
+
+- top-k routing with a STATIC per-expert capacity C — shapes never depend
+  on the router's decisions, so XLA compiles one program;
+- dispatch and combine are one-hot EINSUMS (``tsd,tsec->ecd`` and back),
+  which the MXU eats directly; with expert weights sharded over the ``ep``
+  mesh axis and tokens sharded over the data axes, XLA lowers the pair to
+  ICI all-to-alls — exactly the manual a2a schedule, for free;
+- tokens over capacity are DROPPED (their combine weight is zero and the
+  residual stream carries them through unchanged) — the standard
+  capacity-factor contract;
+- router in f32 (softmax statistics), experts in bf16;
+- aux losses: load-balancing (Switch-style fraction·probability dot) and
+  router z-loss, both returned for the trainer to weigh in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from .llama import (
+    ATTN_PARAM_KINDS, LlamaConfig, _attention_block, attention_params,
+    rms_norm, rope_frequencies,
+)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336          # per-expert hidden
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    router_aux_weight: float = 0.01
+    router_z_weight: float = 1e-3
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def as_llama(self) -> LlamaConfig:
+        """The attention-side view of this config (shared blocks)."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
+            max_seq_len=self.max_seq_len, rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps, dtype=self.dtype)
+
+    def capacity(self, tokens_per_shard: int) -> int:
+        """Static per-expert slot count for a given token count."""
+        cap = int(self.capacity_factor * self.top_k * tokens_per_shard
+                  / self.n_experts)
+        return max(cap, self.top_k)
+
+    # ---- canned configs ----
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "MoEConfig":
+        return cls()
+
+    @classmethod
+    def moe_mini(cls) -> "MoEConfig":
+        """~100M-param 1-chip config, head_dim 128 for the flash path."""
+        return cls(vocab_size=32000, d_model=512, n_layers=4, n_heads=4,
+                   n_kv_heads=2, d_ff=1024, n_experts=8, top_k=2,
+                   max_seq_len=2048)
+
+    @classmethod
+    def tiny(cls) -> "MoEConfig":
+        """Unit-test config for the 8-device CPU mesh."""
+        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=96, n_experts=4, top_k=2,
+                   max_seq_len=128, dtype=jnp.float32)
+
+
+# ---- parameters ------------------------------------------------------------
+
+def init_params(config: MoEConfig, key: jax.Array) -> dict:
+    """Parameter pytree; layers stacked along a leading axis (one lax.scan
+    body, like the llama family)."""
+    c = config
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(stddev=0.02)
+
+    def layer_params(k) -> dict:
+        k_attn, *ks = jax.random.split(k, 5)
+        return {
+            **attention_params(c.as_llama(), k_attn),
+            # router in f32: its softmax decides routing, keep it exact
+            "router": init(ks[0], (c.d_model, c.n_experts), jnp.float32),
+            "we1": init(ks[1], (c.n_experts, c.d_model, c.d_ff), c.dtype),
+            "we3": init(ks[2], (c.n_experts, c.d_model, c.d_ff), c.dtype),
+            "we2": init(ks[3], (c.n_experts, c.d_ff, c.d_model), c.dtype),
+        }
+
+    layer_keys = jax.random.split(k_layers, c.n_layers)
+    layers = jax.vmap(layer_params)(layer_keys)
+    return {
+        "embed": init(k_embed, (c.vocab_size, c.d_model), c.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((c.d_model,), jnp.float32),
+        "lm_head": init(k_out, (c.d_model, c.vocab_size), c.dtype),
+    }
+
+
+def param_kinds(config: MoEConfig) -> dict:
+    """Sharding-kind tree (keys into parallel.mesh.param_sharding_rules)."""
+    return {
+        "embed": "embed",
+        "layers": {
+            **ATTN_PARAM_KINDS,
+            "router": "router",
+            "we1": "expert_in", "we3": "expert_in", "we2": "expert_out",
+        },
+        "final_norm": "norm",
+        "lm_head": "lm_head",
+    }
+
+
+# ---- the MoE block ---------------------------------------------------------
+
+def moe_block(x: jax.Array, layer: dict, config: MoEConfig
+              ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """x [B, S, D] -> (x + moe_out, aux_loss, z_loss).
+
+    Dense-dispatch MoE: top-k routing, static capacity, one-hot dispatch /
+    combine einsums. All shapes are static; sharding (ep on the expert axis)
+    turns the einsums into all-to-alls.
+    """
+    c = config
+    b, s, d = x.shape
+    h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+    t = b * s
+    ht = h.reshape(t, d)
+
+    # -- routing (f32) --
+    logits = ht.astype(jnp.float32) @ layer["router"]        # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, c.top_k)      # [T, K]
+    # Mixtral renormalizes the selected gates
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    cap = c.capacity(t)
+    # position of each (token, k) choice within its expert's capacity:
+    # rank choices expert-major so k=0 picks win slots before k=1 spillover
+    onehot = jax.nn.one_hot(gate_idx, c.n_experts, dtype=jnp.int32)  # [T,K,E]
+    flat = onehot.reshape(t * c.top_k, c.n_experts)
+    pos = jnp.cumsum(flat, axis=0) * flat - 1                # [T*K, E]
+    pos = pos.reshape(t, c.top_k, c.n_experts)
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1)           # [T, K]
+    keep = pos_in_expert < cap
+
+    # -- dispatch/combine tensors --
+    # dispatch [T, E, C]: 1 where token t occupies slot c of expert e
+    slot_onehot = jax.nn.one_hot(
+        jnp.where(keep, pos_in_expert, -1), cap, dtype=ht.dtype)  # [T,K,C]
+    disp = jnp.einsum("tke,tkc->tec", onehot.astype(ht.dtype), slot_onehot)
+    comb = jnp.einsum(
+        "tke,tkc,tk->tec", onehot.astype(jnp.float32),
+        slot_onehot.astype(jnp.float32),
+        gate_vals * keep.astype(jnp.float32))                # [T, E, C] f32
+
+    # -- expert computation --
+    xe = jnp.einsum("td,tec->ecd", ht, disp)                 # [E, C, D]
+    g = jnp.einsum("ecd,edf->ecf", xe, layer["we1"])
+    u = jnp.einsum("ecd,edf->ecf", xe, layer["we3"])
+    y = jax.nn.silu(g) * u                                   # SwiGLU
+    ye = jnp.einsum("ecf,efd->ecd", y, layer["we2"])         # [E, C, D]
+    out = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), comb)
+
+    # -- aux losses (f32 scalars) --
+    # Switch load-balance: E * mean_e(fraction routed) · mean_e(router prob)
+    frac = jnp.mean(onehot[:, 0, :].astype(jnp.float32), axis=0)  # top-1 share
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = c.n_experts * jnp.sum(frac * mean_prob)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+
+    return x + out.reshape(b, s, d).astype(x.dtype), aux, z
+
+
+# ---- forward ---------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config", "impl", "mesh"))
+def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
+                impl: str = "auto", mesh: Optional[Mesh] = None,
+                ) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] f32, router_loss scalar).
+
+    router_loss = aux_weight * load_balance + z_weight * z_loss, summed over
+    layers — add it to the CE loss when training.
+    """
+    c = config
+    lc = c.as_llama()
+    s = tokens.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_frequencies(lc, jnp.arange(s))
+
+    def body(carry, layer):
+        x, aux_sum, z_sum = carry
+        x = _attention_block(x, layer, lc, cos, sin, impl, mesh)
+        x, aux, z = moe_block(x, layer, c)
+        return (x, aux_sum + aux, z_sum + z), None
+
+    (x, aux_sum, z_sum), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    router_loss = (c.router_aux_weight * aux_sum + c.router_z_weight * z_sum)
+    return logits, router_loss

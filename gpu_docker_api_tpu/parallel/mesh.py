@@ -11,8 +11,13 @@ Axes:
   dp    — pure data parallelism (gradient psum over DCN or ICI)
   fsdp  — data parallelism with fully-sharded parameters (ZeRO-3 style;
           XLA all-gathers params per layer, reduce-scatters grads)
+  pp    — pipeline parallelism: decoder trunk split into pp stages,
+          microbatches flow stage-to-stage via ppermute (parallel/pipeline.py)
+  ep    — expert parallelism: MoE expert weights sharded over experts,
+          token dispatch/combine einsums become ICI all-to-alls (models/moe.py)
   tp    — tensor (megatron) parallelism within attention/MLP blocks
-  sp    — sequence/context parallelism for long sequences (ring attention)
+  sp    — sequence/context parallelism for long sequences (ring attention
+          over sp, or Ulysses all-to-all head scatter — parallel/ulysses.py)
 
 The reference control plane has no parallelism code at all (SURVEY §2:
 "DP, TP, PP, SP ... none exist"); this module is the TPU-native answer to
@@ -29,38 +34,47 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 
 @dataclass(frozen=True)
 class MeshPlan:
     """How many devices each parallelism axis gets. Product must equal the
-    device count handed to make_mesh."""
+    device count handed to make_mesh. Axis order = AXES: dp outermost (can
+    ride DCN), then fsdp, pp, ep, with tp and sp innermost (the chattiest
+    axes — per-layer all-gathers/all-to-alls — get the contiguous ICI
+    neighbors under row-major device order)."""
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.pp * self.ep * self.tp * self.sp
 
     @classmethod
-    def auto(cls, n_devices: int, tp: int = 1, sp: int = 1) -> "MeshPlan":
-        """Default recipe: give tp/sp what was asked, spend the rest on fsdp
-        (params sharded as wide as possible — the usual memory winner)."""
-        rest = n_devices // (tp * sp)
-        if tp * sp * rest != n_devices:
+    def auto(cls, n_devices: int, tp: int = 1, sp: int = 1, pp: int = 1,
+             ep: int = 1) -> "MeshPlan":
+        """Default recipe: give tp/sp/pp/ep what was asked, spend the rest on
+        fsdp (params sharded as wide as possible — the usual memory winner)."""
+        fixed = tp * sp * pp * ep
+        rest = n_devices // fixed
+        if fixed * rest != n_devices:
             raise ValueError(
-                f"tp({tp}) * sp({sp}) must divide device count {n_devices}")
-        return cls(dp=1, fsdp=rest, tp=tp, sp=sp)
+                f"tp({tp})*sp({sp})*pp({pp})*ep({ep}) must divide device "
+                f"count {n_devices}")
+        return cls(dp=1, fsdp=rest, pp=pp, ep=ep, tp=tp, sp=sp)
 
 
 def make_mesh(plan: MeshPlan, devices: Optional[list] = None) -> Mesh:
     devs = devices if devices is not None else jax.devices()
     if plan.size != len(devs):
         raise ValueError(f"plan {plan} needs {plan.size} devices, have {len(devs)}")
-    arr = np.asarray(devs).reshape(plan.dp, plan.fsdp, plan.tp, plan.sp)
+    arr = np.asarray(devs).reshape(plan.dp, plan.fsdp, plan.pp, plan.ep,
+                                   plan.tp, plan.sp)
     return Mesh(arr, AXES)
 
 
@@ -81,22 +95,32 @@ def param_sharding_rules() -> dict[str, P]:
         "mlp_out": P("tp", "fsdp"),      # [F, D] (w2)
         "norm": P(None),                 # [D]
         "lm_head": P("fsdp", "tp"),      # [D, V]
+        # MoE (models/moe.py): experts over ep; within an expert the same
+        # column/row-parallel split as the dense MLP
+        "router": P(None, None),         # [D, E] — tiny, replicated
+        "expert_in": P("ep", "fsdp", "tp"),   # [E, D, F] (w1, w3)
+        "expert_out": P("ep", "tp", "fsdp"),  # [E, F, D] (w2)
     }
 
 
+BATCH_AXES = ("dp", "fsdp", "ep")
+
+
 def activation_spec() -> P:
-    """[batch, seq, d_model]: batch over dp+fsdp, sequence over sp."""
-    return P(("dp", "fsdp"), "sp", None)
+    """[batch, seq, d_model]: batch over the data axes (dp+fsdp, plus ep —
+    tokens live distributed over expert devices until the MoE dispatch
+    all-to-all), sequence over sp."""
+    return P(BATCH_AXES, "sp", None)
 
 
 def logits_spec() -> P:
     """[batch, seq, vocab]: vocab over tp keeps the big tensor sharded."""
-    return P(("dp", "fsdp"), "sp", "tp")
+    return P(BATCH_AXES, "sp", "tp")
 
 
 def batch_spec() -> P:
     """Integer token batches [batch, seq]."""
-    return P(("dp", "fsdp"), "sp")
+    return P(BATCH_AXES, "sp")
 
 
 def shard_params(params, mesh: Mesh, kinds) -> dict:
@@ -115,6 +139,18 @@ def constraint(x, mesh: Mesh, spec: P):
     if mesh.empty:
         return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def head_axis_for(mesh: Mesh, n_heads: int, n_kv_heads: int):
+    """The PartitionSpec entry for an attention-head axis inside the
+    sequence-parallel shard_map regions (ring/ulysses): shard heads over tp
+    when both head counts divide by it (attention is per-head independent),
+    else replicate them (None) — the all-gather XLA then inserts is the
+    correctness fallback for odd GQA configs."""
+    tp_n = mesh.shape.get("tp", 1)
+    if tp_n > 1 and n_heads % tp_n == 0 and n_kv_heads % tp_n == 0:
+        return "tp"
+    return None
 
 
 def best_tp_for(n_devices: int, max_tp: int = 8) -> int:

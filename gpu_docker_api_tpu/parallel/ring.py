@@ -34,7 +34,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     if n == 1:
         return _local_attention(q, k, v, causal=causal)
 
-    spec_q = P(("dp", "fsdp"), axis, None, None)
+    from .mesh import BATCH_AXES, head_axis_for
+    head_ax = head_axis_for(mesh, q.shape[2], k.shape[2])
+    spec_q = P(BATCH_AXES, axis, head_ax, None)
     local = functools.partial(_ring_local, axis=axis, ring=n, causal=causal)
     return jax.shard_map(
         local, mesh=mesh,

@@ -1,0 +1,80 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter / seq gather.
+
+The second long-context strategy next to ring attention (parallel/ring.py),
+per the DeepSpeed-Ulysses formulation: with the sequence sharded over `sp`,
+two ICI all-to-alls re-partition attention inputs from sequence-sharded to
+HEAD-sharded — each device then runs ordinary full-sequence attention on
+H/sp heads, and a final all-to-all restores sequence sharding.
+
+Trade-off vs ring attention (why both exist):
+- Ulysses moves q/k/v/o once each (4 all-to-alls of the LOCAL shard) and
+  reuses the single-chip flash kernel unchanged on the full sequence —
+  better when heads >> sp and the pallas kernel dominates.
+- Ring never materializes full-sequence K/V on a device (memory O(S/n))
+  and overlaps its per-hop ppermute with compute — better when S is too
+  long to hold even one full K/V per device.
+
+Built as a shard_map manual over sp only (tp/fsdp stay automatic) with
+lax.all_to_all, XLA lowering both onto ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import attention as _local_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = "sp", causal: bool = True,
+                      impl: str = "auto") -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over `axis` — returns
+    [B,S,H,D] same sharding. Call from OUTSIDE shard_map; global shapes
+    in/out. Requires H % sp == 0 (KV heads are replicated up to the group
+    size first when Hkv % sp != 0)."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return _local_attention(q, k, v, causal=causal, impl=impl)
+
+    from .mesh import BATCH_AXES, head_axis_for
+    head_ax = head_axis_for(mesh, q.shape[2], k.shape[2])
+    tp_n = mesh.shape["tp"] if head_ax else 1
+    if (q.shape[2] // tp_n) % n != 0:
+        raise ValueError(
+            f"n_heads {q.shape[2]}/tp={tp_n} must divide by sp {n} for Ulysses")
+    spec = P(BATCH_AXES, axis, head_ax, None)
+    local = functools.partial(_ulysses_local, axis=axis, sp=n, causal=causal,
+                              impl=impl)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis: str, sp: int, causal: bool, impl: str):
+    """Per-device body. q [b, s/sp, H, D]; k/v [b, s/sp, Hkv, D]."""
+    hkv = k.shape[2]
+    if hkv % sp != 0:
+        # replicate KV heads up to the GQA group so the head axis splits
+        rep = sp // hkv if sp % hkv == 0 else q.shape[2] // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    # seq-sharded -> head-sharded: split heads over sp, gather sequence
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh = scatter_heads(q)          # [b, S, H/sp, D]
+    kh = scatter_heads(k)
+    vh = scatter_heads(v)
+    out = _local_attention(qh, kh, vh, causal=causal, impl=impl)
+    # head-sharded -> seq-sharded: split sequence, gather heads back
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                              tiled=True)

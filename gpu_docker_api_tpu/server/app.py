@@ -333,7 +333,12 @@ class App:
     # --------------------------------------------------- resource handlers
 
     def h_events(self, req: Request) -> Response:
-        limit = int(req.query.get("limit", ["200"])[0])
+        try:
+            limit = int(req.query.get("limit", ["200"])[0])
+        except ValueError:
+            return err(ResCode.InvalidParams)
+        if limit < 0:
+            return err(ResCode.InvalidParams)
         target = req.query.get("target", [""])[0]
         return ok({"events": self.events.recent(limit=limit, target=target)})
 

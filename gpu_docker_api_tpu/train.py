@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .models.llama import LlamaConfig, init_params, llama_forward, param_kinds
+from .models import family_for
 from .parallel.mesh import (
     MeshPlan, batch_spec, make_mesh, param_sharding_rules,
 )
@@ -34,6 +34,7 @@ class TrainConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     remat: bool = True   # jax.checkpoint the layer body: HBM for FLOPs
+    n_microbatches: int = 4  # pipeline microbatches when the mesh has pp > 1
 
 
 def _pathkey(path) -> str:
@@ -49,27 +50,42 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(params, tokens, config: LlamaConfig, impl: str = "auto",
-            mesh=None):
-    """Next-token CE. tokens [B, S]; predicts tokens[:, 1:]."""
-    logits = llama_forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
+def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
+            n_microbatches: int = 0, remat: bool = True):
+    """Next-token CE (+ the family's extra loss, e.g. MoE router aux).
+    tokens [B, S]; predicts tokens[:, 1:]. n_microbatches > 0 selects the
+    pipelined trunk (mesh must have pp > 1)."""
+    fam = family_for(config)
+    if n_microbatches:
+        from .parallel.pipeline import pipeline_forward
+        if fam.returns_extra_loss:
+            raise NotImplementedError(
+                "pipelined MoE trunk not composed yet — use pp=1 for MoE")
+        out = pipeline_forward(params, tokens, config, mesh,
+                               n_microbatches=n_microbatches, impl=impl,
+                               remat=remat)
+    else:
+        out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
+    logits, extra = out if fam.returns_extra_loss else (out, 0.0)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + extra
 
 
-def param_specs(config: LlamaConfig) -> Any:
+def param_specs(config, pipelined: bool = False) -> Any:
     """PartitionSpec pytree matching init_params structure. Layer params are
     STACKED along a leading n_layers axis (one lax.scan body — llama.py
-    init_params), so their 2-D rules get a leading None: the scan axis is
-    never sharded, fsdp/tp land on the documented matrix axes."""
+    init_params); that scan axis is sharded over pp when the trunk is
+    pipelined, else unsharded — fsdp/tp/ep land on the documented matrix
+    axes either way."""
     rules = param_sharding_rules()
-    kinds = param_kinds(config)
+    kinds = family_for(config).param_kinds(config)
+    lead = "pp" if pipelined else None
 
     def stacked(spec: P) -> P:
-        return P(None, *spec)
+        return P(lead, *spec)
 
     return {
         "embed": rules[kinds["embed"]],
@@ -88,14 +104,18 @@ class Trainer:
         state = trainer.init(jax.random.key(0))
         state, metrics = trainer.step(state, tokens)
     """
-    config: LlamaConfig
+    config: Any
     tc: TrainConfig
     mesh: Mesh
     optimizer: optax.GradientTransformation
     _step_fn: Any = None
 
+    @property
+    def _pipelined(self) -> bool:
+        return self.mesh.shape.get("pp", 1) > 1
+
     @classmethod
-    def create(cls, config: LlamaConfig, plan: Optional[MeshPlan] = None,
+    def create(cls, config, plan: Optional[MeshPlan] = None,
                tc: Optional[TrainConfig] = None,
                devices: Optional[list] = None) -> "Trainer":
         plan = plan or MeshPlan.auto(len(devices or jax.devices()))
@@ -107,22 +127,36 @@ class Trainer:
 
     # ---- sharding helpers ----
 
+    def _init_fn(self, k):
+        params = family_for(self.config).init_params(self.config, k)
+        opt_state = self.optimizer.init(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _abstract_and_shardings(self, key):
+        params_sh = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            param_specs(self.config, pipelined=self._pipelined))
+        out_shape = jax.eval_shape(self._init_fn, key)
+        return out_shape, self._state_shardings(out_shape, params_sh)
+
     def init(self, key: jax.Array) -> dict:
         """Sharded init: params materialize directly on the mesh (jit with
         out_shardings — no host-side 8B-param detour)."""
-        params_sh = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), param_specs(self.config))
-
-        def _init(k):
-            params = init_params(self.config, k)
-            opt_state = self.optimizer.init(params)
-            return {"params": params, "opt_state": opt_state,
-                    "step": jnp.zeros((), jnp.int32)}
-
-        out_shape = jax.eval_shape(_init, key)
-        out_sh = self._state_shardings(out_shape, params_sh)
+        _, out_sh = self._abstract_and_shardings(key)
         with self.mesh:
-            return jax.jit(_init, out_shardings=out_sh)(key)
+            return jax.jit(self._init_fn, out_shardings=out_sh)(key)
+
+    def abstract_state(self, key: jax.Array):
+        """ShapeDtypeStructs (with shardings) of the full train state, WITHOUT
+        materializing anything on device — the restore-side template for
+        orbax (resume must not pay a full init first; an 8B-param init just
+        to discard it doubles startup HBM and time on the patch/rollback
+        path the control plane exercises)."""
+        out_shape, out_sh = self._abstract_and_shardings(key)
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            out_shape, out_sh)
 
     def _state_shardings(self, state_shape, params_sh):
         """Shardings for the whole train state: exact specs for params;
@@ -165,10 +199,15 @@ class Trainer:
 
         mesh = self.mesh
 
+        mb = self.tc.n_microbatches if self._pipelined else 0
+
         def step(state, tokens):
             def compute_loss(p):
-                return loss_fn(p, tokens, cfg, mesh=mesh)
-            lfn = jax.checkpoint(compute_loss) if self.tc.remat else compute_loss
+                return loss_fn(p, tokens, cfg, mesh=mesh, n_microbatches=mb,
+                               remat=self.tc.remat)
+            # pipelined trunk remats per-stage inside the schedule
+            use_remat = self.tc.remat and not mb
+            lfn = jax.checkpoint(compute_loss) if use_remat else compute_loss
             loss, grads = jax.value_and_grad(lfn)(state["params"])
             updates, new_opt = self.optimizer.update(
                 grads, state["opt_state"], state["params"])

@@ -97,6 +97,8 @@ def copy_dir(src: str, dest: str) -> None:
             if not os.path.lexists(d):
                 os.symlink(os.readlink(entry.path), d)
         elif entry.is_dir():
+            if os.path.islink(d):
+                continue  # bind link in dest wins over a directory in src too
             copy_dir(entry.path, d)
         else:
             if os.path.lexists(d) and os.path.islink(d):

@@ -62,15 +62,17 @@ def main(argv=None) -> int:
     tp = args.tp or best_tp_for(n_dev)
     plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp)
     trainer = Trainer.create(config, plan, tc=TrainConfig())
-    state = trainer.init(jax.random.key(0))
 
+    # resume-first: restore against the ABSTRACT state template (no device
+    # materialization); pay for a fresh sharded init only when there is no
+    # usable checkpoint
     start_step = 0
     try:
-        abstract = jax.eval_shape(lambda s: s, state)
+        abstract = trainer.abstract_state(jax.random.key(0))
         state, start_step = restore_checkpoint(ckpt_dir, abstract)
         print(f"resumed from checkpoint step {start_step}", flush=True)
     except Exception:  # noqa: BLE001 — no/unreadable checkpoint: fresh start
-        pass
+        state = trainer.init(jax.random.key(0))
 
     metrics_f = open(metrics_path, "a", encoding="utf-8")
     key = jax.random.key(1234)

@@ -18,9 +18,15 @@ def _ensure_env() -> None:
     if "xla_force_host_platform_device_count" not in flags:
         need = True
         flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # The TPU-tunnel sitecustomize keys off this var; with it set, every
+    # backend init dials the tunnel (jax_platforms is forced to "axon,cpu"),
+    # and a wedged tunnel hangs the whole CPU suite. Drop it.
+    if "PALLAS_AXON_POOL_IPS" in os.environ:
+        need = True
     if need and os.environ.get("_TDAPI_TEST_REEXEC") != "1":
         env = dict(os.environ)
         env.update(_WANT)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env["XLA_FLAGS"] = flags
         env["_TDAPI_TEST_REEXEC"] = "1"  # one retry only — never loop
         ret = subprocess.run(

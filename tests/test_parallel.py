@@ -27,7 +27,8 @@ def test_mesh_plan_auto():
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshPlan(dp=1, fsdp=2, tp=2, sp=2))
-    assert mesh.shape == {"dp": 1, "fsdp": 2, "tp": 2, "sp": 2}
+    assert mesh.shape == {"dp": 1, "fsdp": 2, "pp": 1, "ep": 1,
+                          "tp": 2, "sp": 2}
     with pytest.raises(ValueError):
         make_mesh(MeshPlan(dp=3))
 
