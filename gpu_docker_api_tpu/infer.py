@@ -1,0 +1,166 @@
+"""Autoregressive inference with a static-shape KV cache.
+
+The serving-side counterpart of train.py: prefill + single-token decode for
+both model families (llama, moe), built for the XLA execution model —
+
+- the cache is a STATIC [L, B, S_max, Hkv, D] buffer updated with
+  lax.dynamic_update_slice; `length` is data, not shape, so one compiled
+  decode step serves every position (no per-position recompiles);
+- decode attends over the full buffer with an iota<=pos mask — XLA fuses
+  the mask; a 1-token query needs no flash kernel;
+- the whole generation loop is ONE lax.scan over decode steps (compiled
+  once, runs on-device; no Python in the token loop);
+- GQA layout: the cache stores the n_kv_heads, repeated to n_heads only
+  inside the attention einsum (HBM footprint stays at the KV-head count);
+- greedy (argmax) or temperature sampling via jax.random.categorical.
+
+MoE decode routes per-token through the same dense-dispatch block as
+training (models/moe.py) — shapes are static, so the step compiles once.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .models import family_for
+from .models.llama import (
+    LlamaConfig, apply_rope, rms_norm, rope_frequencies,
+)
+from .models.moe import MoEConfig, moe_block
+
+
+def _llama_view(config) -> LlamaConfig:
+    return config.as_llama() if isinstance(config, MoEConfig) else config
+
+
+def init_cache(config, batch: int, max_len: int) -> dict:
+    """Zeroed KV cache for `batch` sequences of up to `max_len` tokens."""
+    c = _llama_view(config)
+    shape = (config.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(q, k_all, v_all, pos):
+    """q [B,T,H,D] at absolute positions pos..pos+T-1; k/v_all [B,S_max,
+    Hkv,D]. Masked attention over the cache buffer (entries past the causal
+    frontier masked out). f32 softmax."""
+    b, t, h, d = q.shape
+    s_max = k_all.shape[1]
+    group = h // k_all.shape[2]
+    kf = jnp.repeat(k_all.astype(jnp.float32), group, axis=2)
+    vf = jnp.repeat(v_all.astype(jnp.float32), group, axis=2)
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    rows = pos + jax.lax.broadcasted_iota(jnp.int32, (t, s_max), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, s_max), 1)
+    scores = jnp.where((cols <= rows)[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)
+
+
+def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
+    """One decoder layer over a T-token slice with cache read+write.
+    x [B,T,D]; cache_k/v [B,S_max,Hkv,D]; pos = absolute start position.
+    Returns (x_out, new_cache_k, new_cache_v)."""
+    c = _llama_view(config)
+    b, t, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
+                                           (0, pos, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
+                                           (0, pos, 0, 0))
+    out = _attend_cached(q, cache_k, cache_v, pos)
+    x = x + out.reshape(b, t, c.n_heads * c.head_dim) @ layer["wo"]
+
+    # family-specific FFN: MoE layers carry expert banks, llama a dense MLP
+    if "we1" in layer:
+        x, _, _ = moe_block(x, layer, config)
+    else:
+        hm = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        x = x + (jax.nn.silu(hm @ layer["w1"]) * (hm @ layer["w3"])) @ layer["w2"]
+    return x, cache_k, cache_v
+
+
+def _forward_cached(params, tokens, cache, config):
+    """tokens [B,T] starting at absolute position cache["length"].
+    Returns (logits [B,T,V] f32, new cache)."""
+    c = _llama_view(config)
+    b, t = tokens.shape
+    pos = cache["length"]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = pos + jnp.arange(t)
+    cos, sin = rope_frequencies(c, positions)
+
+    def body(x, scanned):
+        layer, ck, cv = scanned
+        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs, "length": pos + t}
+
+
+@partial(jax.jit, static_argnames=("config",))
+def prefill(params, tokens, cache, config):
+    """Run the prompt through the model, filling the cache. tokens [B,T];
+    returns (last-position logits [B,V], cache)."""
+    logits, cache = _forward_cached(params, tokens, cache, config)
+    return logits[:, -1], cache
+
+
+@partial(jax.jit, static_argnames=("config",))
+def decode_step(params, token, cache, config):
+    """One token per sequence: token [B] -> (logits [B,V], cache)."""
+    logits, cache = _forward_cached(params, token[:, None], cache, config)
+    return logits[:, -1], cache
+
+
+@partial(jax.jit, static_argnames=("config", "max_new", "temperature"))
+def generate(params, prompt, config, max_new: int,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, T] -> generated tokens [B, max_new]. Greedy when
+    temperature == 0, else categorical sampling. The decode loop is one
+    lax.scan — compiled once, no host round-trips per token."""
+    b, t = prompt.shape
+    cache = init_cache(config, b, t + max_new)
+    logits, cache = _forward_cached(params, prompt, cache, config)
+    logits = logits[:, -1]
+    if key is None:
+        key = jax.random.key(0)
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+
+    key, sub = jax.random.split(key)
+    first = pick(logits, sub)
+
+    def step(carry, k):
+        token, cache = carry
+        logits, cache = _forward_cached(params, token[:, None], cache, config)
+        nxt = pick(logits[:, -1], k)
+        return (nxt, cache), token
+
+    keys = jax.random.split(key, max_new)
+    (_, _), toks = jax.lax.scan(step, (first, cache), keys)
+    return jnp.swapaxes(toks, 0, 1)  # [B, max_new]

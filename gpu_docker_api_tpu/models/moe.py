@@ -145,6 +145,18 @@ def param_kinds(config: MoEConfig) -> dict:
 
 # ---- the MoE block ---------------------------------------------------------
 
+def capacity_positions(onehot: jax.Array) -> jax.Array:
+    """onehot [T, K, E] -> each (token, k) choice's position within its
+    expert's capacity, [T, K]. Ranked K-MAJOR (all k=0 rows first) so every
+    token's top-1 pick wins a slot before any token's k=1 spillover competes
+    for one — the GShard priority policy."""
+    t, k, e = onehot.shape
+    flat = onehot.transpose(1, 0, 2).reshape(t * k, e)
+    pos = jnp.cumsum(flat, axis=0) * flat - 1                # [K*T, E]
+    pos = pos.reshape(k, t, e).transpose(1, 0, 2)
+    return jnp.sum(pos * onehot, axis=-1)                    # [T, K]
+
+
 def moe_block(x: jax.Array, layer: dict, config: MoEConfig
               ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """x [B, S, D] -> (x + moe_out, aux_loss, z_loss).
@@ -168,13 +180,8 @@ def moe_block(x: jax.Array, layer: dict, config: MoEConfig
         jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
     cap = c.capacity(t)
-    # position of each (token, k) choice within its expert's capacity:
-    # rank choices expert-major so k=0 picks win slots before k=1 spillover
     onehot = jax.nn.one_hot(gate_idx, c.n_experts, dtype=jnp.int32)  # [T,K,E]
-    flat = onehot.reshape(t * c.top_k, c.n_experts)
-    pos = jnp.cumsum(flat, axis=0) * flat - 1                # [T*K, E]
-    pos = pos.reshape(t, c.top_k, c.n_experts)
-    pos_in_expert = jnp.sum(pos * onehot, axis=-1)           # [T, K]
+    pos_in_expert = capacity_positions(onehot)               # [T, K]
     keep = pos_in_expert < cap
 
     # -- dispatch/combine tensors --

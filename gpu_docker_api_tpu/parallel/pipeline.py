@@ -121,6 +121,11 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     from ..models.llama import (
         _attention_block, _mlp_block, rms_norm, rope_frequencies,
     )
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError(
+            "pipeline_forward runs attention locally (mesh=None inside the "
+            "pp region); a mesh with sp > 1 would silently skip "
+            "ring/ulysses sequence parallelism — use pp with sp=1")
     c = config
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)

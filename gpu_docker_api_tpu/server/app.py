@@ -67,9 +67,11 @@ class App:
         self.volume_versions = VersionMap(VOLUME_VERSION_MAP_KEY,
                                           self.client, self.wq)
         self.merges = MergeMap(self.client, self.wq)
+        xla_cache = os.path.abspath(os.path.join(state_dir, "xla-cache"))
+        os.makedirs(xla_cache, exist_ok=True)
         self.replicasets = ReplicaSetService(
             self.backend, self.client, self.wq, self.tpu, self.cpu, self.ports,
-            self.container_versions, self.merges)
+            self.container_versions, self.merges, xla_cache_dir=xla_cache)
         self.volumes = VolumeService(self.backend, self.client, self.wq,
                                      self.volume_versions)
         self.events = EventLog(state_dir)

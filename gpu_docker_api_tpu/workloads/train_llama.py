@@ -30,8 +30,9 @@ import time
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
+    p.add_argument("--family", default="llama", choices=["llama", "moe"])
     p.add_argument("--config", default="tiny",
-                   choices=["tiny", "mini", "llama3_8b"])
+                   choices=["tiny", "mini", "llama3_8b", "mixtral_8x7b"])
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq", type=int, default=64)
@@ -39,12 +40,19 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=10)
     p.add_argument("--tp", type=int, default=0, help="0 = auto from devices")
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (llama family)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel width (moe family)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches when --pp > 1")
     args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
 
     from ..models.llama import LlamaConfig
+    from ..models.moe import MoEConfig
     from ..parallel.mesh import MeshPlan, best_tp_for
     from ..train import Trainer, TrainConfig, restore_checkpoint, save_checkpoint
 
@@ -52,16 +60,22 @@ def main(argv=None) -> int:
     ckpt_dir = os.path.abspath(os.path.join(args.workdir, "checkpoints"))
     metrics_path = os.path.join(args.workdir, "metrics.jsonl")
 
-    config = {
-        "tiny": LlamaConfig.tiny,
-        "mini": LlamaConfig.llama_mini,
-        "llama3_8b": LlamaConfig.llama3_8b,
-    }[args.config]()
+    configs = {
+        "llama": {"tiny": LlamaConfig.tiny, "mini": LlamaConfig.llama_mini,
+                  "llama3_8b": LlamaConfig.llama3_8b},
+        "moe": {"tiny": MoEConfig.tiny, "mini": MoEConfig.moe_mini,
+                "mixtral_8x7b": MoEConfig.mixtral_8x7b},
+    }
+    if args.config not in configs[args.family]:
+        p.error(f"--config {args.config} not defined for family {args.family}")
+    config = configs[args.family][args.config]()
 
     n_dev = jax.device_count()
-    tp = args.tp or best_tp_for(n_dev)
-    plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp)
-    trainer = Trainer.create(config, plan, tc=TrainConfig())
+    fixed = args.sp * args.pp * args.ep
+    tp = args.tp or best_tp_for(n_dev // fixed if n_dev % fixed == 0 else 1)
+    plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp, pp=args.pp, ep=args.ep)
+    trainer = Trainer.create(
+        config, plan, tc=TrainConfig(n_microbatches=args.microbatches))
 
     # resume-first: restore against the ABSTRACT state template (no device
     # materialization); pay for a fresh sharded init only when there is no

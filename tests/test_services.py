@@ -305,3 +305,40 @@ def test_volume_same_size_no_patch(world):
     vol.create_volume("vol", "1GB")
     with pytest.raises(xerrors.NoPatchRequiredError):
         vol.patch_volume_size("vol", "1GB")
+
+
+# ------------------------------------------------- xla compile-cache inject
+
+def test_xla_cache_env_injected(world, tmp_path):
+    rs, *_ = world
+    rs.xla_cache_dir = str(tmp_path / "xla-cache")
+    _run(rs, "cached")
+    info = rs.get_container_info("cached")
+    env = info["spec"]["env"]
+    assert f"JAX_COMPILATION_CACHE_DIR={rs.xla_cache_dir}" in env
+    assert "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0" in env
+    bind = f"{rs.xla_cache_dir}:{rs.xla_cache_dir}"
+    assert bind in info["spec"]["binds"]
+
+
+def test_xla_cache_user_override_wins(world, tmp_path):
+    rs, *_ = world
+    rs.xla_cache_dir = str(tmp_path / "xla-cache")
+    _run(rs, "custom", env=["JAX_COMPILATION_CACHE_DIR=/my/own"])
+    env = rs.get_container_info("custom")["spec"]["env"]
+    assert "JAX_COMPILATION_CACHE_DIR=/my/own" in env
+    assert not any(e.startswith(
+        f"JAX_COMPILATION_CACHE_DIR={rs.xla_cache_dir}") for e in env)
+
+
+def test_xla_cache_survives_patch_without_duplication(world, tmp_path):
+    rs, *_ = world
+    rs.xla_cache_dir = str(tmp_path / "xla-cache")
+    _run(rs, "patched")
+    rs.patch_container("patched", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+    spec = rs.get_container_info("patched")["spec"]
+    cache_envs = [e for e in spec["env"]
+                  if e.startswith("JAX_COMPILATION_CACHE_DIR=")]
+    assert cache_envs == [f"JAX_COMPILATION_CACHE_DIR={rs.xla_cache_dir}"]
+    bind = f"{rs.xla_cache_dir}:{rs.xla_cache_dir}"
+    assert spec["binds"].count(bind) == 1
