@@ -22,12 +22,11 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from .models import family_for
 from .models.llama import (
     LlamaConfig, apply_rope, rms_norm, rope_frequencies,
 )
@@ -118,19 +117,46 @@ def _forward_cached(params, tokens, cache, config):
     return logits, {"k": ks, "v": vs, "length": pos + t}
 
 
+def _check_capacity(cache, new_tokens: int) -> None:
+    """Fail loudly when a write would run past the cache buffer —
+    lax.dynamic_update_slice CLAMPS out-of-bounds starts, which would
+    silently overwrite the newest entry and return garbage logits. Checked
+    host-side (cheap scalar read) when length is concrete; inside an outer
+    jit the caller owns the budget."""
+    length = cache["length"]
+    if isinstance(length, jax.core.Tracer):
+        return
+    max_len = cache["k"].shape[2]
+    if int(length) + new_tokens > max_len:
+        raise ValueError(
+            f"KV cache overflow: length {int(length)} + {new_tokens} new "
+            f"token(s) exceeds max_len {max_len} — init_cache with a larger "
+            f"buffer")
+
+
 @partial(jax.jit, static_argnames=("config",))
-def prefill(params, tokens, cache, config):
-    """Run the prompt through the model, filling the cache. tokens [B,T];
-    returns (last-position logits [B,V], cache)."""
+def _prefill_jit(params, tokens, cache, config):
     logits, cache = _forward_cached(params, tokens, cache, config)
     return logits[:, -1], cache
 
 
+def prefill(params, tokens, cache, config):
+    """Run the prompt through the model, filling the cache. tokens [B,T];
+    returns (last-position logits [B,V], cache)."""
+    _check_capacity(cache, tokens.shape[1])
+    return _prefill_jit(params, tokens, cache, config)
+
+
 @partial(jax.jit, static_argnames=("config",))
-def decode_step(params, token, cache, config):
-    """One token per sequence: token [B] -> (logits [B,V], cache)."""
+def _decode_jit(params, token, cache, config):
     logits, cache = _forward_cached(params, token[:, None], cache, config)
     return logits[:, -1], cache
+
+
+def decode_step(params, token, cache, config):
+    """One token per sequence: token [B] -> (logits [B,V], cache)."""
+    _check_capacity(cache, 1)
+    return _decode_jit(params, token, cache, config)
 
 
 @partial(jax.jit, static_argnames=("config", "max_new", "temperature"))
@@ -154,13 +180,18 @@ def generate(params, prompt, config, max_new: int,
 
     key, sub = jax.random.split(key)
     first = pick(logits, sub)
+    if max_new == 1:
+        return first[:, None]
 
     def step(carry, k):
         token, cache = carry
         logits, cache = _forward_cached(params, token[:, None], cache, config)
         nxt = pick(logits[:, -1], k)
-        return (nxt, cache), token
+        return (nxt, cache), nxt
 
-    keys = jax.random.split(key, max_new)
+    # max_new-1 decode forwards produce tokens 2..max_new; the final
+    # sampled token needs no further forward pass
+    keys = jax.random.split(key, max_new - 1)
     (_, _), toks = jax.lax.scan(step, (first, cache), keys)
-    return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
+    return jnp.concatenate([first[:, None], jnp.swapaxes(toks, 0, 1)],
+                           axis=1)  # [B, max_new]

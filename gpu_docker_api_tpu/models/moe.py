@@ -157,13 +157,17 @@ def capacity_positions(onehot: jax.Array) -> jax.Array:
     return jnp.sum(pos * onehot, axis=-1)                    # [T, K]
 
 
-def moe_block(x: jax.Array, layer: dict, config: MoEConfig
+def moe_block(x: jax.Array, layer: dict, config: MoEConfig,
+              mesh: Optional[Mesh] = None
               ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """x [B, S, D] -> (x + moe_out, aux_loss, z_loss).
 
     Dense-dispatch MoE: top-k routing, static capacity, one-hot dispatch /
     combine einsums. All shapes are static; sharding (ep on the expert axis)
-    turns the einsums into all-to-alls.
+    turns the einsums into all-to-alls. With a mesh, the expert activations
+    are explicitly pinned to P("ep", ...) so SPMD propagation doesn't fall
+    back to an involuntary full rematerialization between the dispatch and
+    the expert matmuls.
     """
     c = config
     b, s, d = x.shape
@@ -195,11 +199,22 @@ def moe_block(x: jax.Array, layer: dict, config: MoEConfig
         gate_vals * keep.astype(jnp.float32))                # [T, E, C] f32
 
     # -- expert computation --
+    def pin(arr, spec):
+        if mesh is None or mesh.empty:
+            return arr
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+
+    from jax.sharding import PartitionSpec as P
     xe = jnp.einsum("td,tec->ecd", ht, disp)                 # [E, C, D]
+    xe = pin(xe, P("ep", None, "fsdp"))    # the dispatch a2a lands here
     g = jnp.einsum("ecd,edf->ecf", xe, layer["we1"])
     u = jnp.einsum("ecd,edf->ecf", xe, layer["we3"])
     y = jax.nn.silu(g) * u                                   # SwiGLU
+    y = pin(y, P("ep", None, "tp"))
     ye = jnp.einsum("ecf,efd->ecd", y, layer["we2"])         # [E, C, D]
+    ye = pin(ye, P("ep", None, None))
     out = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), comb)
 
     # -- aux losses (f32 scalars) --
@@ -232,7 +247,7 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
     def body(carry, layer):
         x, aux_sum, z_sum = carry
         x = _attention_block(x, layer, lc, cos, sin, impl, mesh)
-        x, aux, z = moe_block(x, layer, c)
+        x, aux, z = moe_block(x, layer, c, mesh=mesh)
         return (x, aux_sum + aux, z_sum + z), None
 
     (x, aux_sum, z_sum), _ = jax.lax.scan(

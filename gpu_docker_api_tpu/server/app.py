@@ -31,7 +31,7 @@ from ..version import (
 )
 from ..workqueue import WorkQueue
 from .codes import ResCode
-from .http import ApiServer, Request, Response, Router, err, ok
+from .http import ApiServer, RawResponse, Request, Response, Router, err, ok
 
 log = logging.getLogger(__name__)
 
@@ -102,6 +102,8 @@ class App:
         r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
         r.add("GET", f"{v1}/events", self.h_events)
+        r.add("GET", "/metrics", self.h_metrics)
+        r.add("GET", "/openapi.json", self.h_openapi)
         r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
         r.add("GET", f"{v1}/resources/gpus", self.h_res_tpus)  # legacy alias
         r.add("GET", f"{v1}/resources/cpus", self.h_res_cpus)
@@ -343,6 +345,48 @@ class App:
             return err(ResCode.InvalidParams)
         target = req.query.get("target", [""])[0]
         return ok({"events": self.events.recent(limit=limit, target=target)})
+
+    def h_metrics(self, req: Request) -> Response:
+        """Prometheus text exposition of the resource inventories and the
+        write-behind queue — the pull-metrics surface the reference lacks
+        (SURVEY §5.5: 'No Prometheus'; its /resources/* are JSON-only)."""
+        tpu = self.tpu.get_status()
+        cpu = self.cpu.get_status()
+        ports = self.ports.get_status()
+        n_chips = len(tpu["chips"])
+        free_chips = tpu["freeCount"]
+        lines = [
+            "# TYPE tdapi_tpu_chips gauge",
+            f'tdapi_tpu_chips{{state="free"}} {free_chips}',
+            f'tdapi_tpu_chips{{state="used"}} {n_chips - free_chips}',
+            "# TYPE tdapi_cpu_cores gauge",
+            f'tdapi_cpu_cores{{state="used"}} {cpu["usedCount"]}',
+            f'tdapi_cpu_cores{{state="free"}} '
+            f'{cpu["totalCount"] - cpu["usedCount"]}',
+            "# TYPE tdapi_ports gauge",
+            f'tdapi_ports{{state="available"}} {ports["availableCount"]}',
+            f'tdapi_ports{{state="used"}} {len(ports["usedPortSet"])}',
+            "# TYPE tdapi_replicasets gauge",
+            f"tdapi_replicasets {len(self.container_versions.items())}",
+            "# TYPE tdapi_volumes gauge",
+            f"tdapi_volumes {len(self.volume_versions.items())}",
+            "# TYPE tdapi_workqueue_pending gauge",
+            f"tdapi_workqueue_pending {self.wq.pending()}",
+        ]
+        return RawResponse(("\n".join(lines) + "\n").encode(),
+                           "text/plain; version=0.0.4")
+
+    def h_openapi(self, req: Request) -> Response:
+        """Serve the shipped OpenAPI document (reference distributes
+        api/gpu-docker-api-en.openapi.json as a file; here it is also an
+        endpoint)."""
+        spec = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "api", "openapi.json")
+        try:
+            with open(spec, "rb") as f:
+                return RawResponse(f.read())
+        except OSError:
+            return err(ResCode.ServerBusy)
 
     def h_res_tpus(self, req: Request) -> Response:
         return ok({"tpus": self.tpu.get_status()})

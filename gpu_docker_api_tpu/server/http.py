@@ -61,6 +61,19 @@ class Response:
             default=str).encode("utf-8")
 
 
+class RawResponse(Response):
+    """Bypass the JSON envelope — for /metrics (Prometheus text) and
+    /openapi.json (the spec document itself)."""
+
+    def __init__(self, body: bytes, content_type: str = "application/json"):
+        super().__init__(ResCode.Success, None)
+        self._body = body
+        self.content_type = content_type
+
+    def payload(self) -> bytes:
+        return self._body
+
+
 def ok(data: Optional[dict] = None) -> Response:
     return Response(ResCode.Success, data)
 
@@ -148,6 +161,8 @@ class ApiServer:
                 code=int(resp.code),
                 duration_ms=(time.perf_counter() - t0) * 1000,
                 request_id=req.request_id)
+        if isinstance(resp, RawResponse):
+            cors["Content-Type"] = resp.content_type
         return 200, cors, resp.payload()
 
     # ---- lifecycle ----

@@ -70,6 +70,10 @@ class WorkQueue:
             raise RuntimeError("work queue closed")
         self._q.put(_Envelope(msg))
 
+    def pending(self) -> int:
+        """Messages enqueued but not yet fully persisted (for /metrics)."""
+        return self._q.unfinished_tasks
+
     # ---- consumer side ----
 
     def start(self) -> None:

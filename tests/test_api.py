@@ -265,3 +265,37 @@ def test_events_endpoint(app):
     # events.jsonl persisted on disk
     import os
     assert os.path.exists(os.path.join(app.state_dir, "events.jsonl"))
+
+
+# --------------------------------------------------- metrics + openapi
+
+def _call_raw(app, path):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    ctype = resp.getheader("Content-Type")
+    conn.close()
+    return resp.status, ctype, raw
+
+
+def test_metrics_prometheus_text(app):
+    call(app, "POST", "/api/v1/replicaSet",
+         {"imageName": "img", "replicaSetName": "m1", "tpuCount": 2,
+          "cpuCount": 1})
+    status, ctype, raw = _call_raw(app, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = raw.decode()
+    assert 'tdapi_tpu_chips{state="used"} 2' in text
+    assert 'tdapi_cpu_cores{state="used"} 1' in text
+    assert "tdapi_replicasets 1" in text
+    assert "tdapi_workqueue_pending" in text
+
+
+def test_openapi_served(app):
+    status, ctype, raw = _call_raw(app, "/openapi.json")
+    assert status == 200
+    spec = json.loads(raw)
+    assert "/api/v1/replicaSet" in spec["paths"]
+    assert "openapi" in spec

@@ -74,6 +74,30 @@ def test_prefill_then_decode_steps(llama):
     assert bool(jnp.allclose(logits, full[:, -1], atol=1e-4))
 
 
+def test_cache_overflow_raises(llama):
+    """dynamic_update_slice clamps OOB writes — the API must refuse instead
+    of silently corrupting the newest cache entry."""
+    cfg, params = llama
+    prompt = _prompt(cfg)                       # 8 tokens
+    cache = init_cache(cfg, 2, 9)               # room for prompt + 1
+    logits, cache = prefill(params, prompt, cache, cfg)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    _, cache = decode_step(params, tok, cache, cfg)   # fills slot 9/9
+    with pytest.raises(ValueError, match="overflow"):
+        decode_step(params, tok, cache, cfg)
+    with pytest.raises(ValueError, match="overflow"):
+        prefill(params, prompt, init_cache(cfg, 2, 4), cfg)
+
+
+def test_generate_max_new_one(llama):
+    cfg, params = llama
+    prompt = _prompt(cfg)
+    out = generate(params, prompt, cfg, max_new=1)
+    full = llama_forward(params, prompt, cfg)
+    assert bool(jnp.all(
+        out[:, 0] == jnp.argmax(full[:, -1], axis=-1).astype(jnp.int32)))
+
+
 def test_generate_sampling_respects_temperature(llama):
     cfg, params = llama
     prompt = _prompt(cfg)
