@@ -37,14 +37,20 @@ def _llama_view(config) -> LlamaConfig:
     return config.as_llama() if isinstance(config, MoEConfig) else config
 
 
+_DEVICE_KEYS = ("k", "v", "length")
+
+
 def init_cache(config, batch: int, max_len: int) -> dict:
-    """Zeroed KV cache for `batch` sequences of up to `max_len` tokens."""
+    """Zeroed KV cache for `batch` sequences of up to `max_len` tokens.
+    `host_length` mirrors `length` as a plain int so the overflow guard in
+    prefill/decode_step never has to sync the device scalar."""
     c = _llama_view(config)
     shape = (config.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
     return {
         "k": jnp.zeros(shape, c.dtype),
         "v": jnp.zeros(shape, c.dtype),
         "length": jnp.zeros((), jnp.int32),
+        "host_length": 0,
     }
 
 
@@ -117,21 +123,32 @@ def _forward_cached(params, tokens, cache, config):
     return logits, {"k": ks, "v": vs, "length": pos + t}
 
 
-def _check_capacity(cache, new_tokens: int) -> None:
+def _checked_length(cache, new_tokens: int):
     """Fail loudly when a write would run past the cache buffer —
     lax.dynamic_update_slice CLAMPS out-of-bounds starts, which would
-    silently overwrite the newest entry and return garbage logits. Checked
-    host-side (cheap scalar read) when length is concrete; inside an outer
-    jit the caller owns the budget."""
-    length = cache["length"]
-    if isinstance(length, jax.core.Tracer):
-        return
+    silently overwrite the newest entry and return garbage logits.
+
+    The budget check uses the host-side `host_length` mirror (a plain int,
+    so no device sync in the decode loop); a hand-built cache without one
+    falls back to reading the device scalar when it is concrete. Returns
+    the updated host length (or None when unknowable)."""
+    length = cache.get("host_length")
+    if length is None:
+        dev = cache["length"]
+        if isinstance(dev, jax.core.Tracer):
+            return None                  # inside an outer jit: caller's budget
+        length = int(dev)
     max_len = cache["k"].shape[2]
-    if int(length) + new_tokens > max_len:
+    if length + new_tokens > max_len:
         raise ValueError(
-            f"KV cache overflow: length {int(length)} + {new_tokens} new "
+            f"KV cache overflow: length {length} + {new_tokens} new "
             f"token(s) exceeds max_len {max_len} — init_cache with a larger "
             f"buffer")
+    return length + new_tokens
+
+
+def _device_view(cache) -> dict:
+    return {k: cache[k] for k in _DEVICE_KEYS}
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -143,8 +160,11 @@ def _prefill_jit(params, tokens, cache, config):
 def prefill(params, tokens, cache, config):
     """Run the prompt through the model, filling the cache. tokens [B,T];
     returns (last-position logits [B,V], cache)."""
-    _check_capacity(cache, tokens.shape[1])
-    return _prefill_jit(params, tokens, cache, config)
+    new_len = _checked_length(cache, tokens.shape[1])
+    logits, out = _prefill_jit(params, tokens, _device_view(cache), config)
+    if new_len is not None:
+        out["host_length"] = new_len
+    return logits, out
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -155,8 +175,11 @@ def _decode_jit(params, token, cache, config):
 
 def decode_step(params, token, cache, config):
     """One token per sequence: token [B] -> (logits [B,V], cache)."""
-    _check_capacity(cache, 1)
-    return _decode_jit(params, token, cache, config)
+    new_len = _checked_length(cache, 1)
+    logits, out = _decode_jit(params, token, _device_view(cache), config)
+    if new_len is not None:
+        out["host_length"] = new_len
+    return logits, out
 
 
 @partial(jax.jit, static_argnames=("config", "max_new", "temperature"))

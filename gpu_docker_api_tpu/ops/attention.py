@@ -53,8 +53,8 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 # ---- pallas flash kernel ---------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  blk_q: int, blk_k: int, scale: float, causal: bool,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, blk_q: int, blk_k: int, scale: float, causal: bool,
                   seq_len: int):
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
     q = q_ref[0].astype(jnp.float32) * scale            # [blk_q, D]
@@ -99,6 +99,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     jax.lax.fori_loop(0, n_kv, body, 0)
     denom = jnp.maximum(l_ref[:], 1e-30)
     o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+    # logsumexp residual for the backward kernels: lse = m + log(l)
+    m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
+    lse_ref[0] = (m_fin + jnp.log(denom))[:, 0]
 
 
 def _pid(axis: int):
@@ -106,15 +109,9 @@ def _pid(axis: int):
     return pl.program_id(axis)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True,
-                    blk_q: int = DEFAULT_BLOCK,
-                    blk_k: int = DEFAULT_BLOCK,
-                    interpret: bool = False) -> jax.Array:
-    """Pallas TPU flash attention. q [B,S,H,D], k/v [B,S,Hkv,D].
-    interpret=True runs the kernel in the pallas interpreter (CPU tests)."""
+def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
+    """Runs the forward kernel. q [B,S,H,D], k/v [B,S,Hkv,D] ->
+    (out [B,S,H,D], lse [B*H, S] f32 of the SCALED scores)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -143,7 +140,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # bh = batch * h + head; its kv row is batch * hkv + head // group
         return ((bh // h) * hkv + (bh % h) // group, 0, 0)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -151,8 +148,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, s, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, d), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -160,7 +163,232 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+
+
+# ---- pallas flash backward -------------------------------------------------
+#
+# Standard two-kernel flash backward (no [S, S] materialization):
+#   residuals: q, k, v, o, lse (per-row logsumexp of scaled scores)
+#   D_i = rowsum(dO_i * O_i)                (computed outside, XLA-fused)
+#   P_ij = exp(S_ij - lse_i)                (recomputed blockwise)
+#   dV_j = sum_i P_ij^T dO_i
+#   dS_ij = P_ij * (dO_i V_j^T - D_i)
+#   dQ_i = scale * sum_j dS_ij K_j          (grid over q blocks)
+#   dK_j = scale * sum_i dS_ij^T Q_i        (grid over kv blocks x GQA group)
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, blk_q: int, blk_k: int, scale: float,
+                         causal: bool, seq_len: int):
+    import jax.experimental.pallas as pl
+    i = jax.lax.convert_element_type(_pid(1), jnp.int32)
+    q = q_ref[0].astype(jnp.float32) * scale             # [blk_q, D]
+    do = do_ref[0].astype(jnp.float32)                   # [blk_q, D]
+    lse = lse_ref[0][:, None]                            # [blk_q, 1]
+    delta = delta_ref[0][:, None]                        # [blk_q, 1]
+
+    n_kv_total = seq_len // blk_k
+    if causal:
+        n_kv = jnp.minimum(((i + 1) * blk_q + blk_k - 1) // blk_k, n_kv_total)
+    else:
+        n_kv = n_kv_total
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            cols = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(cols <= rows, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[2]
+    acc = jax.lax.fori_loop(0, n_kv, body,
+                            jnp.zeros((blk_q, d), jnp.float32))
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, blk_q: int, blk_k: int,
+                          scale: float, causal: bool, seq_len: int,
+                          group: int):
+    import jax.experimental.pallas as pl
+    j = jax.lax.convert_element_type(_pid(1), jnp.int32)
+    g = jax.lax.convert_element_type(_pid(2), jnp.int32)
+    k = k_ref[0].astype(jnp.float32)                     # [blk_k, D]
+    v = v_ref[0].astype(jnp.float32)                     # [blk_k, D]
+
+    n_q_total = seq_len // blk_q
+    i_start = (j * blk_k) // blk_q if causal else 0
+
+    def body(i, accs):
+        dk_acc, dv_acc = accs
+        q = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * blk_q, blk_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * blk_q, blk_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            cols = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(cols <= rows, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [blk_k, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [blk_k, D]
+        return dk_acc, dv_acc
+
+    d = k_ref.shape[2]
+    zeros = jnp.zeros((blk_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(i_start, n_q_total, body,
+                                       (zeros, zeros))
+    # q was pre-scaled, so ds @ q already carries one factor of `scale`;
+    # dk needs exactly one — nothing more to multiply here
+    first = g == 0
+
+    @pl.when(first)
+    def _init():
+        dk_ref[0] = dk_acc
+        dv_ref[0] = dv_acc
+
+    @pl.when(jnp.logical_not(first))
+    def _accum():
+        dk_ref[0] += dk_acc
+        dv_ref[0] += dv_acc
+
+
+def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    blk_q = min(blk_q, s)
+    blk_k = min(blk_k, s)
+    scale = 1.0 / math.sqrt(d)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # D_i = rowsum(dO * O) — cheap elementwise+reduce, XLA fuses it
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)                              # [B*H, S]
+
+    def kv_index(bh, i):
+        del i
+        return ((bh // h) * hkv + (bh % h) // group, 0, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
+                          scale=scale, causal=causal, seq_len=s),
+        grid=(b * h, s // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), kv_index),
+            pl.BlockSpec((1, s, d), kv_index),
+            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv: grid over kv rows x kv blocks x the GQA group; `g` is the
+    # fastest-varying dim, so consecutive steps revisit the same out block
+    # and accumulate the group's contributions in place
+    def q_row(bh, j, g):
+        del j
+        return ((bh // hkv) * h + (bh % hkv) * group + g, 0, 0)
+
+    def q_row2(bh, j, g):
+        del j
+        return ((bh // hkv) * h + (bh % hkv) * group + g, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
+                          scale=scale, causal=causal, seq_len=s, group=group),
+        grid=(b * hkv, s // blk_k, group),
+        in_specs=[
+            pl.BlockSpec((1, s, d), q_row),
+            pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
+            pl.BlockSpec((1, s, d), q_row),
+            pl.BlockSpec((1, s), q_row2),
+            pl.BlockSpec((1, s), q_row2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hkv, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hkv, s, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, hkv, s, d).transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv.reshape(b, hkv, s, d).transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---- custom_vjp wiring -----------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret):
+    out, _ = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    out, lse = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, blk_q, blk_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_raw(q, k, v, out, lse, do, causal, blk_q, blk_k,
+                          interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    blk_q: int = DEFAULT_BLOCK,
+                    blk_k: int = DEFAULT_BLOCK,
+                    interpret: bool = False) -> jax.Array:
+    """Pallas TPU flash attention, differentiable (custom_vjp with pallas
+    backward kernels — training runs the flash path end-to-end, no [S, S]
+    materialization in either direction). q [B,S,H,D], k/v [B,S,Hkv,D].
+    interpret=True runs the kernels in the pallas interpreter (CPU tests)."""
+    return _flash(q, k, v, causal, blk_q, blk_k, interpret)
 
 
 # ---- dispatcher ------------------------------------------------------------

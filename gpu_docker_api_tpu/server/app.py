@@ -376,17 +376,22 @@ class App:
         return RawResponse(("\n".join(lines) + "\n").encode(),
                            "text/plain; version=0.0.4")
 
+    _openapi_bytes: Optional[bytes] = None
+
     def h_openapi(self, req: Request) -> Response:
         """Serve the shipped OpenAPI document (reference distributes
         api/gpu-docker-api-en.openapi.json as a file; here it is also an
-        endpoint)."""
-        spec = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), "api", "openapi.json")
-        try:
-            with open(spec, "rb") as f:
-                return RawResponse(f.read())
-        except OSError:
-            return err(ResCode.ServerBusy)
+        endpoint). Read once, served from memory thereafter."""
+        if self._openapi_bytes is None:
+            spec = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "api", "openapi.json")
+            try:
+                with open(spec, "rb") as f:
+                    self._openapi_bytes = f.read()
+            except OSError:
+                return err(ResCode.ServerBusy)
+        return RawResponse(self._openapi_bytes)
 
     def h_res_tpus(self, req: Request) -> Response:
         return ok({"tpus": self.tpu.get_status()})

@@ -1,0 +1,78 @@
+"""Differentiable flash attention: the pallas backward kernels (dq, dk/dv
+with GQA group accumulation) must match reference_attention's gradients.
+Run in interpreter mode on CPU; the same kernels compile for TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_docker_api_tpu.ops.attention import (
+    flash_attention, reference_attention,
+)
+
+
+def _grads(b, s, h, hkv, d, causal, blk=64):
+    q = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(2), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(3), (b, s, hkv, d), jnp.float32)
+    cot = jax.random.normal(jax.random.key(4), (b, s, h, d), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, blk_q=blk, blk_k=blk, interpret=True))
+    ref = loss(lambda q, k, v: reference_attention(q, k, v, causal=causal))
+    gf = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    return gf, gr
+
+
+@pytest.mark.parametrize("case", [
+    dict(b=2, s=128, h=4, hkv=4, d=32, causal=True),    # MHA causal
+    dict(b=2, s=128, h=4, hkv=2, d=32, causal=True),    # GQA causal
+    dict(b=1, s=128, h=8, hkv=2, d=16, causal=False),   # GQA full
+    dict(b=1, s=256, h=4, hkv=2, d=32, causal=True),    # multi kv-block
+])
+def test_flash_grads_match_reference(case):
+    gf, gr = _grads(**case)
+    for name, a, b_ in zip(("dq", "dk", "dv"), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-3, rtol=2e-3, err_msg=name)
+
+
+def test_train_step_through_flash_path():
+    """A whole model loss differentiates through the flash kernel (this was
+    impossible before custom_vjp — grad through pallas_call has no rule)."""
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+    from gpu_docker_api_tpu.train import loss_fn
+
+    # 128-seq so blocks divide; flash forced via impl
+    cfg = LlamaConfig(vocab_size=128, d_model=64, n_layers=1, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq_len=128,
+                      dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (1, 128), 0, 128,
+                              dtype=jnp.int32)
+
+    # interpret-mode flash inside the full CE loss. (importlib, not plain
+    # `import a.b.attention`: the package re-exports an `attention` FUNCTION
+    # that shadows the submodule attribute)
+    import importlib
+    att = importlib.import_module("gpu_docker_api_tpu.ops.attention")
+    orig = att.flash_attention
+
+    def interp_flash(q, k, v, causal=True, **kw):
+        return orig(q, k, v, causal=causal, interpret=True)
+
+    att.flash_attention = interp_flash
+    try:
+        val, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, toks, cfg, impl="flash"))(params)
+    finally:
+        att.flash_attention = orig
+    assert bool(jnp.isfinite(val))
+    flat = jax.tree.leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)
+    assert any(float(jnp.max(jnp.abs(g))) > 0 for g in flat)
