@@ -49,6 +49,7 @@ class TpuScheduler(Scheduler):
                 wraparound=state["topology"].get("wraparound", False),
                 worker_id=state["topology"].get("workerId", 0),
                 num_workers=state["topology"].get("numWorkers", 1),
+                chips_per_host=state["topology"].get("chipsPerHost", 4),
             )
             self.status = {int(k): _norm_owner(v)
                            for k, v in state["status"].items()}
@@ -132,7 +133,13 @@ class TpuScheduler(Scheduler):
         (native/topology_alloc.cc) when available on non-torus meshes."""
         native = self._native_find_box(n, free)
         if native is not None:
-            return native or None
+            if not native:
+                return None      # core searched the same space: no box exists
+            # the core doesn't score worker spans — accept its pick when it
+            # can't be beaten on that axis (fits one worker), else re-rank
+            # with the span-aware Python search
+            if len(self.topology.workers_spanned(native)) == 1:
+                return native
         best: Optional[list[int]] = None
         best_key: Optional[tuple] = None
         topo = self.topology
@@ -148,7 +155,10 @@ class TpuScheduler(Scheduler):
                     if nb.index not in box and nb.index in free:
                         ext_free += 1
             sa = dims[0] * dims[1] + dims[1] * dims[2] + dims[0] * dims[2]
-            key = (sa, ext_free, origin[2], origin[1], origin[0])
+            # fewest TPU VM workers spanned first: an intra-host grant needs
+            # no cross-host process mesh (and one container, not K)
+            span = len(topo.workers_spanned(idx))
+            key = (span, sa, ext_free, origin[2], origin[1], origin[0])
             if best_key is None or key < best_key:
                 best_key = key
                 best = idx
@@ -156,7 +166,8 @@ class TpuScheduler(Scheduler):
 
     def _native_find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
         """C++ box search. Returns None when the core doesn't apply (torus,
-        lib missing), [] when it applies but found nothing, else the grant."""
+        lib missing), [] when it applies but found nothing, else a candidate
+        grant (caller re-checks worker span)."""
         if self.topology.wraparound:
             return None
         from .._native import load

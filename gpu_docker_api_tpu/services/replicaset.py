@@ -461,7 +461,7 @@ class ReplicaSetService:
     def get_container_info(self, name: str) -> dict:
         info = self._stored_info(name)
         state = self.backend.inspect(info.containerName)
-        return {
+        out = {
             "version": info.version,
             "createTime": info.createTime,
             "containerName": info.containerName,
@@ -470,6 +470,15 @@ class ReplicaSetService:
             "resourcesReleased": info.resourcesReleased,
             "spec": info.spec.to_json(),
         }
+        # per-worker launch plan when the grant spans TPU VM hosts: the env
+        # each worker's container needs so the libtpu processes form ONE
+        # slice (SURVEY §5.8 — multi-host over the same REST surface)
+        topo = self.tpu.topology
+        chips = info.spec.tpu_chips
+        if chips and len(topo.workers_spanned(chips)) > 1:
+            out["multihost"] = {
+                str(w): env for w, env in topo.multihost_env(chips).items()}
+        return out
 
     def get_container_history(self, name: str) -> list[dict]:
         """Reference GetContainerHistory (:908) — newest first."""

@@ -175,6 +175,104 @@ class TpuTopology:
                     out.append(self._by_coord[(x, y, z)].index)
         return out
 
+    # ---- worker (TPU VM host) mapping -----------------------------------
+
+    def worker_of(self, index: int) -> int:
+        """TPU VM worker owning a chip. Chip indices are row-major and hosts
+        own index-contiguous slabs (libtpu numbering), so this is a plain
+        division."""
+        return min(index // self.chips_per_host,
+                   max(self.num_workers - 1, 0))
+
+    def worker_chips(self, worker: int) -> list[int]:
+        return [c.index for c in self.chips if self.worker_of(c.index) == worker]
+
+    def workers_spanned(self, indices: list[int]) -> list[int]:
+        return sorted({self.worker_of(i) for i in indices})
+
+    def _bbox(self, indices: list[int]) -> tuple[Coord, Coord, bool]:
+        """Bounding box of a chip set: (mins, dims, exactly_fills_box)."""
+        coords = [self.chip(i).coord for i in indices]
+        mins = tuple(min(c[a] for c in coords) for a in range(3))
+        maxs = tuple(max(c[a] for c in coords) for a in range(3))
+        dims = tuple(maxs[a] - mins[a] + 1 for a in range(3))
+        full = dims[0] * dims[1] * dims[2] == len(indices)
+        return mins, dims, full  # type: ignore[return-value]
+
+    def multihost_env(self, indices: list[int], base_port: int = 8476,
+                      host_names: Optional[list[str]] = None
+                      ) -> dict[int, dict[str, str]]:
+        """Per-worker env for a grant spanning TPU VM workers: what each
+        worker's container needs so the libtpu processes form ONE slice
+        (SURVEY §5.8 — the reference has no distributed backend at all; on
+        TPU the control plane's job is exactly this env contract, ICI does
+        the rest). Returns {worker_id: env}.
+
+        TPU_VISIBLE_CHIPS is per-host LOCAL device indices; TPU_WORKER_ID is
+        the RANK within the spanned workers (libtpu indexes it into
+        TPU_WORKER_HOSTNAMES). Process bounds are emitted only when the
+        per-worker boxes are identical, full, and exactly TILE the global
+        box (the libtpu multi-process grid requirement) — a fragmented grant
+        gets addresses/visible-chips only."""
+        workers = self.workers_spanned(indices)
+        hosts = host_names or [f"worker-{w}" for w in workers]
+        addresses = ",".join(f"{h}:{base_port}" for h in hosts)
+        envs: dict[int, dict[str, str]] = {}
+
+        boxes = {
+            w: (sorted(i for i in indices if self.worker_of(i) == w),)
+            for w in workers}
+        boxes = {w: (mine, *self._bbox(mine)) for w, (mine,) in boxes.items()}
+        same_shape = len({b[2] for b in boxes.values()}) == 1
+        all_full = all(b[3] for b in boxes.values())
+
+        per_dims = pbounds = None
+        if same_shape and all_full:
+            per_dims = next(iter(boxes.values()))[2]
+            gmins, gdims, gfull = self._bbox(indices)
+            divisible = all(gdims[a] % per_dims[a] == 0 for a in range(3))
+            if gfull and divisible:
+                cand = tuple(gdims[a] // per_dims[a] for a in range(3))
+                # per-worker boxes must tile the global grid exactly: one
+                # box per grid cell, aligned to the per-worker dims
+                cells = set()
+                aligned = True
+                for _, mins, _, _ in boxes.values():
+                    off = tuple(mins[a] - gmins[a] for a in range(3))
+                    if any(off[a] % per_dims[a] for a in range(3)):
+                        aligned = False
+                        break
+                    cells.add(tuple(off[a] // per_dims[a] for a in range(3)))
+                if (aligned and len(cells) == len(workers)
+                        and cand[0] * cand[1] * cand[2] == len(workers)):
+                    pbounds = cand
+                else:
+                    per_dims = None
+            else:
+                per_dims = None
+
+        for rank, w in enumerate(workers):
+            mine = boxes[w][0]
+            local = [i - w * self.chips_per_host for i in mine]
+            env = {
+                "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in local),
+                "TPU_WORKER_ID": str(rank),
+                "TPU_WORKER_HOSTNAMES": ",".join(hosts),
+                "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+                "TPU_SKIP_MDS_QUERY": "true",
+                "CLOUD_TPU_TASK_ID": str(rank),
+            }
+            if len(workers) > 1:
+                env["TPU_PROCESS_ADDRESSES"] = addresses
+                env["TPU_PROCESS_PORT"] = str(base_port)
+            if per_dims is not None and pbounds is not None:
+                env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = (
+                    f"{per_dims[0]},{per_dims[1]},{per_dims[2]}")
+                env["TPU_PROCESS_BOUNDS"] = (
+                    f"{pbounds[0]},{pbounds[1]},{pbounds[2]}")
+            envs[w] = env
+        return envs
+
     # ---- env plumbing for the scheduled workload ----
 
     def visible_chips_env(self, indices: list[int]) -> dict[str, str]:
@@ -189,16 +287,13 @@ class TpuTopology:
             "TPU_ACCELERATOR_TYPE": self.accelerator_type,
             "TPU_SKIP_MDS_QUERY": "true",
         }
-        coords = [self.chip(i).coord for i in idx]
-        if coords:
-            mins = tuple(min(c[a] for c in coords) for a in range(3))
-            maxs = tuple(max(c[a] for c in coords) for a in range(3))
-            bounds = tuple(maxs[a] - mins[a] + 1 for a in range(3))
+        if idx:
+            _, bounds, full = self._bbox(idx)
             # Declare per-process bounds only when the grant exactly fills its
             # bounding box — for L-shaped/fragmented grants a box declaration
             # would claim chips the process can't see and libtpu mesh init
             # would fail; with VISIBLE_CHIPS alone libtpu infers the layout.
-            if bounds[0] * bounds[1] * bounds[2] == len(idx):
+            if full:
                 env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{bounds[0]},{bounds[1]},{bounds[2]}"
                 env["TPU_PROCESS_BOUNDS"] = "1,1,1"
         return env
@@ -211,11 +306,14 @@ class TpuTopology:
             "wraparound": self.wraparound,
             "workerId": self.worker_id,
             "numWorkers": self.num_workers,
+            "chipsPerHost": self.chips_per_host,
         }
 
 
 def make_topology(accelerator_type: str, worker_id: int = 0) -> TpuTopology:
-    """Build a topology for a known accelerator type, e.g. "v5p-8"."""
+    """Build a topology for a known accelerator type, e.g. "v5p-8". Worker
+    (TPU VM host) count is inferred from the generation's chips-per-host:
+    4 for the 3D tori (v4/v5p), 8 for the 2D meshes (v5e/v6e)."""
     if accelerator_type in _KNOWN_SHAPES:
         gen, shape = _KNOWN_SHAPES[accelerator_type]
     else:
@@ -227,7 +325,11 @@ def make_topology(accelerator_type: str, worker_id: int = 0) -> TpuTopology:
         chips = max(chips, 1)
         # factor into the most cubic box available
         shape = _most_cubic_shape(chips)
-    return TpuTopology(accelerator_type, gen, shape)
+    cph = 4 if gen in _GEN_3D or gen in {"v2", "v3"} else 8
+    n_chips = shape[0] * shape[1] * shape[2]
+    workers = max(1, (n_chips + cph - 1) // cph)
+    return TpuTopology(accelerator_type, gen, shape, chips_per_host=cph,
+                       worker_id=worker_id, num_workers=workers)
 
 
 def _most_cubic_shape(n: int) -> Coord:

@@ -72,11 +72,16 @@ def test_find_box_native_matches_python_cost():
     """The native box search must pick placements with the same cost key as
     the Python implementation, over randomized occupancy."""
     from gpu_docker_api_tpu.schedulers.tpu import TpuScheduler
-    from gpu_docker_api_tpu.topology import make_topology
+    from gpu_docker_api_tpu.topology import TpuTopology
+
+    def single_worker_topo():
+        # the native core only serves single-worker slices (it doesn't score
+        # worker spans); pin chips_per_host to the whole mesh
+        return TpuTopology("v4-32", "v4", (2, 2, 4), chips_per_host=16)
 
     rng = random.Random(42)
     for trial in range(30):
-        topo = make_topology("v4-32")  # 2x2x4
+        topo = single_worker_topo()
         sched = TpuScheduler(None, topology=topo)
         used = rng.sample(range(16), rng.randint(0, 10))
         for i in used:
@@ -87,7 +92,7 @@ def test_find_box_native_matches_python_cost():
                 continue
             native = sched._native_find_box(n, free)
             # force the python path
-            sched_py = TpuScheduler(None, topology=make_topology("v4-32"))
+            sched_py = TpuScheduler(None, topology=single_worker_topo())
             sched_py.status = dict(sched.status)
             from unittest import mock
             with mock.patch.object(sched_py, "_native_find_box",

@@ -184,3 +184,25 @@ def test_tpu_env_omits_bounds_for_nonbox_grant(client):
     s2 = TpuScheduler(None, topology=make_topology("v5p-8"))
     env2 = s2.env_for(s2.apply(4))
     assert env2["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+
+
+# -------------------------------------------------- worker-span preference
+
+def test_apply_prefers_single_worker_grant():
+    from gpu_docker_api_tpu.schedulers.tpu import TpuScheduler
+    from gpu_docker_api_tpu.topology import make_topology
+    sched = TpuScheduler(topology=make_topology("v5p-16"))  # 2 workers x 4
+    grant = sched.apply(4, owner="a")
+    # 4 chips must come from ONE worker (a full host slab), not straddle
+    assert len({sched.topology.worker_of(i) for i in grant}) == 1
+    grant2 = sched.apply(4, owner="b")
+    assert len({sched.topology.worker_of(i) for i in grant2}) == 1
+    assert not set(grant) & set(grant2)
+
+
+def test_apply_spans_workers_only_when_needed():
+    from gpu_docker_api_tpu.schedulers.tpu import TpuScheduler
+    from gpu_docker_api_tpu.topology import make_topology
+    sched = TpuScheduler(topology=make_topology("v5p-16"))
+    grant = sched.apply(8, owner="big")
+    assert sched.topology.workers_spanned(grant) == [0, 1]

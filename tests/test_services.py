@@ -342,3 +342,19 @@ def test_xla_cache_survives_patch_without_duplication(world, tmp_path):
     assert cache_envs == [f"JAX_COMPILATION_CACHE_DIR={rs.xla_cache_dir}"]
     bind = f"{rs.xla_cache_dir}:{rs.xla_cache_dir}"
     assert spec["binds"].count(bind) == 1
+
+
+# ----------------------------------------------------- multi-host plan
+
+def test_info_exposes_multihost_launch_plan(world):
+    rs, *_ = world
+    _run(rs, "big", tpus=8)               # v4-32 world: spans 2 of 4 workers
+    info = rs.get_container_info("big")
+    plan = info["multihost"]
+    assert len(plan) == 2
+    for rank, (w, env) in enumerate(sorted(plan.items(), key=lambda x: int(x[0]))):
+        assert env["TPU_WORKER_ID"] == w
+        assert env["CLOUD_TPU_TASK_ID"] == str(rank)
+        assert "TPU_PROCESS_ADDRESSES" in env
+    _run(rs, "small", tpus=2)
+    assert "multihost" not in rs.get_container_info("small")

@@ -63,3 +63,52 @@ def test_visible_chips_env():
     assert env["TPU_ACCELERATOR_TYPE"] == "v5p-8"
     env4 = t.visible_chips_env([0, 1, 2, 3])
     assert env4["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+
+
+# ---------------------------------------------------- workers / multi-host
+
+def test_worker_mapping_v5p_16():
+    topo = make_topology("v5p-16")        # 8 chips, 4 per host -> 2 workers
+    assert topo.num_workers == 2 and topo.chips_per_host == 4
+    assert [topo.worker_of(i) for i in range(8)] == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert topo.worker_chips(1) == [4, 5, 6, 7]
+    assert topo.workers_spanned([0, 1, 4]) == [0, 1]
+
+
+def test_worker_mapping_v5e_single_host():
+    topo = make_topology("v5e-8")         # 8 chips, 8 per host -> 1 worker
+    assert topo.num_workers == 1 and topo.chips_per_host == 8
+    assert topo.workers_spanned(list(range(8))) == [0]
+
+
+def test_multihost_env_full_slice():
+    topo = make_topology("v5p-16")
+    envs = topo.multihost_env(list(range(8)))
+    assert sorted(envs) == [0, 1]
+    e0, e1 = envs[0], envs[1]
+    # local device indices per host
+    assert e0["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert e1["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert e0["TPU_WORKER_ID"] == "0" and e1["TPU_WORKER_ID"] == "1"
+    assert e0["CLOUD_TPU_TASK_ID"] == "0" and e1["CLOUD_TPU_TASK_ID"] == "1"
+    # identical full per-host boxes -> process bounds declared
+    assert e0["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    assert e0["TPU_PROCESS_BOUNDS"] == "1,1,2"
+    # coordination mesh wiring
+    assert e0["TPU_PROCESS_ADDRESSES"] == e1["TPU_PROCESS_ADDRESSES"]
+    assert e0["TPU_PROCESS_ADDRESSES"].count(":8476") == 2
+    assert e0["TPU_WORKER_HOSTNAMES"] == "worker-0,worker-1"
+
+
+def test_multihost_env_ragged_grant_omits_bounds():
+    topo = make_topology("v5p-16")
+    # 3 chips on worker 0, 4 on worker 1: shapes differ -> no bounds env
+    envs = topo.multihost_env([0, 1, 2, 4, 5, 6, 7])
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in envs[0]
+    assert "TPU_PROCESS_ADDRESSES" in envs[0]
+
+
+def test_serialize_roundtrip_carries_workers():
+    topo = make_topology("v5p-16")
+    d = topo.serialize()
+    assert d["numWorkers"] == 2 and d["chipsPerHost"] == 4
