@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import attention
+from ..parallel.mesh import pin_activation, pin_qkv
 
 
 @dataclass(frozen=True)
@@ -179,6 +180,7 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
     q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
     k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
     v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    q, k, v = pin_qkv(q, k, v, mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
@@ -214,6 +216,7 @@ def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
     c = config
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
+    x = pin_activation(x, mesh)
     cos, sin = rope_frequencies(c, jnp.arange(s))
 
     def body(x, layer):

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ..parallel.mesh import pin_activation
 from .llama import (
     ATTN_PARAM_KINDS, LlamaConfig, _attention_block, attention_params,
     rms_norm, rope_frequencies,
@@ -242,6 +243,7 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
     lc = c.as_llama()
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
+    x = pin_activation(x, mesh)
     cos, sin = rope_frequencies(lc, jnp.arange(s))
 
     def body(carry, layer):

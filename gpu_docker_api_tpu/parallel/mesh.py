@@ -88,7 +88,13 @@ def param_sharding_rules() -> dict[str, P]:
     axis of every matrix (ZeRO-3).
     """
     return {
-        "embed": P("tp", "fsdp"),        # [V, D]
+        # [V, D] vocab-parallel (megatron-style): vocab over tp AND fsdp
+        # (ZeRO-3 memory scaling without sharding D). Sharding D instead
+        # makes the embed gather's output D-sharded while its indices are
+        # batch-sharded — SPMD must then pick one layout per use, and
+        # forward/backward-remat picking differently costs an involuntary
+        # full reshard of the activations every step.
+        "embed": P(("tp", "fsdp"), None),
         "attn_in": P("fsdp", "tp"),      # [D, heads*head_dim] (wq/wk/wv)
         "attn_out": P("tp", "fsdp"),     # [heads*head_dim, D] (wo)
         "mlp_in": P("fsdp", "tp"),       # [D, F] (w1, w3)
@@ -139,6 +145,39 @@ def constraint(x, mesh: Mesh, spec: P):
     if mesh.empty:
         return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def pin_activation(x, mesh: Optional[Mesh]):
+    """Pin a [B, S, D] activation to the canonical layout (batch over the
+    data axes, sequence over sp). The embed gather especially needs it: its
+    input is tp-sharded on vocab and its index batch-sharded, so SPMD
+    propagation can legally choose either layout for the output — and
+    picking differently in the forward vs the rematerialized backward
+    forces an involuntary full reshard of the activations every step."""
+    if mesh is None or mesh.empty:
+        return x
+    return constraint(x, mesh, activation_spec())
+
+
+def qkv_spec(mesh: Mesh, n_heads: int, n_kv_heads: int) -> P:
+    """THE canonical [B, S, H, D_head] layout: batch over the data axes,
+    sequence over sp, heads over tp when GQA-divisible. Used both as the
+    forward's activation pin (models/llama.py) and as the shard_map
+    in/out_specs of the sequence-parallel attention bodies (ring.py,
+    ulysses.py) — one definition so they can never drift apart."""
+    return P(BATCH_AXES, "sp", head_axis_for(mesh, n_heads, n_kv_heads), None)
+
+
+def pin_qkv(q, k, v, mesh: Optional[Mesh]):
+    """Constrain q/k/v to qkv_spec. Without the full pin, SPMD propagation
+    is free to pick batch-sharded in the forward but head-sharded in the
+    rematerialized backward (or vice versa) and the mismatch surfaces as
+    '[SPMD] Involuntary full rematerialization' reshards on every layer."""
+    if mesh is None or mesh.empty:
+        return q, k, v
+    spec = qkv_spec(mesh, q.shape[2], k.shape[2])
+    return (constraint(q, mesh, spec), constraint(k, mesh, spec),
+            constraint(v, mesh, spec))
 
 
 def head_axis_for(mesh: Mesh, n_heads: int, n_kv_heads: int):

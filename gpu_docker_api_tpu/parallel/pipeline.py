@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import pin_activation
+
 
 def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
                    n_microbatches: int, remat: bool = True) -> jax.Array:
@@ -129,6 +131,7 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     c = config
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
+    x = pin_activation(x, mesh)
     cos, sin = rope_frequencies(c, jnp.arange(s))
 
     def layer_fn(h, layer):

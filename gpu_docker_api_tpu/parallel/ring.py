@@ -26,17 +26,17 @@ from ..ops.attention import attention as _local_attention
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis: str = "sp", causal: bool = True) -> jax.Array:
-    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over `axis` — returns
-    [B,S,H,D] with the same sharding. Call from OUTSIDE shard_map; global
-    shapes in, global shapes out."""
+                   causal: bool = True) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over the sp mesh axis —
+    returns [B,S,H,D] with the same sharding. Call from OUTSIDE shard_map;
+    global shapes in, global shapes out."""
+    axis = "sp"                      # the one sequence axis (mesh.AXES)
     n = mesh.shape[axis]
     if n == 1:
         return _local_attention(q, k, v, causal=causal)
 
-    from .mesh import BATCH_AXES, head_axis_for
-    head_ax = head_axis_for(mesh, q.shape[2], k.shape[2])
-    spec_q = P(BATCH_AXES, axis, head_ax, None)
+    from .mesh import qkv_spec
+    spec_q = qkv_spec(mesh, q.shape[2], k.shape[2])
     local = functools.partial(_ring_local, axis=axis, ring=n, causal=causal)
     return jax.shard_map(
         local, mesh=mesh,

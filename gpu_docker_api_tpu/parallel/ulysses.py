@@ -30,23 +30,23 @@ from ..ops.attention import attention as _local_attention
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                      axis: str = "sp", causal: bool = True,
-                      impl: str = "auto") -> jax.Array:
-    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over `axis` — returns
-    [B,S,H,D] same sharding. Call from OUTSIDE shard_map; global shapes
-    in/out. Requires H % sp == 0 (KV heads are replicated up to the group
-    size first when Hkv % sp != 0)."""
+                      causal: bool = True, impl: str = "auto") -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over the sp mesh axis —
+    returns [B,S,H,D] same sharding. Call from OUTSIDE shard_map; global
+    shapes in/out. Requires H % sp == 0 (KV heads are replicated up to the
+    group size first when Hkv % sp != 0)."""
+    axis = "sp"                      # the one sequence axis (mesh.AXES)
     n = mesh.shape[axis]
     if n == 1:
         return _local_attention(q, k, v, causal=causal, impl=impl)
 
-    from .mesh import BATCH_AXES, head_axis_for
+    from .mesh import head_axis_for, qkv_spec
     head_ax = head_axis_for(mesh, q.shape[2], k.shape[2])
     tp_n = mesh.shape["tp"] if head_ax else 1
     if (q.shape[2] // tp_n) % n != 0:
         raise ValueError(
             f"n_heads {q.shape[2]}/tp={tp_n} must divide by sp {n} for Ulysses")
-    spec = P(BATCH_AXES, axis, head_ax, None)
+    spec = qkv_spec(mesh, q.shape[2], k.shape[2])
     local = functools.partial(_ulysses_local, axis=axis, sp=n, causal=causal,
                               impl=impl)
     return jax.shard_map(

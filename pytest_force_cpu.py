@@ -10,6 +10,11 @@ _WANT = {
     "JAX_PLATFORMS": "cpu",
     "JAX_PLATFORM_NAME": "cpu",
     "JAX_ENABLE_X64": "0",
+    # XLA's C++ W-level logging must be visible: the SPMD-reshard regression
+    # test asserts on a stderr warning, which TF_CPP_MIN_LOG_LEVEL>=2 would
+    # silence into a vacuous pass. The level is read at process init, so it
+    # has to be set here (pre-exec), not in the test.
+    "TF_CPP_MIN_LOG_LEVEL": "0",
 }
 
 def _ensure_env() -> None:

@@ -70,9 +70,9 @@ def test_sharded_params_placement():
     trainer = Trainer.create(cfg, MeshPlan(dp=1, fsdp=2, tp=2, sp=2))
     state = trainer.init(jax.random.key(0))
     embed = state["params"]["embed"]
-    # embed [V, D] sharded ("tp", "fsdp") -> each shard is V/2 x D/2
+    # embed [V, D] vocab-parallel (("tp","fsdp"), None) -> V/(2*2) x D
     shard_shapes = {s.data.shape for s in embed.addressable_shards}
-    assert shard_shapes == {(cfg.vocab_size // 2, cfg.d_model // 2)}
+    assert shard_shapes == {(cfg.vocab_size // 4, cfg.d_model)}
     # optimizer moments shard like their params
     leaves = jax.tree.leaves(state["opt_state"],
                              is_leaf=lambda x: hasattr(x, "sharding"))
@@ -116,7 +116,7 @@ def test_param_specs_layer_axis_unsharded():
     specs = param_specs(cfg)
     assert specs["layers"]["wq"] == jax.sharding.PartitionSpec(None, "fsdp", "tp")
     assert specs["layers"]["wo"] == jax.sharding.PartitionSpec(None, "tp", "fsdp")
-    assert specs["embed"] == jax.sharding.PartitionSpec("tp", "fsdp")
+    assert specs["embed"] == jax.sharding.PartitionSpec(("tp", "fsdp"), None)
     # placement: wq [L, D, kq] shards D over fsdp, kq over tp
     trainer = Trainer.create(cfg, MeshPlan(dp=1, fsdp=2, tp=2, sp=2))
     state = trainer.init(jax.random.key(0))

@@ -251,3 +251,52 @@ def test_llama_forward_ulysses_matches_dense(llama_tiny):
         out = llama_forward(params, toks, ucfg, mesh=mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_moe_step_compiles_without_involuntary_reshards(capfd):
+    """VERDICT r1 #3: the ep-sharded MoE train step must compile with zero
+    '[SPMD] Involuntary full rematerialization' warnings — each one is a
+    full activation reshard every step on a real mesh. Fixed by the
+    fully-determined qkv/embed activation pins (models/llama.py) and the
+    vocab-parallel embed spec (parallel/mesh.py)."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan
+    from gpu_docker_api_tpu.train import TrainConfig, Trainer
+
+    config = MoEConfig.tiny()
+    trainer = Trainer.create(config, MeshPlan(fsdp=2, ep=2, tp=2),
+                             tc=TrainConfig(remat=True))
+    state = trainer.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 64), 0,
+                                config.vocab_size, dtype=jnp.int32)
+    tokens = trainer.shard_batch(tokens)
+
+    # Fail-closed preconditions: the warning must be loggable (W-level C++
+    # logs enabled — pytest_force_cpu pins TF_CPP_MIN_LOG_LEVEL=0 pre-exec)
+    # and a real compile must happen (a compilation-cache hit skips the SPMD
+    # partitioner entirely and would pass vacuously).
+    assert os.environ.get("TF_CPP_MIN_LOG_LEVEL", "0") in ("", "0", "1")
+    # XLA's SPMD partitioner logs from C++ directly to fd 2; capture it
+    # across the compile with a dup2 swap (pytest's capfd alone misses
+    # output written before its read, so read the file ourselves).
+    with tempfile.TemporaryFile() as tmp:
+        saved = os.dup(2)
+        cache_was = jax.config.jax_enable_compilation_cache
+        try:
+            os.dup2(tmp.fileno(), 2)
+            jax.config.update("jax_enable_compilation_cache", False)
+            with trainer.mesh:
+                trainer._step_fn.lower(state, tokens).compile()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", cache_was)
+            os.dup2(saved, 2)
+            os.close(saved)
+        tmp.seek(0)
+        stderr = tmp.read().decode(errors="replace")
+    assert "Involuntary full rematerialization" not in stderr, stderr[-2000:]
