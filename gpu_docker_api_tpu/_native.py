@@ -88,6 +88,10 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.mvcc_compact.argtypes = [c.c_void_p, c.c_int64, c.c_char_p]
         lib.mvcc_snapshot.restype = c.c_int
         lib.mvcc_snapshot.argtypes = [c.c_void_p, c.c_char_p]
+        lib.mvcc_maintain.restype = c.c_int64
+        lib.mvcc_maintain.argtypes = [c.c_void_p, c.c_char_p]
+        lib.mvcc_wal_records.restype = c.c_int64
+        lib.mvcc_wal_records.argtypes = [c.c_void_p]
         lib.mvcc_revision.restype = c.c_int64
         lib.mvcc_revision.argtypes = [c.c_void_p]
         lib.mvcc_free.argtypes = [c.c_void_p]

@@ -44,9 +44,16 @@ class App:
                  topology: Optional[TpuTopology] = None,
                  api_key: Optional[str] = None,
                  cpu_cores: Optional[int] = None,
-                 store_engine: str = "auto"):
+                 store_engine: str = "auto",
+                 store_maint_records: int = 5000):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
+        # WAL maintenance trigger: when the record count crosses this,
+        # compact + rewrite (0 disables). The reference leans on an external
+        # etcd's auto-compaction — which its revision walker then breaks
+        # under; here compaction preserves the history prefixes by design.
+        self.store_maint_records = store_maint_records
+        self._maint_stop = None
         # --- reference Init order: docker -> etcd -> workQueue -> schedulers
         #     -> version maps (main.go:53-97) ---
         self.store = open_store(wal_path=os.path.join(state_dir, "state.wal"),
@@ -372,6 +379,8 @@ class App:
             f"tdapi_volumes {len(self.volume_versions.items())}",
             "# TYPE tdapi_workqueue_pending gauge",
             f"tdapi_workqueue_pending {self.wq.pending()}",
+            "# TYPE tdapi_store_wal_records gauge",
+            f"tdapi_store_wal_records {self.store.wal_records}",
         ]
         return RawResponse(("\n".join(lines) + "\n").encode(),
                            "text/plain; version=0.0.4")
@@ -406,19 +415,62 @@ class App:
 
     def start(self) -> None:
         self.server.start()
+        self._start_store_maintenance()
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
                  self.server.host, self.server.port, self.tpu.topology.num_chips)
+
+    # ------------------------------------------------- store maintenance
+
+    def maintain_store(self) -> dict:
+        """One maintenance pass: compact history below the current revision
+        (container/volume/version history prefixes kept in full) and rewrite
+        the WAL. Safe to call any time; also runs automatically when the WAL
+        crosses store_maint_records."""
+        from ..store.client import KEEP_HISTORY_PREFIXES
+        stats = self.store.maintain(KEEP_HISTORY_PREFIXES)
+        log.info("store maintenance: dropped %d revisions, WAL now %d records",
+                 stats["dropped"], stats["wal_records"])
+        return stats
+
+    def _start_store_maintenance(self) -> None:
+        if self.store_maint_records <= 0:
+            return
+        import threading
+        self._maint_stop = threading.Event()
+
+        def loop():
+            while not self._maint_stop.wait(2.0):
+                try:
+                    if self.store.wal_records >= self.store_maint_records:
+                        self.maintain_store()
+                except Exception:  # noqa: BLE001 — keep the janitor alive
+                    log.exception("store maintenance failed")
+
+        self._maint_thread = threading.Thread(
+            target=loop, name="store-maint", daemon=True)
+        self._maint_thread.start()
 
     def stop(self) -> None:
         """Graceful shutdown: drain queue, flush all state (reference Stop,
         main.go:139-154)."""
         self.server.stop()
+        if self._maint_stop is not None:
+            # join, don't just signal: an in-flight maintain() racing past
+            # store.close() would os.replace() its snapshot over a WAL a
+            # successor App may already be appending to (lost writes)
+            self._maint_stop.set()
+            self._maint_thread.join(timeout=10)
         self.wq.close()
         for sch in (self.tpu, self.cpu, self.ports):
             sch.flush()
         self.container_versions.flush()
         self.volume_versions.flush()
         self.merges.flush()
+        if self.store_maint_records > 0:
+            try:
+                self.maintain_store()   # leave a bounded WAL at rest
+            except Exception:  # noqa: BLE001
+                log.exception("final store maintenance failed")
         self.backend.close()
         self.events.close()
         self.store.close()

@@ -34,6 +34,19 @@ def resource_key(resource: str, name: str) -> str:
     return f"{ResourcePrefix.Base}/{resource}/{name}"
 
 
+# Key prefixes whose MVCC history must survive compaction: the per-entity
+# version keys (the durable rollback record) and the primary container/volume
+# keys (whose in-key history backs get_revision_range — the reference-parity
+# view that etcd compaction silently destroys in the reference, SURVEY §2
+# bug 5). Everything else — scheduler status maps, version maps, merges —
+# churns on every mutation and only needs its latest value.
+KEEP_HISTORY_PREFIXES = (
+    f"{ResourcePrefix.Base}/{ResourcePrefix.Versions}/",
+    f"{ResourcePrefix.Base}/{ResourcePrefix.Containers}/",
+    f"{ResourcePrefix.Base}/{ResourcePrefix.Volumes}/",
+)
+
+
 @dataclass(frozen=True)
 class Combine:
     """One history entry: per-key version + global revision + raw value

@@ -51,6 +51,7 @@ class MVCCStore:
         self._wal_path = wal_path
         self._fsync = fsync
         self._wal = None
+        self._wal_records = 0
         if wal_path:
             if os.path.exists(wal_path):
                 self._replay(wal_path)
@@ -212,6 +213,48 @@ class MVCCStore:
                            keep_history_prefixes: tuple[str, ...]) -> None:
         self._compact_locked(revision, keep_history_prefixes)
 
+    @property
+    def wal_records(self) -> int:
+        """Records in the WAL file (replayed + appended since open) — the
+        maintenance trigger for the App's WAL-growth bound."""
+        with self._lock:
+            return self._wal_records
+
+    def maintain(self, keep_history_prefixes: tuple[str, ...] = ()) -> dict:
+        """Bound the WAL: compact in-memory history up to the current
+        revision (keys under keep_history_prefixes keep full history), then
+        rewrite the WAL file as a snapshot of the pruned state and swap the
+        append handle onto it. The rewrite is atomic (tmp + rename); the
+        old handle must be swapped because os.replace leaves an open handle
+        appending to the unlinked inode — writes there would be lost.
+
+        The reference has no equivalent: it leans on an external etcd's
+        auto-compaction, which its own revision walker then breaks under
+        (SURVEY §2 bug 5). Returns {"dropped", "wal_records"}."""
+        if not self._wal_path:
+            return {"dropped": 0, "wal_records": 0}
+        with self._lock:
+            dropped = self._compact_locked(self._rev, keep_history_prefixes)
+            self.snapshot(self._wal_path + ".snap")
+            if self._wal is not None:
+                self._wal.close()
+            try:
+                os.replace(self._wal_path + ".snap", self._wal_path)
+                self._wal = open(self._wal_path, "a", encoding="utf-8")
+            except OSError:
+                # never leave _wal as a closed handle — subsequent puts
+                # would half-apply (memory mutated, WAL append raising)
+                self._wal = open(self._wal_path, "a", encoding="utf-8")
+                raise
+            # re-count: the snapshot holds one "rev" record + the live kvs
+            with open(self._wal_path, "r", encoding="utf-8") as f:
+                self._wal_records = sum(1 for line in f if line.strip())
+            # restore the compaction floor on future replays (the snapshot
+            # itself carries only puts) — a no-op prune that sets _compacted
+            self._wal_append({"op": "compact", "r": self._compacted,
+                              "keep": list(keep_history_prefixes)})
+            return {"dropped": dropped, "wal_records": self._wal_records}
+
     # ---- persistence ----
 
     def _wal_append(self, rec: dict) -> None:
@@ -220,6 +263,7 @@ class MVCCStore:
             self._wal.flush()
             if self._fsync:
                 os.fsync(self._wal.fileno())
+            self._wal_records += 1
 
     def _replay(self, path: str) -> None:
         with open(path, "r", encoding="utf-8") as f:
@@ -231,6 +275,7 @@ class MVCCStore:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail write — stop-the-line would lose the rest
+                self._wal_records += 1
                 rev = rec.get("r", self._rev + 1)
                 self._rev = max(self._rev, rev)
                 if rec["op"] == "put":

@@ -103,6 +103,20 @@ class NativeMVCCStore:
         if not self._lib.mvcc_snapshot(self._handle, path.encode()):
             raise OSError(f"snapshot to {path} failed")
 
+    @property
+    def wal_records(self) -> int:
+        return self._lib.mvcc_wal_records(self._handle)
+
+    def maintain(self, keep_history_prefixes: tuple[str, ...] = ()) -> dict:
+        """Compact + WAL rewrite + handle swap, same contract as
+        MVCCStore.maintain."""
+        blob = (b"".join(p.encode() + b"\0" for p in keep_history_prefixes)
+                + b"\0")
+        dropped = self._lib.mvcc_maintain(self._handle, blob)
+        if dropped < 0:
+            raise OSError("WAL rewrite failed during maintain")
+        return {"dropped": dropped, "wal_records": self.wal_records}
+
     def keys(self):
         return iter(sorted(kv.key for kv in self.range("")))
 

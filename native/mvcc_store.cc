@@ -228,6 +228,7 @@ class Store {
       line += ",\"r\":" + std::to_string(rev_) + "}\n";
       std::fwrite(line.data(), 1, line.size(), wal_);
       std::fflush(wal_);
+      ++wal_records_;
     }
     return rev_;
   }
@@ -245,6 +246,7 @@ class Store {
       line += ",\"r\":" + std::to_string(rev_) + "}\n";
       std::fwrite(line.data(), 1, line.size(), wal_);
       std::fflush(wal_);
+      ++wal_records_;
     }
     return true;
   }
@@ -326,39 +328,64 @@ class Store {
       line += "]}\n";
       std::fwrite(line.data(), 1, line.size(), wal_);
       std::fflush(wal_);
+      ++wal_records_;
     }
     return dropped;
   }
 
   bool Snapshot(const std::string& path) {
     std::lock_guard<std::mutex> g(mu_);
-    std::string tmp = path + ".tmp";
-    FILE* f = std::fopen(tmp.c_str(), "wb");
-    if (!f) return false;
-    std::string line = "{\"op\":\"rev\",\"r\":" + std::to_string(rev_) + "}\n";
-    std::fwrite(line.data(), 1, line.size(), f);
-    for (const auto& [key, revs] : log_) {
-      std::vector<const Rev*> live;
-      for (const auto& r : revs) {
-        if (r.tombstone) live.clear();
-        else live.push_back(&r);
-      }
-      for (const Rev* r : live) {
-        line = "{\"op\":\"put\",\"k\":";
-        json_escape(key, &line);
-        line += ",\"v\":";
-        json_escape(r->value, &line);
-        line += ",\"r\":" + std::to_string(r->mod) + "}\n";
-        std::fwrite(line.data(), 1, line.size(), f);
-      }
+    return SnapshotLocked(path, nullptr);
+  }
+
+  // Bound the WAL: compact up to the current revision (keys under `keep`
+  // retain full history), rewrite the WAL as a snapshot of the pruned
+  // state, and swap the append handle onto the new file (appending through
+  // the old handle after rename would write to the unlinked inode).
+  // Returns dropped revision count, or -1 when the rewrite failed.
+  int64_t Maintain(const std::vector<std::string>& keep) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (wal_path_.empty()) return 0;
+    int64_t dropped = CompactLocked(rev_, keep);
+    if (wal_) {
+      std::fflush(wal_);
+      std::fclose(wal_);
+      wal_ = nullptr;
     }
-    std::fclose(f);
-    return std::rename(tmp.c_str(), path.c_str()) == 0;
+    int64_t records = 0;
+    if (!SnapshotLocked(wal_path_, &records)) {
+      wal_ = std::fopen(wal_path_.c_str(), "ab");  // keep appending regardless
+      return -1;
+    }
+    wal_ = std::fopen(wal_path_.c_str(), "ab");
+    if (!wal_) return -1;  // surface it: silent wal_=nullptr would drop
+                           // every subsequent write from persistence
+    wal_records_ = records;
+    // restore the compaction floor on future replays (the snapshot itself
+    // carries only puts) — a no-op prune that re-sets compacted_
+    if (wal_) {
+      std::string line = "{\"op\":\"compact\",\"r\":" +
+                         std::to_string(compacted_) + ",\"keep\":[";
+      for (size_t i = 0; i < keep.size(); ++i) {
+        if (i) line += ",";
+        json_escape(keep[i], &line);
+      }
+      line += "]}\n";
+      std::fwrite(line.data(), 1, line.size(), wal_);
+      std::fflush(wal_);
+      ++wal_records_;
+    }
+    return dropped;
   }
 
   int64_t revision() {
     std::lock_guard<std::mutex> g(mu_);
     return rev_;
+  }
+
+  int64_t wal_records() {
+    std::lock_guard<std::mutex> g(mu_);
+    return wal_records_;
   }
 
  private:
@@ -383,6 +410,35 @@ class Store {
     r.mod = rev;
     r.tombstone = true;
     revs.push_back(std::move(r));
+  }
+
+  bool SnapshotLocked(const std::string& path, int64_t* records_out) {
+    std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    int64_t records = 1;
+    std::string line = "{\"op\":\"rev\",\"r\":" + std::to_string(rev_) + "}\n";
+    std::fwrite(line.data(), 1, line.size(), f);
+    for (const auto& [key, revs] : log_) {
+      std::vector<const Rev*> live;
+      for (const auto& r : revs) {
+        if (r.tombstone) live.clear();
+        else live.push_back(&r);
+      }
+      for (const Rev* r : live) {
+        line = "{\"op\":\"put\",\"k\":";
+        json_escape(key, &line);
+        line += ",\"v\":";
+        json_escape(r->value, &line);
+        line += ",\"r\":" + std::to_string(r->mod) + "}\n";
+        std::fwrite(line.data(), 1, line.size(), f);
+        ++records;
+      }
+    }
+    std::fclose(f);
+    if (std::rename(tmp.c_str(), path.c_str()) != 0) return false;
+    if (records_out) *records_out = records;
+    return true;
   }
 
   int64_t CompactLocked(int64_t revision, const std::vector<std::string>& keep) {
@@ -425,6 +481,7 @@ class Store {
     auto apply_line = [&](const std::string& l) {
       Record rec = parse_record(l);
       if (!rec.ok) return;  // torn tail record
+      ++wal_records_;
       int64_t rev = rec.r >= 0 ? rec.r : rev_ + 1;
       rev_ = std::max(rev_, rev);
       if (rec.op == "put") ApplyPut(rec.k, rec.v, rev);
@@ -459,6 +516,7 @@ class Store {
   std::map<std::string, std::vector<Rev>> log_;
   int64_t rev_ = 0;
   int64_t compacted_ = 0;
+  int64_t wal_records_ = 0;
   std::string wal_path_;
   FILE* wal_ = nullptr;
 };
@@ -519,6 +577,22 @@ int64_t mvcc_compact(void* h, int64_t revision, const char* keep_prefixes) {
 
 int mvcc_snapshot(void* h, const char* path) {
   return static_cast<Store*>(h)->Snapshot(path) ? 1 : 0;
+}
+
+// keep_prefixes: same NUL-separated format as mvcc_compact. Returns dropped
+// revisions, or -1 when the WAL rewrite failed.
+int64_t mvcc_maintain(void* h, const char* keep_prefixes) {
+  std::vector<std::string> keep;
+  const char* p = keep_prefixes;
+  while (p && *p) {
+    keep.emplace_back(p);
+    p += keep.back().size() + 1;
+  }
+  return static_cast<Store*>(h)->Maintain(keep);
+}
+
+int64_t mvcc_wal_records(void* h) {
+  return static_cast<Store*>(h)->wal_records();
 }
 
 int64_t mvcc_revision(void* h) { return static_cast<Store*>(h)->revision(); }

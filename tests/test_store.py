@@ -206,3 +206,77 @@ def test_compaction_durable_across_restart(tmp_path):
         s2.get_at_revision("k", 1)  # compaction survives restart
     assert s2.get("k").value == "v4"
     s2.close()
+
+
+def test_maintain_bounds_wal_and_keeps_history(tmp_path, request):
+    """VERDICT r1 missing #5: maintain() = compact + WAL rewrite. The WAL
+    must stay bounded under churn, history-prefix keys must keep full
+    history across maintain + restart, and the revision counter must
+    continue (never re-mint). Runs on both engines."""
+    from gpu_docker_api_tpu.store import open_store
+
+    for engine in (["python", "native"]
+                   if __import__("gpu_docker_api_tpu.store",
+                                 fromlist=["native_available"]
+                                 ).native_available() else ["python"]):
+        wal = str(tmp_path / f"maint-{engine}.wal")
+        s = open_store(wal_path=wal, engine=engine)
+        # churner key: hammered status-map-style writes
+        for i in range(500):
+            s.put("/tpu-docker-api/apis/v1/tpus/tpuStatusMap", f"state-{i}")
+        # history keys: container lifecycle (kept prefix)
+        for v in range(1, 6):
+            s.put("/tpu-docker-api/apis/v1/containers/web", f"cfg-v{v}")
+            s.put(f"/tpu-docker-api/apis/v1/versions/containers/web/{v:012d}",
+                  f"cfg-v{v}")
+        assert s.wal_records >= 510
+        rev_before = s.revision
+
+        from gpu_docker_api_tpu.store.client import KEEP_HISTORY_PREFIXES
+        stats = s.maintain(KEEP_HISTORY_PREFIXES)
+        assert stats["dropped"] >= 499            # churner pruned to floor
+        assert stats["wal_records"] < 30          # bounded WAL
+        assert s.wal_records == stats["wal_records"]
+        # live state intact, history intact
+        assert s.get("/tpu-docker-api/apis/v1/tpus/tpuStatusMap").value == "state-499"
+        hist = s.history("/tpu-docker-api/apis/v1/containers/web")
+        assert [kv.value for kv in hist] == [f"cfg-v{v}" for v in range(1, 6)]
+        # writes after maintain land in the rewritten WAL
+        s.put("/tpu-docker-api/apis/v1/tpus/tpuStatusMap", "state-after")
+        s.close()
+
+        # restart: replay the rewritten WAL
+        s2 = open_store(wal_path=wal, engine=engine)
+        assert s2.revision >= rev_before + 1      # counter continues
+        assert s2.get("/tpu-docker-api/apis/v1/tpus/tpuStatusMap").value == "state-after"
+        hist = s2.history("/tpu-docker-api/apis/v1/containers/web")
+        assert [kv.value for kv in hist] == [f"cfg-v{v}" for v in range(1, 6)]
+        # compaction floor survives the restart
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            s2.get_at_revision("/tpu-docker-api/apis/v1/tpus/tpuStatusMap", 1)
+        new_rev = s2.put("/tpu-docker-api/apis/v1/containers/web", "cfg-v6")
+        assert new_rev > rev_before               # never re-mints revisions
+        s2.close()
+
+
+def test_cross_engine_wal_after_maintain(tmp_path):
+    """The rewritten WAL must stay byte-compatible: maintain under one
+    engine, reopen under the other."""
+    from gpu_docker_api_tpu.store import native_available, open_store
+    from gpu_docker_api_tpu.store.client import KEEP_HISTORY_PREFIXES
+    if not native_available():
+        import pytest
+        pytest.skip("native engine unavailable")
+    wal = str(tmp_path / "cross.wal")
+    s = open_store(wal_path=wal, engine="native")
+    for i in range(50):
+        s.put("/tpu-docker-api/apis/v1/cpus/cpuStatusMap", f"c{i}")
+    s.put("/tpu-docker-api/apis/v1/containers/db", "v1")
+    s.maintain(KEEP_HISTORY_PREFIXES)
+    s.close()
+    p = open_store(wal_path=wal, engine="python")
+    assert p.get("/tpu-docker-api/apis/v1/cpus/cpuStatusMap").value == "c49"
+    assert p.get("/tpu-docker-api/apis/v1/containers/db").value == "v1"
+    assert p.wal_records < 20
+    p.close()
