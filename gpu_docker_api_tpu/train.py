@@ -57,15 +57,16 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
     pipelined trunk (mesh must have pp > 1)."""
     fam = family_for(config)
     if n_microbatches:
-        from .parallel.pipeline import pipeline_forward
+        from .parallel.pipeline import pipeline_loss
         if fam.returns_extra_loss:
             raise NotImplementedError(
                 "pipelined MoE trunk not composed yet — use pp=1 for MoE")
-        out = pipeline_forward(params, tokens, config, mesh,
-                               n_microbatches=n_microbatches, impl=impl,
-                               remat=remat)
-    else:
-        out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
+        # pipelined CE: the trunk output leaves the pp region sharded from
+        # the last stage (one ring crossing, no full-buffer all-reduce)
+        return pipeline_loss(params, tokens, config, mesh,
+                             n_microbatches=n_microbatches, impl=impl,
+                             remat=remat)
+    out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
     logits, extra = out if fam.returns_extra_loss else (out, 0.0)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
