@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK = 128
+# TPU vector lanes. Per-row residuals (logsumexp) are stored lane-replicated
+# [.., S, LANES] because mosaic requires the last two dims of every block to
+# be (8k, 128m)-aligned — a [B*H, S] residual with (1, blk_q) blocks does not
+# lower (the official jax TPU flash kernel stores l/m the same way).
+LANES = 128
 
 
 # ---- reference (XLA) -------------------------------------------------------
@@ -53,9 +58,14 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 # ---- pallas flash kernel ---------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                  *, blk_q: int, blk_k: int, scale: float, causal: bool,
-                  seq_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  blk_q: int, blk_k: int, scale: float, causal: bool,
+                  seq_len: int, want_lse: bool):
+    if want_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref = None
+        acc_ref, m_ref, l_ref = rest
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
     q = q_ref[0].astype(jnp.float32) * scale            # [blk_q, D]
     m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
@@ -99,9 +109,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     jax.lax.fori_loop(0, n_kv, body, 0)
     denom = jnp.maximum(l_ref[:], 1e-30)
     o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
-    # logsumexp residual for the backward kernels: lse = m + log(l)
-    m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
-    lse_ref[0] = (m_fin + jnp.log(denom))[:, 0]
+    if want_lse:
+        # logsumexp residual for the backward kernels: lse = m + log(l),
+        # lane-replicated [blk_q, LANES] (see LANES note above)
+        m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
+        lse_ref[0] = jnp.broadcast_to(m_fin + jnp.log(denom),
+                                      (lse_ref.shape[1], lse_ref.shape[2]))
 
 
 def _pid(axis: int):
@@ -109,9 +122,12 @@ def _pid(axis: int):
     return pl.program_id(axis)
 
 
-def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
+def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
+                   want_lse: bool = True):
     """Runs the forward kernel. q [B,S,H,D], k/v [B,S,Hkv,D] ->
-    (out [B,S,H,D], lse [B*H, S] f32 of the SCALED scores)."""
+    (out [B,S,H,D], lse [B*H, S, LANES] f32 of the SCALED scores — lane
+    replicated; None when want_lse=False, which skips the residual write
+    entirely on the inference path)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -133,14 +149,22 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
     grid = (b * h, s // blk_q)
     kernel = functools.partial(
         _flash_kernel, blk_q=blk_q, blk_k=blk_k, scale=scale,
-        causal=causal, seq_len=s)
+        causal=causal, seq_len=s, want_lse=want_lse)
 
     def kv_index(bh, i):
         del i
         # bh = batch * h + head; its kv row is batch * hkv + head // group
         return ((bh // h) * hkv + (bh % h) // group, 0, 0)
 
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, s, d), q.dtype)]
+    if want_lse:
+        out_specs.append(
+            pl.BlockSpec((1, blk_q, LANES), lambda bh, i: (bh, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32))
+
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -148,14 +172,8 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, s, d), kv_index),
         ],
-        out_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((blk_q, d), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -163,6 +181,7 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
+    out, lse = res if want_lse else (res[0], None)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
 
 
@@ -177,15 +196,18 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret):
 #   dQ_i = scale * sum_j dS_ij K_j          (grid over q blocks)
 #   dK_j = scale * sum_i dS_ij^T Q_i        (grid over kv blocks x GQA group)
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                          dq_ref, *, blk_q: int, blk_k: int, scale: float,
                          causal: bool, seq_len: int):
     import jax.experimental.pallas as pl
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
     q = q_ref[0].astype(jnp.float32) * scale             # [blk_q, D]
     do = do_ref[0].astype(jnp.float32)                   # [blk_q, D]
-    lse = lse_ref[0][:, None]                            # [blk_q, 1]
-    delta = delta_ref[0][:, None]                        # [blk_q, 1]
+    lse = lse_ref[0][:, 0:1]                             # [blk_q, 1]
+    # D_i = rowsum(dO_i * O_i), computed in-VMEM from the o/do blocks (no
+    # lane-replicated HBM delta array needed)
+    delta = jnp.sum(do * o_ref[0].astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [blk_q, 1]
 
     n_kv_total = seq_len // blk_k
     if causal:
@@ -217,7 +239,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                           dk_ref, dv_ref, *, blk_q: int, blk_k: int,
                           scale: float, causal: bool, seq_len: int,
                           group: int):
@@ -234,8 +256,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = accs
         q = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * blk_q, blk_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * blk_q, blk_q)][:, None]
+        lse = lse_ref[0, pl.ds(i * blk_q, blk_q), :][:, 0:1]
+        delta = jnp.sum(
+            do * o_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32),
+            axis=-1, keepdims=True)                      # [blk_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -290,9 +314,6 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     dot = do.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    # D_i = rowsum(dO * O) — cheap elementwise+reduce, XLA fuses it
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)                              # [B*H, S]
 
     def kv_index(bh, i):
         del i
@@ -307,13 +328,13 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, blk_q, LANES), lambda bh, i: (bh, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, ot, dot, lse)
 
     # dk/dv: grid over kv rows x kv blocks x the GQA group; `g` is the
     # fastest-varying dim, so consecutive steps revisit the same out block
@@ -321,10 +342,6 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
     def q_row(bh, j, g):
         del j
         return ((bh // hkv) * h + (bh % hkv) * group + g, 0, 0)
-
-    def q_row2(bh, j, g):
-        del j
-        return ((bh // hkv) * h + (bh % hkv) * group + g, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
@@ -335,8 +352,8 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
             pl.BlockSpec((1, s, d), q_row),
-            pl.BlockSpec((1, s), q_row2),
-            pl.BlockSpec((1, s), q_row2),
+            pl.BlockSpec((1, s, d), q_row),
+            pl.BlockSpec((1, s, LANES), q_row),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
@@ -347,7 +364,7 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
             jax.ShapeDtypeStruct((b * hkv, s, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, ot, dot, lse)
 
     dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     dk = dk.reshape(b, hkv, s, d).transpose(0, 2, 1, 3).astype(k.dtype)
@@ -359,7 +376,9 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, blk_q, blk_k, interpret):
-    out, _ = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    # primal-only path (inference / no grad): skip the lse residual write
+    out, _ = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
+                            want_lse=False)
     return out
 
 
@@ -377,17 +396,31 @@ def _flash_vjp_bwd(causal, blk_q, blk_k, interpret, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _auto_block(s: int) -> int:
+    """Measured on a real v5e chip (bench round 2): 256 blocks beat 128 ~2x
+    at S<=2048; 512 wins at S>=4096 (27.6 TF/s vs 19.4 at 256, 12.0 at 128).
+    Small 128x128 score matmuls underfeed the MXU pipeline."""
+    target = 512 if s >= 4096 else 256
+    while target > s or s % target:
+        target //= 2
+    return max(target, 1)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "blk_q", "blk_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
-                    blk_q: int = DEFAULT_BLOCK,
-                    blk_k: int = DEFAULT_BLOCK,
+                    blk_q: int | None = None,
+                    blk_k: int | None = None,
                     interpret: bool = False) -> jax.Array:
     """Pallas TPU flash attention, differentiable (custom_vjp with pallas
     backward kernels — training runs the flash path end-to-end, no [S, S]
     materialization in either direction). q [B,S,H,D], k/v [B,S,Hkv,D].
+    blk_q/blk_k default to a measured seq-length-dependent tile size.
     interpret=True runs the kernels in the pallas interpreter (CPU tests)."""
+    s = q.shape[1]
+    blk_q = blk_q or _auto_block(s)
+    blk_k = blk_k or _auto_block(s)
     return _flash(q, k, v, causal, blk_q, blk_k, interpret)
 
 
