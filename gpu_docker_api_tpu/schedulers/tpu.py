@@ -25,7 +25,7 @@ from typing import Optional
 
 from .. import xerrors
 from ..store.client import StateClient
-from ..topology import TpuTopology, discover_topology
+from ..topology import TpuTopology, chips_per_host_for, discover_topology
 from ..workqueue import WorkQueue
 from .base import FREE, Scheduler, _norm_owner, merge_stored_status
 
@@ -42,14 +42,20 @@ class TpuScheduler(Scheduler):
         self.allow_fragmented = allow_fragmented
         state = self._load_state()
         if state is not None and topology is None:
+            gen = state["topology"]["generation"]
             self.topology = TpuTopology(
                 accelerator_type=state["topology"]["acceleratorType"],
-                generation=state["topology"]["generation"],
+                generation=gen,
                 shape=tuple(state["topology"]["shape"]),  # type: ignore[arg-type]
                 wraparound=state["topology"].get("wraparound", False),
                 worker_id=state["topology"].get("workerId", 0),
                 num_workers=state["topology"].get("numWorkers", 1),
-                chips_per_host=state["topology"].get("chipsPerHost", 4),
+                # state written by older versions lacks the key: infer from
+                # the generation (8 on v5e/v6e — a flat 4 would corrupt
+                # worker_of mapping and the multihost env grouping)
+                chips_per_host=state["topology"].get(
+                    "chipsPerHost", chips_per_host_for(gen)),
+                ici_connected=state["topology"].get("iciConnected", True),
             )
             self.status = {int(k): _norm_owner(v)
                            for k, v in state["status"].items()}
@@ -186,7 +192,17 @@ class TpuScheduler(Scheduler):
 
     def _find_connected(self, n: int, free: set[int]) -> Optional[list[int]]:
         """Connected free set of n chips via greedy BFS from each free seed,
-        preferring tight bounding boxes."""
+        preferring tight bounding boxes.
+
+        COMPLETE for existence: from each seed the loop keeps absorbing
+        frontier neighbors until either n chips are picked or the seed's
+        entire connected component is exhausted — so whenever any free
+        component holds >= n chips, a connected grant is returned (any
+        connected graph with >= n vertices contains a connected n-subgraph,
+        and BFS absorption constructs one). Only the bounding-box TIGHTNESS
+        of the returned set is heuristic (the tie-break which frontier chip
+        to absorb next); tests/test_schedulers.py pins both properties on
+        snake- and L-shaped free regions."""
         topo = self.topology
         best: Optional[list[int]] = None
         best_vol: Optional[int] = None

@@ -25,6 +25,9 @@ from typing import Iterator, Optional
 
 Coord = tuple[int, int, int]
 
+# device-node probe pattern; module-level so tests can point it at a fake
+ACCEL_GLOB = "/dev/accel[0-9]*"
+
 
 @dataclass(frozen=True)
 class Chip:
@@ -73,6 +76,11 @@ class TpuTopology:
     chips_per_host: int = 4
     worker_id: int = 0                 # TPU VM worker identity (multi-host)
     num_workers: int = 1
+    # False for probed non-standard chip counts: the shape then only numbers
+    # the chips — NO ICI adjacency or process-bounds claims are derived from
+    # it (asserting links the hardware may not have would corrupt grants and
+    # libtpu mesh init)
+    ici_connected: bool = True
 
     def __post_init__(self) -> None:
         if not self.chips:
@@ -105,7 +113,10 @@ class TpuTopology:
 
     def neighbors(self, chip: Chip) -> list[Chip]:
         """ICI neighbors: ±1 along each axis, wrapping when the slice is a
-        torus on that axis (axis size > 2 required for a distinct wrap link)."""
+        torus on that axis (axis size > 2 required for a distinct wrap link).
+        Empty when the topology makes no connectivity claims."""
+        if not self.ici_connected:
+            return []
         out = []
         for axis in range(3):
             for d in (-1, 1):
@@ -227,6 +238,8 @@ class TpuTopology:
         all_full = all(b[3] for b in boxes.values())
 
         per_dims = pbounds = None
+        if not self.ici_connected:
+            same_shape = all_full = False
         if same_shape and all_full:
             per_dims = next(iter(boxes.values()))[2]
             gmins, gdims, gfull = self._bbox(indices)
@@ -287,12 +300,14 @@ class TpuTopology:
             "TPU_ACCELERATOR_TYPE": self.accelerator_type,
             "TPU_SKIP_MDS_QUERY": "true",
         }
-        if idx:
+        if idx and self.ici_connected:
             _, bounds, full = self._bbox(idx)
             # Declare per-process bounds only when the grant exactly fills its
             # bounding box — for L-shaped/fragmented grants a box declaration
             # would claim chips the process can't see and libtpu mesh init
             # would fail; with VISIBLE_CHIPS alone libtpu infers the layout.
+            # (An ici_connected=False topology never declares bounds: its
+            # shape is a numbering, not a layout claim.)
             if full:
                 env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{bounds[0]},{bounds[1]},{bounds[2]}"
                 env["TPU_PROCESS_BOUNDS"] = "1,1,1"
@@ -307,13 +322,19 @@ class TpuTopology:
             "workerId": self.worker_id,
             "numWorkers": self.num_workers,
             "chipsPerHost": self.chips_per_host,
+            "iciConnected": self.ici_connected,
         }
+
+
+def chips_per_host_for(generation: str) -> int:
+    """Chips per TPU-VM host by generation: 4 for the 3D tori (v4/v5p, and
+    v2/v3 boards), 8 for the 2D meshes (v5e/v6e)."""
+    return 4 if generation in _GEN_3D or generation in {"v2", "v3"} else 8
 
 
 def make_topology(accelerator_type: str, worker_id: int = 0) -> TpuTopology:
     """Build a topology for a known accelerator type, e.g. "v5p-8". Worker
-    (TPU VM host) count is inferred from the generation's chips-per-host:
-    4 for the 3D tori (v4/v5p), 8 for the 2D meshes (v5e/v6e)."""
+    (TPU VM host) count is inferred from the generation's chips-per-host."""
     if accelerator_type in _KNOWN_SHAPES:
         gen, shape = _KNOWN_SHAPES[accelerator_type]
     else:
@@ -325,7 +346,7 @@ def make_topology(accelerator_type: str, worker_id: int = 0) -> TpuTopology:
         chips = max(chips, 1)
         # factor into the most cubic box available
         shape = _most_cubic_shape(chips)
-    cph = 4 if gen in _GEN_3D or gen in {"v2", "v3"} else 8
+    cph = chips_per_host_for(gen)
     n_chips = shape[0] * shape[1] * shape[2]
     workers = max(1, (n_chips + cph - 1) // cph)
     return TpuTopology(accelerator_type, gen, shape, chips_per_host=cph,
@@ -361,11 +382,22 @@ def discover_topology(mock_accelerator_type: Optional[str] = None) -> TpuTopolog
     runtime decision.
     """
     acc_type = os.environ.get("TPU_ACCELERATOR_TYPE")
-    accel_nodes = sorted(glob.glob("/dev/accel[0-9]*"))
+    accel_nodes = sorted(glob.glob(ACCEL_GLOB))
     if acc_type:
-        topo = make_topology(acc_type)
-        return topo
+        # explicit operator/platform signal wins; an unparsable value raises
+        # (a typo'd type must not silently become a guessed topology)
+        return make_topology(acc_type)
     if accel_nodes:
-        return make_topology(f"v5e-{len(accel_nodes)}") if len(accel_nodes) in (1, 4, 8) \
-            else TpuTopology("unknown", "v5e", _most_cubic_shape(len(accel_nodes)))
+        n = len(accel_nodes)
+        if n in (1, 4, 8):
+            # the standard per-host chip counts have exact known shapes
+            return make_topology(f"v5e-{n}")
+        # Any other local count (2 chips, a half-drained host, ...): the
+        # chips get a line NUMBERING but ici_connected=False — no adjacency
+        # or process-bounds claims are derived from a shape we can't verify
+        # (which links exist depends on which chips of the real mesh these
+        # are); grants degrade to visible-chips-only env, which libtpu can
+        # always initialize.
+        return TpuTopology(f"local-{n}", "v5e", (n, 1, 1), chips_per_host=n,
+                           ici_connected=False)
     return make_topology(mock_accelerator_type or "v5p-8")
