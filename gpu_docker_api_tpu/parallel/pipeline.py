@@ -1,23 +1,40 @@
-"""Pipeline parallelism over the `pp` mesh axis — GPipe schedule, SPMD-style.
+"""Pipeline parallelism over the `pp` mesh axis — GPipe and interleaved
+(virtual-stage) schedules, SPMD-style.
 
-The decoder trunk is split into pp stages (layer-stacked params sharded
-P("pp", ...) on the leading n_layers axis); microbatches flow stage-to-stage
-around an ICI ring via lax.ppermute. Built the XLA way: ONE program for all
-stages inside a shard_map that is manual ONLY over "pp"
-(axis_names={"pp"}) — tp/fsdp/ep/sp stay automatic, so the per-stage matmul
-collectives are still inserted by the compiler. Schedule is a lax.scan over
-M + pp - 1 ticks (static trip count; no data-dependent Python control flow):
+The decoder trunk is split into stages (layer-stacked params sharded
+P("pp", ...)); microbatches flow stage-to-stage around an ICI ring via
+lax.ppermute. Built the XLA way: ONE program for all stages inside a
+shard_map that is manual ONLY over "pp" (axis_names={"pp"}) — tp/fsdp/ep
+stay automatic, so the per-stage matmul collectives are still inserted by
+the compiler. Both schedules are a lax.scan with a STATIC trip count (no
+data-dependent Python control flow).
+
+GPipe (virtual_stages=1), M + pp - 1 ticks:
 
     tick t:  stage 0 injects microbatch t        (t < M)
              every stage runs its local layers
              stage pp-1 banks its finished microbatch t-(pp-1)
              activations rotate one hop forward on the pp ring
 
-The bubble is the standard GPipe (pp-1)/(M+pp-1) fraction — pick
-n_microbatches >= 2*pp to keep it small. Backward flows through
-ppermute/scan automatically (jax.grad of the whole thing), giving the
-mirrored 1B1F-free schedule; remat of the stage body keeps the activation
-footprint at one microbatch per stage.
+Interleaved (virtual_stages=v>1), the Megatron-LM circular schedule
+(arXiv:2104.04473 §2.2) in SPMD form: each device holds v layer CHUNKS
+(device d owns global chunks {l*pp + d, l<v}) and every microbatch rides
+the ring v laps. Microbatches are injected in groups of pp; at global tick
+t, device d's phase is τ = t - d, and it deterministically processes
+
+    lap   l  = (τ // pp) mod v          (which local chunk)
+    micro mb = (τ // (pp*v))*pp + τ%pp  (which microbatch)
+
+The ring delivery lines up exactly — what device d-1 produced at t-1 is
+what device d must consume at t (same phase), and a lap finishing at device
+pp-1 re-enters device 0 one block later, which is precisely when its next
+lap is scheduled. No buffering, one live activation per device. Ticks =
+M*v + pp - 1 of L/(v*pp) layers each, so the bubble overhead drops from
+GPipe's (pp-1)/M to (pp-1)/(M*v) — see schedule_work_units.
+
+Backward flows through ppermute/scan automatically (jax.grad of the whole
+thing); remat of the stage body keeps the activation footprint at one
+microbatch per stage.
 
 The reference control plane has no PP (SURVEY §2 checklist: "PP: none
 exist"); this is the TPU-native obligation from SURVEY §5.7/5.8.
@@ -34,22 +51,40 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .mesh import pin_activation
 
 
-def _check_divisible(layers, x, npp: int, m: int) -> None:
+def schedule_work_units(pp: int, m: int, v: int = 1) -> float:
+    """Per-device work of one pipelined step, in units of a FULL network
+    pass (L layers) on one microbatch: ticks x per-tick depth. The useful
+    work is m/pp; everything above it is bubble. The step-time proxy the
+    schedule tests compare (same per-tick math, only the schedule differs).
+    """
+    ticks = m * v + pp - 1
+    return ticks / (v * pp)
+
+
+def _check_divisible(layers, x, npp: int, m: int, v: int = 1) -> None:
     """Clear errors up front: an indivisible layer count otherwise surfaces
     later as an opaque uneven-sharding error from NamedSharding on the
     stacked layer axis; an indivisible batch as a reshape error."""
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
     n_layers = jax.tree.leaves(layers)[0].shape[0]
-    if n_layers % npp != 0:
+    if n_layers % (npp * v) != 0:
         raise ValueError(
-            f"n_layers {n_layers} not divisible by pp {npp} — each pipeline "
-            f"stage must hold the same number of layers")
+            f"n_layers {n_layers} not divisible by pp*virtual_stages "
+            f"{npp}*{v} — each pipeline chunk must hold the same number "
+            f"of layers")
     b = x.shape[0]
     if b % m != 0:
         raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    if v > 1 and m % npp != 0:
+        raise ValueError(
+            f"interleaved schedule injects microbatches in groups of pp: "
+            f"n_microbatches {m} must be divisible by pp {npp}")
 
 
 def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
-                   n_microbatches: int, remat: bool = True) -> jax.Array:
+                   n_microbatches: int, remat: bool = True,
+                   virtual_stages: int = 1) -> jax.Array:
     """Run `layer_fn` over stacked `layers` as a pp-stage pipeline.
 
     layers: pytree with leading [n_layers] axis, sharded P("pp", ...) so each
@@ -57,8 +92,10 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
     x:      [B, S, D] activations (batch sharded over the data axes; the
             pp axis sees the full local batch).
     layer_fn(x, layer) -> x: one decoder layer.
+    virtual_stages: v>1 selects the interleaved schedule (v layer chunks per
+            device, v ring laps per microbatch — bubble/v; see module doc).
     Returns [B, S, D], numerically identical to a sequential scan over all
-    layers (GPipe does not change math, only schedule).
+    layers (neither schedule changes math, only order).
     """
     npp = mesh.shape["pp"]
     if npp == 1:
@@ -66,47 +103,71 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             return layer_fn(h, layer), None
         return jax.lax.scan(body, x, layers)[0]
 
-    _check_divisible(layers, x, npp, n_microbatches)
+    v = virtual_stages
+    _check_divisible(layers, x, npp, n_microbatches, v)
     b, s, d = x.shape
     m = n_microbatches
 
-    def run_stage(h, layers_local):
+    def run_stage(h, layers_chunk):
         def body(h, layer):
             return layer_fn(h, layer), None
         if remat:
             return jax.checkpoint(
-                lambda h: jax.lax.scan(body, h, layers_local)[0])(h)
-        return jax.lax.scan(body, h, layers_local)[0]
+                lambda h: jax.lax.scan(body, h, layers_chunk)[0])(h)
+        return jax.lax.scan(body, h, layers_chunk)[0]
 
     fwd = [(i, (i + 1) % npp) for i in range(npp)]
 
+    in_dtype = x.dtype
+    # XLA:CPU's AllReducePromotion pass CHECK-crashes on the bf16 cotangent
+    # psum of a replicated shard_map input — cross the boundary in f32 there.
+    # CPU-only: on TPU the pass doesn't run and the upcast would double the
+    # [M, b/M, S, D] buffer's HBM + its cotangent for nothing.
+    f32_boundary = (jax.default_backend() == "cpu"
+                    and in_dtype != jnp.float32)
+
     def staged(layers_local, x_mb):
-        """Per-stage SPMD body. layers_local: [L/pp, ...]; x_mb [M, b/M, S, D]
-        (replicated w.r.t. pp)."""
+        """Per-stage SPMD body. layers_local: [v, 1, L/(v*pp), ...] (the
+        size-1 dim is this stage's slice of the pp-sharded axis; chunk l is
+        global chunk l*pp + stage); x_mb [M, b/M, S, D] (replicated w.r.t.
+        pp; f32 at the boundary on CPU — see f32_boundary above — the ring
+        itself always stays in the model dtype)."""
         stage = jax.lax.axis_index("pp")
-        is_first = (stage == 0)
+        x_mb = x_mb.astype(in_dtype)
+        # drop the local pp axis: [v, 1, Lc, ...] -> [v, Lc, ...]
+        layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)
 
         def tick(carry, t):
             state, outputs = carry
-            # stage 0 takes fresh input; everyone else what the ring delivered
+            # device-local phase: which (lap, microbatch) this stage works on
+            tau = t - stage
+            k = tau // npp                      # block index
+            lap = k % v
+            mb = (k // v) * npp + tau % npp
+            mb_c = jnp.clip(mb, 0, m - 1)
+            # fresh injection only at stage 0 on lap 0; everyone/everything
+            # else consumes what the ring delivered (phases line up exactly)
             inject = jax.lax.dynamic_index_in_dim(
-                x_mb, jnp.minimum(t, m - 1), 0, keepdims=False)
-            h = jnp.where(is_first, inject, state)
-            y = run_stage(h, layers_local)
-            # last stage banks microbatch t-(npp-1) once it exists
-            out_idx = t - (npp - 1)
-            valid = (out_idx >= 0) & (out_idx < m)
-            idx = jnp.clip(out_idx, 0, m - 1)
-            cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0, keepdims=False)
+                x_mb, mb_c, 0, keepdims=False)
+            h = jnp.where((stage == 0) & (lap == 0), inject, state)
+            chunk = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, lap, 0, keepdims=False), layers_local)
+            y = run_stage(h, chunk)
+            # last stage banks a microbatch when its final lap completes
+            valid = ((tau >= 0) & (tau < m * v)
+                     & (stage == npp - 1) & (lap == v - 1))
+            cur = jax.lax.dynamic_index_in_dim(
+                outputs, mb_c, 0, keepdims=False)
             outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(valid, y, cur), idx, 0)
+                outputs, jnp.where(valid, y, cur), mb_c, 0)
             state = jax.lax.ppermute(y, "pp", fwd)
             return (state, outputs), None
 
         state0 = jnp.zeros_like(x_mb[0])
         out0 = jnp.zeros_like(x_mb)
         (_, outputs), _ = jax.lax.scan(
-            tick, (state0, out0), jnp.arange(m + npp - 1))
+            tick, (state0, out0), jnp.arange(m * v + npp - 1))
         # each stage returns its own bank under a fresh pp-sharded leading
         # axis — NO collective here. Only the last stage's bank is real;
         # the caller slices it out, so the buffer crosses the ring once
@@ -114,20 +175,31 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         # banks added in (VERDICT r1 weak #4).
         return outputs[None]
 
+    # [L, ...] -> [v, pp, Lc, ...]: global layer (l*pp + d)*Lc + j lands at
+    # [l, d, j] — device d's chunks are exactly {l*pp + d}, and walking laps
+    # visits the network in sequential layer order
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    lc = n_layers // (v * npp)
+    layers_v = jax.tree.map(
+        lambda a: a.reshape(v, npp, lc, *a.shape[1:]), layers)
+
     x_mb = x.reshape(m, b // m, s, d)
+    if f32_boundary:
+        x_mb = x_mb.astype(jnp.float32)
     out = jax.shard_map(
         staged, mesh=mesh,
-        in_specs=(P("pp"), P()),
+        in_specs=(P(None, "pp"), P()),
         out_specs=P("pp"),         # [pp, M, b/M, S, D], dim 0 pp-sharded
         axis_names={"pp"},         # manual over pp ONLY — tp/fsdp stay auto
         check_vma=False,
-    )(layers, x_mb)
+    )(layers_v, x_mb)
     return out[-1].reshape(b, s, d)
 
 
 def pipeline_loss(params: dict, tokens: jax.Array, config,
                   mesh: Mesh, n_microbatches: int = 4,
-                  impl: str = "auto", remat: bool = True) -> jax.Array:
+                  impl: str = "auto", remat: bool = True,
+                  virtual_stages: int = 1) -> jax.Array:
     """Next-token CE loss with the trunk pipelined — the TRAINING entry.
 
     Design note (VERDICT r1 weak #4): the trunk returns its outputs
@@ -141,7 +213,7 @@ def pipeline_loss(params: dict, tokens: jax.Array, config,
     the lm_head + CE stay outside, auto-sharded over fsdp/tp as usual."""
     logits = pipeline_forward(params, tokens, config, mesh,
                               n_microbatches=n_microbatches, impl=impl,
-                              remat=remat)
+                              remat=remat, virtual_stages=virtual_stages)
     return _token_ce(logits, tokens)
 
 
@@ -157,13 +229,19 @@ def _token_ce(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 
 def pipeline_forward(params: dict, tokens: jax.Array, config,
                      mesh: Mesh, n_microbatches: int = 4,
-                     impl: str = "auto", remat: bool = True) -> jax.Array:
+                     impl: str = "auto", remat: bool = True,
+                     virtual_stages: int = 1) -> jax.Array:
     """Llama-family forward with the trunk pipelined over pp.
 
     Embedding and lm_head run outside the pipeline region (auto-sharded over
     fsdp/tp as usual — they are one matmul each; the trunk is where the
     n_layers × depth cost lives). Ring attention (sp) inside a pipelined
     trunk is not composed yet: use pp with sp=1.
+
+    virtual_stages > 1 (interleaved schedule): the train state keeps the
+    canonical contiguous [L]-sharding, so the trunk's strided chunk regroup
+    reshards the layer weights across pp once per step — acceptable below
+    ~1B params; for larger models store the stack strided (future work).
     """
     from ..models.llama import (
         _attention_block, _mlp_block, rms_norm, rope_frequencies,
@@ -184,6 +262,7 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
         return _mlp_block(h, layer, c)
 
     x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
-                       n_microbatches, remat=remat)
+                       n_microbatches, remat=remat,
+                       virtual_stages=virtual_stages)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)

@@ -35,6 +35,9 @@ class TrainConfig:
     grad_clip: float = 1.0
     remat: bool = True   # jax.checkpoint the layer body: HBM for FLOPs
     n_microbatches: int = 4  # pipeline microbatches when the mesh has pp > 1
+    # >1 selects the interleaved pipeline schedule (v layer chunks per
+    # stage, bubble/v — parallel/pipeline.py module doc)
+    virtual_stages: int = 1
 
 
 def _pathkey(path) -> str:
@@ -51,7 +54,8 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
 
 
 def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
-            n_microbatches: int = 0, remat: bool = True):
+            n_microbatches: int = 0, remat: bool = True,
+            virtual_stages: int = 1):
     """Next-token CE (+ the family's extra loss, e.g. MoE router aux).
     tokens [B, S]; predicts tokens[:, 1:]. n_microbatches > 0 selects the
     pipelined trunk (mesh must have pp > 1)."""
@@ -65,7 +69,7 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
         # the last stage (one ring crossing, no full-buffer all-reduce)
         return pipeline_loss(params, tokens, config, mesh,
                              n_microbatches=n_microbatches, impl=impl,
-                             remat=remat)
+                             remat=remat, virtual_stages=virtual_stages)
     out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
     logits, extra = out if fam.returns_extra_loss else (out, 0.0)
     targets = tokens[:, 1:]
@@ -209,7 +213,8 @@ class Trainer:
         def step(state, tokens):
             def compute_loss(p):
                 return loss_fn(p, tokens, cfg, mesh=mesh, n_microbatches=mb,
-                               remat=self.tc.remat)
+                               remat=self.tc.remat,
+                               virtual_stages=self.tc.virtual_stages)
             # pipelined trunk remats per-stage inside the schedule
             use_remat = self.tc.remat and not mb
             lfn = jax.checkpoint(compute_loss) if use_remat else compute_loss

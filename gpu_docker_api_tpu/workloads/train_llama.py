@@ -46,6 +46,9 @@ def main(argv=None) -> int:
                    help="expert-parallel width (moe family)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches when --pp > 1")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="interleaved pipeline schedule: layer chunks per "
+                        "stage (bubble shrinks by this factor)")
     args = p.parse_args(argv)
 
     import jax
@@ -75,7 +78,8 @@ def main(argv=None) -> int:
     tp = args.tp or best_tp_for(n_dev // fixed if n_dev % fixed == 0 else 1)
     plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp, pp=args.pp, ep=args.ep)
     trainer = Trainer.create(
-        config, plan, tc=TrainConfig(n_microbatches=args.microbatches))
+        config, plan, tc=TrainConfig(n_microbatches=args.microbatches,
+                                     virtual_stages=args.virtual_stages))
 
     # resume-first: restore against the ABSTRACT state template (no device
     # materialization); pay for a fresh sharded init only when there is no
