@@ -1,0 +1,99 @@
+"""Multi-host bring-up: turn the control plane's env contract into a live
+jax.distributed cluster.
+
+The scheduler's multihost_env (topology.py) stamps each worker's container
+with the TPU slice contract — TPU_WORKER_ID (rank), TPU_WORKER_HOSTNAMES
+(all workers, rank-ordered), TPU_PROCESS_ADDRESSES / TPU_PROCESS_PORT
+(libtpu's mesh controller endpoints). libtpu consumes those to form the ICI
+slice; what is still missing on a multi-host run is JAX's own coordination
+service (distributed arrays, multihost collectives over DCN, orbax
+multi-process checkpointing all need it). This module derives that
+initialization from the SAME contract, so a workload launched by the
+control plane needs exactly one call:
+
+    from gpu_docker_api_tpu.distributed import maybe_initialize_from_env
+    maybe_initialize_from_env()
+
+Design notes:
+- The JAX coordinator must not collide with libtpu's mesh-controller port,
+  so it binds TPU_PROCESS_PORT + JAX_COORDINATOR_PORT_OFFSET on worker 0.
+- JAX_COORDINATOR_ADDRESS, when set, overrides the derived address (the
+  reference-style operator escape hatch; also what the multihost e2e test
+  uses to point "worker-0" at 127.0.0.1).
+- Single-worker grants are a no-op: the contract only carries process
+  addresses when the grant actually spans workers, and jax.distributed is
+  pure overhead for one process.
+
+Reference parity: the reference has NO distributed backend (SURVEY §5.8) —
+its NCCL path lives inside whatever the container runs. On TPU the control
+plane owns the env contract and this module closes the loop from contract
+to running cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+PORT_OFFSET = 1011  # JAX coordinator = TPU_PROCESS_PORT + this
+
+
+def cluster_spec_from_env(env: Optional[dict] = None) -> Optional[dict]:
+    """Parse the control plane's multihost contract out of `env` (default
+    os.environ). Returns {coordinator, num_processes, process_id} or None
+    when the env describes a single-process run."""
+    e = os.environ if env is None else env
+    hosts = [h for h in e.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hosts) <= 1:
+        return None
+    try:
+        rank = int(e.get("TPU_WORKER_ID", "0"))
+    except ValueError as err:
+        # a malformed rank on a genuinely multi-worker contract must fail
+        # LOUDLY here — silently going single-process would leave the rest
+        # of the cluster blocked in initialize() waiting for this worker
+        raise ValueError(
+            f"multi-worker contract ({len(hosts)} hosts) with unparsable "
+            f"TPU_WORKER_ID={e.get('TPU_WORKER_ID')!r}") from err
+    coordinator = e.get("JAX_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        try:
+            base_port = int(e.get("TPU_PROCESS_PORT", "8476"))
+        except ValueError:
+            base_port = 8476
+        coordinator = f"{hosts[0]}:{base_port + PORT_OFFSET}"
+    return {
+        "coordinator": coordinator,
+        "num_processes": len(hosts),
+        "process_id": rank,
+    }
+
+
+def maybe_initialize_from_env(env: Optional[dict] = None) -> Optional[dict]:
+    """Initialize jax.distributed from the control-plane contract when (and
+    only when) the grant spans workers. Idempotent; returns the spec used,
+    or None for single-process runs."""
+    global _initialized
+    spec = cluster_spec_from_env(env)
+    if spec is None:
+        return None
+    if _initialized:
+        return spec
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=spec["coordinator"],
+            num_processes=spec["num_processes"],
+            process_id=spec["process_id"],
+        )
+    except RuntimeError as e:
+        # idempotence against out-of-band initialization too; jax words this
+        # "should only be called once" (older versions: "already initialized")
+        msg = str(e).lower()
+        if "once" not in msg and "already initialized" not in msg:
+            raise
+    _initialized = True
+    return spec
+
+
+_initialized = False

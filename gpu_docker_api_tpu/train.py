@@ -235,7 +235,17 @@ class Trainer:
             return self._step_fn(state, tokens)
 
     def shard_batch(self, tokens):
-        return jax.device_put(tokens, NamedSharding(self.mesh, batch_spec()))
+        sh = NamedSharding(self.mesh, batch_spec())
+        if jax.process_count() > 1:
+            # multi-host: the global sharding is not fully addressable from
+            # one process, so device_put can't place it. Every process holds
+            # an identical full copy (same PRNG key), so serving index
+            # requests from the local copy yields a consistent global array.
+            import numpy as np
+            arr = np.asarray(tokens)
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx: arr[idx])
+        return jax.device_put(tokens, sh)
 
 
 # ---- checkpointing (orbax) -------------------------------------------------

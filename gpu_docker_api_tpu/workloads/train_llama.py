@@ -51,6 +51,12 @@ def main(argv=None) -> int:
                         "stage (bubble shrinks by this factor)")
     args = p.parse_args(argv)
 
+    # multi-host: when the control plane granted chips across TPU VM
+    # workers, its env contract describes the cluster — join it BEFORE
+    # touching any jax API (distributed.py)
+    from ..distributed import maybe_initialize_from_env
+    cluster = maybe_initialize_from_env()
+
     import jax
     import jax.numpy as jnp
 
@@ -105,6 +111,8 @@ def main(argv=None) -> int:
         rec = {"step": step + 1, "loss": round(loss, 5),
                "step_time_s": round(time.perf_counter() - t0, 4),
                "devices": n_dev, "plan": str(plan), "time": time.time()}
+        if cluster is not None:
+            rec["process"] = f"{cluster['process_id']}/{cluster['num_processes']}"
         metrics_f.write(json.dumps(rec) + "\n")
         metrics_f.flush()
         if (step + 1) % args.checkpoint_every == 0 or step + 1 == args.steps:

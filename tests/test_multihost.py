@@ -1,0 +1,170 @@
+"""Multi-host execution e2e: the control plane's env contract actually
+assembles a live multi-process JAX cluster (SURVEY §5.8 — round 1 shipped
+the contract but never RAN a multi-host path).
+
+Flow: a replicaSet grant spanning two TPU-VM workers -> GET info exposes
+the per-worker env -> two REAL processes are launched with exactly that
+env (the operator's per-worker launcher role) -> each joins the cluster
+via distributed.maybe_initialize_from_env -> together they run a sharded
+train step over the GLOBAL 8-device mesh and agree on the loss.
+
+CPU stands in for the chips (4 virtual devices per process); the contract
+path exercised — TPU_WORKER_ID/HOSTNAMES/PROCESS_PORT -> jax.distributed —
+is the same one a real TPU pod slice uses.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from gpu_docker_api_tpu.distributed import cluster_spec_from_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SCRIPT = r"""
+import json, os, sys
+from gpu_docker_api_tpu.distributed import maybe_initialize_from_env
+
+spec = maybe_initialize_from_env()
+assert spec is not None, "contract should describe a 2-process cluster"
+
+import jax
+import jax.numpy as jnp
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+from gpu_docker_api_tpu.models.llama import LlamaConfig
+from gpu_docker_api_tpu.parallel.mesh import MeshPlan
+from gpu_docker_api_tpu.train import Trainer
+
+cfg = LlamaConfig.tiny()
+trainer = Trainer.create(cfg, MeshPlan.auto(8, tp=2))
+state = trainer.init(jax.random.key(0))
+tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size,
+                            jnp.int32)
+tokens = trainer.shard_batch(tokens)
+state, metrics = trainer.step(state, tokens)
+loss = float(metrics["loss"])
+
+rec = {"rank": spec["process_id"], "loss": loss,
+       "devices": jax.device_count(), "processes": jax.process_count()}
+out = sys.argv[1]
+open(out, "w").write(json.dumps(rec))
+print("worker done", rec, flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_spec_parsing_single_worker_is_noop():
+    assert cluster_spec_from_env({"TPU_WORKER_HOSTNAMES": "localhost"}) is None
+    assert cluster_spec_from_env({}) is None
+
+
+def test_spec_parsing_derives_coordinator():
+    spec = cluster_spec_from_env({
+        "TPU_WORKER_HOSTNAMES": "worker-0,worker-1",
+        "TPU_WORKER_ID": "1",
+        "TPU_PROCESS_PORT": "8476",
+    })
+    assert spec == {"coordinator": "worker-0:9487",
+                    "num_processes": 2, "process_id": 1}
+    # operator override wins
+    spec = cluster_spec_from_env({
+        "TPU_WORKER_HOSTNAMES": "a,b",
+        "TPU_WORKER_ID": "0",
+        "JAX_COORDINATOR_ADDRESS": "10.0.0.5:1234",
+    })
+    assert spec["coordinator"] == "10.0.0.5:1234"
+
+
+@pytest.mark.slow
+def test_two_worker_cluster_from_replicaset_env(tmp_path):
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    app = App(state_dir=str(tmp_path / "state"), backend="mock",
+              addr="127.0.0.1:0", topology=make_topology("v5p-16"),
+              api_key="")
+    app.start()
+    try:
+        import http.client
+
+        def call(method, path, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                              timeout=30)
+            conn.request(method, path,
+                         json.dumps(body) if body is not None else None,
+                         {"Content-Type": "application/json"})
+            out = json.loads(conn.getresponse().read())
+            conn.close()
+            assert out["code"] == 200, out
+            return out["data"]
+
+        call("POST", "/api/v1/replicaSet", {
+            "imageName": "x", "replicaSetName": "pod", "tpuCount": 8})
+        info = call("GET", "/api/v1/replicaSet/pod")["info"]
+        multihost = info["multihost"]
+        assert sorted(multihost) == ["0", "1"]
+        for w, env in multihost.items():
+            assert env["TPU_WORKER_ID"] in ("0", "1")
+            assert env["TPU_WORKER_HOSTNAMES"] == "worker-0,worker-1"
+            assert "TPU_PROCESS_ADDRESSES" in env
+    finally:
+        app.stop()
+
+    # launch one REAL process per worker with the granted env (the
+    # operator's per-worker launcher); CPU stands in for the chips
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    port = _free_port()
+    procs = []
+    for w, contract in sorted(multihost.items()):
+        env = dict(os.environ)
+        env.update(contract)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        })
+        out = tmp_path / f"out-{w}.json"
+        procs.append((w, out, subprocess.Popen(
+            [sys.executable, str(script), str(out)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)))
+
+    results = {}
+    for w, out, p in procs:
+        stdout, _ = p.communicate(timeout=420)
+        assert p.returncode == 0, stdout.decode(errors="replace")[-3000:]
+        results[w] = json.loads(out.read_text())
+
+    assert results["0"]["processes"] == 2 and results["1"]["processes"] == 2
+    assert results["0"]["devices"] == 8
+    # both processes computed the SAME global training step
+    assert results["0"]["loss"] == pytest.approx(results["1"]["loss"])
+    assert results["0"]["rank"] == 0 and results["1"]["rank"] == 1
+
+
+def test_spec_parsing_bad_rank_raises():
+    """A malformed rank on a multi-worker contract must fail loudly — a
+    silent single-process fallback would leave the rest of the cluster
+    blocked in initialize() waiting for this worker."""
+    with pytest.raises(ValueError, match="TPU_WORKER_ID"):
+        cluster_spec_from_env({
+            "TPU_WORKER_HOSTNAMES": "worker-0,worker-1",
+            "TPU_WORKER_ID": "worker-1",
+        })

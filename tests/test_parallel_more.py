@@ -373,9 +373,7 @@ def test_pipeline_layers_divisibility_error(llama_tiny):
 # than GPipe at the same (pp, M).
 
 def _tiny4():
-    cfg = LlamaConfig(vocab_size=256, d_model=64, n_layers=4, n_heads=4,
-                      n_kv_heads=2, d_ff=128, max_seq_len=128,
-                      dtype=jnp.float32)
+    cfg = dataclasses.replace(LlamaConfig.tiny(), n_layers=4)
     return cfg, llama_init(cfg, jax.random.key(0))
 
 
