@@ -80,18 +80,16 @@ def maybe_initialize_from_env(env: Optional[dict] = None) -> Optional[dict]:
     if _initialized:
         return spec
     import jax
-    try:
-        jax.distributed.initialize(
-            coordinator_address=spec["coordinator"],
-            num_processes=spec["num_processes"],
-            process_id=spec["process_id"],
-        )
-    except RuntimeError as e:
-        # idempotence against out-of-band initialization too; jax words this
-        # "should only be called once" (older versions: "already initialized")
-        msg = str(e).lower()
-        if "once" not in msg and "already initialized" not in msg:
-            raise
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        # out-of-band initialization (launcher wrapper, test harness)
+        _initialized = True
+        return spec
+    jax.distributed.initialize(
+        coordinator_address=spec["coordinator"],
+        num_processes=spec["num_processes"],
+        process_id=spec["process_id"],
+    )
     _initialized = True
     return spec
 

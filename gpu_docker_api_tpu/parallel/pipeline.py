@@ -61,18 +61,55 @@ def schedule_work_units(pp: int, m: int, v: int = 1) -> float:
     return ticks / (v * pp)
 
 
-def _check_divisible(layers, x, npp: int, m: int, v: int = 1) -> None:
+def group_layers(layers, pp: int, v: int):
+    """[L, ...] -> [v, pp, L/(v*pp), ...]: global layer (l*pp + d)*Lc + j
+    lands at [l, d, j] — device d's chunks are exactly {l*pp + d}, and
+    walking laps visits the network in sequential layer order. Train states
+    configured for the interleaved schedule store layers in THIS layout
+    (sharded P(None, "pp", ...)), so the strided chunk assignment costs no
+    per-step reshard."""
+    def g(a):
+        n = a.shape[0]
+        if n % (v * pp):
+            raise ValueError(
+                f"n_layers {n} not divisible by pp*virtual_stages {pp}*{v}")
+        return a.reshape(v, pp, n // (v * pp), *a.shape[1:])
+    return jax.tree.map(g, layers)
+
+
+def ungroup_layers(layers, pp: int, v: int):
+    """Inverse of group_layers — back to the canonical [L, ...] stack (e.g.
+    to serve a checkpoint saved by an interleaved-pipelined trainer with the
+    sequential forward / KV-cache inference path)."""
+    def u(a):
+        if tuple(a.shape[:2]) != (v, pp):
+            raise ValueError(
+                f"layer leaf leads with {tuple(a.shape[:3])}, expected "
+                f"(v={v}, pp={pp}, Lc) — not a group_layers layout")
+        return a.reshape(a.shape[0] * a.shape[1] * a.shape[2], *a.shape[3:])
+    return jax.tree.map(u, layers)
+
+
+def _check_divisible(layers, x, npp: int, m: int, v: int = 1,
+                     pregrouped: bool = False) -> None:
     """Clear errors up front: an indivisible layer count otherwise surfaces
     later as an opaque uneven-sharding error from NamedSharding on the
     stacked layer axis; an indivisible batch as a reshape error."""
     if v < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {v}")
-    n_layers = jax.tree.leaves(layers)[0].shape[0]
-    if n_layers % (npp * v) != 0:
-        raise ValueError(
-            f"n_layers {n_layers} not divisible by pp*virtual_stages "
-            f"{npp}*{v} — each pipeline chunk must hold the same number "
-            f"of layers")
+    lead = jax.tree.leaves(layers)[0]
+    if pregrouped:
+        if tuple(lead.shape[:2]) != (v, npp):
+            raise ValueError(
+                f"pregrouped layers lead with {tuple(lead.shape[:3])}, "
+                f"expected (v={v}, pp={npp}, Lc)")
+    else:
+        n_layers = lead.shape[0]
+        if n_layers % (npp * v) != 0:
+            raise ValueError(
+                f"n_layers {n_layers} not divisible by pp*virtual_stages "
+                f"{npp}*{v} — each pipeline chunk must hold the same number "
+                f"of layers")
     b = x.shape[0]
     if b % m != 0:
         raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
@@ -84,11 +121,16 @@ def _check_divisible(layers, x, npp: int, m: int, v: int = 1) -> None:
 
 def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
                    n_microbatches: int, remat: bool = True,
-                   virtual_stages: int = 1) -> jax.Array:
+                   virtual_stages: int = 1,
+                   pregrouped: bool = False) -> jax.Array:
     """Run `layer_fn` over stacked `layers` as a pp-stage pipeline.
 
     layers: pytree with leading [n_layers] axis, sharded P("pp", ...) so each
-            stage materializes n_layers/pp of them.
+            stage materializes n_layers/pp of them — or, with
+            pregrouped=True, already in group_layers' [v, pp, Lc, ...]
+            layout sharded P(None, "pp", ...) (how an interleaved Trainer
+            stores its state: the strided chunk assignment then costs no
+            per-step reshard).
     x:      [B, S, D] activations (batch sharded over the data axes; the
             pp axis sees the full local batch).
     layer_fn(x, layer) -> x: one decoder layer.
@@ -99,12 +141,14 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
     """
     npp = mesh.shape["pp"]
     if npp == 1:
+        if pregrouped:
+            raise ValueError("pregrouped layers require a pp>1 mesh")
         def body(h, layer):
             return layer_fn(h, layer), None
         return jax.lax.scan(body, x, layers)[0]
 
     v = virtual_stages
-    _check_divisible(layers, x, npp, n_microbatches, v)
+    _check_divisible(layers, x, npp, n_microbatches, v, pregrouped)
     b, s, d = x.shape
     m = n_microbatches
 
@@ -175,13 +219,9 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         # banks added in (VERDICT r1 weak #4).
         return outputs[None]
 
-    # [L, ...] -> [v, pp, Lc, ...]: global layer (l*pp + d)*Lc + j lands at
-    # [l, d, j] — device d's chunks are exactly {l*pp + d}, and walking laps
-    # visits the network in sequential layer order
-    n_layers = jax.tree.leaves(layers)[0].shape[0]
-    lc = n_layers // (v * npp)
-    layers_v = jax.tree.map(
-        lambda a: a.reshape(v, npp, lc, *a.shape[1:]), layers)
+    # interleaved trainers pass layers already in group_layers layout (no
+    # per-step reshard); ungrouped callers pay one regroup here
+    layers_v = layers if pregrouped else group_layers(layers, npp, v)
 
     x_mb = x.reshape(m, b // m, s, d)
     if f32_boundary:
@@ -199,7 +239,8 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
 def pipeline_loss(params: dict, tokens: jax.Array, config,
                   mesh: Mesh, n_microbatches: int = 4,
                   impl: str = "auto", remat: bool = True,
-                  virtual_stages: int = 1) -> jax.Array:
+                  virtual_stages: int = 1,
+                  pregrouped: bool = False) -> jax.Array:
     """Next-token CE loss with the trunk pipelined — the TRAINING entry.
 
     Design note (VERDICT r1 weak #4): the trunk returns its outputs
@@ -213,7 +254,8 @@ def pipeline_loss(params: dict, tokens: jax.Array, config,
     the lm_head + CE stay outside, auto-sharded over fsdp/tp as usual."""
     logits = pipeline_forward(params, tokens, config, mesh,
                               n_microbatches=n_microbatches, impl=impl,
-                              remat=remat, virtual_stages=virtual_stages)
+                              remat=remat, virtual_stages=virtual_stages,
+                              pregrouped=pregrouped)
     return _token_ce(logits, tokens)
 
 
@@ -230,7 +272,8 @@ def _token_ce(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 def pipeline_forward(params: dict, tokens: jax.Array, config,
                      mesh: Mesh, n_microbatches: int = 4,
                      impl: str = "auto", remat: bool = True,
-                     virtual_stages: int = 1) -> jax.Array:
+                     virtual_stages: int = 1,
+                     pregrouped: bool = False) -> jax.Array:
     """Llama-family forward with the trunk pipelined over pp.
 
     Embedding and lm_head run outside the pipeline region (auto-sharded over
@@ -238,10 +281,10 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     n_layers × depth cost lives). Ring attention (sp) inside a pipelined
     trunk is not composed yet: use pp with sp=1.
 
-    virtual_stages > 1 (interleaved schedule): the train state keeps the
-    canonical contiguous [L]-sharding, so the trunk's strided chunk regroup
-    reshards the layer weights across pp once per step — acceptable below
-    ~1B params; for larger models store the stack strided (future work).
+    virtual_stages > 1 (interleaved schedule): pass pregrouped=True with
+    params["layers"] in group_layers' [v, pp, Lc, ...] layout (what an
+    interleaved Trainer stores) to avoid a per-step strided weight reshard;
+    canonical [L] stacks also work and pay one regroup inside.
     """
     from ..models.llama import (
         _attention_block, _mlp_block, rms_norm, rope_frequencies,
@@ -263,6 +306,7 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
 
     x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
                        n_microbatches, remat=remat,
-                       virtual_stages=virtual_stages)
+                       virtual_stages=virtual_stages,
+                       pregrouped=pregrouped)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)

@@ -55,10 +55,13 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
 
 def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
             n_microbatches: int = 0, remat: bool = True,
-            virtual_stages: int = 1):
+            virtual_stages: int = 1, pregrouped: bool = False):
     """Next-token CE (+ the family's extra loss, e.g. MoE router aux).
     tokens [B, S]; predicts tokens[:, 1:]. n_microbatches > 0 selects the
-    pipelined trunk (mesh must have pp > 1)."""
+    pipelined trunk (mesh must have pp > 1). pregrouped=True when
+    params["layers"] is already in pipeline.group_layers layout (how an
+    interleaved Trainer stores state); canonical [L] stacks pay one regroup
+    inside."""
     fam = family_for(config)
     if n_microbatches:
         from .parallel.pipeline import pipeline_loss
@@ -66,10 +69,12 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
             raise NotImplementedError(
                 "pipelined MoE trunk not composed yet — use pp=1 for MoE")
         # pipelined CE: the trunk output leaves the pp region sharded from
-        # the last stage (one ring crossing, no full-buffer all-reduce)
+        # the last stage (one ring crossing, no full-buffer all-reduce);
+        # interleaved states store layers pre-grouped (no per-step reshard)
         return pipeline_loss(params, tokens, config, mesh,
                              n_microbatches=n_microbatches, impl=impl,
-                             remat=remat, virtual_stages=virtual_stages)
+                             remat=remat, virtual_stages=virtual_stages,
+                             pregrouped=pregrouped)
     out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
     logits, extra = out if fam.returns_extra_loss else (out, 0.0)
     targets = tokens[:, 1:]
@@ -79,18 +84,27 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
     return -jnp.mean(ll) + extra
 
 
-def param_specs(config, pipelined: bool = False) -> Any:
-    """PartitionSpec pytree matching init_params structure. Layer params are
-    STACKED along a leading n_layers axis (one lax.scan body — llama.py
-    init_params); that scan axis is sharded over pp when the trunk is
-    pipelined, else unsharded — fsdp/tp/ep land on the documented matrix
-    axes either way."""
+def param_specs(config, pipelined: bool = False,
+                virtual_stages: int = 1) -> Any:
+    """PartitionSpec pytree matching the train state's parameter structure.
+    Layer params are STACKED along a leading n_layers axis (one lax.scan
+    body — llama.py init_params); that scan axis is sharded over pp when the
+    trunk is pipelined, else unsharded — fsdp/tp/ep land on the documented
+    matrix axes either way. An interleaved pipeline (virtual_stages > 1)
+    stores layers pre-grouped as [v, pp, Lc, ...] (pipeline.group_layers),
+    sharded on the pp dim, so the strided chunk assignment costs no
+    per-step reshard."""
     rules = param_sharding_rules()
     kinds = family_for(config).param_kinds(config)
-    lead = "pp" if pipelined else None
 
-    def stacked(spec: P) -> P:
-        return P(lead, *spec)
+    if pipelined and virtual_stages > 1:
+        def stacked(spec: P) -> P:
+            return P(None, "pp", None, *spec)
+    else:
+        lead = "pp" if pipelined else None
+
+        def stacked(spec: P) -> P:
+            return P(lead, *spec)
 
     return {
         "embed": rules[kinds["embed"]],
@@ -138,6 +152,13 @@ class Trainer:
 
     def _init_fn(self, k):
         params = family_for(self.config).init_params(self.config, k)
+        if self._pipelined and self.tc.virtual_stages > 1:
+            # interleaved schedule: store layers pre-grouped (see
+            # param_specs) so the pipeline never reshards weights per step
+            from .parallel.pipeline import group_layers
+            params["layers"] = group_layers(
+                params["layers"], self.mesh.shape["pp"],
+                self.tc.virtual_stages)
         opt_state = self.optimizer.init(params)
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
@@ -145,7 +166,8 @@ class Trainer:
     def _abstract_and_shardings(self, key):
         params_sh = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
-            param_specs(self.config, pipelined=self._pipelined))
+            param_specs(self.config, pipelined=self._pipelined,
+                        virtual_stages=self.tc.virtual_stages))
         out_shape = jax.eval_shape(self._init_fn, key)
         return out_shape, self._state_shardings(out_shape, params_sh)
 
@@ -214,7 +236,10 @@ class Trainer:
             def compute_loss(p):
                 return loss_fn(p, tokens, cfg, mesh=mesh, n_microbatches=mb,
                                remat=self.tc.remat,
-                               virtual_stages=self.tc.virtual_stages)
+                               virtual_stages=self.tc.virtual_stages,
+                               # Trainer state stores interleaved layers
+                               # pre-grouped (see _init_fn)
+                               pregrouped=self.tc.virtual_stages > 1)
             # pipelined trunk remats per-stage inside the schedule
             use_remat = self.tc.remat and not mb
             lfn = jax.checkpoint(compute_loss) if use_remat else compute_loss

@@ -95,7 +95,12 @@ def main(argv=None) -> int:
         abstract = trainer.abstract_state(jax.random.key(0))
         state, start_step = restore_checkpoint(ckpt_dir, abstract)
         print(f"resumed from checkpoint step {start_step}", flush=True)
-    except Exception:  # noqa: BLE001 — no/unreadable checkpoint: fresh start
+    except FileNotFoundError:
+        # no checkpoint yet: fresh start. Anything else (shape mismatch
+        # from a changed --pp/--virtual-stages, corrupt payload) must fail
+        # LOUDLY — silently re-initializing would discard real progress on
+        # the same workdir (pipeline.ungroup_layers converts layouts when a
+        # schedule change across a resume is intended).
         state = trainer.init(jax.random.key(0))
 
     metrics_f = open(metrics_path, "a", encoding="utf-8")
@@ -116,7 +121,10 @@ def main(argv=None) -> int:
         metrics_f.write(json.dumps(rec) + "\n")
         metrics_f.flush()
         if (step + 1) % args.checkpoint_every == 0 or step + 1 == args.steps:
-            save_checkpoint(ckpt_dir, jax.device_get(state), step + 1)
+            # hand orbax the sharded state as-is: on multi-host runs
+            # device_get would raise (arrays span non-addressable devices);
+            # orbax coordinates the multi-process save itself
+            save_checkpoint(ckpt_dir, state, step + 1)
             metrics_f.write(json.dumps(
                 {"checkpoint": step + 1, "time": time.time()}) + "\n")
             metrics_f.flush()
