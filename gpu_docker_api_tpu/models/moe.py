@@ -158,6 +158,14 @@ def capacity_positions(onehot: jax.Array) -> jax.Array:
     return jnp.sum(pos * onehot, axis=-1)                    # [T, K]
 
 
+def weighted_router_loss(aux, z, config: MoEConfig):
+    """The router objective both training paths add to CE: load-balance and
+    z losses under their config weights (sequential moe_forward applies it
+    to layer sums; the pipelined trunk per layer — same result, the formula
+    is linear)."""
+    return config.router_aux_weight * aux + config.router_z_weight * z
+
+
 def moe_block(x: jax.Array, layer: dict, config: MoEConfig,
               mesh: Optional[Mesh] = None
               ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -257,5 +265,4 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
         params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    router_loss = (c.router_aux_weight * aux_sum + c.router_z_weight * z_sum)
-    return logits, router_loss
+    return logits, weighted_router_loss(aux_sum, z_sum, c)

@@ -122,7 +122,8 @@ def _check_divisible(layers, x, npp: int, m: int, v: int = 1,
 def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
                    n_microbatches: int, remat: bool = True,
                    virtual_stages: int = 1,
-                   pregrouped: bool = False) -> jax.Array:
+                   pregrouped: bool = False,
+                   with_aux: bool = False):
     """Run `layer_fn` over stacked `layers` as a pp-stage pipeline.
 
     layers: pytree with leading [n_layers] axis, sharded P("pp", ...) so each
@@ -133,19 +134,35 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             per-step reshard).
     x:      [B, S, D] activations (batch sharded over the data axes; the
             pp axis sees the full local batch).
-    layer_fn(x, layer) -> x: one decoder layer.
+    layer_fn(x, layer) -> x: one decoder layer — or (x, aux_scalar) with
+            with_aux=True (e.g. the MoE router losses); per-layer aux is
+            then accumulated over REAL chunk-visits only (bubble ticks
+            excluded) and psum'd over pp.
     virtual_stages: v>1 selects the interleaved schedule (v layer chunks per
             device, v ring laps per microbatch — bubble/v; see module doc).
-    Returns [B, S, D], numerically identical to a sequential scan over all
-    layers (neither schedule changes math, only order).
+    Returns [B, S, D] (or ([B, S, D], aux_total) with with_aux), the
+    activations numerically identical to a sequential scan over all layers
+    (neither schedule changes math, only order). Aux statistics computed
+    over per-microbatch token pools (e.g. MoE load-balance means, static
+    capacity) see b/M tokens per call — same semantics as any microbatched
+    MoE trainer, documented rather than hidden.
     """
+    def aux_body(carry, layer):
+        """Scan body shared by the pp=1 fast path and the per-stage chunk
+        scan: apply one layer, accumulate its aux scalar when carrying one."""
+        h, aux = carry
+        if with_aux:
+            h, a = layer_fn(h, layer)
+            return (h, aux + a), None
+        return (layer_fn(h, layer), aux), None
+
     npp = mesh.shape["pp"]
     if npp == 1:
         if pregrouped:
             raise ValueError("pregrouped layers require a pp>1 mesh")
-        def body(h, layer):
-            return layer_fn(h, layer), None
-        return jax.lax.scan(body, x, layers)[0]
+        (out, aux), _ = jax.lax.scan(
+            aux_body, (x, jnp.zeros((), jnp.float32)), layers)
+        return (out, aux) if with_aux else out
 
     v = virtual_stages
     _check_divisible(layers, x, npp, n_microbatches, v, pregrouped)
@@ -153,12 +170,13 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
     m = n_microbatches
 
     def run_stage(h, layers_chunk):
-        def body(h, layer):
-            return layer_fn(h, layer), None
+        def stage(h):
+            (h, aux), _ = jax.lax.scan(
+                aux_body, (h, jnp.zeros((), jnp.float32)), layers_chunk)
+            return h, aux
         if remat:
-            return jax.checkpoint(
-                lambda h: jax.lax.scan(body, h, layers_chunk)[0])(h)
-        return jax.lax.scan(body, h, layers_chunk)[0]
+            return jax.checkpoint(stage)(h)
+        return stage(h)
 
     fwd = [(i, (i + 1) % npp) for i in range(npp)]
 
@@ -182,7 +200,7 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # device-local phase: which (lap, microbatch) this stage works on
             tau = t - stage
             k = tau // npp                      # block index
@@ -197,27 +215,33 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             chunk = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, lap, 0, keepdims=False), layers_local)
-            y = run_stage(h, chunk)
+            y, aux_tick = run_stage(h, chunk)
+            # only REAL phases contribute aux (bubble ticks chew on zeros)
+            real = (tau >= 0) & (tau < m * v)
+            aux_acc = aux_acc + jnp.where(real, aux_tick, 0.0)
             # last stage banks a microbatch when its final lap completes
-            valid = ((tau >= 0) & (tau < m * v)
-                     & (stage == npp - 1) & (lap == v - 1))
+            valid = real & (stage == npp - 1) & (lap == v - 1)
             cur = jax.lax.dynamic_index_in_dim(
                 outputs, mb_c, 0, keepdims=False)
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(valid, y, cur), mb_c, 0)
             state = jax.lax.ppermute(y, "pp", fwd)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
         state0 = jnp.zeros_like(x_mb[0])
         out0 = jnp.zeros_like(x_mb)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state0, out0), jnp.arange(m * v + npp - 1))
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (state0, out0, jnp.zeros((), jnp.float32)),
+            jnp.arange(m * v + npp - 1))
         # each stage returns its own bank under a fresh pp-sharded leading
         # axis — NO collective here. Only the last stage's bank is real;
         # the caller slices it out, so the buffer crosses the ring once
         # (broadcast) instead of riding a full all-reduce with pp-1 zero
-        # banks added in (VERDICT r1 weak #4).
-        return outputs[None]
+        # banks added in (VERDICT r1 weak #4). The aux scalar DOES psum
+        # (each stage holds its own chunks' contributions) — one f32 —
+        # and averages over microbatches so it matches the sequential
+        # full-batch semantics (a sum would scale the router losses by M).
+        return outputs[None], jax.lax.psum(aux_acc, "pp") / m
 
     # interleaved trainers pass layers already in group_layers layout (no
     # per-step reshard); ungrouped callers pay one regroup here
@@ -226,14 +250,15 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
     x_mb = x.reshape(m, b // m, s, d)
     if f32_boundary:
         x_mb = x_mb.astype(jnp.float32)
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         staged, mesh=mesh,
         in_specs=(P(None, "pp"), P()),
-        out_specs=P("pp"),         # [pp, M, b/M, S, D], dim 0 pp-sharded
+        out_specs=(P("pp"), P()),  # [pp, M, b/M, S, D] + replicated scalar
         axis_names={"pp"},         # manual over pp ONLY — tp/fsdp stay auto
         check_vma=False,
     )(layers_v, x_mb)
-    return out[-1].reshape(b, s, d)
+    result = out[-1].reshape(b, s, d)
+    return (result, aux) if with_aux else result
 
 
 def pipeline_loss(params: dict, tokens: jax.Array, config,
@@ -252,11 +277,15 @@ def pipeline_loss(params: dict, tokens: jax.Array, config,
     partial-auto shard_map CHECK-crashes this XLA version's SPMD
     partitioner (spmd_partitioner_util.cc partition-group mismatch), so
     the lm_head + CE stay outside, auto-sharded over fsdp/tp as usual."""
-    logits = pipeline_forward(params, tokens, config, mesh,
-                              n_microbatches=n_microbatches, impl=impl,
-                              remat=remat, virtual_stages=virtual_stages,
-                              pregrouped=pregrouped)
-    return _token_ce(logits, tokens)
+    out = pipeline_forward(params, tokens, config, mesh,
+                           n_microbatches=n_microbatches, impl=impl,
+                           remat=remat, virtual_stages=virtual_stages,
+                           pregrouped=pregrouped)
+    from ..models import family_for
+    if family_for(config).returns_extra_loss:
+        logits, extra = out
+        return _token_ce(logits, tokens) + extra
+    return _token_ce(out, tokens)
 
 
 def _token_ce(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -285,7 +314,13 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     params["layers"] in group_layers' [v, pp, Lc, ...] layout (what an
     interleaved Trainer stores) to avoid a per-step strided weight reshard;
     canonical [L] stacks also work and pay one regroup inside.
+
+    MoE configs return (logits, router_loss): the per-layer router losses
+    accumulate inside the pipeline (bubble ticks masked out, one scalar
+    psum across stages). Routing statistics and static capacity see b/M
+    tokens per microbatch — the standard microbatched-MoE semantics.
     """
+    from ..models import family_for
     from ..models.llama import (
         _attention_block, _mlp_block, rms_norm, rope_frequencies,
     )
@@ -295,18 +330,34 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
             "pp region); a mesh with sp > 1 would silently skip "
             "ring/ulysses sequence parallelism — use pp with sp=1")
     c = config
+    moe = family_for(config).returns_extra_loss
+    lc = c.as_llama() if moe else c
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
     x = pin_activation(x, mesh)
-    cos, sin = rope_frequencies(c, jnp.arange(s))
+    cos, sin = rope_frequencies(lc, jnp.arange(s))
 
-    def layer_fn(h, layer):
-        h = _attention_block(h, layer, c, cos, sin, impl, None)
-        return _mlp_block(h, layer, c)
+    if moe:
+        from ..models.moe import moe_block, weighted_router_loss
 
-    x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
-                       n_microbatches, remat=remat,
-                       virtual_stages=virtual_stages,
-                       pregrouped=pregrouped)
-    x = rms_norm(x, params["final_norm"], c.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+        def layer_fn(h, layer):
+            h = _attention_block(h, layer, lc, cos, sin, impl, None)
+            h, aux, z = moe_block(h, layer, c, mesh=mesh)
+            return h, weighted_router_loss(aux, z, c)
+
+        x, router_loss = pipeline_trunk(
+            params["layers"], x, layer_fn, mesh, n_microbatches,
+            remat=remat, virtual_stages=virtual_stages,
+            pregrouped=pregrouped, with_aux=True)
+    else:
+        def layer_fn(h, layer):
+            h = _attention_block(h, layer, c, cos, sin, impl, None)
+            return _mlp_block(h, layer, c)
+
+        x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
+                           n_microbatches, remat=remat,
+                           virtual_stages=virtual_stages,
+                           pregrouped=pregrouped)
+    x = rms_norm(x, params["final_norm"], lc.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return (logits, router_loss) if moe else logits

@@ -65,12 +65,10 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
     fam = family_for(config)
     if n_microbatches:
         from .parallel.pipeline import pipeline_loss
-        if fam.returns_extra_loss:
-            raise NotImplementedError(
-                "pipelined MoE trunk not composed yet — use pp=1 for MoE")
-        # pipelined CE: the trunk output leaves the pp region sharded from
-        # the last stage (one ring crossing, no full-buffer all-reduce);
-        # interleaved states store layers pre-grouped (no per-step reshard)
+        # pipelined CE (+MoE router aux accumulated inside the pipeline):
+        # the trunk output leaves the pp region sharded from the last stage
+        # (one ring crossing, no full-buffer all-reduce); interleaved
+        # states store layers pre-grouped (no per-step reshard)
         return pipeline_loss(params, tokens, config, mesh,
                              n_microbatches=n_microbatches, impl=impl,
                              remat=remat, virtual_stages=virtual_stages,
