@@ -96,12 +96,7 @@ class ProcessBackend(Backend):
             p = self._get(name)
             if p.popen is not None and p.popen.poll() is None:
                 return
-            env = dict(os.environ)
-            for kv in p.spec.env:
-                k, _, v = kv.partition("=")
-                env[k] = v
-            env.update(p.spec.tpu_env)
-            env["CONTAINER_ROOT"] = p.rootfs
+            env = self._build_env(p)
             cmd = list(p.spec.cmd) or ["sleep", "infinity"]
             if p.spec.cpuset and shutil.which("taskset"):
                 cmd = ["taskset", "-c", p.spec.cpuset] + cmd
@@ -177,12 +172,7 @@ class ProcessBackend(Backend):
             running = p.popen is not None and p.popen.poll() is None
             if not running:
                 return 1, "container not running"
-            env = dict(os.environ)
-            for kv in p.spec.env:
-                k, _, v = kv.partition("=")
-                env[k] = v
-            env.update(p.spec.tpu_env)
-            env["CONTAINER_ROOT"] = p.rootfs
+            env = self._build_env(p)
             cwd = os.path.join(p.rootfs, workdir.lstrip("/")) if workdir else p.rootfs
         try:
             out = subprocess.run(
@@ -252,6 +242,35 @@ class ProcessBackend(Backend):
                 pass
 
     # ---- helpers ----
+
+    @staticmethod
+    def _build_env(p: _Proc) -> dict:
+        """The ONE environment a container's main process and execs share:
+        daemon env + spec env + TPU grant + CONTAINER_ROOT + port grants.
+
+        Port grants: docker NATs containerPort->hostPort; a host process
+        can't be NATed, so the workload binds the granted HOST port
+        directly — HOST_PORT_{containerPort}=hostPort per binding, plus
+        PORT for the FIRST-DECLARED container port (dict preserves the
+        request's containerPorts order). Only a PORT set explicitly in the
+        spec's own env overrides that; one inherited from the daemon's
+        environment must not leak into workloads."""
+        env = dict(os.environ)
+        spec_keys = set()
+        for kv in p.spec.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+            spec_keys.add(k)
+        env.update(p.spec.tpu_env)
+        env["CONTAINER_ROOT"] = p.rootfs
+        first = None
+        for cp, hp in p.spec.port_bindings.items():
+            env[f"HOST_PORT_{cp}"] = str(hp)
+            if first is None:
+                first = hp
+        if first is not None and "PORT" not in spec_keys:
+            env["PORT"] = str(first)
+        return env
 
     def _image_path(self, image: str, create_dirs: bool = False) -> str:
         if not image:

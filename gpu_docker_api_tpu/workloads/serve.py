@@ -1,0 +1,200 @@
+"""Schedulable inference server — the serving half of the workload story.
+
+The control plane schedules this exactly like the training workload
+(BASELINE config shapes: `POST /replicaSet {"cmd": [... serve, ...]}`, port
+granted by the port scheduler and passed via --port): it loads a model
+(fresh init or an orbax checkpoint produced by workloads/train_llama.py,
+including grouped-layout checkpoints from interleaved-pipelined runs), and
+answers token-level generation requests over HTTP.
+
+Token-level by design: the reference schedules opaque containers and speaks
+no NLP; this framework is tokenizer-agnostic the same way — bring your own
+tokenizer, send token ids.
+
+API (same envelope as the control plane):
+  GET  /healthz               -> {"code":200, "data":{"model","params", ...}}
+  POST /generate              body {"tokens": [[...]], "max_new": N,
+                                    "temperature": 0.0}
+                              -> {"code":200, "data":{"tokens": [[...]]}}
+
+Serving is single-flight (one chip, one compiled program at a time); each
+new (batch, prompt_len, max_new, temperature) shape pays one XLA compile
+(amortized by the shared JAX_COMPILATION_CACHE_DIR the control plane
+injects), then streams from the compiled KV-cache decode loop (infer.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _load_params(trainer, ckpt_dir: str | None):
+    import jax
+
+    if not ckpt_dir:
+        return trainer.init(jax.random.key(0))["params"]
+    from ..train import restore_checkpoint
+    state, step = restore_checkpoint(ckpt_dir)
+    print(f"restored checkpoint step {step}", flush=True)
+    return state["params"]
+
+
+def _maybe_ungroup(params: dict, config) -> dict:
+    """Checkpoints from interleaved-pipelined trainers store layers as
+    [v, pp, Lc, ...] (pipeline.group_layers). The sequential KV-cache
+    forward needs the canonical [L, ...] stack; detect the two extra
+    leading dims against the family's canonical shapes and ungroup."""
+    import jax
+
+    from ..models import family_for
+    from ..parallel.pipeline import ungroup_layers
+
+    canonical = jax.eval_shape(
+        lambda: family_for(config).init_params(config, jax.random.key(0)))
+    got = jax.tree.leaves(params["layers"])[0].ndim
+    want = jax.tree.leaves(canonical["layers"])[0].ndim
+    if got == want:
+        return params
+    if got == want + 2:
+        lead = jax.tree.leaves(params["layers"])[0].shape
+        v, pp = int(lead[0]), int(lead[1])
+        params = dict(params)
+        params["layers"] = ungroup_layers(params["layers"], pp, v)
+        print(f"ungrouped interleaved checkpoint (v={v}, pp={pp})",
+              flush=True)
+        return params
+    raise ValueError(
+        f"layer leaves have {got} dims, expected {want} (canonical) or "
+        f"{want + 2} (group_layers layout)")
+
+
+class _Server:
+    def __init__(self, config, params):
+        self.config = config
+        self.params = params
+        self.lock = threading.Lock()   # single-flight: one chip
+        import jax
+        self.n_params = sum(p.size for p in jax.tree.leaves(params))
+
+    def generate(self, tokens, max_new: int, temperature: float):
+        import jax
+        import jax.numpy as jnp
+
+        from ..infer import generate
+        prompt = jnp.asarray(tokens, jnp.int32)
+        if prompt.ndim != 2:
+            raise ValueError("tokens must be [batch, prompt_len]")
+        if int(jnp.max(prompt)) >= self.config.vocab_size or int(
+                jnp.min(prompt)) < 0:
+            raise ValueError("token id out of range")
+        with self.lock:
+            out = generate(self.params, prompt, self.config, int(max_new),
+                           temperature=float(temperature),
+                           key=jax.random.key(int.from_bytes(
+                               os.urandom(4), "big")))
+        return jax.device_get(out).tolist()
+
+
+def _handler_for(srv: _Server, model_name: str):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, msg: str, data):
+            payload = json.dumps(
+                {"code": code, "msg": msg, "data": data}).encode()
+            self.send_response(200)     # control-plane envelope style
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, "Success", {
+                    "model": model_name,
+                    "params": srv.n_params,
+                    "vocab": srv.config.vocab_size,
+                    "maxSeqLen": srv.config.max_seq_len,
+                })
+            else:
+                self._send(404, "route not found", None)
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, "route not found", None)
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                tokens = body["tokens"]
+                max_new = int(body.get("max_new", 16))
+                temperature = float(body.get("temperature", 0.0))
+                if max_new < 1:
+                    raise ValueError("max_new must be >= 1")
+                out = srv.generate(tokens, max_new, temperature)
+                self._send(200, "Success", {"tokens": out})
+            except (KeyError, TypeError, ValueError) as e:
+                self._send(400, f"bad request: {e}", None)
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--family", default="llama", choices=["llama", "moe"])
+    p.add_argument("--config", default="tiny",
+                   choices=["tiny", "mini", "llama3_8b", "mixtral_8x7b"])
+    p.add_argument("--checkpoint", default="",
+                   help="orbax checkpoint dir (e.g. the training workload's "
+                        "<workdir>/checkpoints); fresh init when empty")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = the control plane's granted port ($PORT from "
+                        "the process substrate), falling back to 8000")
+    args = p.parse_args(argv)
+    if not args.port:
+        args.port = int(os.environ.get("PORT", "8000"))
+
+    from ..models.llama import LlamaConfig
+    from ..models.moe import MoEConfig
+    from ..parallel.mesh import MeshPlan
+    from ..train import Trainer
+
+    configs = {
+        "llama": {"tiny": LlamaConfig.tiny, "mini": LlamaConfig.llama_mini,
+                  "llama3_8b": LlamaConfig.llama3_8b},
+        "moe": {"tiny": MoEConfig.tiny, "mini": MoEConfig.moe_mini,
+                "mixtral_8x7b": MoEConfig.mixtral_8x7b},
+    }
+    if args.config not in configs[args.family]:
+        p.error(f"--config {args.config} not defined for family {args.family}")
+    config = configs[args.family][args.config]()
+
+    import jax
+    trainer = Trainer.create(config, MeshPlan(), devices=jax.devices()[:1])
+    params = _maybe_ungroup(_load_params(trainer, args.checkpoint), config)
+    srv = _Server(config, params)
+
+    name = f"{args.family}/{args.config}"
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                _handler_for(srv, name))
+    print(f"serving {name} ({srv.n_params:,} params) on "
+          f"{args.host}:{httpd.server_address[1]}", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

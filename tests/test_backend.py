@@ -158,3 +158,35 @@ def test_docker_demux_frames():
     frame = b"\x01\x00\x00\x00\x00\x00\x00\x05hello" + b"\x02\x00\x00\x00\x00\x00\x00\x06 world"
     assert _demux_stream(frame) == "hello world"
     assert _demux_stream(b"plain tty output") == "plain tty output"
+
+
+def test_process_port_grant_env(tmp_path):
+    """The process substrate can't NAT like docker: granted host ports are
+    exported so workloads bind them directly (serving workload contract).
+    PORT = the FIRST-DECLARED container port, not the lexicographically
+    smallest ("10001" < "8080" as strings)."""
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(port_bindings={"8080": 40123, "10001": 40456}))
+    b.start("rs-1")
+    code, out = b.execute(
+        "rs-1", ["sh", "-c", "echo p=$PORT a=$HOST_PORT_8080 b=$HOST_PORT_10001"])
+    assert code == 0
+    assert "p=40123 a=40123 b=40456" in out
+    b.close()
+
+
+def test_process_port_env_daemon_port_does_not_leak(tmp_path, monkeypatch):
+    """A PORT in the daemon's own environment must not override the grant;
+    a PORT in the spec's env must."""
+    monkeypatch.setenv("PORT", "1234")  # the daemon's own (e.g. PaaS) PORT
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(port_bindings={"8000": 40999}))
+    b.start("rs-1")
+    code, out = b.execute("rs-1", ["sh", "-c", "echo p=$PORT"])
+    assert "p=40999" in out
+    b.create("rs-2", _spec(port_bindings={"8000": 40999},
+                           env=["PORT=7777"]))
+    b.start("rs-2")
+    code, out = b.execute("rs-2", ["sh", "-c", "echo p=$PORT"])
+    assert "p=7777" in out
+    b.close()
