@@ -49,6 +49,11 @@ def main(argv=None) -> int:
     p.add_argument("--virtual-stages", type=int, default=1,
                    help="interleaved pipeline schedule: layer chunks per "
                         "stage (bubble shrinks by this factor)")
+    p.add_argument("--data", default="",
+                   help="flat binary token file (uint16, or uint32 with a "
+                        ".u32 suffix — the nanoGPT/llm.c format); empty = "
+                        "synthetic random tokens")
+    p.add_argument("--seed", type=int, default=1234)
     args = p.parse_args(argv)
 
     # multi-host: when the control plane granted chips across TPU VM
@@ -58,7 +63,6 @@ def main(argv=None) -> int:
     cluster = maybe_initialize_from_env()
 
     import jax
-    import jax.numpy as jnp
 
     from ..models.llama import LlamaConfig
     from ..models.moe import MoEConfig
@@ -103,13 +107,22 @@ def main(argv=None) -> int:
         # schedule change across a resume is intended).
         state = trainer.init(jax.random.key(0))
 
+    # data pipeline: deterministic (seed, step) batches — resume replays the
+    # exact stream — prefetched onto the device while the step runs.
+    # process_id stays 0 even multi-host: shard_batch serves the global
+    # array from each process's local copy, so every process MUST hold
+    # identical data (a replicated batch shard fed different per-process
+    # streams is undefined); disjoint per-process streams need
+    # shard-ownership-aware placement first (data.py keeps the hook).
+    from ..data import Prefetcher, make_dataset
+    dataset = make_dataset(
+        args.data, config.vocab_size, args.batch, args.seq, seed=args.seed)
+    prefetch = Prefetcher(dataset.iter_from(start_step),
+                          place=trainer.shard_batch)
+
     metrics_f = open(metrics_path, "a", encoding="utf-8")
-    key = jax.random.key(1234)
     for step in range(start_step, args.steps):
-        key, sub = jax.random.split(key)
-        tokens = jax.random.randint(
-            sub, (args.batch, args.seq), 0, config.vocab_size, dtype=jnp.int32)
-        tokens = trainer.shard_batch(tokens)
+        tokens = next(prefetch)
         t0 = time.perf_counter()
         state, metrics = trainer.step(state, tokens)
         loss = float(metrics["loss"])
@@ -129,6 +142,7 @@ def main(argv=None) -> int:
                 {"checkpoint": step + 1, "time": time.time()}) + "\n")
             metrics_f.flush()
     metrics_f.close()
+    prefetch.close()
     print(f"done: {args.steps} steps", flush=True)
     return 0
 
