@@ -314,6 +314,43 @@ def flash_bench() -> dict:
     return out
 
 
+def scheduling_bench() -> dict:
+    """BASELINE's second metric: TPU chips scheduled/sec, through the FULL
+    REST stack (HTTP -> service -> ICI allocator -> store write-behind ->
+    substrate) on the mock substrate — the control plane's own throughput,
+    no accelerator in the loop."""
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    state_dir = tempfile.mkdtemp(prefix="tdapi-sched-")
+    app = App(state_dir=state_dir, backend="mock", addr="127.0.0.1:0",
+              topology=make_topology("v4-64"),   # 32 chips
+              api_key="", cpu_cores=max(os.cpu_count() or 1, 4))
+    app.start()
+    try:
+        chips_per_rs = 4
+        n = 50
+        # warm the path (first request pays route/store setup)
+        call(app.server.port, "POST", "/api/v1/replicaSet", {
+            "imageName": "x", "replicaSetName": "warm",
+            "tpuCount": chips_per_rs})
+        call(app.server.port, "DELETE", "/api/v1/replicaSet/warm")
+        t0 = time.perf_counter()
+        for i in range(n):
+            call(app.server.port, "POST", "/api/v1/replicaSet", {
+                "imageName": "x", "replicaSetName": f"s{i}",
+                "tpuCount": chips_per_rs})
+            call(app.server.port, "DELETE", f"/api/v1/replicaSet/s{i}")
+        dt = time.perf_counter() - t0
+        return {
+            "chips_per_sec": round(n * chips_per_rs / dt, 1),
+            "replicasets_per_sec": round(n / dt, 1),
+            "cycles": n, "chips_per_rs": chips_per_rs,
+        }
+    finally:
+        app.stop()
+
+
 # ---- headline ---------------------------------------------------------------
 
 def prior_round_value(platform: str) -> float | None:
@@ -356,6 +393,10 @@ def main() -> None:
         app.stop()
 
     extra: dict = {}
+    try:
+        extra["scheduling"] = scheduling_bench()
+    except Exception as e:  # noqa: BLE001 — extras must never kill the headline
+        log(f"scheduling bench failed: {type(e).__name__}: {e}")
     try:
         import jax
         if jax.default_backend() in ("tpu", "axon"):

@@ -29,6 +29,12 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 
+def _fold_seed(seed: int, process_id: int) -> int:
+    """Disjoint per-process streams; same (seed, step) -> same batch.
+    Wrapped mod 2^64 so any Python int (negative --seed included) works."""
+    return (seed * 1_000_003 + process_id) % (1 << 64)
+
+
 class TokenFileDataset:
     """Random [batch, seq] crops from a flat binary token file.
 
@@ -50,9 +56,7 @@ class TokenFileDataset:
         self.batch = batch
         self.seq = seq
         self.vocab_size = vocab_size
-        # disjoint per-process streams; same (seed, step) -> same batch
-        self.seed = np.uint64(seed) * np.uint64(1_000_003) + np.uint64(
-            process_id)
+        self.seed = _fold_seed(seed, process_id)
 
     @property
     def n_tokens(self) -> int:
@@ -92,8 +96,7 @@ class SyntheticDataset:
         self.vocab_size = vocab_size
         self.batch = batch
         self.seq = seq
-        self.seed = np.uint64(seed) * np.uint64(1_000_003) + np.uint64(
-            process_id)
+        self.seed = _fold_seed(seed, process_id)
 
     def batch_at(self, step: int) -> np.ndarray:
         rng = np.random.default_rng((int(self.seed), int(step)))
@@ -166,6 +169,12 @@ class Prefetcher:
                 if not self._thread.is_alive():
                     break
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            import warnings
+            warnings.warn(
+                "Prefetcher.close(): producer still running after 5s "
+                "(a slow in-flight host->device transfer?) — abandoned as "
+                "a daemon thread", RuntimeWarning, stacklevel=2)
 
 
 def make_dataset(path: str, vocab_size: int, batch: int, seq: int,

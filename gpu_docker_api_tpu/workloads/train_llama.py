@@ -121,6 +121,20 @@ def main(argv=None) -> int:
                           place=trainer.shard_batch)
 
     metrics_f = open(metrics_path, "a", encoding="utf-8")
+    try:
+        _train_loop(args, trainer, state, start_step, prefetch, metrics_f,
+                    ckpt_dir, n_dev, plan, cluster, save_checkpoint)
+    finally:
+        metrics_f.close()
+        prefetch.close()
+    print(f"done: {args.steps} steps", flush=True)
+    return 0
+
+
+def _train_loop(args, trainer, state, start_step, prefetch, metrics_f,
+                ckpt_dir, n_dev, plan, cluster, save_checkpoint):
+    import time
+    import json
     for step in range(start_step, args.steps):
         tokens = next(prefetch)
         t0 = time.perf_counter()
@@ -141,10 +155,6 @@ def main(argv=None) -> int:
             metrics_f.write(json.dumps(
                 {"checkpoint": step + 1, "time": time.time()}) + "\n")
             metrics_f.flush()
-    metrics_f.close()
-    prefetch.close()
-    print(f"done: {args.steps} steps", flush=True)
-    return 0
 
 
 if __name__ == "__main__":
