@@ -173,7 +173,11 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
-                     mesh: Optional[Mesh]):
+                     mesh: Optional[Mesh], attn_fn=None):
+    """One attention sub-block (norm + QKV + RoPE + attention + residual).
+    attn_fn overrides the attention core — (q, k, v) -> [B,S,H,D] — for
+    callers already inside a manual collective region (the pipelined sp
+    trunk passes ring attention's per-device body)."""
     c = config
     b, s, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
@@ -183,7 +187,9 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
     q, k, v = pin_qkv(q, k, v, mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+    if attn_fn is not None:
+        out = attn_fn(q, k, v)
+    elif mesh is not None and mesh.shape.get("sp", 1) > 1:
         if c.sp_attn == "ulysses":
             # all-to-all head scatter: full-seq kernel on H/sp heads
             from ..parallel.ulysses import ulysses_attention

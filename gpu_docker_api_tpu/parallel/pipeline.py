@@ -123,7 +123,8 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
                    n_microbatches: int, remat: bool = True,
                    virtual_stages: int = 1,
                    pregrouped: bool = False,
-                   with_aux: bool = False):
+                   with_aux: bool = False,
+                   seq_shard: bool = False):
     """Run `layer_fn` over stacked `layers` as a pp-stage pipeline.
 
     layers: pytree with leading [n_layers] axis, sharded P("pp", ...) so each
@@ -140,6 +141,11 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             excluded) and psum'd over pp.
     virtual_stages: v>1 selects the interleaved schedule (v layer chunks per
             device, v ring laps per microbatch — bubble/v; see module doc).
+    seq_shard: the shard_map goes manual over {"pp", "sp"} and activations
+            enter sequence-SHARDED (S/sp per device) — layer_fn then runs
+            inside the sp region too and may use sp collectives directly
+            (ring attention's per-device body). The pp ring rotates
+            per-sp-coordinate; banking/injection are shape-agnostic.
     Returns [B, S, D] (or ([B, S, D], aux_total) with with_aux), the
     activations numerically identical to a sequential scan over all layers
     (neither schedule changes math, only order). Aux statistics computed
@@ -250,11 +256,22 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
     x_mb = x.reshape(m, b // m, s, d)
     if f32_boundary:
         x_mb = x_mb.astype(jnp.float32)
+    if seq_shard:
+        n_sp = mesh.shape.get("sp", 1)
+        if s % n_sp:
+            raise ValueError(f"seq {s} not divisible by sp {n_sp}")
+        x_spec = P(None, None, "sp", None)
+        out_spec = P("pp", None, None, "sp", None)
+        manual = {"pp", "sp"}
+    else:
+        x_spec = P()
+        out_spec = P("pp")
+        manual = {"pp"}
     out, aux = jax.shard_map(
         staged, mesh=mesh,
-        in_specs=(P(None, "pp"), P()),
-        out_specs=(P("pp"), P()),  # [pp, M, b/M, S, D] + replicated scalar
-        axis_names={"pp"},         # manual over pp ONLY — tp/fsdp stay auto
+        in_specs=(P(None, "pp"), x_spec),
+        out_specs=(out_spec, P()),  # [pp, M, b/M, S, D] + replicated scalar
+        axis_names=manual,          # tp/fsdp stay auto either way
         check_vma=False,
     )(layers_v, x_mb)
     result = out[-1].reshape(b, s, d)
@@ -307,8 +324,7 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
 
     Embedding and lm_head run outside the pipeline region (auto-sharded over
     fsdp/tp as usual — they are one matmul each; the trunk is where the
-    n_layers × depth cost lives). Ring attention (sp) inside a pipelined
-    trunk is not composed yet: use pp with sp=1.
+    n_layers × depth cost lives).
 
     virtual_stages > 1 (interleaved schedule): pass pregrouped=True with
     params["layers"] in group_layers' [v, pp, Lc, ...] layout (what an
@@ -319,25 +335,63 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     accumulate inside the pipeline (bubble ticks masked out, one scalar
     psum across stages). Routing statistics and static capacity see b/M
     tokens per microbatch — the standard microbatched-MoE semantics.
+
+    sp > 1 composes with pp (llama family): the trunk goes manual over
+    {"pp", "sp"}, activations flow sequence-sharded, and attention runs as
+    ring attention's per-device body (K/V rotate the sp ring inside each
+    pipeline stage) with RoPE applied at global positions.
     """
     from ..models import family_for
     from ..models.llama import (
         _attention_block, _mlp_block, rms_norm, rope_frequencies,
     )
-    if mesh.shape.get("sp", 1) > 1:
-        raise ValueError(
-            "pipeline_forward runs attention locally (mesh=None inside the "
-            "pp region); a mesh with sp > 1 would silently skip "
-            "ring/ulysses sequence parallelism — use pp with sp=1")
     c = config
     moe = family_for(config).returns_extra_loss
+    sp = mesh.shape.get("sp", 1)
+    if sp > 1 and moe:
+        raise ValueError(
+            "pipelined MoE with sequence parallelism not composed yet — "
+            "use pp x ep with sp=1 for MoE")
+    if sp > 1 and mesh.shape.get("pp", 1) == 1:
+        raise ValueError(
+            "mesh has sp>1 but pp=1 — use the non-pipelined forward "
+            "(loss_fn without microbatches / llama_forward), which runs "
+            "ring/ulysses sequence parallelism itself")
+    if sp > 1 and getattr(c, "sp_attn", "ring") != "ring":
+        raise ValueError(
+            f"pipelined trunk composes with ring attention only; "
+            f"sp_attn={c.sp_attn!r} + pp is not supported — set "
+            f"sp_attn='ring' (or use pp with sp=1)")
     lc = c.as_llama() if moe else c
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
     x = pin_activation(x, mesh)
     cos, sin = rope_frequencies(lc, jnp.arange(s))
 
-    if moe:
+    if sp > 1:
+        import functools as _ft
+
+        from .ring import _ring_local
+        ring_core = _ft.partial(_ring_local, axis="sp", ring=sp, causal=True)
+
+        def layer_fn(h, layer):
+            # inside manual {"pp","sp"}: h [b_mb, S/sp, D]. Same block as
+            # every other path (_attention_block), with RoPE tables sliced
+            # to this shard's GLOBAL positions and ring attention's
+            # per-device body as the attention core.
+            s_loc = h.shape[1]
+            sp_idx = jax.lax.axis_index("sp")
+            cos_l = jax.lax.dynamic_slice_in_dim(cos, sp_idx * s_loc, s_loc)
+            sin_l = jax.lax.dynamic_slice_in_dim(sin, sp_idx * s_loc, s_loc)
+            h = _attention_block(h, layer, c, cos_l, sin_l, impl, None,
+                                 attn_fn=ring_core)
+            return _mlp_block(h, layer, c)
+
+        x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
+                           n_microbatches, remat=remat,
+                           virtual_stages=virtual_stages,
+                           pregrouped=pregrouped, seq_shard=True)
+    elif moe:
         from ..models.moe import moe_block, weighted_router_loss
 
         def layer_fn(h, layer):

@@ -137,10 +137,6 @@ class Trainer:
                devices: Optional[list] = None) -> "Trainer":
         plan = plan or MeshPlan.auto(len(devices or jax.devices()))
         tc = tc or TrainConfig()
-        if plan.pp > 1 and plan.sp > 1:
-            raise NotImplementedError(
-                "pipelined trunk + sequence-parallel attention not composed "
-                "yet — use pp with sp=1")
         mesh = make_mesh(plan, devices)
         t = cls(config=config, tc=tc, mesh=mesh, optimizer=make_optimizer(tc))
         t._step_fn = t._build_step()
