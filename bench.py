@@ -314,6 +314,37 @@ def flash_bench() -> dict:
     return out
 
 
+def decode_bench() -> dict:
+    """Serving-side number: KV-cache decode throughput on the chip.
+    generate() is ONE jitted lax.scan (single dispatch), so a host fetch of
+    the result is an honest end-to-end clock even over the axon tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.llama_mini()
+    params = init_params(cfg, jax.random.key(0))
+    batch, prompt_len, max_new = 8, 128, 128
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
+                                cfg.vocab_size, jnp.int32)
+    t0 = time.perf_counter()
+    out = generate(params, prompt, cfg, max_new)
+    jax.device_get(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = generate(params, prompt, cfg, max_new)
+    jax.device_get(out)
+    dt = time.perf_counter() - t0
+    return {
+        "model": "llama_mini", "batch": batch,
+        "prompt_len": prompt_len, "max_new": max_new,
+        "decode_tokens_per_sec": round(batch * max_new / dt),
+        "wall_s": round(dt, 3), "compile_s": round(compile_s, 1),
+    }
+
+
 def scheduling_bench() -> dict:
     """BASELINE's second metric: TPU chips scheduled/sec, through the FULL
     REST stack (HTTP -> service -> ICI allocator -> store write-behind ->
@@ -400,9 +431,10 @@ def main() -> None:
     try:
         import jax
         if jax.default_backend() in ("tpu", "axon"):
-            log("running on-chip extras (mfu, flash timings)...")
+            log("running on-chip extras (mfu, flash timings, decode)...")
             extra["train"] = mfu_bench()
             extra["attention_fwd"] = flash_bench()
+            extra["decode"] = decode_bench()
         else:
             log(f"backend is {jax.default_backend()}; skipping on-chip extras")
     except Exception as e:  # noqa: BLE001 — extras must never kill the headline

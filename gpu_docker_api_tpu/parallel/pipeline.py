@@ -162,6 +162,12 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             return (h, aux + a), None
         return (layer_fn(h, layer), aux), None
 
+    if seq_shard and with_aux:
+        # the aux psum below reduces over pp only and declares the scalar
+        # replicated — under a {pp, sp} manual region each sp shard would
+        # hold a DIFFERENT partial sum and the claim would be silently
+        # false (no caller composes these yet; moe+sp is refused upstream)
+        raise ValueError("seq_shard with with_aux is not composed yet")
     npp = mesh.shape["pp"]
     if npp == 1:
         if pregrouped:
