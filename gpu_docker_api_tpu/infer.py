@@ -228,13 +228,37 @@ def decode_step(params, token, cache, config):
     return logits, out
 
 
-@partial(jax.jit, static_argnames=("config", "max_new", "temperature"))
+def _filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the k highest logits per row; the rest go to -inf. Static k —
+    one compiled program per setting (serving caches by shape anyway)."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus sampling: keep the smallest set of tokens whose cumulative
+    probability reaches top_p (the top token always survives). Sort-based,
+    static shapes — one sort + scatter-free gather back via argsort ranks."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]           # desc
+    cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    # cutoff logit: the smallest sorted logit still inside the nucleus
+    # (first index where cumulative prob reaches top_p)
+    inside = cum - jax.nn.softmax(sorted_logits, axis=-1) < top_p
+    cutoff = jnp.min(jnp.where(inside, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits >= cutoff, logits, -jnp.inf)
+
+
+@partial(jax.jit, static_argnames=("config", "max_new", "temperature",
+                                   "top_k", "top_p"))
 def generate(params, prompt, config, max_new: int,
              temperature: float = 0.0,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             top_k: int = 0, top_p: float = 1.0) -> jax.Array:
     """prompt [B, T] -> generated tokens [B, max_new]. Greedy when
-    temperature == 0, else categorical sampling. The decode loop is one
-    lax.scan — compiled once, no host round-trips per token."""
+    temperature == 0, else categorical sampling with optional top-k and/or
+    nucleus (top-p) filtering. The decode loop is one lax.scan — compiled
+    once, no host round-trips per token."""
     b, t = prompt.shape
     cache = init_cache(config, b, t + max_new)
     logits, cache = _forward_cached(params, prompt, cache, config)
@@ -245,7 +269,12 @@ def generate(params, prompt, config, max_new: int,
     def pick(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k:
+            logits = _filter_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _filter_top_p(logits, top_p)
+        return jax.random.categorical(k, logits).astype(jnp.int32)
 
     key, sub = jax.random.split(key)
     first = pick(logits, sub)

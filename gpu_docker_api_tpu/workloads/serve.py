@@ -14,7 +14,8 @@ tokenizer, send token ids.
 API (same envelope as the control plane):
   GET  /healthz               -> {"code":200, "data":{"model","params", ...}}
   POST /generate              body {"tokens": [[...]], "max_new": N,
-                                    "temperature": 0.0}
+                                    "temperature": 0.0, "top_k": 0,
+                                    "top_p": 1.0}
                               -> {"code":200, "data":{"tokens": [[...]]}}
 
 Serving is single-flight (one chip, one compiled program at a time); each
@@ -80,7 +81,8 @@ class _Server:
         import jax
         self.n_params = sum(p.size for p in jax.tree.leaves(params))
 
-    def generate(self, tokens, max_new: int, temperature: float):
+    def generate(self, tokens, max_new: int, temperature: float,
+                 top_k: int = 0, top_p: float = 1.0):
         import jax
         import jax.numpy as jnp
 
@@ -94,6 +96,7 @@ class _Server:
         with self.lock:
             out = generate(self.params, prompt, self.config, int(max_new),
                            temperature=float(temperature),
+                           top_k=int(top_k), top_p=float(top_p),
                            key=jax.random.key(int.from_bytes(
                                os.urandom(4), "big")))
         return jax.device_get(out).tolist()
@@ -136,9 +139,23 @@ def _handler_for(srv: _Server, model_name: str):
                 tokens = body["tokens"]
                 max_new = int(body.get("max_new", 16))
                 temperature = float(body.get("temperature", 0.0))
+                top_k = int(body.get("top_k", 0))
+                top_p = float(body.get("top_p", 1.0))
                 if max_new < 1:
                     raise ValueError("max_new must be >= 1")
-                out = srv.generate(tokens, max_new, temperature)
+                if not 0.0 < top_p <= 1.0:
+                    raise ValueError("top_p must be in (0, 1]")
+                if top_k < 0:
+                    raise ValueError("top_k must be >= 0")
+                # sampling params are jit-STATIC: quantize them so a client
+                # sweeping float values can't force a fresh XLA compile per
+                # request (each held under the single-flight lock) or grow
+                # the program cache without bound
+                temperature = round(temperature, 2)
+                top_p = round(top_p * 20) / 20 or 0.05
+                top_k = min(top_k, 128)
+                out = srv.generate(tokens, max_new, temperature,
+                                   top_k=top_k, top_p=top_p)
                 self._send(200, "Success", {"tokens": out})
             except (KeyError, TypeError, ValueError) as e:
                 self._send(400, f"bad request: {e}", None)

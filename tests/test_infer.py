@@ -182,3 +182,41 @@ def test_decode_step_donates_cache(llama):
     # donation invalidates the input buffer
     assert k_before.is_deleted()
     assert cache2["host_length"] == 4
+
+
+# -------------------------------------------------- sampling filters
+
+def test_top_k_filter_keeps_k_highest():
+    from gpu_docker_api_tpu.infer import _filter_top_k
+    logits = jnp.array([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = _filter_top_k(logits, 2)
+    assert bool(jnp.isfinite(out[0, 1])) and bool(jnp.isfinite(out[0, 4]))
+    assert not bool(jnp.isfinite(out[0, 0]))
+    assert not bool(jnp.isfinite(out[0, 2]))
+    assert not bool(jnp.isfinite(out[0, 3]))
+
+
+def test_top_p_filter_nucleus():
+    from gpu_docker_api_tpu.infer import _filter_top_p
+    # probs ~ [0.643, 0.236, 0.087, 0.032, ...]: nucleus(0.7) = {0} until
+    # cumulative BEFORE a token reaches p — token 1 enters at 0.643 < 0.7
+    logits = jnp.log(jnp.array([[0.643, 0.236, 0.087, 0.022, 0.012]]))
+    out = _filter_top_p(logits, 0.7)
+    assert bool(jnp.isfinite(out[0, 0]))
+    assert bool(jnp.isfinite(out[0, 1]))
+    assert not bool(jnp.isfinite(out[0, 2]))
+    # the top token ALWAYS survives even with tiny p
+    out1 = _filter_top_p(logits, 1e-6)
+    assert bool(jnp.isfinite(out1[0, 0]))
+    assert not bool(jnp.isfinite(out1[0, 1]))
+
+
+def test_generate_sampled_tokens_respect_top_k():
+    """With top_k=1, sampling at any temperature IS greedy."""
+    cfg = LlamaConfig.tiny()
+    params = llama_init(cfg, jax.random.key(0))
+    prompt = jnp.array([[5, 9, 2, 7]], jnp.int32)
+    greedy = generate(params, prompt, cfg, 5, temperature=0.0)
+    topk1 = generate(params, prompt, cfg, 5, temperature=1.3, top_k=1,
+                     key=jax.random.key(42))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
