@@ -363,11 +363,11 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
             "mesh has sp>1 but pp=1 — use the non-pipelined forward "
             "(loss_fn without microbatches / llama_forward), which runs "
             "ring/ulysses sequence parallelism itself")
-    if sp > 1 and getattr(c, "sp_attn", "ring") != "ring":
+    sp_attn = getattr(c, "sp_attn", "ring")
+    if sp > 1 and sp_attn == "ulysses" and c.n_heads % sp:
         raise ValueError(
-            f"pipelined trunk composes with ring attention only; "
-            f"sp_attn={c.sp_attn!r} + pp is not supported — set "
-            f"sp_attn='ring' (or use pp with sp=1)")
+            f"Ulysses under pp needs n_heads {c.n_heads} divisible by "
+            f"sp {sp}")
     lc = c.as_llama() if moe else c
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
@@ -377,20 +377,27 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     if sp > 1:
         import functools as _ft
 
-        from .ring import _ring_local
-        ring_core = _ft.partial(_ring_local, axis="sp", ring=sp, causal=True)
+        if sp_attn == "ulysses":
+            # all-to-all head scatter inside the manual {pp, sp} region
+            from .ulysses import _ulysses_local
+            attn_core = _ft.partial(_ulysses_local, axis="sp", sp=sp,
+                                    causal=True, impl=impl)
+        else:
+            from .ring import _ring_local
+            attn_core = _ft.partial(_ring_local, axis="sp", ring=sp,
+                                    causal=True)
 
         def layer_fn(h, layer):
             # inside manual {"pp","sp"}: h [b_mb, S/sp, D]. Same block as
             # every other path (_attention_block), with RoPE tables sliced
-            # to this shard's GLOBAL positions and ring attention's
-            # per-device body as the attention core.
+            # to this shard's GLOBAL positions and the configured sequence-
+            # parallel attention body (ring or ulysses) as the core.
             s_loc = h.shape[1]
             sp_idx = jax.lax.axis_index("sp")
             cos_l = jax.lax.dynamic_slice_in_dim(cos, sp_idx * s_loc, s_loc)
             sin_l = jax.lax.dynamic_slice_in_dim(sin, sp_idx * s_loc, s_loc)
             h = _attention_block(h, layer, c, cos_l, sin_l, impl, None,
-                                 attn_fn=ring_core)
+                                 attn_fn=attn_core)
             return _mlp_block(h, layer, c)
 
         x = pipeline_trunk(params["layers"], x, layer_fn, mesh,

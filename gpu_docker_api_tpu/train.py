@@ -137,17 +137,18 @@ class Trainer:
                devices: Optional[list] = None) -> "Trainer":
         plan = plan or MeshPlan.auto(len(devices or jax.devices()))
         tc = tc or TrainConfig()
-        # fail the unsupported pipeline x sp combos HERE, before init
-        # materializes checkpoint-scale state (clear errors up front)
+        # fail unsupported/ill-formed pipeline x sp combos HERE, before
+        # init materializes checkpoint-scale state (clear errors up front)
         if plan.pp > 1 and plan.sp > 1:
             if family_for(config).returns_extra_loss:
                 raise ValueError(
                     "pipelined MoE with sequence parallelism not composed "
                     "yet — use pp x ep with sp=1 for MoE")
-            if getattr(config, "sp_attn", "ring") != "ring":
+            if (getattr(config, "sp_attn", "ring") == "ulysses"
+                    and config.n_heads % plan.sp):
                 raise ValueError(
-                    "pipelined trunk composes with ring attention only; "
-                    f"sp_attn={config.sp_attn!r} + pp is not supported")
+                    f"Ulysses under pp needs n_heads {config.n_heads} "
+                    f"divisible by sp {plan.sp}")
         mesh = make_mesh(plan, devices)
         t = cls(config=config, tc=tc, mesh=mesh, optimizer=make_optimizer(tc))
         t._step_fn = t._build_step()

@@ -147,11 +147,14 @@ def _handler_for(srv: _Server, model_name: str):
                     raise ValueError("top_p must be in (0, 1]")
                 if top_k < 0:
                     raise ValueError("top_k must be >= 0")
-                # sampling params are jit-STATIC: quantize them so a client
-                # sweeping float values can't force a fresh XLA compile per
-                # request (each held under the single-flight lock) or grow
-                # the program cache without bound
-                temperature = round(temperature, 2)
+                if not 0.0 <= temperature <= 10.0:
+                    raise ValueError("temperature must be in [0, 10]")
+                # sampling params are jit-STATIC: quantize ALL of them so a
+                # client sweeping float values can't force a fresh XLA
+                # compile per request (each held under the single-flight
+                # lock) or grow the program cache without bound — bounded
+                # buckets: 201 temperatures x 20 top_p x 129 top_k
+                temperature = round(temperature * 20) / 20
                 top_p = round(top_p * 20) / 20 or 0.05
                 top_k = min(top_k, 128)
                 out = srv.generate(tokens, max_new, temperature,

@@ -590,10 +590,9 @@ def test_pipelined_moe_with_sp_rejected(moe_tiny):
         pipeline_forward(params, toks, cfg, mesh, n_microbatches=4)
 
 
-def test_pipeline_sp_requires_pp_and_ring(llama_tiny):
-    """Misuse fails with actionable errors, not an unbound-axis NameError:
-    sp>1 with pp=1 points at the non-pipelined path; ulysses under pp is
-    rejected (the pipelined trunk composes with ring only)."""
+def test_pipeline_sp_requires_pp(llama_tiny):
+    """Misuse fails with an actionable error, not an unbound-axis
+    NameError: sp>1 with pp=1 points at the non-pipelined path."""
     cfg, params = llama_tiny
     toks = jax.random.randint(jax.random.key(2), (8, 32), 0,
                               cfg.vocab_size, dtype=jnp.int32)
@@ -601,11 +600,23 @@ def test_pipeline_sp_requires_pp_and_ring(llama_tiny):
         pipeline_forward(params, toks, cfg,
                          make_mesh(MeshPlan(sp=2, tp=2, fsdp=2)),
                          n_microbatches=2)
-    cfg_u = dataclasses.replace(cfg, sp_attn="ulysses")
-    with pytest.raises(ValueError, match="ring"):
-        pipeline_forward(params, toks, cfg_u,
-                         make_mesh(MeshPlan(pp=2, sp=2, tp=2)),
-                         n_microbatches=2)
+
+
+def test_pipeline_ulysses_matches_sequential(llama_tiny):
+    """pp x sp with the Ulysses strategy: all-to-all head scatter runs
+    inside the manual {pp, sp} region — exact vs sequential, same as the
+    ring path."""
+    cfg, params = llama_tiny
+    cfg = dataclasses.replace(cfg, sp_attn="ulysses")
+    toks = jax.random.randint(jax.random.key(11), (8, 32), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    ref = llama_forward(params, toks, cfg)
+    mesh = make_mesh(MeshPlan(pp=2, sp=2, tp=2))
+    with mesh:
+        out = jax.jit(lambda p, t: pipeline_forward(
+            p, t, cfg, mesh, n_microbatches=4))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_moe_with_sequence_parallel_trains(moe_tiny):

@@ -90,3 +90,32 @@ def test_maybe_ungroup_roundtrip():
     # canonical params pass through untouched
     same = _maybe_ungroup(params, cfg)
     assert jax.tree.leaves(same)[0] is jax.tree.leaves(params)[0]
+
+
+def test_generate_sampling_params_over_http(served):
+    """The REST surface accepts top_k/top_p, and top_k=1 at any temperature
+    is greedy (proves the kwargs actually reach generate)."""
+    cfg, params, port = served
+    prompt = [[5, 9, 2, 7]]
+    greedy = _call(port, "POST", "/generate",
+                   {"tokens": prompt, "max_new": 5})["data"]["tokens"]
+    topk1 = _call(port, "POST", "/generate",
+                  {"tokens": prompt, "max_new": 5, "temperature": 1.5,
+                   "top_k": 1, "top_p": 0.9})
+    assert topk1["code"] == 200, topk1
+    assert topk1["data"]["tokens"] == greedy
+
+
+def test_generate_sampling_validation(served):
+    _, _, port = served
+    base = {"tokens": [[1, 2]], "max_new": 2}
+    assert _call(port, "POST", "/generate",
+                 {**base, "top_p": 0.0})["code"] == 400
+    assert _call(port, "POST", "/generate",
+                 {**base, "top_p": 1.5})["code"] == 400
+    assert _call(port, "POST", "/generate",
+                 {**base, "top_k": -1})["code"] == 400
+    assert _call(port, "POST", "/generate",
+                 {**base, "temperature": -1.0})["code"] == 400
+    assert _call(port, "POST", "/generate",
+                 {**base, "temperature": 99.0})["code"] == 400
