@@ -13,15 +13,16 @@ workload command directly with:
 - bind "mounts" realized as symlinks inside the rootfs,
 - stdout/stderr captured to a per-container log.
 
-CPU pinning uses `taskset` when available; memory limits are recorded in the
-spec (enforced by the container substrate in the docker backend; advisory
-here). Pause/continue are SIGSTOP/SIGCONT — the exact process-level analog of
+CPU pinning uses `taskset` when available; memory limits are ENFORCED as
+RLIMIT_AS in the child (the host-process analog of `docker run -m`).
+Pause/continue are SIGSTOP/SIGCONT — the exact process-level analog of
 docker pause (which freezes the cgroup).
 """
 
 from __future__ import annotations
 
 import os
+import resource
 import shutil
 import signal
 import subprocess
@@ -100,10 +101,25 @@ class ProcessBackend(Backend):
             cmd = list(p.spec.cmd) or ["sleep", "infinity"]
             if p.spec.cpuset and shutil.which("taskset"):
                 cmd = ["taskset", "-c", p.spec.cpuset] + cmd
+            # memory limit ENFORCED, not advisory: the docker backend gets
+            # it from the cgroup; a host process gets RLIMIT_AS in the
+            # child (reference parity for `docker run -m`) — allocations
+            # beyond the grant fail inside the workload instead of eating
+            # the host
+            preexec = None
+            if p.spec.memory_bytes:
+                lim = int(p.spec.memory_bytes)
+                setrlimit = resource.setrlimit      # pre-bind: preexec_fn
+                as_limit = resource.RLIMIT_AS       # runs post-fork where
+                                                    # imports can deadlock
+
+                def preexec():
+                    setrlimit(as_limit, (lim, lim))
             logf = open(p.log_path, "ab")
             p.popen = subprocess.Popen(
                 cmd, cwd=p.rootfs, env=env, stdout=logf, stderr=subprocess.STDOUT,
-                start_new_session=True)  # own process group for clean signaling
+                start_new_session=True,  # own process group for clean signaling
+                preexec_fn=preexec)
             logf.close()
             p.started_at = time.time()
             p.paused = False
@@ -215,6 +231,16 @@ class ProcessBackend(Backend):
         mp = os.path.join(self.state_dir, "volumes", name)
         if os.path.exists(mp):
             raise RuntimeError(f"volume {name} already exists")
+        if size_bytes:
+            # persist the quota in its OWN namespace (a volume named
+            # ".quotas" must not collide) BEFORE the mountpoint exists, so
+            # a failed write leaves the create cleanly retryable. The
+            # overlay2-XFS `size=` analog; a plain directory can't
+            # hard-enforce it, so the SERVICE layer guards shrink/patch
+            # against used vs limit.
+            os.makedirs(self._quota_dir, exist_ok=True)
+            with open(os.path.join(self._quota_dir, name), "w") as f:
+                f.write(str(int(size_bytes)))
         os.makedirs(mp)
         return VolumeState(name=name, exists=True, mountpoint=mp,
                            size_limit_bytes=size_bytes,
@@ -223,13 +249,24 @@ class ProcessBackend(Backend):
     def volume_remove(self, name: str) -> None:
         shutil.rmtree(os.path.join(self.state_dir, "volumes", name),
                       ignore_errors=True)
+        try:
+            os.unlink(os.path.join(self._quota_dir, name))
+        except OSError:
+            pass
 
     def volume_inspect(self, name: str) -> VolumeState:
         from ..utils.file import dir_size
         mp = os.path.join(self.state_dir, "volumes", name)
         if not os.path.isdir(mp):
             return VolumeState(name=name, exists=False)
+        limit = 0
+        try:
+            with open(os.path.join(self._quota_dir, name)) as f:
+                limit = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
         return VolumeState(name=name, exists=True, mountpoint=mp,
+                           size_limit_bytes=limit,
                            used_bytes=dir_size(mp))
 
     # ---- lifecycle ----
@@ -242,6 +279,10 @@ class ProcessBackend(Backend):
                 pass
 
     # ---- helpers ----
+
+    @property
+    def _quota_dir(self) -> str:
+        return os.path.join(self.state_dir, "volume_quotas")
 
     @staticmethod
     def _build_env(p: _Proc) -> dict:

@@ -190,3 +190,51 @@ def test_process_port_env_daemon_port_does_not_leak(tmp_path, monkeypatch):
     code, out = b.execute("rs-2", ["sh", "-c", "echo p=$PORT"])
     assert "p=7777" in out
     b.close()
+
+
+def test_process_memory_limit_enforced(tmp_path):
+    """memory_bytes is a real RLIMIT_AS, not bookkeeping: a workload
+    allocating past its grant dies; the same workload under no limit
+    succeeds."""
+    alloc = "import sys; b = bytearray(400 * 1024 * 1024); print('ok')"
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("fat", _spec(cmd=["python3", "-c", alloc],
+                          memory_bytes=200 * 1024 * 1024))
+    b.start("fat")
+    b._get("fat").popen.wait(timeout=60)
+    assert b.inspect("fat").exit_code != 0
+    b.create("ok", _spec(cmd=["python3", "-c", alloc]))
+    b.start("ok")
+    b._get("ok").popen.wait(timeout=60)
+    assert b.inspect("ok").exit_code == 0
+    b.close()
+
+
+def test_process_volume_quota_persisted(tmp_path):
+    """The quota survives inspect (overlay2-XFS size= analog, service-level
+    guarded) and never pollutes the volume's own contents/usage."""
+    b = ProcessBackend(str(tmp_path / "s"))
+    v = b.volume_create("vol", size_bytes=5 * 1024 ** 2)
+    with open(os.path.join(v.mountpoint, "d.bin"), "wb") as f:
+        f.write(b"x" * 1024)
+    got = b.volume_inspect("vol")
+    assert got.size_limit_bytes == 5 * 1024 ** 2
+    assert got.used_bytes == 1024
+    b.volume_remove("vol")
+    assert not b.volume_inspect("vol").exists
+    # recreating without a quota must not inherit the old one
+    v2 = b.volume_create("vol")
+    assert b.volume_inspect("vol").size_limit_bytes == 0
+    b.close()
+
+
+def test_process_volume_named_like_quota_dir(tmp_path):
+    """Quota metadata lives in its own namespace: a volume named '.quotas'
+    is just a volume, and removing it can't wipe other volumes' quotas."""
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.volume_create("vol", size_bytes=1024)
+    v = b.volume_create(".quotas")
+    assert v.exists
+    b.volume_remove(".quotas")
+    assert b.volume_inspect("vol").size_limit_bytes == 1024
+    b.close()
