@@ -14,8 +14,9 @@ workload command directly with:
 - stdout/stderr captured to a per-container log.
 
 CPU pinning uses `taskset` when available; memory limits are ENFORCED as
-RLIMIT_AS in the child (the host-process analog of `docker run -m`).
-Pause/continue are SIGSTOP/SIGCONT — the exact process-level analog of
+RLIMIT_DATA on the child (the closest host-process analog of
+`docker run -m` — see _apply_memory_limit). Pause/continue are
+SIGSTOP/SIGCONT — the exact process-level analog of
 docker pause (which freezes the cgroup).
 """
 
@@ -101,26 +102,12 @@ class ProcessBackend(Backend):
             cmd = list(p.spec.cmd) or ["sleep", "infinity"]
             if p.spec.cpuset and shutil.which("taskset"):
                 cmd = ["taskset", "-c", p.spec.cpuset] + cmd
-            # memory limit ENFORCED, not advisory: the docker backend gets
-            # it from the cgroup; a host process gets RLIMIT_AS in the
-            # child (reference parity for `docker run -m`) — allocations
-            # beyond the grant fail inside the workload instead of eating
-            # the host
-            preexec = None
-            if p.spec.memory_bytes:
-                lim = int(p.spec.memory_bytes)
-                setrlimit = resource.setrlimit      # pre-bind: preexec_fn
-                as_limit = resource.RLIMIT_AS       # runs post-fork where
-                                                    # imports can deadlock
-
-                def preexec():
-                    setrlimit(as_limit, (lim, lim))
             logf = open(p.log_path, "ab")
             p.popen = subprocess.Popen(
                 cmd, cwd=p.rootfs, env=env, stdout=logf, stderr=subprocess.STDOUT,
-                start_new_session=True,  # own process group for clean signaling
-                preexec_fn=preexec)
+                start_new_session=True)  # own process group for clean signaling
             logf.close()
+            self._apply_memory_limit(p.popen.pid, p.spec.memory_bytes)
             p.started_at = time.time()
             p.paused = False
             p.exit_code = None
@@ -191,10 +178,17 @@ class ProcessBackend(Backend):
             env = self._build_env(p)
             cwd = os.path.join(p.rootfs, workdir.lstrip("/")) if workdir else p.rootfs
         try:
-            out = subprocess.run(
-                cmd, cwd=cwd, env=env, capture_output=True, text=True, timeout=300)
-            return out.returncode, (out.stdout or "") + (out.stderr or "")
+            # execs share the container's memory grant (docker exec runs in
+            # the same cgroup as -m; same story here)
+            proc = subprocess.Popen(
+                cmd, cwd=cwd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            self._apply_memory_limit(proc.pid, p.spec.memory_bytes)
+            out, _ = proc.communicate(timeout=300)
+            return proc.returncode, out or ""
         except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
             return 124, "exec timed out"
         except OSError as e:
             return 127, str(e)
@@ -228,20 +222,29 @@ class ProcessBackend(Backend):
     # ---- volumes ----
 
     def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
-        mp = os.path.join(self.state_dir, "volumes", name)
-        if os.path.exists(mp):
-            raise RuntimeError(f"volume {name} already exists")
-        if size_bytes:
-            # persist the quota in its OWN namespace (a volume named
-            # ".quotas" must not collide) BEFORE the mountpoint exists, so
-            # a failed write leaves the create cleanly retryable. The
-            # overlay2-XFS `size=` analog; a plain directory can't
-            # hard-enforce it, so the SERVICE layer guards shrink/patch
-            # against used vs limit.
-            os.makedirs(self._quota_dir, exist_ok=True)
-            with open(os.path.join(self._quota_dir, name), "w") as f:
-                f.write(str(int(size_bytes)))
-        os.makedirs(mp)
+        with self._lock:
+            mp = os.path.join(self.state_dir, "volumes", name)
+            if os.path.exists(mp):
+                raise RuntimeError(f"volume {name} already exists")
+            if size_bytes:
+                # quota lives in its OWN namespace (a volume named
+                # ".quotas" must not collide). The overlay2-XFS `size=`
+                # analog; a plain directory can't hard-enforce it, so the
+                # SERVICE layer guards shrink/patch against used vs limit.
+                os.makedirs(self._quota_dir, exist_ok=True)
+                with open(os.path.join(self._quota_dir, name), "w") as f:
+                    f.write(str(int(size_bytes)))
+            try:
+                os.makedirs(mp)
+            except OSError:
+                # no orphaned quota: a later quota-less recreate must not
+                # silently inherit this one
+                if size_bytes:
+                    try:
+                        os.unlink(os.path.join(self._quota_dir, name))
+                    except OSError:
+                        pass
+                raise
         return VolumeState(name=name, exists=True, mountpoint=mp,
                            size_limit_bytes=size_bytes,
                            driver_opts={"size": size_bytes})
@@ -279,6 +282,23 @@ class ProcessBackend(Backend):
                 pass
 
     # ---- helpers ----
+
+    @staticmethod
+    def _apply_memory_limit(pid: int, memory_bytes: int) -> None:
+        """Memory grant ENFORCED, not advisory: prlimit from the PARENT
+        right after spawn — no post-fork Python (preexec_fn can deadlock a
+        threaded daemon on allocator locks). RLIMIT_DATA (brk + private
+        writable mappings, kernel >= 4.7) rather than RLIMIT_AS: closest
+        host-process analog of `docker run -m` that doesn't kill runtimes
+        for merely RESERVING address space. The instants-after-spawn race
+        is the same one a cgroup attach has."""
+        if not memory_bytes:
+            return
+        lim = int(memory_bytes)
+        try:
+            resource.prlimit(pid, resource.RLIMIT_DATA, (lim, lim))
+        except (ProcessLookupError, PermissionError):
+            pass    # already exited / restricted: the wait() sees it
 
     @property
     def _quota_dir(self) -> str:

@@ -238,3 +238,17 @@ def test_process_volume_named_like_quota_dir(tmp_path):
     b.volume_remove(".quotas")
     assert b.volume_inspect("vol").size_limit_bytes == 1024
     b.close()
+
+
+def test_process_exec_shares_memory_limit(tmp_path):
+    """docker exec runs inside the container's -m cgroup; exec here gets
+    the same RLIMIT_DATA as the main process."""
+    b = ProcessBackend(str(tmp_path / "s"))
+    b.create("rs-1", _spec(cmd=["sleep", "30"],
+                           memory_bytes=200 * 1024 * 1024))
+    b.start("rs-1")
+    code, out = b.execute(
+        "rs-1", ["python3", "-c",
+                 "b = bytearray(400 * 1024 * 1024); print('survived')"])
+    assert code != 0 and "survived" not in out
+    b.close()

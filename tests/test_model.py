@@ -82,3 +82,24 @@ def test_flash_noncausal_matches_reference():
     out = flash_attention(q, k, v, causal=False, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-3, rtol=2e-3)
+
+
+def test_flagship_config_param_counts():
+    """The full-size configs must match their published parameter counts
+    (Llama-3-8B = 8.03B, Mixtral-8x7B = 46.7B) — verified abstractly via
+    eval_shape, nothing materializes. Guards against silent config drift
+    (a wrong d_ff or head count changes the billions digit)."""
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, count_params
+    from gpu_docker_api_tpu.models.llama import init_params as llama_ip
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.models.moe import init_params as moe_ip
+
+    def count(cfg, init):
+        # count_params works on eval_shape output: ShapeDtypeStruct has .size
+        return count_params(jax.eval_shape(
+            lambda: init(cfg, jax.random.key(0))))
+
+    assert count(LlamaConfig.llama3_8b(), llama_ip) == pytest.approx(
+        8.03e9, rel=0.005)
+    assert count(MoEConfig.mixtral_8x7b(), moe_ip) == pytest.approx(
+        46.7e9, rel=0.005)
