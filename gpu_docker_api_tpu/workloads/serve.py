@@ -39,7 +39,9 @@ def _load_params(trainer, ckpt_dir: str | None):
     if not ckpt_dir:
         return trainer.init(jax.random.key(0))["params"]
     from ..train import restore_checkpoint
-    state, step = restore_checkpoint(ckpt_dir)
+    # orbax needs an absolute path; scheduled workloads pass volume-bind
+    # paths relative to $CONTAINER_ROOT (the process substrate's cwd)
+    state, step = restore_checkpoint(os.path.abspath(ckpt_dir))
     print(f"restored checkpoint step {step}", flush=True)
     return state["params"]
 

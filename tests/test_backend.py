@@ -193,7 +193,7 @@ def test_process_port_env_daemon_port_does_not_leak(tmp_path, monkeypatch):
 
 
 def test_process_memory_limit_enforced(tmp_path):
-    """memory_bytes is a real RLIMIT_AS, not bookkeeping: a workload
+    """memory_bytes is a real RLIMIT_DATA, not bookkeeping: a workload
     allocating past its grant dies; the same workload under no limit
     succeeds."""
     alloc = "import sys; b = bytearray(400 * 1024 * 1024); print('ok')"
