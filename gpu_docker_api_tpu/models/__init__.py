@@ -44,6 +44,28 @@ MOE = ModelFamily(
 
 FAMILIES = {f.name: f for f in (LLAMA, MOE)}
 
+# named configs per family — the single table both workload CLIs
+# (train_llama, serve) resolve --family/--config against
+NAMED_CONFIGS = {
+    "llama": {"tiny": _llama.LlamaConfig.tiny,
+              "mini": _llama.LlamaConfig.llama_mini,
+              "llama3_8b": _llama.LlamaConfig.llama3_8b},
+    "moe": {"tiny": _moe.MoEConfig.tiny,
+            "mini": _moe.MoEConfig.moe_mini,
+            "mixtral_8x7b": _moe.MoEConfig.mixtral_8x7b},
+}
+
+
+def named_config(family: str, name: str):
+    """Resolve a (family, config-name) pair; raises KeyError with the
+    valid choices when unknown."""
+    table = NAMED_CONFIGS[family]
+    if name not in table:
+        raise KeyError(
+            f"config {name!r} not defined for family {family!r} "
+            f"(choices: {sorted(table)})")
+    return table[name]()
+
 
 def family_for(config) -> ModelFamily:
     """The family owning a config instance."""

@@ -42,7 +42,8 @@ exist"); this is the TPU-native obligation from SURVEY §5.7/5.8.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import functools
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -375,17 +376,15 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     cos, sin = rope_frequencies(lc, jnp.arange(s))
 
     if sp > 1:
-        import functools as _ft
-
         if sp_attn == "ulysses":
             # all-to-all head scatter inside the manual {pp, sp} region
             from .ulysses import _ulysses_local
-            attn_core = _ft.partial(_ulysses_local, axis="sp", sp=sp,
-                                    causal=True, impl=impl)
+            attn_core = functools.partial(_ulysses_local, axis="sp", sp=sp,
+                                          causal=True, impl=impl)
         else:
             from .ring import _ring_local
-            attn_core = _ft.partial(_ring_local, axis="sp", ring=sp,
-                                    causal=True)
+            attn_core = functools.partial(_ring_local, axis="sp", ring=sp,
+                                          causal=True)
 
         def layer_fn(h, layer):
             # inside manual {"pp","sp"}: h [b_mb, S/sp, D]. Same block as

@@ -92,8 +92,8 @@ class _Server:
         prompt = jnp.asarray(tokens, jnp.int32)
         if prompt.ndim != 2:
             raise ValueError("tokens must be [batch, prompt_len]")
-        if int(jnp.max(prompt)) >= self.config.vocab_size or int(
-                jnp.min(prompt)) < 0:
+        lo, hi = jax.device_get((jnp.min(prompt), jnp.max(prompt)))
+        if hi >= self.config.vocab_size or lo < 0:
             raise ValueError("token id out of range")
         with self.lock:
             out = generate(self.params, prompt, self.config, int(max_new),
@@ -184,20 +184,14 @@ def main(argv=None) -> int:
     if not args.port:
         args.port = int(os.environ.get("PORT", "8000"))
 
-    from ..models.llama import LlamaConfig
-    from ..models.moe import MoEConfig
+    from ..models import named_config
     from ..parallel.mesh import MeshPlan
     from ..train import Trainer
 
-    configs = {
-        "llama": {"tiny": LlamaConfig.tiny, "mini": LlamaConfig.llama_mini,
-                  "llama3_8b": LlamaConfig.llama3_8b},
-        "moe": {"tiny": MoEConfig.tiny, "mini": MoEConfig.moe_mini,
-                "mixtral_8x7b": MoEConfig.mixtral_8x7b},
-    }
-    if args.config not in configs[args.family]:
-        p.error(f"--config {args.config} not defined for family {args.family}")
-    config = configs[args.family][args.config]()
+    try:
+        config = named_config(args.family, args.config)
+    except KeyError as e:
+        p.error(str(e))
 
     import jax
     trainer = Trainer.create(config, MeshPlan(), devices=jax.devices()[:1])

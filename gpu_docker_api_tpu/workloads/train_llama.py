@@ -64,8 +64,7 @@ def main(argv=None) -> int:
 
     import jax
 
-    from ..models.llama import LlamaConfig
-    from ..models.moe import MoEConfig
+    from ..models import named_config
     from ..parallel.mesh import MeshPlan, best_tp_for
     from ..train import Trainer, TrainConfig, restore_checkpoint, save_checkpoint
 
@@ -73,15 +72,10 @@ def main(argv=None) -> int:
     ckpt_dir = os.path.abspath(os.path.join(args.workdir, "checkpoints"))
     metrics_path = os.path.join(args.workdir, "metrics.jsonl")
 
-    configs = {
-        "llama": {"tiny": LlamaConfig.tiny, "mini": LlamaConfig.llama_mini,
-                  "llama3_8b": LlamaConfig.llama3_8b},
-        "moe": {"tiny": MoEConfig.tiny, "mini": MoEConfig.moe_mini,
-                "mixtral_8x7b": MoEConfig.mixtral_8x7b},
-    }
-    if args.config not in configs[args.family]:
-        p.error(f"--config {args.config} not defined for family {args.family}")
-    config = configs[args.family][args.config]()
+    try:
+        config = named_config(args.family, args.config)
+    except KeyError as e:
+        p.error(str(e))
 
     n_dev = jax.device_count()
     fixed = args.sp * args.pp * args.ep
