@@ -378,6 +378,39 @@ def decode_bench() -> dict:
     }
 
 
+def store_bench() -> dict:
+    """MVCC store engines head-to-head: puts+gets/sec with a live WAL, the
+    python engine vs the C++ core (native/mvcc_store.cc) — the control
+    plane's state-spine hot path (every grant/release/version bump is a
+    store write behind the workqueue)."""
+    import shutil
+
+    from gpu_docker_api_tpu.store.native import native_available, open_store
+
+    out = {}
+    n = 2000
+    for engine in ("python", "native"):
+        if engine == "native" and not native_available():
+            out[engine] = "unavailable"
+            continue
+        d = tempfile.mkdtemp(prefix=f"tdapi-store-{engine}-")
+        try:
+            # the same factory the app boots through — the bench measures
+            # the production construction path, not a hand-rolled one
+            s = open_store(os.path.join(d, "wal"), engine=engine)
+            t0 = time.perf_counter()
+            for i in range(n):
+                s.put(f"/bench/k{i % 100}", f"v{i}")
+            for i in range(n):
+                s.get(f"/bench/k{i % 100}")
+            dt = time.perf_counter() - t0
+            out[engine] = round(2 * n / dt)
+            s.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return {"put_get_ops_per_sec": out, "ops": 2 * n}
+
+
 def scheduling_bench() -> dict:
     """BASELINE's second metric: TPU chips scheduled/sec, through the FULL
     REST stack (HTTP -> service -> ICI allocator -> store write-behind ->
@@ -461,6 +494,10 @@ def main() -> None:
         extra["scheduling"] = scheduling_bench()
     except Exception as e:  # noqa: BLE001 — extras must never kill the headline
         log(f"scheduling bench failed: {type(e).__name__}: {e}")
+    try:
+        extra["store"] = store_bench()
+    except Exception as e:  # noqa: BLE001
+        log(f"store bench failed: {type(e).__name__}: {e}")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
