@@ -394,6 +394,7 @@ def store_bench() -> dict:
             out[engine] = "unavailable"
             continue
         d = tempfile.mkdtemp(prefix=f"tdapi-store-{engine}-")
+        s = None
         try:
             # the same factory the app boots through — the bench measures
             # the production construction path, not a hand-rolled one
@@ -405,8 +406,9 @@ def store_bench() -> dict:
                 s.get(f"/bench/k{i % 100}")
             dt = time.perf_counter() - t0
             out[engine] = round(2 * n / dt)
-            s.close()
         finally:
+            if s is not None:
+                s.close()          # before the WAL dir disappears
             shutil.rmtree(d, ignore_errors=True)
     return {"put_get_ops_per_sec": out, "ops": 2 * n}
 

@@ -3,15 +3,22 @@ from .mock import MockBackend  # noqa: F401
 from .process import ProcessBackend  # noqa: F401
 
 
-def make_backend(kind: str, state_dir: str) -> Backend:
+def make_backend(kind: str, state_dir: str,
+                 volume_tiers: dict | None = None) -> Backend:
     """Runtime backend selection — the reference does this at compile time
     with Go build tags (`-tags mock` vs `-tags nvidia`, Makefile:25-47);
-    a runtime seam keeps one binary and makes CI trivial."""
+    a runtime seam keeps one binary and makes CI trivial. volume_tiers maps
+    tier name -> storage root (process/mock) for the local-SSD/NFS
+    data-disk split; the docker backend takes driver-opts templates via
+    its volume_tier_opts attribute instead."""
     if kind == "mock":
-        return MockBackend(state_dir)
-    if kind == "process":
-        return ProcessBackend(state_dir)
-    if kind == "docker":
+        b = MockBackend(state_dir)
+    elif kind == "process":
+        b = ProcessBackend(state_dir)
+    elif kind == "docker":
         from .docker import DockerBackend
-        return DockerBackend(state_dir)
-    raise ValueError(f"unknown backend {kind!r} (mock|process|docker)")
+        b = DockerBackend(state_dir)
+    else:
+        raise ValueError(f"unknown backend {kind!r} (mock|process|docker)")
+    b.volume_tiers = dict(volume_tiers or {})
+    return b

@@ -16,6 +16,7 @@ implementations:
 from __future__ import annotations
 
 import abc
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -43,6 +44,25 @@ class VolumeState:
     size_limit_bytes: int = 0
     used_bytes: int = 0
     driver_opts: dict = field(default_factory=dict)
+    tier: str = ""                 # storage tier ("" = default/local)
+
+
+def resolve_tier_root(default_root: str, tiers: dict, tier: str) -> str:
+    """Map a volume tier name to its storage root. '' / 'local' is the
+    default root; anything else must be configured (--volume-tier NAME=PATH
+    — e.g. nfs=/mnt/nfs per the reference's local-SSD + NFS data-disk
+    split, README.md:47-51)."""
+    if tier in ("", "local"):
+        return default_root
+    root = (tiers or {}).get(tier)
+    if not root:
+        raise ValueError(
+            f"unknown volume tier {tier!r} — configure it with "
+            f"--volume-tier {tier}=PATH (known: {sorted(tiers or {})})")
+    # namespace managed volumes under the configured root: a shared export
+    # may contain foreign directories that must never be mistaken for (or
+    # rmtree'd as) volumes
+    return os.path.join(root, "tpu-volumes")
 
 
 class Backend(abc.ABC):
@@ -88,7 +108,8 @@ class Backend(abc.ABC):
     # ---- volumes ----
 
     @abc.abstractmethod
-    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState: ...
+    def volume_create(self, name: str, size_bytes: int = 0,
+                      tier: str = "") -> VolumeState: ...
 
     @abc.abstractmethod
     def volume_remove(self, name: str) -> None: ...

@@ -210,11 +210,30 @@ class DockerBackend(Backend):
 
     # ---- volumes ----
 
-    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
+    def volume_create(self, name: str, size_bytes: int = 0,
+                      tier: str = "") -> VolumeState:
         opts = {}
         if size_bytes:
             # overlay2/XFS project quota (reference volume.go:36-38)
             opts = {"size": str(size_bytes)}
+        if tier and tier != "local":
+            # tiers come from the SAME --volume-tier config as the other
+            # backends: a "k=v,k=v" value is local-driver opts verbatim
+            # (e.g. nfs: "type=nfs,o=addr=10.0.0.5,device=:/export"); a
+            # plain path is a bind root — the managed subdir is created
+            # and bind-mounted as the volume
+            spec = getattr(self, "volume_tiers", {}).get(tier)
+            if spec is None:
+                raise ValueError(
+                    f"unknown volume tier {tier!r} — configure it with "
+                    f"--volume-tier {tier}=PATH (or driver opts k=v,...)")
+            if "=" in spec:
+                opts.update(kv.split("=", 1) for kv in spec.split(","))
+            else:
+                import os
+                device = os.path.join(spec, "tpu-volumes", name)
+                os.makedirs(device, exist_ok=True)
+                opts.update({"type": "none", "o": "bind", "device": device})
         out = self._request("POST", "/volumes/create",
                             {"Name": name, "DriverOpts": opts})
         return VolumeState(name=name, exists=True,

@@ -120,14 +120,19 @@ class MockBackend(Backend):
 
     # ---- volumes ----
 
-    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
+    def volume_create(self, name: str, size_bytes: int = 0,
+                      tier: str = "") -> VolumeState:
+        from .base import resolve_tier_root
         with self._lock:
             if name in self._volumes:
                 raise RuntimeError(f"volume {name} already exists")
-            mp = os.path.join(self.state_dir, "volumes", name)
+            root = resolve_tier_root(
+                os.path.join(self.state_dir, "volumes"),
+                getattr(self, "volume_tiers", {}), tier)
+            mp = os.path.join(root, name)
             os.makedirs(mp, exist_ok=True)
             v = VolumeState(name=name, exists=True, mountpoint=mp,
-                            size_limit_bytes=size_bytes,
+                            size_limit_bytes=size_bytes, tier=tier,
                             driver_opts={"size": size_bytes})
             self._volumes[name] = v
             return v

@@ -221,10 +221,16 @@ class ProcessBackend(Backend):
 
     # ---- volumes ----
 
-    def volume_create(self, name: str, size_bytes: int = 0) -> VolumeState:
+    def volume_create(self, name: str, size_bytes: int = 0,
+                      tier: str = "") -> VolumeState:
+        from .base import resolve_tier_root
         with self._lock:
-            mp = os.path.join(self.state_dir, "volumes", name)
-            if os.path.exists(mp):
+            root = resolve_tier_root(
+                os.path.join(self.state_dir, "volumes"),
+                getattr(self, "volume_tiers", {}), tier)
+            os.makedirs(root, exist_ok=True)
+            mp = os.path.join(root, name)
+            if os.path.exists(mp) or self._find_volume(name):
                 raise RuntimeError(f"volume {name} already exists")
             if size_bytes:
                 # quota lives in its OWN namespace (a volume named
@@ -246,12 +252,25 @@ class ProcessBackend(Backend):
                         pass
                 raise
         return VolumeState(name=name, exists=True, mountpoint=mp,
-                           size_limit_bytes=size_bytes,
+                           size_limit_bytes=size_bytes, tier=tier,
                            driver_opts={"size": size_bytes})
 
+    def _find_volume(self, name: str):
+        """(mountpoint, tier) across the default root and every configured
+        tier root, or None."""
+        mp = os.path.join(self.state_dir, "volumes", name)
+        if os.path.isdir(mp):
+            return mp, ""
+        for tier, root in getattr(self, "volume_tiers", {}).items():
+            mp = os.path.join(root, "tpu-volumes", name)
+            if os.path.isdir(mp):
+                return mp, tier
+        return None
+
     def volume_remove(self, name: str) -> None:
-        shutil.rmtree(os.path.join(self.state_dir, "volumes", name),
-                      ignore_errors=True)
+        found = self._find_volume(name)
+        if found:
+            shutil.rmtree(found[0], ignore_errors=True)
         try:
             os.unlink(os.path.join(self._quota_dir, name))
         except OSError:
@@ -259,9 +278,10 @@ class ProcessBackend(Backend):
 
     def volume_inspect(self, name: str) -> VolumeState:
         from ..utils.file import dir_size
-        mp = os.path.join(self.state_dir, "volumes", name)
-        if not os.path.isdir(mp):
+        found = self._find_volume(name)
+        if not found:
             return VolumeState(name=name, exists=False)
+        mp, tier = found
         limit = 0
         try:
             with open(os.path.join(self._quota_dir, name)) as f:
@@ -269,7 +289,7 @@ class ProcessBackend(Backend):
         except (OSError, ValueError):
             pass
         return VolumeState(name=name, exists=True, mountpoint=mp,
-                           size_limit_bytes=limit,
+                           size_limit_bytes=limit, tier=tier,
                            used_bytes=dir_size(mp))
 
     # ---- lifecycle ----

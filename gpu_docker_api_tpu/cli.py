@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="substrate (default: process; mock needs no hardware)")
     p.add_argument("-t", "--topology", default=None,
                    help="force accelerator type (e.g. v5p-8); default: probe")
+    p.add_argument("--volume-tier", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="extra volume storage tier (repeatable), e.g. "
+                        "nfs=/mnt/nfs — the local-SSD/NFS data-disk split")
     return p
 
 
@@ -54,8 +58,21 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     topology = make_topology(args.topology) if args.topology else None
+    tiers = {}
+    for spec in args.volume_tier:
+        tname, sep, path = spec.partition("=")
+        if not sep or not tname or not path:
+            raise SystemExit(f"--volume-tier expects NAME=PATH, got {spec!r}")
+        if tname == "local":
+            raise SystemExit(
+                "--volume-tier local=... is not configurable: 'local' is "
+                "the state-dir default tier")
+        if tname in tiers:
+            raise SystemExit(f"duplicate --volume-tier {tname!r}")
+        tiers[tname] = path
     app = App(state_dir=args.state_dir, backend=args.backend, addr=args.addr,
-              port_range=parse_port_range(args.portRange), topology=topology)
+              port_range=parse_port_range(args.portRange), topology=topology,
+              volume_tiers=tiers)
     app.start()
 
     status = app.tpu.get_status()

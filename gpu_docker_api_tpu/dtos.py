@@ -218,6 +218,7 @@ class StoredVolumeInfo:
     createTime: str = ""
     volumeName: str = ""          # versioned name {name}-{version}
     size: str = ""                # e.g. "20GB"
+    tier: str = ""                # storage tier ("" = default/local)
 
     def serialize(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)

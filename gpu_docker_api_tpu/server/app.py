@@ -45,7 +45,8 @@ class App:
                  api_key: Optional[str] = None,
                  cpu_cores: Optional[int] = None,
                  store_engine: str = "auto",
-                 store_maint_records: int = 5000):
+                 store_maint_records: int = 5000,
+                 volume_tiers: Optional[dict] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         # WAL maintenance trigger: when the record count crosses this,
@@ -61,7 +62,8 @@ class App:
         self.client = StateClient(self.store)
         self.wq = WorkQueue(self.client)
         self.wq.start()
-        self.backend = make_backend(backend, os.path.join(state_dir, "backend"))
+        self.backend = make_backend(backend, os.path.join(state_dir, "backend"),
+                                    volume_tiers=volume_tiers)
         # an explicit topology overrides the store; otherwise boot from stored
         # state (crash-resume) and only probe the host on first run
         if topology is None and self.client.get("tpus", "tpuStatusMap") is None:
@@ -295,9 +297,14 @@ class App:
         if size and not valid_size_unit(size):
             return err(ResCode.VolumeSizeNotSupported)
         try:
-            return ok(self.volumes.create_volume(name, size))
+            return ok(self.volumes.create_volume(
+                name, size, tier=body.get("tier", "")))
         except xerrors.VolumeExistedError:
             return err(ResCode.VolumeExisted)
+        except ValueError as e:
+            # client input error (e.g. unknown tier) — return the
+            # actionable message, don't bury it in a server stack trace
+            return err(ResCode.VolumeCreateFailed, str(e))
         except Exception:  # noqa: BLE001
             log.exception("volume create failed [%s]", req.request_id)
             return err(ResCode.VolumeCreateFailed)

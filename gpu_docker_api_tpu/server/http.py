@@ -78,8 +78,8 @@ def ok(data: Optional[dict] = None) -> Response:
     return Response(ResCode.Success, data)
 
 
-def err(code: ResCode) -> Response:
-    return Response(code, None)
+def err(code: ResCode, msg: "str | None" = None) -> Response:
+    return Response(code, None, msg=msg)
 
 
 class Router:

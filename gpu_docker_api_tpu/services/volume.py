@@ -51,24 +51,26 @@ class VolumeService:
 
     # ---- create ----
 
-    def create_volume(self, name: str, size: str) -> dict:
-        """POST /volumes (reference CreateVolume :26-96)."""
+    def create_volume(self, name: str, size: str, tier: str = "") -> dict:
+        """POST /volumes (reference CreateVolume :26-96). tier selects the
+        storage root (local-SSD default vs e.g. an NFS tier)."""
         with self._mutex(name):
             if self.versions.exist(name):
                 raise xerrors.VolumeExistedError(name)
-            return self._create_version(name, size)
+            return self._create_version(name, size, tier)
 
-    def _create_version(self, name: str, size: str) -> dict:
+    def _create_version(self, name: str, size: str, tier: str = "") -> dict:
         version = self.versions.bump(name)
         vol_name = f"{name}-{version}"
         size_bytes = to_bytes(size) if size else 0
         try:
-            state = self.backend.volume_create(vol_name, size_bytes)
+            state = self.backend.volume_create(vol_name, size_bytes,
+                                               tier=tier)
         except Exception:
             self.versions.rollback_bump(name, version - 1)
             raise
         info = StoredVolumeInfo(version=version, createTime=_now(),
-                                volumeName=vol_name, size=size)
+                                volumeName=vol_name, size=size, tier=tier)
         payload = info.serialize()
         self._latest[name] = info
         self.wq.submit(PutKeyValue(VOLUMES, name, payload))
@@ -98,7 +100,8 @@ class VolumeService:
                 raise xerrors.VolumeSizeUsedGreaterThanReducedError(
                     f"used {old_state.used_bytes}B > target {new_bytes}B")
 
-            out = self._create_version(name, size)
+            # a scaled version stays on its tier (data migrates in-tier)
+            out = self._create_version(name, size, tier=info.tier)
             new_state = self.backend.volume_inspect(out["name"])
             try:
                 move_dir_contents(old_state.mountpoint, new_state.mountpoint)
@@ -160,6 +163,7 @@ class VolumeService:
             "createTime": info.createTime,
             "volumeName": info.volumeName,
             "size": info.size,
+            "tier": info.tier,
             "mountpoint": state.mountpoint,
             "usedBytes": state.used_bytes,
         }

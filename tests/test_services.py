@@ -358,3 +358,43 @@ def test_info_exposes_multihost_launch_plan(world):
         assert "TPU_PROCESS_ADDRESSES" in env
     _run(rs, "small", tpus=2)
     assert "multihost" not in rs.get_container_info("small")
+
+
+# -------------------------------------------------- volume tiers
+
+def test_volume_tiers_end_to_end(tmp_path):
+    """SURVEY §7.7: the local-SSD/NFS data-disk split. A volume created on a
+    configured tier lands under that tier's root, reports its tier, keeps
+    it across a scale-up (data migrates in-tier), and unknown tiers fail
+    with the configuration hint."""
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+    nfs = tmp_path / "fake-nfs"
+    nfs.mkdir()
+    app = App(state_dir=str(tmp_path / "state"), backend="process",
+              addr="127.0.0.1:0", topology=make_topology("v5p-8"),
+              api_key="", cpu_cores=4, volume_tiers={"nfs": str(nfs)})
+    app.start()
+    try:
+        out = app.volumes.create_volume("shared", "1GB", tier="nfs")
+        assert out["mountpoint"].startswith(str(nfs))
+        info = app.volumes.get_volume_info("shared")
+        assert info["tier"] == "nfs"
+        # default tier volumes stay under the state dir
+        local = app.volumes.create_volume("scratch", "1GB")
+        assert not local["mountpoint"].startswith(str(nfs))
+        # scale-up keeps the tier and migrates data in-tier
+        import os
+        with open(os.path.join(out["mountpoint"], "w.bin"), "wb") as f:
+            f.write(b"D" * 64)
+        scaled = app.volumes.patch_volume_size("shared", "2GB")
+        assert scaled["mountpoint"].startswith(str(nfs))
+        assert open(os.path.join(scaled["mountpoint"], "w.bin"), "rb").read() \
+            == b"D" * 64
+        assert app.volumes.get_volume_info("shared")["tier"] == "nfs"
+        # unknown tier: actionable error
+        import pytest as _pt
+        with _pt.raises(ValueError, match="--volume-tier"):
+            app.volumes.create_volume("bad", "1GB", tier="warpfs")
+    finally:
+        app.stop()
