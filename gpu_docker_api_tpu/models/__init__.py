@@ -19,7 +19,7 @@ from . import moe as _moe
 class ModelFamily:
     name: str
     init_params: Callable
-    forward: Callable          # (params, tokens, config, *, impl, mesh)
+    forward: Callable          # (params, tokens, config, *, impl, mesh, remat)
     param_kinds: Callable
     config_cls: Any
     returns_extra_loss: bool = False
@@ -49,6 +49,7 @@ FAMILIES = {f.name: f for f in (LLAMA, MOE)}
 NAMED_CONFIGS = {
     "llama": {"tiny": _llama.LlamaConfig.tiny,
               "mini": _llama.LlamaConfig.llama_mini,
+              "250m": _llama.LlamaConfig.llama_250m,
               "llama3_8b": _llama.LlamaConfig.llama3_8b},
     "moe": {"tiny": _moe.MoEConfig.tiny,
             "mini": _moe.MoEConfig.moe_mini,

@@ -30,6 +30,7 @@ from jax.sharding import Mesh
 
 from ..ops.attention import attention
 from ..parallel.mesh import pin_activation, pin_qkv
+from .remat import remat_wrap
 
 
 @dataclass(frozen=True)
@@ -67,6 +68,15 @@ class LlamaConfig:
         head_dim = 128 so the pallas flash path engages on TPU."""
         return cls(vocab_size=32000, d_model=512, n_layers=4, n_heads=4,
                    n_kv_heads=2, d_ff=1408, max_seq_len=2048)
+
+    @classmethod
+    def llama_250m(cls) -> "LlamaConfig":
+        """~250M-param config: big enough to feed the MXU properly (the MFU
+        benchmark model — llama_mini's d_model=512 matmuls underfeed the
+        128x128 systolic array), small enough that params+AdamW+remat
+        activations fit one v5e chip's 16GB HBM."""
+        return cls(vocab_size=32000, d_model=1024, n_layers=16, n_heads=8,
+                   n_kv_heads=4, d_ff=2816, max_seq_len=4096)
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -213,12 +223,15 @@ def _mlp_block(x, layer, config: LlamaConfig):
 
 # ---- forward ---------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("config", "impl", "mesh"))
+@partial(jax.jit, static_argnames=("config", "impl", "mesh", "remat"))
 def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
                   impl: str = "auto",
-                  mesh: Optional[Mesh] = None) -> jax.Array:
+                  mesh: Optional[Mesh] = None,
+                  remat: str = "none") -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V] float32. With a mesh whose
-    sp axis > 1, attention runs as ring attention over the sequence shards."""
+    sp axis > 1, attention runs as ring attention over the sequence shards.
+    remat: "none" | "full" | "dots" — per-layer checkpointing of the scan
+    body (models/remat.py)."""
     c = config
     s = tokens.shape[1]
     x = jnp.take(params["embed"], tokens, axis=0)
@@ -230,7 +243,7 @@ def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
         x = _mlp_block(x, layer, c)
         return x, None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, _ = jax.lax.scan(remat_wrap(body, remat), x, params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     # logits in f32: the loss softmax needs the headroom
     return (x @ params["lm_head"]).astype(jnp.float32)

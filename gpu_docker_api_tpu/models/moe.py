@@ -40,6 +40,7 @@ from .llama import (
     ATTN_PARAM_KINDS, LlamaConfig, _attention_block, attention_params,
     rms_norm, rope_frequencies,
 )
+from .remat import remat_wrap
 
 
 @dataclass(frozen=True)
@@ -238,10 +239,10 @@ def moe_block(x: jax.Array, layer: dict, config: MoEConfig,
 
 # ---- forward ---------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("config", "impl", "mesh"))
+@partial(jax.jit, static_argnames=("config", "impl", "mesh", "remat"))
 def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
                 impl: str = "auto", mesh: Optional[Mesh] = None,
-                ) -> tuple[jax.Array, jax.Array]:
+                remat: str = "none") -> tuple[jax.Array, jax.Array]:
     """tokens [B, S] int32 -> (logits [B, S, V] f32, router_loss scalar).
 
     router_loss = aux_weight * load_balance + z_weight * z_loss, summed over
@@ -261,7 +262,8 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
         return (x, aux_sum + aux, z_sum + z), None
 
     (x, aux_sum, z_sum), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        remat_wrap(body, remat),
+        (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

@@ -1,0 +1,29 @@
+"""Per-layer rematerialization policy for scan-based decoder trunks.
+
+Model-agnostic: every family's forward wraps its lax.scan body with
+remat_wrap. Checkpointing the WHOLE loss instead would recompute the full
+forward in the backward and still store every layer's residuals during that
+recompute — the worst of both; per-layer checkpointing of the scan body is
+the TPU-correct policy (memory O(L x layer inputs), recompute bounded to
+one layer at a time).
+"""
+
+from __future__ import annotations
+
+import jax
+
+POLICIES = ("none", "full", "dots")
+
+
+def remat_wrap(body, remat: str):
+    """"full" saves only layer inputs (min HBM); "dots" additionally saves
+    matmul outputs so the backward's recompute skips the MXU work (small
+    HBM cost, near-zero FLOP overhead). prevent_cse=False: scan's loop
+    structure already provides the barrier."""
+    if remat not in POLICIES:
+        raise ValueError(f"remat {remat!r} not in {POLICIES}")
+    if remat == "none":
+        return body
+    policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+              if remat == "dots" else None)
+    return jax.checkpoint(body, policy=policy, prevent_cse=False)

@@ -33,7 +33,11 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
-    remat: bool = True   # jax.checkpoint the layer body: HBM for FLOPs
+    remat: bool = True   # per-layer jax.checkpoint of the scan body
+    # "dots" saves matmul outputs across the remat boundary (backward skips
+    # the MXU recompute — near-zero FLOP overhead, small HBM cost); "full"
+    # saves only layer inputs (min HBM, forward recomputed on backward)
+    remat_policy: str = "dots"
     n_microbatches: int = 4  # pipeline microbatches when the mesh has pp > 1
     # >1 selects the interleaved pipeline schedule (v layer chunks per
     # stage, bubble/v — parallel/pipeline.py module doc)
@@ -55,7 +59,8 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
 
 def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
             n_microbatches: int = 0, remat: bool = True,
-            virtual_stages: int = 1, pregrouped: bool = False):
+            virtual_stages: int = 1, pregrouped: bool = False,
+            remat_policy: str = "dots"):
     """Next-token CE (+ the family's extra loss, e.g. MoE router aux).
     tokens [B, S]; predicts tokens[:, 1:]. n_microbatches > 0 selects the
     pipelined trunk (mesh must have pp > 1). pregrouped=True when
@@ -73,7 +78,8 @@ def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
                              n_microbatches=n_microbatches, impl=impl,
                              remat=remat, virtual_stages=virtual_stages,
                              pregrouped=pregrouped)
-    out = fam.forward(params, tokens, config, impl=impl, mesh=mesh)  # f32
+    out = fam.forward(params, tokens, config, impl=impl, mesh=mesh,
+                      remat=remat_policy if remat else "none")  # f32
     logits, extra = out if fam.returns_extra_loss else (out, 0.0)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
@@ -240,16 +246,19 @@ class Trainer:
 
         def step(state, tokens):
             def compute_loss(p):
+                # remat happens per-layer INSIDE the forward's scan body
+                # (models/remat.py) or per-stage inside the pipeline
+                # schedule — never around the whole loss, which would pay a
+                # full forward recompute AND still store every layer's
+                # residuals during it
                 return loss_fn(p, tokens, cfg, mesh=mesh, n_microbatches=mb,
                                remat=self.tc.remat,
+                               remat_policy=self.tc.remat_policy,
                                virtual_stages=self.tc.virtual_stages,
                                # Trainer state stores interleaved layers
                                # pre-grouped (see _init_fn)
                                pregrouped=self.tc.virtual_stages > 1)
-            # pipelined trunk remats per-stage inside the schedule
-            use_remat = self.tc.remat and not mb
-            lfn = jax.checkpoint(compute_loss) if use_remat else compute_loss
-            loss, grads = jax.value_and_grad(lfn)(state["params"])
+            loss, grads = jax.value_and_grad(compute_loss)(state["params"])
             updates, new_opt = self.optimizer.update(
                 grads, state["opt_state"], state["params"])
             new_params = optax.apply_updates(state["params"], updates)

@@ -108,6 +108,34 @@ def test_train_step_with_remat_matches():
     np.testing.assert_allclose(float(base), float(rematted), rtol=1e-6)
 
 
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_remat_policies_identical_numerics(family):
+    """Per-layer remat ("full" min-HBM and "dots" save-matmul-outputs) must
+    not change the step's loss or gradients vs no remat — rematerialization
+    is a scheduling choice, never a numerics one. MoE is the riskier
+    target: its scan body carries (x, aux_sum, z_sum) with router losses
+    crossing the remat boundary."""
+    if family == "moe":
+        from gpu_docker_api_tpu.models.moe import MoEConfig
+        cfg = MoEConfig.tiny()
+    else:
+        cfg = LlamaConfig.tiny()
+    tokens = jax.random.randint(jax.random.key(9), (4, 32), 0, cfg.vocab_size)
+    outs = {}
+    for label, tc in {
+        "none": TrainConfig(remat=False),
+        "full": TrainConfig(remat=True, remat_policy="full"),
+        "dots": TrainConfig(remat=True, remat_policy="dots"),
+    }.items():
+        trainer = Trainer.create(cfg, MeshPlan(dp=2, fsdp=2, tp=2, sp=1),
+                                 tc=tc)
+        state = trainer.init(jax.random.key(0))
+        _, m = trainer.step(state, trainer.shard_batch(tokens))
+        outs[label] = (float(m["loss"]), float(m["grad_norm"]))
+    np.testing.assert_allclose(outs["full"], outs["none"], rtol=2e-5)
+    np.testing.assert_allclose(outs["dots"], outs["none"], rtol=2e-5)
+
+
 def test_param_specs_layer_axis_unsharded():
     """Layer-stacked params: the scan axis must be None; fsdp/tp land on the
     matrix axes (regression: specs were written for 2-D weights)."""
