@@ -241,8 +241,8 @@ def _train_step_flops(config, batch: int, seq: int) -> float:
     return dense + 3.0 * attn_fwd
 
 
-def mfu_bench() -> dict:
-    """Timed llama_mini train steps on the real chip -> MFU vs chip peak.
+def _mfu_one(name: str, cfg, batch: int, seq: int, K: int) -> dict:
+    """Timed train steps on the real chip -> MFU vs chip peak.
 
     Timing discipline for the axon tunnel: block_until_ready does NOT
     synchronize remote execution there, so K full train steps run as ONE
@@ -252,19 +252,15 @@ def mfu_bench() -> dict:
     """
     import jax
     import jax.numpy as jnp
-    from gpu_docker_api_tpu.models.llama import LlamaConfig
     from gpu_docker_api_tpu.train import Trainer
     from gpu_docker_api_tpu.parallel.mesh import MeshPlan
 
-    cfg = LlamaConfig.llama_mini()
-    batch, seq = 8, 1024
     trainer = Trainer.create(cfg, MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
                              devices=jax.devices()[:1])
     state = trainer.init(jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 cfg.vocab_size, jnp.int32)
     tokens = trainer.shard_batch(tokens)
-    K = 8
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_k(st, toks):
@@ -285,7 +281,7 @@ def mfu_bench() -> dict:
     flops = _train_step_flops(cfg, batch, seq)
     peak, gen = _chip_peak_flops()
     rec = {
-        "model": "llama_mini", "batch": batch, "seq": seq,
+        "model": name, "batch": batch, "seq": seq,
         "step_ms": round(step_s * 1e3, 2),
         "tokens_per_sec": round(batch * seq / step_s),
         "compile_s": round(compile_s, 1),
@@ -296,6 +292,20 @@ def mfu_bench() -> dict:
     if peak:
         rec["mfu"] = round(flops / step_s / peak, 4)
     return rec
+
+
+def mfu_bench() -> dict:
+    """MFU on two sizes: llama_mini (the fast smoke every round can afford)
+    and llama_250m (big enough to feed the MXU — the serious MFU number)."""
+    from gpu_docker_api_tpu.models.llama import LlamaConfig
+    out = {"mini": _mfu_one("llama_mini", LlamaConfig.llama_mini(),
+                            batch=8, seq=1024, K=8)}
+    try:
+        out["250m"] = _mfu_one("llama_250m", LlamaConfig.llama_250m(),
+                               batch=8, seq=2048, K=4)
+    except Exception as e:  # OOM/tunnel hiccup must not kill the headline
+        out["250m"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def flash_bench() -> dict:
@@ -482,7 +492,10 @@ def main() -> None:
     state_dir = tempfile.mkdtemp(prefix="tdapi-bench-")
     topo = discover_topology()
     app = App(state_dir=state_dir, backend="process", addr="127.0.0.1:0",
-              topology=topo, api_key="", cpu_cores=max(os.cpu_count() or 1, 4))
+              topology=topo, api_key="", cpu_cores=max(os.cpu_count() or 1, 4),
+              # the serve cli's default: a warm pre-imported worker absorbs
+              # each run's interpreter+`import jax` startup (warmpool.py)
+              warm_pool=1)
     app.start()
     try:
         # one real chip is the axon reality; grant 1 when any exist

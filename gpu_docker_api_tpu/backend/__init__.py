@@ -4,17 +4,20 @@ from .process import ProcessBackend  # noqa: F401
 
 
 def make_backend(kind: str, state_dir: str,
-                 volume_tiers: dict | None = None) -> Backend:
+                 volume_tiers: dict | None = None,
+                 warm_pool: int = 0) -> Backend:
     """Runtime backend selection — the reference does this at compile time
     with Go build tags (`-tags mock` vs `-tags nvidia`, Makefile:25-47);
     a runtime seam keeps one binary and makes CI trivial. volume_tiers maps
     tier name -> storage root (process/mock) for the local-SSD/NFS
     data-disk split; the docker backend takes driver-opts templates via
-    its volume_tier_opts attribute instead."""
+    its volume_tier_opts attribute instead. warm_pool > 0 keeps that many
+    pre-imported Python workers for fast workload start (process backend
+    only — backend/warmpool.py)."""
     if kind == "mock":
         b = MockBackend(state_dir)
     elif kind == "process":
-        b = ProcessBackend(state_dir)
+        b = ProcessBackend(state_dir, warm_pool=warm_pool)
     elif kind == "docker":
         from .docker import DockerBackend
         b = DockerBackend(state_dir)

@@ -51,12 +51,20 @@ class _Proc:
 
 
 class ProcessBackend(Backend):
-    def __init__(self, state_dir: str):
+    def __init__(self, state_dir: str, warm_pool: int = 0,
+                 warm_preimport: str = "jax"):
         self.state_dir = state_dir
         self._lock = threading.RLock()
         self._procs: dict[str, _Proc] = {}
         for sub in ("rootfs", "volumes", "images", "logs"):
             os.makedirs(os.path.join(state_dir, sub), exist_ok=True)
+        # warm worker pool (warmpool.py): python workloads start in a
+        # pre-imported interpreter, skipping startup+`import jax` on the
+        # cold-start critical path. 0 = off (unit tests, non-JAX hosts).
+        self._pool = None
+        if warm_pool > 0:
+            from .warmpool import WarmPool
+            self._pool = WarmPool(size=warm_pool, preimport=warm_preimport)
 
     # ---- containers ----
 
@@ -100,17 +108,43 @@ class ProcessBackend(Backend):
                 return
             env = self._build_env(p)
             cmd = list(p.spec.cmd) or ["sleep", "infinity"]
-            if p.spec.cpuset and shutil.which("taskset"):
-                cmd = ["taskset", "-c", p.spec.cpuset] + cmd
-            logf = open(p.log_path, "ab")
-            p.popen = subprocess.Popen(
-                cmd, cwd=p.rootfs, env=env, stdout=logf, stderr=subprocess.STDOUT,
-                start_new_session=True)  # own process group for clean signaling
-            logf.close()
+            p.popen = self._start_warm(p, cmd, env)
+            if p.popen is None:
+                if p.spec.cpuset and shutil.which("taskset"):
+                    cmd = ["taskset", "-c", p.spec.cpuset] + cmd
+                logf = open(p.log_path, "ab")
+                p.popen = subprocess.Popen(
+                    cmd, cwd=p.rootfs, env=env, stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True)  # own pgid for clean signaling
+                logf.close()
             self._apply_memory_limit(p.popen.pid, p.spec.memory_bytes)
             p.started_at = time.time()
             p.paused = False
             p.exit_code = None
+
+    def _start_warm(self, p: _Proc, cmd: list[str], env: dict):
+        """Try to run the container on a warm pool worker; None -> cold
+        spawn. The worker becomes the container process (its Popen is kept),
+        so stop/pause/inspect work identically. CPU pinning that the cold
+        path does with a taskset wrapper is applied here via
+        sched_setaffinity on the live worker."""
+        if self._pool is None or not self._pool.supports(cmd, p.spec.env):
+            return None
+        w = self._pool.take()
+        if w is None:
+            return None
+        if not self._pool.dispatch(w, cmd, env, p.rootfs, p.log_path):
+            from .warmpool import _reap
+            _reap(w)
+            return None
+        if p.spec.cpuset:
+            try:
+                cpus = {int(c) for c in p.spec.cpuset.split(",") if c.strip()}
+                os.sched_setaffinity(w.pid, cpus)
+            except (OSError, ValueError):
+                pass  # already exited / bad set: same tolerance as taskset
+        return w
 
     def stop(self, name: str, timeout: float = 10.0) -> None:
         with self._lock:
@@ -295,6 +329,8 @@ class ProcessBackend(Backend):
     # ---- lifecycle ----
 
     def close(self) -> None:
+        if self._pool is not None:
+            self._pool.close()
         for name in self.list_names():
             try:
                 self.stop(name, timeout=2)

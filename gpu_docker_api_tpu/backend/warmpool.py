@@ -1,0 +1,202 @@
+"""Warm worker pool: pre-imported Python interpreters for fast workload start.
+
+The headline metric of this framework is replicaSet cold-start -> first XLA
+step (BASELINE.md). For a Python/JAX workload the cold path pays interpreter
+startup + `import jax` (~1-1.5s) before any device work can begin. On a TPU
+VM the chip grant is pure environment (TPU_VISIBLE_CHIPS is consumed at
+backend *init*, not at import), so a worker that has already imported jax —
+but not yet initialized a backend — can absorb any granted chip set. This is
+the same idea production TPU stacks use (persistent executors that accept
+work), applied at the container-start seam.
+
+Mechanics: the pool keeps N idle workers, each a `python -c <worker loop>`
+child that imports the configured modules and then blocks on stdin. Starting
+a container hands ONE json job line to a worker: {cmd, env, cwd, log}. The
+worker redirects stdout/stderr onto the container log, replaces its
+environment wholesale with the container's (daemon env + spec env + TPU
+grant — exactly what a cold spawn would see), chdirs, rebinds sys.argv, and
+runs the command in-process (exec for `-c`, runpy for scripts/modules). The
+worker *becomes* the container process: the parent keeps its Popen, so
+stop/pause/inspect (killpg etc.) are identical to the cold path.
+
+Only python commands are absorbed (`python [-u] -c/-m/script ...`); anything
+else — and any dispatch failure — falls back to the cold spawn in
+ProcessBackend.start. A taken worker is replaced asynchronously, so its
+replacement warms its imports while the dispatched workload runs.
+
+No reference counterpart (the reference starts docker containers and pays
+image/runtime startup every time); this is a TPU-native addition.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+# The worker loop. Runs under `python -u -c`; heavy imports happen BEFORE
+# the stdin read, so an idle worker is a fully warmed interpreter.
+_WORKER_SRC = r"""
+import importlib, json, os, sys
+for _m in os.environ.get("TDAPI_WARM_PREIMPORT", "").split(","):
+    _m = _m.strip()
+    if _m:
+        try:
+            importlib.import_module(_m)
+        except Exception:
+            pass
+_line = sys.stdin.buffer.readline()
+if not _line.strip():
+    sys.exit(0)                      # pool shutdown: EOF on stdin
+_job = json.loads(_line)
+_fd = os.open(_job["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+os.dup2(_fd, 1)
+os.dup2(_fd, 2)
+os.close(_fd)
+os.environ.clear()
+os.environ.update(_job["env"])
+# jax.config binds some values from env at import time; re-point the ones a
+# job may override (the forced-CPU bench fallback sets JAX_PLATFORMS=cpu)
+if "jax" in sys.modules and _job["env"].get("JAX_PLATFORMS"):
+    try:
+        import jax
+        jax.config.update("jax_platforms", _job["env"]["JAX_PLATFORMS"])
+    except Exception:
+        pass
+os.chdir(_job["cwd"])
+_args = _job["cmd"][1:]
+while _args and _args[0] == "-u":
+    _args = _args[1:]
+import runpy
+if _args[0] == "-c":
+    sys.argv = ["-c"] + _args[2:]
+    _g = {"__name__": "__main__", "__builtins__": __builtins__}
+    exec(compile(_args[1], "<warm-worker>", "exec"), _g)
+elif _args[0] == "-m":
+    sys.argv = _args[1:]
+    runpy.run_module(_args[1], run_name="__main__", alter_sys=True)
+else:
+    sys.argv = _args
+    runpy.run_path(_args[0], run_name="__main__")
+"""
+
+
+class WarmPool:
+    """N idle pre-imported interpreters; take() pops one, a replacement
+    spawns in the background."""
+
+    def __init__(self, size: int = 1, preimport: str = "jax"):
+        self.size = max(int(size), 0)
+        self.preimport = preimport
+        self._lock = threading.Lock()
+        self._idle: list[subprocess.Popen] = []
+        self._closed = False
+        for _ in range(self.size):
+            self._add_worker()
+
+    # ---- worker lifecycle ----
+
+    def _spawn(self) -> Optional[subprocess.Popen]:
+        env = dict(os.environ)
+        env["TDAPI_WARM_PREIMPORT"] = self.preimport
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-u", "-c", _WORKER_SRC],
+                stdin=subprocess.PIPE, env=env,
+                start_new_session=True)  # own pgid: killpg-clean, like cold
+        except OSError:
+            return None
+
+    def _add_worker(self) -> None:
+        w = self._spawn()
+        if w is not None:
+            with self._lock:
+                if self._closed:
+                    _reap(w)
+                    return
+                self._idle.append(w)
+
+    def _refill_async(self) -> None:
+        threading.Thread(target=self._add_worker, daemon=True).start()
+
+    # ---- dispatch ----
+
+    @staticmethod
+    def supports(cmd: list[str], env: Optional[list[str]] = None) -> bool:
+        """True for `python [-u] (-c code | -m mod | script) [args...]`.
+
+        env is the container spec's env list: a job that sets any PYTHON*
+        variable (PYTHONPATH, PYTHONHASHSEED, ...) is refused — those are
+        consumed at interpreter STARTUP, which the warm worker has already
+        paid, so os.environ.update can't honor them; it must cold-spawn."""
+        if not cmd or not os.path.basename(cmd[0]).startswith("python"):
+            return False
+        for kv in env or []:
+            if kv.partition("=")[0].startswith("PYTHON"):
+                return False
+        args = cmd[1:]
+        while args and args[0] == "-u":
+            args = args[1:]
+        if not args:
+            return False
+        if args[0] in ("-c", "-m"):
+            return len(args) >= 2
+        return not args[0].startswith("-")
+
+    def take(self) -> Optional[subprocess.Popen]:
+        """Pop a live idle worker (None when the pool is empty/closed).
+        Every popped worker — taken OR found dead — schedules a
+        replacement, so a crashed worker can never shrink the pool
+        permanently."""
+        refills, taken = 0, None
+        with self._lock:
+            if self._closed:
+                return None
+            while self._idle:
+                w = self._idle.pop()
+                refills += 1
+                if w.poll() is None:
+                    taken = w
+                    break
+        for _ in range(refills):
+            self._refill_async()
+        return taken
+
+    @staticmethod
+    def dispatch(worker: subprocess.Popen, cmd: list[str], env: dict,
+                 cwd: str, log_path: str) -> bool:
+        """Hand the job line to a taken worker. False = caller must kill the
+        worker and cold-spawn instead."""
+        job = json.dumps({"cmd": cmd, "env": {k: str(v) for k, v in env.items()},
+                          "cwd": cwd, "log": log_path})
+        try:
+            assert worker.stdin is not None
+            worker.stdin.write(job.encode() + b"\n")
+            worker.stdin.flush()
+            worker.stdin.close()     # job code must see EOF on stdin
+            return True
+        except (OSError, ValueError, AssertionError):
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for w in idle:
+            _reap(w)
+
+
+def _reap(w: subprocess.Popen) -> None:
+    try:
+        if w.stdin:
+            w.stdin.close()          # EOF -> clean exit
+        w.wait(timeout=2)
+    except (OSError, subprocess.TimeoutExpired):
+        try:
+            w.kill()
+            w.wait(timeout=2)
+        except (OSError, subprocess.TimeoutExpired):
+            pass

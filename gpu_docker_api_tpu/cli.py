@@ -48,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="NAME=PATH",
                    help="extra volume storage tier (repeatable), e.g. "
                         "nfs=/mnt/nfs — the local-SSD/NFS data-disk split")
+    p.add_argument("--warm-pool", type=int, default=1, metavar="N",
+                   help="pre-imported Python workers for fast workload "
+                        "start (process backend; 0 disables; default 1)")
     return p
 
 
@@ -72,7 +75,7 @@ def main(argv=None) -> int:
         tiers[tname] = path
     app = App(state_dir=args.state_dir, backend=args.backend, addr=args.addr,
               port_range=parse_port_range(args.portRange), topology=topology,
-              volume_tiers=tiers)
+              volume_tiers=tiers, warm_pool=args.warm_pool)
     app.start()
 
     status = app.tpu.get_status()

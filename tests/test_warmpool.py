@@ -1,0 +1,161 @@
+"""Warm worker pool (backend/warmpool.py): fast workload start for the
+process substrate. Tests use preimport="json" — the mechanism is identical
+to the production preimport="jax" but costs milliseconds, per the suite's
+fake-substrate strategy (SURVEY §4)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gpu_docker_api_tpu.backend.process import ProcessBackend
+from gpu_docker_api_tpu.backend.warmpool import WarmPool
+from gpu_docker_api_tpu.dtos import ContainerSpec
+
+
+def wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_supports_classification():
+    py = sys.executable
+    assert WarmPool.supports([py, "-c", "pass"])
+    assert WarmPool.supports([py, "-u", "-c", "pass"])
+    assert WarmPool.supports([py, "-m", "json.tool"])
+    assert WarmPool.supports([py, "script.py", "arg"])
+    assert WarmPool.supports(["python3", "-c", "pass"])
+    assert not WarmPool.supports([])
+    assert not WarmPool.supports(["sleep", "5"])
+    assert not WarmPool.supports([py])                  # bare REPL
+    assert not WarmPool.supports([py, "-c"])            # missing code
+    assert not WarmPool.supports([py, "-X", "dev", "x.py"])  # unknown flag
+    # PYTHON* env is consumed at interpreter startup — warm can't honor it
+    assert not WarmPool.supports([py, "-c", "pass"], ["PYTHONPATH=/x"])
+    assert not WarmPool.supports([py, "-c", "pass"], ["PYTHONHASHSEED=0"])
+    assert WarmPool.supports([py, "-c", "pass"], ["FOO=bar", "PY=1"])
+
+
+@pytest.fixture()
+def warm_backend(tmp_path):
+    b = ProcessBackend(str(tmp_path / "b"), warm_pool=1,
+                       warm_preimport="json")
+    yield b
+    b.close()
+
+
+def _run(b, name, code, env=None, cpuset=""):
+    spec = ContainerSpec(image="", cmd=[sys.executable, "-c", code],
+                         env=env or [], cpuset=cpuset)
+    b.create(name, spec)
+    b.start(name)
+    return b.inspect(name)
+
+
+def test_warm_start_runs_in_pool_worker(warm_backend, tmp_path):
+    b = warm_backend
+    # the idle worker (spawned at pool init) is who must run the job
+    pool_pids = {w.pid for w in b._pool._idle}
+    st = _run(b, "c1", (
+        "import os, sys, json\n"
+        "rec = {'pid': os.getpid(), 'cwd': os.getcwd(),\n"
+        "       'argv': sys.argv, 'foo': os.environ.get('FOO'),\n"
+        "       'root': os.environ.get('CONTAINER_ROOT'),\n"
+        "       'stdin': sys.stdin.read(),\n"
+        "       'json_warm': 'json' in sys.modules}\n"
+        "open('marker.json', 'w').write(json.dumps(rec))\n"
+        "print('hello-from-warm')\n"
+    ), env=["FOO=bar"])
+    assert st.running
+    marker = os.path.join(st.upper_dir, "marker.json")
+    wait_for(lambda: os.path.exists(marker), msg="marker")
+    import json as _json
+    rec = _json.loads(open(marker).read())
+    assert rec["pid"] in pool_pids            # absorbed by the warm worker
+    assert rec["cwd"] == os.path.realpath(st.upper_dir) or \
+        rec["cwd"] == st.upper_dir
+    assert rec["argv"][0] == "-c"
+    assert rec["foo"] == "bar"                # spec env applied
+    assert rec["root"] == st.upper_dir        # grant env applied
+    assert rec["stdin"] == ""                 # stdin is EOF, not a hang
+    # stdout lands in the container log
+    wait_for(lambda: os.path.exists(b._get("c1").log_path), msg="log")
+    wait_for(lambda: "hello-from-warm" in open(b._get("c1").log_path).read(),
+             msg="log content")
+
+
+def test_warm_worker_is_stoppable_and_exit_code_seen(warm_backend):
+    b = warm_backend
+    st = _run(b, "c2", "import time\ntime.sleep(60)\n")
+    assert st.running
+    b.stop("c2", timeout=5)
+    st = b.inspect("c2")
+    assert not st.running
+    # a failing job surfaces its exit code through the same Popen
+    _run(b, "c3", "import sys\nsys.exit(7)\n")
+    wait_for(lambda: not b.inspect("c3").running, msg="c3 exit")
+    assert b.inspect("c3").exit_code == 7
+
+
+def test_pool_refills_after_take(warm_backend):
+    b = warm_backend
+    _run(b, "c4", "pass")
+    wait_for(lambda: len(b._pool._idle) >= 1, msg="pool refill")
+
+
+def test_dead_worker_falls_back_to_cold_spawn(warm_backend, tmp_path):
+    b = warm_backend
+    wait_for(lambda: len(b._pool._idle) >= 1, msg="initial worker")
+    for w in list(b._pool._idle):
+        w.kill()
+        w.wait(timeout=5)
+    st = _run(b, "c5", (
+        "open('cold.txt', 'w').write('ran')\n"
+    ))
+    marker = os.path.join(st.upper_dir, "cold.txt")
+    wait_for(lambda: os.path.exists(marker), msg="cold marker")
+    # a popped-dead worker must be REPLACED, not shrink the pool forever
+    wait_for(lambda: len(b._pool._idle) >= 1, msg="refill after dead worker")
+
+
+def test_pythonpath_env_bypasses_pool(warm_backend):
+    """PYTHONPATH is read at interpreter startup: the job must cold-spawn
+    (where it works), never run on a warm worker (where it can't)."""
+    b = warm_backend
+    pool_pids = {w.pid for w in b._pool._idle}
+    st = _run(b, "c7", (
+        "import os, sys\n"
+        "ok = '/warm-test-libs' in sys.path\n"
+        "open('pp.txt', 'w').write(f'{os.getpid()} {ok}')\n"
+    ), env=["PYTHONPATH=/warm-test-libs"])
+    marker = os.path.join(st.upper_dir, "pp.txt")
+    wait_for(lambda: os.path.exists(marker), msg="pp marker")
+    pid, ok = open(marker).read().split()
+    assert int(pid) not in pool_pids
+    assert ok == "True"                        # the var actually took effect
+
+
+def test_non_python_cmd_bypasses_pool(warm_backend):
+    b = warm_backend
+    spec = ContainerSpec(image="", cmd=["sleep", "30"])
+    b.create("c6", spec)
+    b.start("c6")
+    assert b.inspect("c6").running
+    b.stop("c6", timeout=5)
+
+
+def test_pool_close_reaps_workers(tmp_path):
+    b = ProcessBackend(str(tmp_path / "b2"), warm_pool=2,
+                       warm_preimport="json")
+    wait_for(lambda: len(b._pool._idle) == 2, msg="two workers")
+    workers = list(b._pool._idle)
+    b.close()
+    for w in workers:
+        assert w.poll() is not None           # exited (EOF on stdin)
+    assert b._pool.take() is None             # closed pool hands out nothing
