@@ -365,20 +365,24 @@ def decode_bench() -> dict:
     from gpu_docker_api_tpu.infer import generate
     from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
 
+    from gpu_docker_api_tpu.ops.quant import quantize_params
+
     cfg = LlamaConfig.llama_mini()
     params = init_params(cfg, jax.random.key(0))
     batch, prompt_len, max_new = 8, 128, 128
     prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
                                 cfg.vocab_size, jnp.int32)
-    t0 = time.perf_counter()
-    out = generate(params, prompt, cfg, max_new)
-    jax.device_get(out)
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = generate(params, prompt, cfg, max_new)
-    jax.device_get(out)
-    dt = time.perf_counter() - t0
-    return {
+
+    def run(p):
+        t0 = time.perf_counter()
+        jax.device_get(generate(p, prompt, cfg, max_new))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.device_get(generate(p, prompt, cfg, max_new))
+        return time.perf_counter() - t0, compile_s
+
+    dt, compile_s = run(params)
+    rec = {
         "model": "llama_mini", "batch": batch,
         "prompt_len": prompt_len, "max_new": max_new,
         # end-to-end: the clock covers the prompt prefill AND the decode
@@ -386,6 +390,11 @@ def decode_bench() -> dict:
         "generate_tokens_per_sec": round(batch * max_new / dt),
         "wall_s": round(dt, 3), "compile_s": round(compile_s, 1),
     }
+    # int8 weight-only serving path (ops/quant.py): same clock, quantized
+    dt_q, _ = run(jax.jit(lambda p: quantize_params(p, "w8"))(params))
+    rec["w8_tokens_per_sec"] = round(batch * max_new / dt_q)
+    rec["w8_speedup"] = round(dt / dt_q, 2)
+    return rec
 
 
 def store_bench() -> dict:

@@ -35,6 +35,7 @@ from .models.llama import (
     LlamaConfig, apply_rope, rms_norm, rope_frequencies,
 )
 from .models.moe import MoEConfig, moe_block
+from .ops.quant import qmatmul
 
 
 def _llama_view(config) -> LlamaConfig:
@@ -126,9 +127,10 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
     c = _llama_view(config)
     b, t, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
-    k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
-    v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    # qmatmul == `@` for dense arrays; int8 path for quantized serving
+    q = qmatmul(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = qmatmul(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = qmatmul(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
@@ -136,14 +138,15 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                            (0, pos, 0, 0))
     out = _attend_cached(q, cache_k, cache_v, pos)
-    x = x + out.reshape(b, t, c.n_heads * c.head_dim) @ layer["wo"]
+    x = x + qmatmul(out.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
 
     # family-specific FFN: MoE layers carry expert banks, llama a dense MLP
     if "we1" in layer:
         x, _, _ = moe_block(x, layer, config)
     else:
         hm = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-        x = x + (jax.nn.silu(hm @ layer["w1"]) * (hm @ layer["w3"])) @ layer["w2"]
+        x = x + qmatmul(jax.nn.silu(qmatmul(hm, layer["w1"]))
+                        * qmatmul(hm, layer["w3"]), layer["w2"])
     return x, cache_k, cache_v
 
 
@@ -165,7 +168,7 @@ def _forward_cached(params, tokens, cache, config):
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs, "length": pos + t}
 
 

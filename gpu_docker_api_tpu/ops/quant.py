@@ -1,0 +1,123 @@
+"""Int8 quantization for the inference path, TPU-first.
+
+Two modes, chosen per deployment (workloads/serve.py --quantize):
+
+- "w8"  — weight-only int8: weights live in HBM as int8 + a per-output-
+  channel f32 scale; the matmul runs bf16 with the int8->bf16 convert fused
+  into the dot's operand read and the scale applied to the OUTPUT (exact
+  same numerics as dequantize-first, since the scale is per out-channel and
+  factors out of the contraction). Decode is HBM-bandwidth-bound — halving
+  weight bytes is the win that matters there.
+- "w8a8" — dynamic per-row activation quantization on top of w8: both
+  operands int8, accumulated in int32 on the MXU's int8 path (2x the bf16
+  peak on v5e/v6e), rescaled by (row_scale x col_scale). The compute-bound
+  prefill's mode.
+
+Symmetric quantization (no zero point): scale = amax/127 over the
+contraction axis, per output channel — the standard recipe (e.g. AQT,
+jax-ml). The embedding gather and norms stay unquantized; quantize_params
+converts the projection/MLP/lm_head leaves of a params tree in place.
+
+No reference counterpart (the reference schedules containers, never opens
+a tensor — SURVEY §2); this is workload-runtime surface the TPU build adds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+MODES = ("w8", "w8a8")
+# weight keys quantize_params converts when present (llama projections/MLP;
+# MoE expert banks stay dense — their einsum layout is a later target)
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class QTensor:
+    """int8 weight + f32 per-output-channel scale; a pytree, so it flows
+    through jit/scan/sharding like the dense array it replaces.
+
+    q: int8, the original weight's layout ([in, out] or [L, in, out]);
+    s: f32 [out] (or [L, out]) — amax/127 over the contraction axis;
+    mode: "w8" | "w8a8" (static: part of the tree structure)."""
+    q: jax.Array
+    s: jax.Array
+    mode: str = "w8"
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.mode
+
+    @classmethod
+    def tree_unflatten(cls, mode, children):
+        return cls(*children, mode=mode)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize(w: jax.Array, mode: str = "w8") -> QTensor:
+    """Symmetric int8 per-out-channel quantization of a weight matrix
+    [in, out] or a layer-stacked [L, in, out] (contraction axis = -2)."""
+    if mode not in MODES:
+        raise ValueError(f"mode {mode!r} not in {MODES}")
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    s = jnp.maximum(amax, 1e-8) / 127.0                    # [..., out]
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s, mode=mode)
+
+
+def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (qt.q.astype(jnp.float32) * qt.s[..., None, :]).astype(dtype)
+
+
+def qmatmul(x: jax.Array, w) -> jax.Array:
+    """x [..., in] @ w — drop-in for `x @ w` that also accepts a QTensor
+    ([in, out] only; scan unstacks the layer axis before this runs)."""
+    if not isinstance(w, QTensor):
+        return x @ w
+    if w.mode == "w8a8":
+        # dynamic per-row activation quantization -> int8 MXU path
+        ax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        sx = jnp.maximum(ax, 1e-8) / 127.0                 # [..., 1]
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx),
+                      -127, 127).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            xq, w.q, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [..., out] i32
+        return (y.astype(jnp.float32) * sx * w.s).astype(x.dtype)
+    # w8: int8->bf16 convert fuses into the dot; per-out-channel scale
+    # factors out of the contraction, so it applies to the OUTPUT
+    y = jax.lax.dot_general(
+        x, w.q.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (y * w.s).astype(x.dtype)
+
+
+def quantize_params(params: dict, mode: str = "w8") -> dict:
+    """Quantize the matmul weights of a family params tree for inference:
+    every QUANT_KEYS leaf under params["layers"] plus lm_head. Embedding
+    (gather), norms (f32 vectors), and MoE expert banks stay dense."""
+    if mode not in MODES:
+        raise ValueError(f"mode {mode!r} not in {MODES}")
+    layers = dict(params["layers"])
+    for k in QUANT_KEYS:
+        if k in layers:
+            layers[k] = quantize(layers[k], mode)
+    out = dict(params)
+    out["layers"] = layers
+    out["lm_head"] = quantize(params["lm_head"], mode)
+    return out
+
+
+def is_quantized(params: dict) -> bool:
+    return isinstance(params.get("lm_head"), QTensor)

@@ -172,10 +172,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--family", default="llama", choices=["llama", "moe"])
     p.add_argument("--config", default="tiny",
-                   choices=["tiny", "mini", "llama3_8b", "mixtral_8x7b"])
+                   help="named config for the family (models.NAMED_CONFIGS; "
+                        "e.g. tiny, mini, 250m, llama3_8b, mixtral_8x7b)")
     p.add_argument("--checkpoint", default="",
                    help="orbax checkpoint dir (e.g. the training workload's "
                         "<workdir>/checkpoints); fresh init when empty")
+    p.add_argument("--quantize", default="", choices=["", "w8", "w8a8"],
+                   help="int8 post-load quantization of the matmul weights "
+                        "(ops/quant.py): w8 = weight-only (HBM-bound "
+                        "decode), w8a8 = +dynamic activation int8 (MXU)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -196,6 +201,15 @@ def main(argv=None) -> int:
     import jax
     trainer = Trainer.create(config, MeshPlan(), devices=jax.devices()[:1])
     params = _maybe_ungroup(_load_params(trainer, args.checkpoint), config)
+    if args.quantize:
+        from ..ops.quant import quantize_params
+        # donate the dense tree: without it the bf16 params AND the int8
+        # copy are live together and the llama3_8b-on-16GB case this flag
+        # exists for OOMs during startup
+        params = jax.jit(lambda p: quantize_params(p, args.quantize),
+                         donate_argnums=0)(params)
+        print(f"quantized matmul weights to int8 ({args.quantize})",
+              flush=True)
     srv = _Server(config, params)
 
     name = f"{args.family}/{args.config}"

@@ -1,0 +1,106 @@
+"""Int8 quantization (ops/quant.py): roundtrip error bounds, qmatmul
+equivalences, and the quantized end-to-end inference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_docker_api_tpu.infer import generate, init_cache, prefill
+from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+from gpu_docker_api_tpu.ops.quant import (
+    QTensor, dequantize, is_quantized, qmatmul, quantize, quantize_params,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.key(0), (64, 48), jnp.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.s.shape == (48,)
+    # symmetric per-channel: |error| <= scale/2 per element
+    err = np.abs(np.asarray(dequantize(qt, jnp.float32)) - np.asarray(w))
+    assert (err <= np.asarray(qt.s)[None, :] * 0.5 + 1e-6).all()
+
+
+def test_quantize_stacked_layers_axis():
+    w = jax.random.normal(jax.random.key(1), (3, 16, 8), jnp.float32)
+    qt = quantize(w)
+    assert qt.s.shape == (3, 8)          # per-layer, per-out-channel
+    err = np.abs(np.asarray(dequantize(qt, jnp.float32)) - np.asarray(w))
+    assert (err <= np.asarray(qt.s)[:, None, :] * 0.5 + 1e-6).all()
+
+
+def test_qmatmul_dense_passthrough():
+    x = jax.random.normal(jax.random.key(2), (4, 16), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (16, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(qmatmul(x, w)),
+                               np.asarray(x @ w), rtol=1e-6)
+
+
+def test_qmatmul_w8_equals_dequantized_matmul():
+    """Output-side scaling must be numerically equivalent to dequantizing
+    the weight first (the scale factors out of the contraction)."""
+    x = jax.random.normal(jax.random.key(4), (4, 32), jnp.float32)
+    w = jax.random.normal(jax.random.key(5), (32, 16), jnp.float32)
+    qt = quantize(w, "w8")
+    got = np.asarray(qmatmul(x, qt))
+    want = np.asarray(x @ dequantize(qt, jnp.float32))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    # and both are close to the dense product
+    np.testing.assert_allclose(got, np.asarray(x @ w), rtol=0.15, atol=0.15)
+
+
+def test_qmatmul_w8a8_close_to_dense():
+    x = jax.random.normal(jax.random.key(6), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(7), (64, 16), jnp.float32)
+    qt = quantize(w, "w8a8")
+    got = np.asarray(qmatmul(x, qt))
+    want = np.asarray(x @ w)
+    # dynamic 8-bit on both sides: ~1% relative error on gaussian data
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.05
+
+
+def test_qtensor_is_a_pytree_through_jit():
+    w = jax.random.normal(jax.random.key(8), (16, 8), jnp.float32)
+    qt = quantize(w)
+    out = jax.jit(lambda q: qmatmul(jnp.ones((2, 16), jnp.float32), q))(qt)
+    assert out.shape == (2, 8)
+    leaves, treedef = jax.tree.flatten(qt)
+    assert len(leaves) == 2              # q + s; mode rides the treedef
+    qt2 = jax.tree.unflatten(treedef, leaves)
+    assert qt2.mode == qt.mode
+
+
+@pytest.mark.parametrize("mode", ["w8", "w8a8"])
+def test_quantized_prefill_logits_close(mode):
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params, mode)
+    assert is_quantized(qparams) and not is_quantized(params)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    dense_logits, _ = prefill(params, toks, init_cache(cfg, 2, 32), cfg)
+    q_logits, _ = prefill(qparams, toks, init_cache(cfg, 2, 32), cfg)
+    d, q = np.asarray(dense_logits), np.asarray(q_logits)
+    # logits track the dense model closely relative to their spread
+    assert np.abs(q - d).max() / (np.abs(d).max() + 1e-9) < 0.08
+    # and the top-1 token mostly survives quantization
+    agree = (d.argmax(-1) == q.argmax(-1)).mean()
+    assert agree >= 0.5, f"top-1 agreement {agree}"
+
+
+def test_quantized_generate_runs_greedy():
+    cfg = LlamaConfig.tiny()
+    params = quantize_params(init_params(cfg, jax.random.key(0)), "w8")
+    prompt = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg.vocab_size)
+    out = generate(params, prompt, cfg, max_new=6)
+    assert out.shape == (2, 6)
+    assert (np.asarray(out) >= 0).all()
+    assert (np.asarray(out) < cfg.vocab_size).all()
+
+
+def test_quantize_params_rejects_unknown_mode():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError):
+        quantize_params(params, "int4")
