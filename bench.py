@@ -339,9 +339,12 @@ def flash_bench() -> dict:
                 c, _ = jax.lax.scan(body, q0, None, length=N)
                 return jnp.sum(c.astype(jnp.float32))
             float(chain(q))                       # compile + warm
-            t0 = time.perf_counter()
-            float(chain(q))                       # host fetch = real sync
-            return (time.perf_counter() - t0) / N
+            best = float("inf")
+            for _ in range(3):                    # min-of-3: one tunnel
+                t0 = time.perf_counter()          # latency spike must not
+                float(chain(q))                   # masquerade as kernel time
+                best = min(best, time.perf_counter() - t0)
+            return best / N
 
         t_flash = timed(flash_attention)
         t_xla = timed(reference_attention)

@@ -92,9 +92,9 @@ class TpuScheduler(Scheduler):
             if len(free) < n:
                 raise xerrors.TpuNotEnoughError(
                     f"want {n}, only {len(free)} of {len(self.status)} free")
-            grant = self._find_box(n, free)
+            grant = self._find_box(n, free, prefer=reusable)
             if grant is None:
-                grant = self._find_connected(n, free)
+                grant = self._find_connected(n, free, prefer=reusable)
             if grant is None:
                 if not self.allow_fragmented:
                     raise xerrors.TpuNotEnoughError(
@@ -132,19 +132,25 @@ class TpuScheduler(Scheduler):
 
     # ---- placement search ----
 
-    def _find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
+    def _find_box(self, n: int, free: set[int],
+                  prefer: Optional[set[int]] = None) -> Optional[list[int]]:
         """Best free axis-aligned box of volume n: compact dims first, then
-        the most packed placement (fewest free ICI neighbors outside the box
-        — keeps the remaining free space contiguous). Uses the C++ core
-        (native/topology_alloc.cc) when available on non-torus meshes."""
+        max overlap with `prefer` (the lift-in-place chips on a patch —
+        SURVEY §7 hard part 1: the new grant should CONTAIN the old one
+        when an equally good box does), then the most packed placement
+        (fewest free ICI neighbors outside the box — keeps the remaining
+        free space contiguous). Uses the C++ core (native/topology_alloc.cc)
+        when available on non-torus meshes."""
+        prefer = prefer or set()
         native = self._native_find_box(n, free)
         if native is not None:
             if not native:
                 return None      # core searched the same space: no box exists
-            # the core doesn't score worker spans — accept its pick when it
-            # can't be beaten on that axis (fits one worker), else re-rank
-            # with the span-aware Python search
-            if len(self.topology.workers_spanned(native)) == 1:
+            # the core doesn't score worker spans or reuse overlap — accept
+            # its pick only when neither axis could rank another box higher
+            # (full prefer containment can't be beaten on the overlap axis)
+            if (prefer <= set(native)
+                    and len(self.topology.workers_spanned(native)) == 1):
                 return native
         best: Optional[list[int]] = None
         best_key: Optional[tuple] = None
@@ -164,7 +170,8 @@ class TpuScheduler(Scheduler):
             # fewest TPU VM workers spanned first: an intra-host grant needs
             # no cross-host process mesh (and one container, not K)
             span = len(topo.workers_spanned(idx))
-            key = (span, sa, ext_free, origin[2], origin[1], origin[0])
+            key = (span, sa, -len(box & prefer), ext_free,
+                   origin[2], origin[1], origin[0])
             if best_key is None or key < best_key:
                 best_key = key
                 best = idx
@@ -190,9 +197,12 @@ class TpuScheduler(Scheduler):
         ok = lib.topo_find_box(sx, sy, sz, status, n, out)
         return [int(out[i]) for i in range(n)] if ok else []
 
-    def _find_connected(self, n: int, free: set[int]) -> Optional[list[int]]:
+    def _find_connected(self, n: int, free: set[int],
+                        prefer: Optional[set[int]] = None,
+                        ) -> Optional[list[int]]:
         """Connected free set of n chips via greedy BFS from each free seed,
-        preferring tight bounding boxes.
+        preferring sets that overlap `prefer` (lift-in-place chips), then
+        tight bounding boxes.
 
         COMPLETE for existence: from each seed the loop keeps absorbing
         frontier neighbors until either n chips are picked or the seed's
@@ -204,18 +214,22 @@ class TpuScheduler(Scheduler):
         to absorb next); tests/test_schedulers.py pins both properties on
         snake- and L-shaped free regions."""
         topo = self.topology
+        prefer = prefer or set()
         best: Optional[list[int]] = None
-        best_vol: Optional[int] = None
-        for seed in sorted(free):
+        best_key: Optional[tuple] = None
+        # prefer-chips seed first: absorption growing out of the old grant
+        # maximizes its chance of being contained
+        for seed in sorted(free, key=lambda i: (i not in prefer, i)):
             picked = [seed]
             frontier = [nb.index for nb in topo.neighbors(topo.chip(seed))
                         if nb.index in free]
             seen = {seed}
             while len(picked) < n and frontier:
-                # pick the frontier chip keeping the bounding box tightest
-                def vol_with(i: int) -> int:
+                # pick the frontier chip keeping the bounding box tightest,
+                # prefer-chips breaking ties
+                def vol_with(i: int) -> tuple:
                     coords = [topo.chip(p).coord for p in picked] + [topo.chip(i).coord]
-                    return _bbox_volume(coords)
+                    return (_bbox_volume(coords), i not in prefer)
                 frontier.sort(key=vol_with)
                 nxt = frontier.pop(0)
                 if nxt in seen:
@@ -227,11 +241,12 @@ class TpuScheduler(Scheduler):
                         frontier.append(nb.index)
             if len(picked) == n:
                 vol = _bbox_volume([topo.chip(p).coord for p in picked])
-                if best_vol is None or vol < best_vol:
-                    best_vol = vol
+                key = (-len(set(picked) & prefer), vol)
+                if best_key is None or key < best_key:
+                    best_key = key
                     best = picked
-                if best_vol == n:  # can't do better than a perfect box
-                    break
+                if best_key == (-min(len(prefer), n), n):
+                    break     # full overlap at perfect-box volume: optimal
         return best
 
     # ---- status / env ----

@@ -291,3 +291,63 @@ def test_restored_state_infers_chips_per_host(client):
     assert s2.topology.chips_per_host == 8
     assert s2.topology.worker_of(7) == 0    # flat-4 default would say 1
     assert s2.topology.worker_of(8) == 1
+
+
+def test_tpu_patch_grant_contains_reused_chips(client):
+    """Lift-in-place (SURVEY §7 hard part 1): growing a grant 1->4 must
+    return a placement CONTAINING the old chip when an equally compact box
+    through it exists — not an arbitrary equal-quality box elsewhere."""
+    s = TpuScheduler(client, topology=make_topology("v4-32"))  # 2x2x4
+    old = s.apply(1, owner="rs")
+    # all other chips still free: many 2x2x1 slabs tie on compactness
+    grown = s.apply(4, owner="rs", reuse=old)
+    assert set(old) <= set(grown)
+    assert s.topology.is_connected(grown)
+    # and at the far end of the mesh too (not just the default origin)
+    s2 = TpuScheduler(None, topology=make_topology("v4-32"))
+    far = [max(s2.status)]                     # last chip, z=3 corner
+    s2.status[far[0]] = "rs2"
+    grown2 = s2.apply(4, owner="rs2", reuse=far)
+    assert set(far) <= set(grown2)
+
+
+def test_tpu_connected_fallback_prefers_reused(client, monkeypatch):
+    """When no box exists, the connected search must still grow out of the
+    reused chips rather than assembling a fresh set elsewhere."""
+    topo = make_topology("v4-32")
+    s = TpuScheduler(client, topology=topo)
+    # occupy everything except an L of 3 through the old chip and a
+    # disjoint equally-good free region: no 3-box survives, so the grant
+    # must come from _find_connected, and the overlap preference must make
+    # it grow out of the old chip instead of the other region
+    old_chip = 0
+    l_around_old = {i.index for i in topo.neighbors(topo.chip(old_chip))}
+    l_around_old = {old_chip} | set(sorted(l_around_old)[:2])
+    far = max(s.status)
+    l_far = {i.index for i in topo.neighbors(topo.chip(far))}
+    l_far = {far} | set(sorted(l_far)[:2])
+    for idx in s.status:
+        if idx not in (l_around_old | l_far):
+            s.status[idx] = "other"
+    s.status[old_chip] = "rs"
+    called = {}
+    orig = s._find_connected
+    def spy(n, free, prefer=None):
+        called["yes"] = True
+        return orig(n, free, prefer)
+    monkeypatch.setattr(s, "_find_connected", spy)
+    grown = s.apply(3, owner="rs", reuse=[old_chip])
+    assert called.get("yes"), "grant was satisfied by a box; the scenario " \
+        "must exercise the connected fallback"
+    assert old_chip in set(grown)
+    assert set(grown) <= l_around_old          # grew out of the old chip
+    assert topo.is_connected(grown)
+
+
+def test_tpu_shrink_reuse_keeps_subset(client):
+    """Shrinking 4->2 with reuse must grant a subset of the old chips (all
+    of the new grant was already owned — zero churn)."""
+    s = TpuScheduler(client, topology=make_topology("v4-32"))
+    old = s.apply(4, owner="rs")
+    small = s.apply(2, owner="rs", reuse=old)
+    assert set(small) <= set(old)
