@@ -394,9 +394,38 @@ def decode_bench() -> dict:
         "wall_s": round(dt, 3), "compile_s": round(compile_s, 1),
     }
     # int8 weight-only serving path (ops/quant.py): same clock, quantized
-    dt_q, _ = run(jax.jit(lambda p: quantize_params(p, "w8"))(params))
+    qparams = jax.jit(lambda p: quantize_params(p, "w8"))(params)
+    dt_q, _ = run(qparams)
     rec["w8_tokens_per_sec"] = round(batch * max_new / dt_q)
     rec["w8_speedup"] = round(dt / dt_q, 2)
+
+    # long-context decode on llama_250m: there the KV cache (~300MB at
+    # B=8, S=2304) rivals the int8 weights in per-step HBM traffic, so the
+    # int8 cache (kv_quant) A/B is representative — on llama_mini the
+    # cache is 21MB and kv8's dequant VPU work wins nothing
+    lcfg = LlamaConfig.llama_250m()
+    lq = jax.jit(lambda p: quantize_params(p, "w8"))(
+        init_params(lcfg, jax.random.key(3)))
+    long_prompt = jax.random.randint(jax.random.key(2), (8, 2048), 0,
+                                     lcfg.vocab_size, jnp.int32)
+
+    def run_long(kv_quant: bool) -> float:
+        def go():
+            return generate(lq, long_prompt, lcfg, 256, kv_quant=kv_quant)
+        jax.device_get(go())
+        t0 = time.perf_counter()
+        jax.device_get(go())
+        return time.perf_counter() - t0
+
+    dt_l = run_long(False)
+    dt_lq = run_long(True)
+    rec["long"] = {
+        "model": "llama_250m+w8",
+        "prompt_len": 2048, "max_new": 256, "batch": 8,
+        "tokens_per_sec": round(8 * 256 / dt_l),
+        "kv8_tokens_per_sec": round(8 * 256 / dt_lq),
+        "kv8_speedup": round(dt_l / dt_lq, 2),
+    }
     return rec
 
 

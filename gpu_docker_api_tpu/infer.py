@@ -42,21 +42,47 @@ def _llama_view(config) -> LlamaConfig:
     return config.as_llama() if isinstance(config, MoEConfig) else config
 
 
-_DEVICE_KEYS = ("k", "v", "length")
+def _device_keys(cache) -> tuple:
+    return tuple(k for k in cache if k != "host_length")
 
 
-def init_cache(config, batch: int, max_len: int) -> dict:
+def init_cache(config, batch: int, max_len: int,
+               quantized: bool = False) -> dict:
     """Zeroed KV cache for `batch` sequences of up to `max_len` tokens.
     `host_length` mirrors `length` as a plain int so the overflow guard in
-    prefill/decode_step never has to sync the device scalar."""
+    prefill/decode_step never has to sync the device scalar.
+
+    quantized=True stores K/V as int8 with a per-token-per-head f32 scale
+    ("ks"/"vs") — decode is HBM-bandwidth-bound on the cache reads, so
+    halving the bytes per token is a direct throughput/therefore-context
+    win; blocks dequantize in-register inside the attend loop."""
     c = _llama_view(config)
     shape = (config.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    if not quantized:
+        return {
+            "k": jnp.zeros(shape, c.dtype),
+            "v": jnp.zeros(shape, c.dtype),
+            "length": jnp.zeros((), jnp.int32),
+            "host_length": 0,
+        }
+    sshape = shape[:-1] + (1,)
     return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "ks": jnp.ones(sshape, jnp.float32),
+        "vs": jnp.ones(sshape, jnp.float32),
         "length": jnp.zeros((), jnp.int32),
         "host_length": 0,
     }
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-token-per-head symmetric int8: x [B,T,Hkv,D] -> (q int8, scale
+    f32 [B,T,Hkv,1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
 
 
 def _block_for(s_max: int, preferred: int = 128) -> int:
@@ -73,7 +99,7 @@ def blocks_used(pos, t: int, blk: int):
     return (pos + t + blk - 1) // blk
 
 
-def _attend_cached(q, k_all, v_all, pos):
+def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1; k/v_all [B,S_max,
     Hkv,D]. Length-aware blockwise attention over the cache buffer: a
     lax.fori_loop with DYNAMIC trip count ceil((pos+T)/blk) runs
@@ -82,6 +108,9 @@ def _attend_cached(q, k_all, v_all, pos):
     and HBM reads scale with the used prefix, not with S_max, while `pos`
     stays data (one compiled step for every position). Blocks past the
     frontier are never read (VERDICT r1 weak #5).
+
+    With k_scale/v_scale (int8 cache — [B,S_max,Hkv,1] f32), blocks are
+    read from HBM at half the bytes and dequantized in-register here.
 
     GQA: K/V are consumed at the Hkv head count; q is viewed as
     [B,T,Hkv,G,D] so no repeated K/V is ever materialized."""
@@ -93,11 +122,19 @@ def _attend_cached(q, k_all, v_all, pos):
     qf = (q.astype(jnp.float32) / math.sqrt(d)).reshape(b, t, hkv, group, d)
     rows = pos + jnp.arange(t)                               # absolute q pos
 
+    def _deq(xb, scale_all, i):
+        if scale_all is None:
+            return xb.astype(jnp.float32)
+        sb = jax.lax.dynamic_slice_in_dim(scale_all, i * blk, blk, axis=1)
+        return xb.astype(jnp.float32) * sb
+
     def body(i, carry):
         acc, m, l = carry
         kb = jax.lax.dynamic_slice_in_dim(k_all, i * blk, blk, axis=1)
         vb = jax.lax.dynamic_slice_in_dim(v_all, i * blk, blk, axis=1)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kb.astype(jnp.float32))
+        kb = _deq(kb, k_scale, i)
+        vb = _deq(vb, v_scale, i)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kb)
         cols = i * blk + jnp.arange(blk)
         s = jnp.where((cols[None, :] <= rows[:, None])[None, None, None],
                       s, -jnp.inf)
@@ -106,8 +143,7 @@ def _attend_cached(q, k_all, v_all, pos):
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p,
-                                       vb.astype(jnp.float32))
+        acc = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p, vb)
         return acc, m_new, l
 
     acc0 = jnp.zeros((b, hkv, group, t, d), jnp.float32)
@@ -120,10 +156,12 @@ def _attend_cached(q, k_all, v_all, pos):
     return out.astype(q.dtype)
 
 
-def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
+def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin,
+                scale_k=None, scale_v=None):
     """One decoder layer over a T-token slice with cache read+write.
     x [B,T,D]; cache_k/v [B,S_max,Hkv,D]; pos = absolute start position.
-    Returns (x_out, new_cache_k, new_cache_v)."""
+    With scale_k/scale_v (int8 cache), new K/V quantize on write.
+    Returns (x_out, new caches...) — 3-tuple dense, 5-tuple quantized."""
     c = _llama_view(config)
     b, t, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
@@ -133,11 +171,18 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
     v = qmatmul(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    if scale_k is not None:
+        k, ks_new = _quantize_kv(k)
+        v, vs_new = _quantize_kv(v)
+        scale_k = jax.lax.dynamic_update_slice(scale_k, ks_new,
+                                               (0, pos, 0, 0))
+        scale_v = jax.lax.dynamic_update_slice(scale_v, vs_new,
+                                               (0, pos, 0, 0))
     cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
                                            (0, pos, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                            (0, pos, 0, 0))
-    out = _attend_cached(q, cache_k, cache_v, pos)
+    out = _attend_cached(q, cache_k, cache_v, pos, scale_k, scale_v)
     x = x + qmatmul(out.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
 
     # family-specific FFN: MoE layers carry expert banks, llama a dense MLP
@@ -147,6 +192,8 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin):
         hm = rms_norm(x, layer["mlp_norm"], c.norm_eps)
         x = x + qmatmul(jax.nn.silu(qmatmul(hm, layer["w1"]))
                         * qmatmul(hm, layer["w3"]), layer["w2"])
+    if scale_k is not None:
+        return x, cache_k, cache_v, scale_k, scale_v
     return x, cache_k, cache_v
 
 
@@ -159,17 +206,22 @@ def _forward_cached(params, tokens, cache, config):
     x = jnp.take(params["embed"], tokens, axis=0)
     positions = pos + jnp.arange(t)
     cos, sin = rope_frequencies(c, positions)
+    quantized = "ks" in cache
+    xs = (params["layers"], cache["k"], cache["v"]) + (
+        (cache["ks"], cache["vs"]) if quantized else ())
 
     def body(x, scanned):
-        layer, ck, cv = scanned
-        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin)
-        return x, (ck, cv)
+        layer, *kv = scanned
+        x, *kv = _layer_step(x, layer, *kv[:2], pos, config, cos, sin,
+                             *kv[2:])
+        return x, tuple(kv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    x, kv_out = jax.lax.scan(body, x, xs)
+    new_cache = dict(zip(("k", "v", "ks", "vs"), kv_out))
+    new_cache["length"] = pos + t
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": ks, "v": vs, "length": pos + t}
+    return logits, new_cache
 
 
 def _checked_length(cache, new_tokens: int):
@@ -197,7 +249,7 @@ def _checked_length(cache, new_tokens: int):
 
 
 def _device_view(cache) -> dict:
-    return {k: cache[k] for k in _DEVICE_KEYS}
+    return {k: cache[k] for k in _device_keys(cache)}
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
@@ -253,17 +305,19 @@ def _filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("config", "max_new", "temperature",
-                                   "top_k", "top_p"))
+                                   "top_k", "top_p", "kv_quant"))
 def generate(params, prompt, config, max_new: int,
              temperature: float = 0.0,
              key: Optional[jax.Array] = None,
-             top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+             top_k: int = 0, top_p: float = 1.0,
+             kv_quant: bool = False) -> jax.Array:
     """prompt [B, T] -> generated tokens [B, max_new]. Greedy when
     temperature == 0, else categorical sampling with optional top-k and/or
     nucleus (top-p) filtering. The decode loop is one lax.scan — compiled
-    once, no host round-trips per token."""
+    once, no host round-trips per token. kv_quant=True holds the KV cache
+    in int8 (half the decode-loop HBM traffic)."""
     b, t = prompt.shape
-    cache = init_cache(config, b, t + max_new)
+    cache = init_cache(config, b, t + max_new, quantized=kv_quant)
     logits, cache = _forward_cached(params, prompt, cache, config)
     logits = logits[:, -1]
     if key is None:

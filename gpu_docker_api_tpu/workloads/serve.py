@@ -76,9 +76,10 @@ def _maybe_ungroup(params: dict, config) -> dict:
 
 
 class _Server:
-    def __init__(self, config, params):
+    def __init__(self, config, params, kv_quant: bool = False):
         self.config = config
         self.params = params
+        self.kv_quant = kv_quant
         self.lock = threading.Lock()   # single-flight: one chip
         import jax
         self.n_params = sum(p.size for p in jax.tree.leaves(params))
@@ -99,6 +100,7 @@ class _Server:
             out = generate(self.params, prompt, self.config, int(max_new),
                            temperature=float(temperature),
                            top_k=int(top_k), top_p=float(top_p),
+                           kv_quant=self.kv_quant,
                            key=jax.random.key(int.from_bytes(
                                os.urandom(4), "big")))
         return jax.device_get(out).tolist()
@@ -181,6 +183,10 @@ def main(argv=None) -> int:
                    help="int8 post-load quantization of the matmul weights "
                         "(ops/quant.py): w8 = weight-only (HBM-bound "
                         "decode), w8a8 = +dynamic activation int8 (MXU)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache: half the decode-loop HBM traffic "
+                        "(per-token-per-head scales, dequantized in the "
+                        "attend loop)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -210,7 +216,7 @@ def main(argv=None) -> int:
                          donate_argnums=0)(params)
         print(f"quantized matmul weights to int8 ({args.quantize})",
               flush=True)
-    srv = _Server(config, params)
+    srv = _Server(config, params, kv_quant=args.kv_quant)
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),
