@@ -350,3 +350,103 @@ def generate(params, prompt, config, max_new: int,
     (_, _), toks = jax.lax.scan(step, (first, cache), keys)
     return jnp.concatenate([first[:, None], jnp.swapaxes(toks, 0, 1)],
                            axis=1)  # [B, max_new]
+
+
+# ---- speculative decoding --------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config", "draft_config", "max_new",
+                                   "gamma", "kv_quant"))
+def speculative_generate(params, draft_params, prompt, config, draft_config,
+                         max_new: int, gamma: int = 4,
+                         kv_quant: bool = False):
+    """Greedy speculative decoding (Leviathan et al. 2211.17192, greedy
+    case): a cheap draft model proposes `gamma` tokens autoregressively,
+    the target verifies all of them in ONE cached forward of gamma+1
+    positions — decode is weight-HBM-bound, so the verify forward costs
+    about one decode step while scoring gamma+1 positions. Greedy
+    acceptance keeps the longest proposal prefix matching the target's
+    argmax and takes the target's token at the first divergence, so the
+    OUTPUT IS EXACTLY the target-only greedy stream for ANY draft — the
+    draft's quality only changes the speed (accepted tokens/round).
+
+    B=1 (latency-oriented; rows would need per-row cache lengths). The
+    whole thing is one jitted lax.while_loop over rounds: no host
+    round-trips, all shapes static, cache `length` is data.
+
+    Returns (tokens [1, max_new], stats {"rounds", "accepted"})."""
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate is B=1 (per-row cache "
+                         "lengths diverge otherwise)")
+    cap = t + max_new + gamma + 2          # verify block may overshoot
+    t_cache = init_cache(config, 1, cap, quantized=kv_quant)
+    d_cache = init_cache(draft_config, 1, cap, quantized=kv_quant)
+
+    # prefill both; invariant from here on: caches hold y_1..y_{m-1},
+    # `last` = y_m is NOT yet in either cache
+    t_logits, t_cache = _forward_cached(params, prompt, t_cache, config)
+    _, d_cache = _forward_cached(draft_params, prompt, d_cache,
+                                 draft_config)
+    last = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)   # [1]
+
+    buf = jnp.zeros((1, max_new + gamma + 1), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, last[:, None], (0, 0))
+
+    def round_body(carry):
+        buf, count, last, t_cache, d_cache, rounds, accepted = carry
+
+        # draft proposes gamma tokens from `last`
+        def d_step(c, _):
+            tok, dc = c
+            lg, dc = _forward_cached(draft_params, tok[:, None], dc,
+                                     draft_config)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, dc), nxt
+
+        (_, d_cache), drafts = jax.lax.scan(
+            d_step, (last, d_cache), None, length=gamma)
+        drafts = drafts[:, 0]                                   # [gamma]
+
+        # target scores last + the gamma proposals in one forward
+        block = jnp.concatenate([last, drafts])[None, :]        # [1, g+1]
+        lg, t_cache = _forward_cached(params, block, t_cache, config)
+        greedy = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)   # [g+1]
+
+        # longest accepted prefix: drafts[j] == greedy[j] for j < a
+        ok = drafts == greedy[:-1]
+        a = jnp.argmin(jnp.concatenate([ok, jnp.zeros(1, bool)]))
+        # emit drafts[0..a-1] then the target's token at the divergence
+        emit = jnp.where(jnp.arange(gamma + 1) < a,
+                         jnp.concatenate([drafts, jnp.zeros(1, jnp.int32)]),
+                         jnp.broadcast_to(greedy[a], (gamma + 1,)))
+        new_last = greedy[a][None]                              # [1]
+        buf = jax.lax.dynamic_update_slice(buf, emit[None, :],
+                                           (0, count + 1))
+
+        # roll both caches back to exactly the accepted entries
+        # (y_1..y_m, d_1..d_a). The target wrote gamma+1, keep a+1 of them;
+        # the draft wrote gamma (through d_{gamma-1}) — when a == gamma its
+        # d_gamma entry is missing, so fill it with one extra step
+        m_minus_1 = t_cache["length"] - (gamma + 1)             # before round
+        t_cache = dict(t_cache, length=m_minus_1 + 1 + a)
+        d_cache = dict(d_cache, length=m_minus_1 + 1 + a)
+
+        def fill(dc):
+            dc = dict(dc, length=m_minus_1 + gamma)
+            _, dc = _forward_cached(draft_params, drafts[-1:][None, :], dc,
+                                    draft_config)
+            return dc
+
+        d_cache = jax.lax.cond(a == gamma, fill, lambda dc: dc, d_cache)
+        return (buf, count + 1 + a, new_last, t_cache, d_cache,
+                rounds + 1, accepted + a)
+
+    def cond(carry):
+        # buf[0..count] already holds count+1 valid tokens
+        return carry[1] + 1 < max_new
+
+    init = (buf, jnp.zeros((), jnp.int32), last, t_cache, d_cache,
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    buf, count, *_rest = jax.lax.while_loop(cond, round_body, init)
+    rounds, accepted = _rest[-2], _rest[-1]
+    return buf[:, :max_new], {"rounds": rounds, "accepted": accepted}

@@ -76,10 +76,13 @@ def _maybe_ungroup(params: dict, config) -> dict:
 
 
 class _Server:
-    def __init__(self, config, params, kv_quant: bool = False):
+    def __init__(self, config, params, kv_quant: bool = False,
+                 draft: tuple = None, gamma: int = 4):
         self.config = config
         self.params = params
         self.kv_quant = kv_quant
+        self.draft = draft             # (draft_config, draft_params) | None
+        self.gamma = gamma
         self.lock = threading.Lock()   # single-flight: one chip
         import jax
         self.n_params = sum(p.size for p in jax.tree.leaves(params))
@@ -97,12 +100,25 @@ class _Server:
         if hi >= self.config.vocab_size or lo < 0:
             raise ValueError("token id out of range")
         with self.lock:
-            out = generate(self.params, prompt, self.config, int(max_new),
-                           temperature=float(temperature),
-                           top_k=int(top_k), top_p=float(top_p),
-                           kv_quant=self.kv_quant,
-                           key=jax.random.key(int.from_bytes(
-                               os.urandom(4), "big")))
+            # speculative path: greedy + single sequence + a draft loaded
+            # (the greedy-case guarantee makes it transparent — the output
+            # is exactly the target-only greedy stream)
+            if (self.draft is not None and float(temperature) == 0.0
+                    and prompt.shape[0] == 1):
+                from ..infer import speculative_generate
+                dcfg, dparams = self.draft
+                out, _ = speculative_generate(
+                    self.params, dparams, prompt, self.config, dcfg,
+                    int(max_new), gamma=self.gamma,
+                    kv_quant=self.kv_quant)
+            else:
+                out = generate(self.params, prompt, self.config,
+                               int(max_new),
+                               temperature=float(temperature),
+                               top_k=int(top_k), top_p=float(top_p),
+                               kv_quant=self.kv_quant,
+                               key=jax.random.key(int.from_bytes(
+                                   os.urandom(4), "big")))
         return jax.device_get(out).tolist()
 
 
@@ -187,6 +203,15 @@ def main(argv=None) -> int:
                    help="int8 KV cache: half the decode-loop HBM traffic "
                         "(per-token-per-head scales, dequantized in the "
                         "attend loop)")
+    p.add_argument("--draft-config", default="",
+                   help="named config of a draft model for speculative "
+                        "decoding (greedy B=1 requests; output is exactly "
+                        "the target's greedy stream)")
+    p.add_argument("--draft-checkpoint", default="",
+                   help="orbax checkpoint for the draft (fresh init when "
+                        "empty — useful only for testing)")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="speculative proposal length per round")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -216,7 +241,19 @@ def main(argv=None) -> int:
                          donate_argnums=0)(params)
         print(f"quantized matmul weights to int8 ({args.quantize})",
               flush=True)
-    srv = _Server(config, params, kv_quant=args.kv_quant)
+    draft = None
+    if args.draft_config:
+        dcfg = named_config(args.family, args.draft_config)
+        dtrainer = Trainer.create(dcfg, MeshPlan(), devices=jax.devices()[:1])
+        dparams = _maybe_ungroup(
+            _load_params(dtrainer, args.draft_checkpoint), dcfg)
+        if dcfg.vocab_size != config.vocab_size:
+            raise SystemExit("draft and target must share a vocab")
+        draft = (dcfg, dparams)
+        print(f"speculative decoding armed: draft {args.draft_config}, "
+              f"gamma {args.gamma}", flush=True)
+    srv = _Server(config, params, kv_quant=args.kv_quant, draft=draft,
+                  gamma=args.gamma)
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),

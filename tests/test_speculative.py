@@ -1,0 +1,88 @@
+"""Speculative decoding (infer.speculative_generate): the greedy-case
+guarantee is that the output equals the target-only greedy stream for ANY
+draft model — the draft changes speed, never content."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_docker_api_tpu.infer import generate, speculative_generate
+from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    target = init_params(cfg, jax.random.key(0))
+    # a DIFFERENT random-init draft: worst-case proposals (near-zero
+    # acceptance) — exactness must hold regardless
+    draft = init_params(cfg, jax.random.key(42))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size, jnp.int32)
+    return cfg, target, draft, prompt
+
+
+def test_exact_match_with_bad_draft(setup):
+    cfg, target, draft, prompt = setup
+    want = np.asarray(generate(target, prompt, cfg, max_new=12))
+    got, stats = speculative_generate(target, draft, prompt, cfg, cfg,
+                                      max_new=12, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(stats["rounds"]) >= 1
+
+
+def test_exact_match_with_perfect_draft_and_fewer_rounds(setup):
+    """Draft == target: every proposal accepted, so each round emits
+    gamma+1 tokens — rounds ~ max_new/(gamma+1), and the a==gamma
+    cache-fill path is exercised every round."""
+    cfg, target, _, prompt = setup
+    want = np.asarray(generate(target, prompt, cfg, max_new=12))
+    got, stats = speculative_generate(target, target, prompt, cfg, cfg,
+                                      max_new=12, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # 12 tokens: first + rounds*(<=4); perfect acceptance -> 3 rounds
+    assert int(stats["rounds"]) == 3
+    assert int(stats["accepted"]) == 3 * 3     # a == gamma every round
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 5])
+def test_exact_across_gamma(setup, gamma):
+    cfg, target, draft, prompt = setup
+    want = np.asarray(generate(target, prompt, cfg, max_new=9))
+    got, _ = speculative_generate(target, draft, prompt, cfg, cfg,
+                                  max_new=9, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_heterogeneous_draft_config(setup):
+    """The draft may be a different architecture entirely (that's the
+    point); only the vocab must match."""
+    cfg, target, _, prompt = setup
+    small = LlamaConfig(vocab_size=cfg.vocab_size, d_model=32, n_layers=1,
+                        n_heads=2, n_kv_heads=1, d_ff=64, max_seq_len=128,
+                        dtype=jnp.float32)
+    draft = init_params(small, jax.random.key(7))
+    want = np.asarray(generate(target, prompt, cfg, max_new=10))
+    got, _ = speculative_generate(target, draft, prompt, cfg, small,
+                                  max_new=10, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_rejects_batch(setup):
+    cfg, target, draft, _ = setup
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError):
+        speculative_generate(target, draft, prompt, cfg, cfg, max_new=4)
+
+
+def test_speculative_with_kv_quant(setup):
+    """kv_quant must flow into BOTH caches (a --kv-quant server's greedy
+    path keeps the int8 cache); the stream matches the target's own
+    kv-quant greedy stream."""
+    cfg, target, draft, prompt = setup
+    want = np.asarray(generate(target, prompt, cfg, max_new=10,
+                               kv_quant=True))
+    got, _ = speculative_generate(target, draft, prompt, cfg, cfg,
+                                  max_new=10, gamma=4, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
