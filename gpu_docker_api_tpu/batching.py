@@ -1,0 +1,96 @@
+"""Continuous batching: a slot-based KV cache with per-row lengths.
+
+The serving pattern vLLM/JetStream made standard, in XLA-native form: the
+server holds ONE cache of `slots` rows; requests claim a free slot, prefill
+into it, and every decode step advances ALL active slots together — new
+requests join between steps instead of waiting for the batch to drain.
+Decode is weight-HBM-bound, so stepping 4 slots costs about the same as
+stepping 1: admission converts idle rows directly into throughput.
+
+Built on infer.py's length-as-data design, generalized to a LENGTHS VECTOR:
+each row attends to its own frontier (per-row causal mask in the blockwise
+attend loop, trip count = the furthest row), RoPE runs at per-row positions,
+and cache writes scatter at per-row offsets (vmapped dynamic_update_slice).
+Everything compiles ONCE: slot index, lengths, and the active mask are data.
+
+Greedy per-step decode (the batching server's mode); sampling requests fall
+back to the per-request scan path in serve.py.
+
+No reference counterpart (SURVEY §2 — the reference never opens a tensor);
+serving-side runtime the TPU build adds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .infer import _forward_cached, _layer_step, _llama_view
+from .models.llama import rms_norm, rope_frequencies
+from .ops.quant import qmatmul
+
+
+def init_slot_cache(config, slots: int, max_len: int) -> dict:
+    """Cache of `slots` rows, each up to max_len tokens, with per-row
+    lengths. (Dense only: the int8 cache composes with the per-request
+    paths; slot serving keeps bf16 K/V for now.)"""
+    c = _llama_view(config)
+    shape = (config.n_layers, slots, max_len, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def slot_prefill(params, prompt, cache, slot, config):
+    """Run prompt [1, T] through the model into slot row `slot` (data — one
+    compiled program serves every slot). Returns (last logits [1, V], cache).
+    The row's previous content is logically discarded: its length resets to
+    T and writes start at 0."""
+    row = {
+        "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+        "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+        "length": jnp.zeros((), jnp.int32),
+    }
+    logits, row = _forward_cached(params, prompt, row, config)
+    return logits[:, -1], {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], row["k"], (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], row["v"], (0, slot, 0, 0, 0)),
+        "lengths": jax.lax.dynamic_update_slice(
+            cache["lengths"], jnp.array([prompt.shape[1]], jnp.int32),
+            (slot,)),
+    }
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def slot_decode(params, tokens, cache, active, config):
+    """One decode step for every slot together. tokens [slots] (last token
+    per row; anything for inactive rows), active [slots] bool. Returns
+    (logits [slots, V], cache) — inactive rows write junk at their frozen
+    frontier (harmlessly overwritten by their next prefill) and do NOT
+    advance their length."""
+    c = _llama_view(config)
+    pos = cache["lengths"]                                   # [slots]
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)   # [slots,1,D]
+    cos, sin = rope_frequencies(c, pos)                      # [slots, d/2]
+    cos, sin = cos[:, None, :], sin[:, None, :]              # per-row [B,1,:]
+
+    def body(x, scanned):
+        layer, ck, cv = scanned
+        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits[:, -1], {
+        "k": ks, "v": vs,
+        "lengths": pos + active.astype(jnp.int32),
+    }

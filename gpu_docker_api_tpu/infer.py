@@ -112,6 +112,11 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     With k_scale/v_scale (int8 cache — [B,S_max,Hkv,1] f32), blocks are
     read from HBM at half the bytes and dequantized in-register here.
 
+    pos is a scalar (whole batch at one frontier) or a [B] vector of
+    per-row frontiers (the continuous-batching slot cache, batching.py);
+    the block loop then runs to the FURTHEST row's frontier with each row
+    masked to its own.
+
     GQA: K/V are consumed at the Hkv head count; q is viewed as
     [B,T,Hkv,G,D] so no repeated K/V is ever materialized."""
     b, t, h, d = q.shape
@@ -120,7 +125,11 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     group = h // hkv
     blk = _block_for(s_max)
     qf = (q.astype(jnp.float32) / math.sqrt(d)).reshape(b, t, hkv, group, d)
-    rows = pos + jnp.arange(t)                               # absolute q pos
+    pos = jnp.asarray(pos)
+    per_row = pos.ndim == 1
+    # absolute q positions: [t] shared, or [B, t] per row
+    rows = (pos[:, None] if per_row else pos) + jnp.arange(t)
+    far = jnp.max(pos) if per_row else pos
 
     def _deq(xb, scale_all, i):
         if scale_all is None:
@@ -136,8 +145,12 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
         vb = _deq(vb, v_scale, i)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kb)
         cols = i * blk + jnp.arange(blk)
-        s = jnp.where((cols[None, :] <= rows[:, None])[None, None, None],
-                      s, -jnp.inf)
+        if per_row:
+            mask = (cols[None, None, :] <= rows[:, :, None])  # [B,t,blk]
+            mask = mask[:, None, None]                        # [B,1,1,t,blk]
+        else:
+            mask = (cols[None, :] <= rows[:, None])[None, None, None]
+        s = jnp.where(mask, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
@@ -149,17 +162,30 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     acc0 = jnp.zeros((b, hkv, group, t, d), jnp.float32)
     m0 = jnp.full((b, hkv, group, t, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, hkv, group, t, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, blocks_used(pos, t, blk), body,
+    acc, m, l = jax.lax.fori_loop(0, blocks_used(far, t, blk), body,
                                   (acc0, m0, l0))
     out = acc / jnp.maximum(l, 1e-30)                        # [b,hkv,g,t,d]
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d)
     return out.astype(q.dtype)
 
 
+def _cache_write(cache, new, pos):
+    """Write new [B,T,...] into cache [B,S_max,...] at start position
+    `pos`: scalar (one frontier) or [B] (per-row frontiers, vmapped)."""
+    new = new.astype(cache.dtype)
+    if jnp.asarray(pos).ndim == 1:
+        return jax.vmap(
+            lambda cb, nb, p: jax.lax.dynamic_update_slice(
+                cb, nb, (p,) + (0,) * (cb.ndim - 1)))(cache, new, pos)
+    return jax.lax.dynamic_update_slice(
+        cache, new, (0, pos) + (0,) * (cache.ndim - 2))
+
+
 def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin,
                 scale_k=None, scale_v=None):
     """One decoder layer over a T-token slice with cache read+write.
-    x [B,T,D]; cache_k/v [B,S_max,Hkv,D]; pos = absolute start position.
+    x [B,T,D]; cache_k/v [B,S_max,Hkv,D]; pos = absolute start position
+    (scalar, or [B] per-row for the slot cache).
     With scale_k/scale_v (int8 cache), new K/V quantize on write.
     Returns (x_out, new caches...) — 3-tuple dense, 5-tuple quantized."""
     c = _llama_view(config)
@@ -174,14 +200,10 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin,
     if scale_k is not None:
         k, ks_new = _quantize_kv(k)
         v, vs_new = _quantize_kv(v)
-        scale_k = jax.lax.dynamic_update_slice(scale_k, ks_new,
-                                               (0, pos, 0, 0))
-        scale_v = jax.lax.dynamic_update_slice(scale_v, vs_new,
-                                               (0, pos, 0, 0))
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
-                                           (0, pos, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
-                                           (0, pos, 0, 0))
+        scale_k = _cache_write(scale_k, ks_new, pos)
+        scale_v = _cache_write(scale_v, vs_new, pos)
+    cache_k = _cache_write(cache_k, k, pos)
+    cache_v = _cache_write(cache_v, v, pos)
     out = _attend_cached(q, cache_k, cache_v, pos, scale_k, scale_v)
     x = x + qmatmul(out.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
 

@@ -174,10 +174,12 @@ def rope_frequencies(config: LlamaConfig, positions: jax.Array) -> tuple[jax.Arr
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: [B, S, H, Dh]; rotate pairs (split-half convention)."""
+    """x: [B, S, H, Dh]; rotate pairs (split-half convention). cos/sin are
+    [S, Dh/2] (shared positions) or [B, S, Dh/2] (per-row positions — the
+    continuous-batching slot cache, batching.py)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    c = cos[None, :, None, :] if cos.ndim == 2 else cos[:, :, None, :]
+    s = sin[None, :, None, :] if sin.ndim == 2 else sin[:, :, None, :]
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
                            axis=-1).astype(x.dtype)
 

@@ -75,6 +75,145 @@ def _maybe_ungroup(params: dict, config) -> dict:
         f"{want + 2} (group_layers layout)")
 
 
+class _Batcher:
+    """Continuous batching (batching.py): one background thread owns a
+    slot cache; greedy requests enqueue, claim a free slot, prefill, and
+    then every decode step advances ALL active slots together — a new
+    request joins between steps instead of waiting for the batch to
+    drain. Decode is weight-bound, so occupied slots are nearly free
+    throughput."""
+
+    def __init__(self, config, params, slots: int, max_len: int):
+        import queue
+
+        from ..batching import init_slot_cache
+        self.config = config
+        self.params = params
+        self.max_len = max_len
+        self.queue: "queue.Queue" = queue.Queue()
+        self.cache = init_slot_cache(config, slots, max_len)
+        self.slots: list = [None] * slots
+        self._stop = False
+        self._dead: Exception | None = None   # loop crash / close reason
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def submit(self, prompt_row, max_new: int) -> list[int]:
+        """Blocking: returns the greedy stream for one sequence. Raises if
+        the scheduler thread has died or the batcher is closed — a request
+        must never hang on an event nobody will set."""
+        if self._dead is not None:
+            raise RuntimeError(f"batcher unavailable: {self._dead}")
+        if prompt_row.shape[0] + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "
+                f"the batcher's max_len {self.max_len}")
+        item = {"prompt": prompt_row, "max_new": int(max_new),
+                "done": threading.Event(), "out": None, "error": None}
+        self.queue.put(item)
+        item["done"].wait()
+        if item["error"] is not None:
+            raise RuntimeError(f"batcher failed: {item['error']}")
+        return item["out"]
+
+    def close(self):
+        self._stop = True
+        self.thread.join(timeout=5)
+        self._fail_all(RuntimeError("batcher closed"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Release every waiter — in-flight slots and queued items; the
+        scheduler is gone, so blocking forever is the only alternative."""
+        import queue
+        self._dead = self._dead or exc
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                s["error"] = exc
+                s["done"].set()
+                self.slots[i] = None
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            item["error"] = exc
+            item["done"].set()
+
+    def _run(self):
+        try:
+            self._loop()
+        except Exception as e:  # noqa: BLE001 — device OOM/XLA errors land
+            # here; every waiter must be released, not left hanging
+            import traceback
+            traceback.print_exc()
+            self._fail_all(e)
+
+    # ---- the scheduler loop (single thread owns the cache) ----
+
+    def _admit(self):
+        import jax
+        import jax.numpy as jnp
+        import queue
+
+        from ..batching import slot_prefill
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                continue
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                logits, self.cache = slot_prefill(
+                    self.params, item["prompt"][None], self.cache,
+                    jnp.int32(i), self.config)
+                tok = int(jax.device_get(jnp.argmax(logits[0])))
+            except Exception as e:
+                # the item is in neither the queue nor a slot here — fail
+                # it directly, then let the crash propagate (_run releases
+                # everyone else)
+                item["error"] = e
+                item["done"].set()
+                raise
+            item["stream"] = [tok]
+            item["last"] = tok
+            if item["max_new"] <= 1:
+                item["out"] = item["stream"]
+                item["done"].set()
+            else:
+                self.slots[i] = item
+
+    def _loop(self):
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..batching import slot_decode
+        while not self._stop:
+            self._admit()
+            active = [s is not None for s in self.slots]
+            if not any(active):
+                _time.sleep(0.002)
+                continue
+            toks = jnp.array([s["last"] if s else 0 for s in self.slots],
+                             jnp.int32)
+            logits, self.cache = slot_decode(
+                self.params, toks, self.cache,
+                jnp.array(active), self.config)
+            nxt = jax.device_get(jnp.argmax(logits, axis=-1))
+            for i, s in enumerate(self.slots):
+                if s is None:
+                    continue
+                tok = int(nxt[i])
+                s["stream"].append(tok)
+                s["last"] = tok
+                if len(s["stream"]) >= s["max_new"]:
+                    s["out"] = s["stream"]
+                    s["done"].set()
+                    self.slots[i] = None   # slot free; stale KV is dead
+
+
 class _Server:
     def __init__(self, config, params, kv_quant: bool = False,
                  draft: tuple = None, gamma: int = 4):
@@ -83,6 +222,7 @@ class _Server:
         self.kv_quant = kv_quant
         self.draft = draft             # (draft_config, draft_params) | None
         self.gamma = gamma
+        self.batcher: _Batcher | None = None
         self.lock = threading.Lock()   # single-flight: one chip
         import jax
         self.n_params = sum(p.size for p in jax.tree.leaves(params))
@@ -99,6 +239,12 @@ class _Server:
         lo, hi = jax.device_get((jnp.min(prompt), jnp.max(prompt)))
         if hi >= self.config.vocab_size or lo < 0:
             raise ValueError("token id out of range")
+        # continuous batching: greedy single-sequence requests join the
+        # running slot batch WITHOUT the single-flight lock — concurrency
+        # is the whole point; the batcher thread owns the cache
+        if (self.batcher is not None and float(temperature) == 0.0
+                and prompt.shape[0] == 1):
+            return [self.batcher.submit(prompt[0], int(max_new))]
         with self.lock:
             # speculative path: greedy + single sequence + a draft loaded
             # (the greedy-case guarantee makes it transparent — the output
@@ -212,6 +358,13 @@ def main(argv=None) -> int:
                         "empty — useful only for testing)")
     p.add_argument("--gamma", type=int, default=4,
                    help="speculative proposal length per round")
+    p.add_argument("--batch-slots", type=int, default=0,
+                   help="continuous batching: N cache slots; greedy "
+                        "single-sequence requests join the running batch "
+                        "between decode steps (0 = off)")
+    p.add_argument("--batch-max-len", type=int, default=0,
+                   help="slot cache length (default: the model's "
+                        "max_seq_len)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -254,6 +407,22 @@ def main(argv=None) -> int:
               f"gamma {args.gamma}", flush=True)
     srv = _Server(config, params, kv_quant=args.kv_quant, draft=draft,
                   gamma=args.gamma)
+    if args.batch_slots > 0:
+        # keep the serving-mode matrix explicit: the batcher owns greedy
+        # B=1 traffic, which is exactly what --draft-config targets, and
+        # its slot cache is dense — refuse ambiguous combinations instead
+        # of silently disabling a configured feature
+        if args.draft_config:
+            raise SystemExit("--batch-slots and --draft-config both claim "
+                             "greedy single-sequence requests; pick one")
+        if args.kv_quant:
+            raise SystemExit("--batch-slots serves a dense slot cache; "
+                             "--kv-quant is not supported with it yet")
+        srv.batcher = _Batcher(config, params, slots=args.batch_slots,
+                               max_len=args.batch_max_len
+                               or config.max_seq_len)
+        print(f"continuous batching: {args.batch_slots} slots x "
+              f"{srv.batcher.max_len} tokens", flush=True)
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),

@@ -119,3 +119,83 @@ def test_generate_sampling_validation(served):
                  {**base, "temperature": -1.0})["code"] == 400
     assert _call(port, "POST", "/generate",
                  {**base, "temperature": 99.0})["code"] == 400
+
+
+def test_continuous_batching_concurrent_requests():
+    """Three concurrent greedy requests through the batcher (2 slots, so
+    one waits for a free slot) must each equal their solo greedy stream —
+    admission mid-decode must not disturb running rows."""
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    srv = _Server(cfg, params)
+    srv.batcher = _Batcher(cfg, params, slots=2, max_len=64)
+    try:
+        prompts = [
+            jax.random.randint(jax.random.key(i), (4 + 3 * i,), 0,
+                               cfg.vocab_size) for i in range(3)
+        ]
+        want = [np.asarray(generate(params, p[None], cfg, max_new=5))[0]
+                for p in prompts]
+        got = [None] * 3
+
+        def ask(i):
+            got[i] = srv.generate(np.asarray(prompts[i])[None].tolist(),
+                                  max_new=5, temperature=0.0)[0]
+
+        threads = [threading.Thread(target=ask, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i in range(3):
+            np.testing.assert_array_equal(got[i], want[i])
+    finally:
+        srv.batcher.close()
+
+
+def test_batcher_rejects_overlong_request():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=16)
+    try:
+        with pytest.raises(ValueError):
+            b.submit(jnp.zeros((14,), jnp.int32), 8)
+    finally:
+        b.close()
+
+
+def test_batcher_crash_releases_waiters(monkeypatch):
+    """A dying scheduler thread must fail pending submits, not hang them."""
+    from gpu_docker_api_tpu.workloads import serve as serve_mod
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=32)
+    import gpu_docker_api_tpu.batching as batching_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(batching_mod, "slot_prefill", boom)
+    with pytest.raises(RuntimeError, match="batcher"):
+        b.submit(jnp.zeros((4,), jnp.int32), 4)
+    # thread is dead; later submits fail fast instead of hanging
+    with pytest.raises(RuntimeError, match="unavailable"):
+        b.submit(jnp.zeros((4,), jnp.int32), 4)
+
+
+def test_batcher_close_fails_fast():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=32)
+    b.close()
+    with pytest.raises(RuntimeError, match="unavailable"):
+        b.submit(jnp.zeros((4,), jnp.int32), 2)
