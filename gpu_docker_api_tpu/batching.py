@@ -83,7 +83,8 @@ def slot_decode(params, tokens, cache, active, config):
 
     def body(x, scanned):
         layer, ck, cv = scanned
-        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin)
+        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin,
+                                active=active)
         return x, (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],

@@ -99,7 +99,8 @@ def blocks_used(pos, t: int, blk: int):
     return (pos + t + blk - 1) // blk
 
 
-def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
+def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None,
+                   window: int = 0, active=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1; k/v_all [B,S_max,
     Hkv,D]. Length-aware blockwise attention over the cache buffer: a
     lax.fori_loop with DYNAMIC trip count ceil((pos+T)/blk) runs
@@ -130,6 +131,17 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     # absolute q positions: [t] shared, or [B, t] per row
     rows = (pos[:, None] if per_row else pos) + jnp.arange(t)
     far = jnp.max(pos) if per_row else pos
+    # `near` drives the window's dead-block skip; idle slot rows (length 0)
+    # must not drag it to 0, so active rows only when a mask is given
+    if per_row:
+        near = jnp.min(jnp.where(active, pos, jnp.int32(2 ** 30))
+                       if active is not None else pos)
+    else:
+        near = pos
+    # sliding window: blocks wholly before (earliest row - window) are
+    # dead — decode reads O(window) cache, not O(length)
+    blk_lo = (jnp.maximum((near - window + 1) // _block_for(s_max), 0)
+              if window else 0)
 
     def _deq(xb, scale_all, i):
         if scale_all is None:
@@ -147,9 +159,14 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
         cols = i * blk + jnp.arange(blk)
         if per_row:
             mask = (cols[None, None, :] <= rows[:, :, None])  # [B,t,blk]
+            if window:
+                mask &= cols[None, None, :] > rows[:, :, None] - window
             mask = mask[:, None, None]                        # [B,1,1,t,blk]
         else:
-            mask = (cols[None, :] <= rows[:, None])[None, None, None]
+            mask = cols[None, :] <= rows[:, None]
+            if window:
+                mask &= cols[None, :] > rows[:, None] - window
+            mask = mask[None, None, None]
         s = jnp.where(mask, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -162,7 +179,7 @@ def _attend_cached(q, k_all, v_all, pos, k_scale=None, v_scale=None):
     acc0 = jnp.zeros((b, hkv, group, t, d), jnp.float32)
     m0 = jnp.full((b, hkv, group, t, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, hkv, group, t, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, blocks_used(far, t, blk), body,
+    acc, m, l = jax.lax.fori_loop(blk_lo, blocks_used(far, t, blk), body,
                                   (acc0, m0, l0))
     out = acc / jnp.maximum(l, 1e-30)                        # [b,hkv,g,t,d]
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d)
@@ -182,7 +199,7 @@ def _cache_write(cache, new, pos):
 
 
 def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin,
-                scale_k=None, scale_v=None):
+                scale_k=None, scale_v=None, active=None):
     """One decoder layer over a T-token slice with cache read+write.
     x [B,T,D]; cache_k/v [B,S_max,Hkv,D]; pos = absolute start position
     (scalar, or [B] per-row for the slot cache).
@@ -204,7 +221,8 @@ def _layer_step(x, layer, cache_k, cache_v, pos, config, cos, sin,
         scale_v = _cache_write(scale_v, vs_new, pos)
     cache_k = _cache_write(cache_k, k, pos)
     cache_v = _cache_write(cache_v, v, pos)
-    out = _attend_cached(q, cache_k, cache_v, pos, scale_k, scale_v)
+    out = _attend_cached(q, cache_k, cache_v, pos, scale_k, scale_v,
+                         window=c.sliding_window, active=active)
     x = x + qmatmul(out.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
 
     # family-specific FFN: MoE layers carry expert banks, llama a dense MLP

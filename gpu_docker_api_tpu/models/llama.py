@@ -50,6 +50,11 @@ class LlamaConfig:
     # memory); "ulysses" = all-to-all head scatter (parallel/ulysses.py,
     # full-seq flash kernel per head group)
     sp_attn: str = "ring"
+    # > 0 = sliding-window attention (Mistral-style): each position
+    # attends its last `sliding_window` keys only; prefill/decode cost
+    # becomes O(window) per token instead of O(S). Not composed with
+    # sp-sharded attention (ring/ulysses) yet.
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -199,6 +204,16 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
     q, k, v = pin_qkv(q, k, v, mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    # the guard must fire for BOTH sp-sharded shapes: the in-mesh dispatch
+    # below AND a pipelined trunk's attn_fn override (ring/ulysses local
+    # bodies know nothing of windows — silently running full attention
+    # would diverge from the single-chip windowed model)
+    if c.sliding_window and (
+            attn_fn is not None
+            or (mesh is not None and mesh.shape.get("sp", 1) > 1)):
+        raise NotImplementedError(
+            "sliding_window with sp-sharded attention (ring/ulysses) "
+            "is not composed yet — use sp=1 for windowed models")
     if attn_fn is not None:
         out = attn_fn(q, k, v)
     elif mesh is not None and mesh.shape.get("sp", 1) > 1:
@@ -212,7 +227,8 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
             from ..parallel.ring import ring_attention
             out = ring_attention(q, k, v, mesh, causal=True)
     else:
-        out = attention(q, k, v, causal=True, impl=impl)   # [B, S, H, Dh]
+        out = attention(q, k, v, causal=True, impl=impl,
+                        window=c.sliding_window)           # [B, S, H, Dh]
     out = out.reshape(b, s, c.n_heads * c.head_dim) @ layer["wo"]
     return x + out
 

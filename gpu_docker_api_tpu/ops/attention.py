@@ -36,8 +36,12 @@ LANES = 128
 # ---- reference (XLA) -------------------------------------------------------
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True) -> jax.Array:
-    """q [B,S,H,D], k/v [B,S,Hkv,D] -> [B,S,H,D]. f32 softmax."""
+                        causal: bool = True, window: int = 0) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D] -> [B,S,H,D]. f32 softmax.
+    window > 0 = sliding-window (Mistral-style): row r attends keys
+    (r-window, r] only."""
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     b, s, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -46,11 +50,14 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kf = jnp.repeat(k.astype(jnp.float32), group, axis=2)
     vf = jnp.repeat(v.astype(jnp.float32), group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    keep = jnp.ones((s, s), bool)
     if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-        scores = jnp.where(cols[None, None] <= rows[None, None],
-                           scores, -jnp.inf)
+        keep &= cols <= rows
+    if window:
+        keep &= cols > rows - window
+    scores = jnp.where(keep[None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
     return out.astype(q.dtype)
@@ -60,7 +67,7 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   blk_q: int, blk_k: int, scale: float, causal: bool,
-                  seq_len: int, want_lse: bool):
+                  seq_len: int, want_lse: bool, window: int = 0):
     if want_lse:
         lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -78,6 +85,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         n_kv = jnp.minimum(((i + 1) * blk_q + blk_k - 1) // blk_k, n_kv_total)
     else:
         n_kv = n_kv_total
+    if window:
+        # sliding window: blocks wholly left of (first row - window) are
+        # dead — decode/long-prefill cost is O(window), not O(S)
+        kv_lo = jnp.maximum((i * blk_q - window + 1) // blk_k, 0)
+    else:
+        kv_lo = 0
 
     def body(j, _):
         import jax.experimental.pallas as pl
@@ -86,12 +99,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [blk_q, blk_k]
-        if causal:
+        if causal or window:
             rows = i * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 0)
             cols = j * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(cols <= rows, s, -jnp.inf)
+            keep = cols <= rows if causal else (cols == cols)
+            if window:
+                keep &= cols > rows - window
+            s = jnp.where(keep, s, -jnp.inf)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # guard the all-masked row case: exp(-inf - -inf) -> use finite m
@@ -106,7 +122,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         m_ref[:] = m_new
         return 0
 
-    jax.lax.fori_loop(0, n_kv, body, 0)
+    jax.lax.fori_loop(kv_lo, n_kv, body, 0)
     denom = jnp.maximum(l_ref[:], 1e-30)
     o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
     if want_lse:
@@ -123,7 +139,7 @@ def _pid(axis: int):
 
 
 def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
-                   want_lse: bool = True):
+                   want_lse: bool = True, window: int = 0):
     """Runs the forward kernel. q [B,S,H,D], k/v [B,S,Hkv,D] ->
     (out [B,S,H,D], lse [B*H, S, LANES] f32 of the SCALED scores — lane
     replicated; None when want_lse=False, which skips the residual write
@@ -149,7 +165,7 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
     grid = (b * h, s // blk_q)
     kernel = functools.partial(
         _flash_kernel, blk_q=blk_q, blk_k=blk_k, scale=scale,
-        causal=causal, seq_len=s, want_lse=want_lse)
+        causal=causal, seq_len=s, want_lse=want_lse, window=window)
 
     def kv_index(bh, i):
         del i
@@ -198,7 +214,7 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                          dq_ref, *, blk_q: int, blk_k: int, scale: float,
-                         causal: bool, seq_len: int):
+                         causal: bool, seq_len: int, window: int = 0):
     import jax.experimental.pallas as pl
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
     q = q_ref[0].astype(jnp.float32) * scale             # [blk_q, D]
@@ -214,18 +230,23 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         n_kv = jnp.minimum(((i + 1) * blk_q + blk_k - 1) // blk_k, n_kv_total)
     else:
         n_kv = n_kv_total
+    kv_lo = (jnp.maximum((i * blk_q - window + 1) // blk_k, 0)
+             if window else 0)
 
     def body(j, acc):
         k = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or window:
             rows = i * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 0)
             cols = j * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(cols <= rows, s, -jnp.inf)
+            keep = cols <= rows if causal else (cols == cols)
+            if window:
+                keep &= cols > rows - window
+            s = jnp.where(keep, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -234,7 +255,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                                          preferred_element_type=jnp.float32)
 
     d = q_ref.shape[2]
-    acc = jax.lax.fori_loop(0, n_kv, body,
+    acc = jax.lax.fori_loop(kv_lo, n_kv, body,
                             jnp.zeros((blk_q, d), jnp.float32))
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
@@ -242,7 +263,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                           dk_ref, dv_ref, *, blk_q: int, blk_k: int,
                           scale: float, causal: bool, seq_len: int,
-                          group: int):
+                          group: int, window: int = 0):
     import jax.experimental.pallas as pl
     j = jax.lax.convert_element_type(_pid(1), jnp.int32)
     g = jax.lax.convert_element_type(_pid(2), jnp.int32)
@@ -251,6 +272,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     n_q_total = seq_len // blk_q
     i_start = (j * blk_k) // blk_q if causal else 0
+    if window:
+        # rows past col+window never see this kv block: r < c + window
+        i_end = jnp.minimum(
+            ((j + 1) * blk_k - 1 + window) // blk_q + 1, n_q_total)
+    else:
+        i_end = n_q_total
 
     def body(i, accs):
         dk_acc, dv_acc = accs
@@ -262,12 +289,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             axis=-1, keepdims=True)                      # [blk_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or window:
             rows = i * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 0)
             cols = j * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(cols <= rows, s, -jnp.inf)
+            keep = cols <= rows if causal else (cols == cols)
+            if window:
+                keep &= cols > rows - window
+            s = jnp.where(keep, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -282,7 +312,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     d = k_ref.shape[2]
     zeros = jnp.zeros((blk_k, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(i_start, n_q_total, body,
+    dk_acc, dv_acc = jax.lax.fori_loop(i_start, i_end, body,
                                        (zeros, zeros))
     # q was pre-scaled, so ds @ q already carries one factor of `scale`;
     # dk needs exactly one — nothing more to multiply here
@@ -299,7 +329,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] += dv_acc
 
 
-def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
+def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
+                   window: int = 0):
     import jax.experimental.pallas as pl
 
     b, s, h, d = q.shape
@@ -321,7 +352,8 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
-                          scale=scale, causal=causal, seq_len=s),
+                          scale=scale, causal=causal, seq_len=s,
+                          window=window),
         grid=(b * h, s // blk_q),
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
@@ -345,7 +377,8 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
-                          scale=scale, causal=causal, seq_len=s, group=group),
+                          scale=scale, causal=causal, seq_len=s, group=group,
+                          window=window),
         grid=(b * hkv, s // blk_k, group),
         in_specs=[
             pl.BlockSpec((1, s, d), q_row),
@@ -374,23 +407,24 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret):
 
 # ---- custom_vjp wiring -----------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret, window):
     # primal-only path (inference / no grad): skip the lse residual write
     out, _ = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
-                            want_lse=False)
+                            want_lse=False, window=window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret):
-    out, lse = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret, window):
+    out, lse = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
+                              window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, blk_q, blk_k, interpret, res, do):
+def _flash_vjp_bwd(causal, blk_q, blk_k, interpret, window, res, do):
     q, k, v, out, lse = res
     return _flash_bwd_raw(q, k, v, out, lse, do, causal, blk_q, blk_k,
-                          interpret)
+                          interpret, window=window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -407,21 +441,25 @@ def _auto_block(s: int) -> int:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
+                   static_argnames=("causal", "blk_q", "blk_k", "interpret",
+                                    "window"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     blk_q: int | None = None,
                     blk_k: int | None = None,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool = False,
+                    window: int = 0) -> jax.Array:
     """Pallas TPU flash attention, differentiable (custom_vjp with pallas
     backward kernels — training runs the flash path end-to-end, no [S, S]
     materialization in either direction). q [B,S,H,D], k/v [B,S,Hkv,D].
     blk_q/blk_k default to a measured seq-length-dependent tile size.
     interpret=True runs the kernels in the pallas interpreter (CPU tests)."""
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     s = q.shape[1]
     blk_q = blk_q or _auto_block(s)
     blk_k = blk_k or _auto_block(s)
-    return _flash(q, k, v, causal, blk_q, blk_k, interpret)
+    return _flash(q, k, v, causal, blk_q, blk_k, interpret, window)
 
 
 # ---- dispatcher ------------------------------------------------------------
@@ -434,14 +472,16 @@ def _on_tpu() -> bool:
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
-              causal: bool = True, impl: str = "auto") -> jax.Array:
+              causal: bool = True, impl: str = "auto",
+              window: int = 0) -> jax.Array:
     """Dispatch: pallas flash on TPU when shapes are kernel-friendly
-    (128-aligned seq, head_dim a lane multiple), XLA reference otherwise."""
+    (128-aligned seq, head_dim a lane multiple), XLA reference otherwise.
+    window > 0 = sliding-window attention (both impls)."""
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
     if impl == "xla":
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal, window=window)
     s, d = q.shape[1], q.shape[3]
     if _on_tpu() and s % DEFAULT_BLOCK == 0 and d % 128 == 0:
-        return flash_attention(q, k, v, causal=causal)
-    return reference_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    return reference_attention(q, k, v, causal=causal, window=window)

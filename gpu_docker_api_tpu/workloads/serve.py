@@ -111,6 +111,12 @@ class _Batcher:
         item = {"prompt": prompt_row, "max_new": int(max_new),
                 "done": threading.Event(), "out": None, "error": None}
         self.queue.put(item)
+        # re-check AFTER the put: _fail_all may have drained the queue
+        # between our _dead check and the put, leaving this item in a dead
+        # queue that nobody will ever service
+        if self._dead is not None and not item["done"].is_set():
+            item["error"] = self._dead
+            item["done"].set()
         item["done"].wait()
         if item["error"] is not None:
             raise RuntimeError(f"batcher failed: {item['error']}")
