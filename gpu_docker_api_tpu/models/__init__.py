@@ -50,7 +50,8 @@ NAMED_CONFIGS = {
     "llama": {"tiny": _llama.LlamaConfig.tiny,
               "mini": _llama.LlamaConfig.llama_mini,
               "250m": _llama.LlamaConfig.llama_250m,
-              "llama3_8b": _llama.LlamaConfig.llama3_8b},
+              "llama3_8b": _llama.LlamaConfig.llama3_8b,
+              "mistral_7b": _llama.LlamaConfig.mistral_7b},
     "moe": {"tiny": _moe.MoEConfig.tiny,
             "mini": _moe.MoEConfig.moe_mini,
             "mixtral_8x7b": _moe.MoEConfig.mixtral_8x7b},

@@ -84,6 +84,15 @@ class LlamaConfig:
                    n_kv_heads=4, d_ff=2816, max_seq_len=4096)
 
     @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.1: same trunk as Llama with a 4096-token sliding
+        window — the canned config exercising the windowed kernels at
+        production dimensions."""
+        return cls(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, d_ff=14336, max_seq_len=32768,
+                   rope_theta=10000.0, sliding_window=4096)
+
+    @classmethod
     def tiny(cls) -> "LlamaConfig":
         """Unit-test config — small enough for an 8-device CPU mesh."""
         return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,

@@ -122,6 +122,11 @@ class _Batcher:
             raise RuntimeError(f"batcher failed: {item['error']}")
         return item["out"]
 
+    @property
+    def alive(self) -> bool:
+        """Scheduler thread is running and accepting work (/healthz)."""
+        return self._dead is None
+
     def close(self):
         self._stop = True
         self.thread.join(timeout=5)
@@ -292,12 +297,22 @@ def _handler_for(srv: _Server, model_name: str):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, "Success", {
+                data = {
                     "model": model_name,
                     "params": srv.n_params,
                     "vocab": srv.config.vocab_size,
                     "maxSeqLen": srv.config.max_seq_len,
-                })
+                }
+                if srv.batcher is not None:
+                    b = srv.batcher
+                    data["batching"] = {
+                        "slots": len(b.slots),
+                        "active": sum(s is not None for s in b.slots),
+                        "queued": b.queue.qsize(),
+                        "maxLen": b.max_len,
+                        "alive": b.alive,
+                    }
+                self._send(200, "Success", data)
             else:
                 self._send(404, "route not found", None)
 

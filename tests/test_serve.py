@@ -199,3 +199,27 @@ def test_batcher_close_fails_fast():
     b.close()
     with pytest.raises(RuntimeError, match="unavailable"):
         b.submit(jnp.zeros((4,), jnp.int32), 2)
+
+
+def test_healthz_reports_batching_stats():
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    srv = _Server(cfg, params)
+    srv.batcher = _Batcher(cfg, params, slots=3, max_len=32)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _handler_for(srv, "t"))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = httpd.server_address[1]
+        r = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        b = r["data"]["batching"]
+        assert b["slots"] == 3 and b["active"] == 0 and b["alive"] is True
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.batcher.close()

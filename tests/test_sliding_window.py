@@ -115,3 +115,17 @@ def test_window_with_sp_raises():
                                              cfg.vocab_size, jnp.int32))
     with pytest.raises(NotImplementedError, match="sliding_window"):
         tr.step(st, toks)
+
+
+def test_mistral_7b_canned_config():
+    """Resolves from the registry; windowed; shapes check out abstractly
+    (no 7B init on CPU — eval_shape only)."""
+    from gpu_docker_api_tpu.models import named_config, family_for
+
+    cfg = named_config("llama", "mistral_7b")
+    assert cfg.sliding_window == 4096
+    assert cfg.n_kv_heads == 8 and cfg.d_ff == 14336
+    shapes = jax.eval_shape(
+        lambda: family_for(cfg).init_params(cfg, jax.random.key(0)))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+    assert 7.0e9 < n < 7.6e9          # ~7.24B params
