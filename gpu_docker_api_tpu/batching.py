@@ -45,16 +45,23 @@ def init_slot_cache(config, slots: int, max_len: int) -> dict:
     }
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
-def slot_prefill(params, prompt, cache, slot, config):
+@partial(jax.jit, static_argnames=("config", "append"), donate_argnums=(2,))
+def slot_prefill(params, prompt, cache, slot, config, append: bool = False):
     """Run prompt [1, T] through the model into slot row `slot` (data — one
     compiled program serves every slot). Returns (last logits [1, V], cache).
-    The row's previous content is logically discarded: its length resets to
-    T and writes start at 0."""
+
+    append=False: the row's previous content is logically discarded (length
+    resets to T, writes start at 0). append=True: continues at the row's
+    current length — CHUNKED prefill, so a long prompt can be fed in pieces
+    interleaved with decode steps for the other slots (a multi-thousand-
+    token prefill otherwise stalls every running stream for its whole
+    forward)."""
+    cur = jax.lax.dynamic_slice(cache["lengths"], (slot,), (1,))[0]
+    start = cur if append else jnp.zeros((), jnp.int32)
     row = {
         "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
         "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
-        "length": jnp.zeros((), jnp.int32),
+        "length": start,
     }
     logits, row = _forward_cached(params, prompt, row, config)
     return logits[:, -1], {
@@ -63,8 +70,7 @@ def slot_prefill(params, prompt, cache, slot, config):
         "v": jax.lax.dynamic_update_slice(
             cache["v"], row["v"], (0, slot, 0, 0, 0)),
         "lengths": jax.lax.dynamic_update_slice(
-            cache["lengths"], jnp.array([prompt.shape[1]], jnp.int32),
-            (slot,)),
+            cache["lengths"], (start + prompt.shape[1])[None], (slot,)),
     }
 
 

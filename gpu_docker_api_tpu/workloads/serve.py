@@ -83,13 +83,18 @@ class _Batcher:
     drain. Decode is weight-bound, so occupied slots are nearly free
     throughput."""
 
-    def __init__(self, config, params, slots: int, max_len: int):
+    def __init__(self, config, params, slots: int, max_len: int,
+                 prefill_chunk: int = 0):
         import queue
 
         from ..batching import init_slot_cache
         self.config = config
         self.params = params
         self.max_len = max_len
+        # > 0: feed prompts to the model in pieces of this many tokens,
+        # one piece per loop tick, so a long prefill interleaves with
+        # decode steps for the other slots instead of stalling them
+        self.prefill_chunk = prefill_chunk
         self.queue: "queue.Queue" = queue.Queue()
         self.cache = init_slot_cache(config, slots, max_len)
         self.slots: list = [None] * slots
@@ -104,6 +109,10 @@ class _Batcher:
         must never hang on an event nobody will set."""
         if self._dead is not None:
             raise RuntimeError(f"batcher unavailable: {self._dead}")
+        if prompt_row.shape[0] == 0:
+            # chunked admission would park an empty chunks list forever;
+            # the plain path would crash the scheduler — reject up front
+            raise ValueError("empty prompt")
         if prompt_row.shape[0] + max_new > self.max_len:
             raise ValueError(
                 f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "
@@ -162,11 +171,11 @@ class _Batcher:
     # ---- the scheduler loop (single thread owns the cache) ----
 
     def _admit(self):
-        import jax
-        import jax.numpy as jnp
+        """Claim free slots for queued items. Without chunking, the whole
+        prompt prefills here; with chunking, the item parks in the slot
+        with its remaining pieces and _prefill_tick feeds them."""
         import queue
 
-        from ..batching import slot_prefill
         for i, s in enumerate(self.slots):
             if s is not None:
                 continue
@@ -175,10 +184,16 @@ class _Batcher:
             except queue.Empty:
                 return
             try:
-                logits, self.cache = slot_prefill(
-                    self.params, item["prompt"][None], self.cache,
-                    jnp.int32(i), self.config)
-                tok = int(jax.device_get(jnp.argmax(logits[0])))
+                if self.prefill_chunk > 0:
+                    c = self.prefill_chunk
+                    p = item["prompt"]
+                    item["chunks"] = [p[j:j + c]
+                                      for j in range(0, p.shape[0], c)]
+                    item["stream"] = None        # not decodable yet
+                    self.slots[i] = item
+                else:
+                    self._prefill_piece(i, item, item["prompt"], first=True)
+                    self._arm_or_finish(i, item)
             except Exception as e:
                 # the item is in neither the queue nor a slot here — fail
                 # it directly, then let the crash propagate (_run releases
@@ -186,13 +201,48 @@ class _Batcher:
                 item["error"] = e
                 item["done"].set()
                 raise
-            item["stream"] = [tok]
-            item["last"] = tok
-            if item["max_new"] <= 1:
-                item["out"] = item["stream"]
-                item["done"].set()
-            else:
-                self.slots[i] = item
+
+    def _prefill_piece(self, i, item, piece, first: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from ..batching import slot_prefill
+        logits, self.cache = slot_prefill(
+            self.params, piece[None], self.cache, jnp.int32(i),
+            self.config, append=not first)
+        item["_last_logits"] = logits
+
+    def _arm_or_finish(self, i, item):
+        """Prefill complete: first token comes off the last piece's
+        logits; one-token requests answer immediately."""
+        import jax
+        import jax.numpy as jnp
+
+        tok = int(jax.device_get(jnp.argmax(item.pop("_last_logits")[0])))
+        item["stream"] = [tok]
+        item["last"] = tok
+        if item["max_new"] <= 1:
+            item["out"] = item["stream"]
+            item["done"].set()
+            self.slots[i] = None
+        else:
+            self.slots[i] = item
+
+    def _prefill_tick(self) -> bool:
+        """Feed ONE pending prompt piece (chunked mode). True if fed."""
+        for i, s in enumerate(self.slots):
+            if s is None or not s.get("chunks"):
+                continue
+            # no local error handling: the item is slot-resident, so a
+            # crash propagating to _run hits _fail_all, which releases it
+            piece = s["chunks"].pop(0)
+            self._prefill_piece(i, s, piece,
+                                first="_last_logits" not in s)
+            if not s["chunks"]:
+                del s["chunks"]
+                self._arm_or_finish(i, s)
+            return True
+        return False
 
     def _loop(self):
         import time as _time
@@ -203,18 +253,24 @@ class _Batcher:
         from ..batching import slot_decode
         while not self._stop:
             self._admit()
-            active = [s is not None for s in self.slots]
+            fed = self._prefill_tick()      # one prompt piece per tick
+            # decodable = prefill finished (mid-prefill slots sit out the
+            # step: their lengths must not advance)
+            active = [s is not None and s.get("stream") is not None
+                      for s in self.slots]
             if not any(active):
-                _time.sleep(0.002)
+                if not fed:
+                    _time.sleep(0.002)
                 continue
-            toks = jnp.array([s["last"] if s else 0 for s in self.slots],
-                             jnp.int32)
+            toks = jnp.array(
+                [s["last"] if active[i] else 0
+                 for i, s in enumerate(self.slots)], jnp.int32)
             logits, self.cache = slot_decode(
                 self.params, toks, self.cache,
                 jnp.array(active), self.config)
             nxt = jax.device_get(jnp.argmax(logits, axis=-1))
             for i, s in enumerate(self.slots):
-                if s is None:
+                if not active[i]:
                     continue
                 tok = int(nxt[i])
                 s["stream"].append(tok)
@@ -386,6 +442,11 @@ def main(argv=None) -> int:
     p.add_argument("--batch-max-len", type=int, default=0,
                    help="slot cache length (default: the model's "
                         "max_seq_len)")
+    p.add_argument("--batch-prefill-chunk", type=int, default=0,
+                   help="chunked prefill: feed prompts in pieces of N "
+                        "tokens interleaved with decode steps, so a long "
+                        "prompt doesn't stall running streams (0 = whole "
+                        "prompt at once)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -441,7 +502,8 @@ def main(argv=None) -> int:
                              "--kv-quant is not supported with it yet")
         srv.batcher = _Batcher(config, params, slots=args.batch_slots,
                                max_len=args.batch_max_len
-                               or config.max_seq_len)
+                               or config.max_seq_len,
+                               prefill_chunk=args.batch_prefill_chunk)
         print(f"continuous batching: {args.batch_slots} slots x "
               f"{srv.batcher.max_len} tokens", flush=True)
 

@@ -185,7 +185,10 @@ def test_batcher_crash_releases_waiters(monkeypatch):
     monkeypatch.setattr(batching_mod, "slot_prefill", boom)
     with pytest.raises(RuntimeError, match="batcher"):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
-    # thread is dead; later submits fail fast instead of hanging
+    # once the scheduler thread has fully unwound, submits fail fast
+    # instead of hanging (mid-teardown they may race to 'batcher failed'
+    # via _fail_all's queue drain — also a fast failure, hence the join)
+    b.thread.join(timeout=10)
     with pytest.raises(RuntimeError, match="unavailable"):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
 
@@ -223,3 +226,72 @@ def test_healthz_reports_batching_stats():
         httpd.shutdown()
         httpd.server_close()
         srv.batcher.close()
+
+
+def test_chunked_prefill_streams_exact():
+    """Chunked prefill (pieces interleaved with decode for other slots)
+    must produce the same greedy streams as whole-prompt prefill."""
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=2, max_len=64, prefill_chunk=4)
+    try:
+        # a long prompt (chunked into 4-token pieces, last piece ragged)
+        # and a short one running concurrently
+        p_long = jax.random.randint(jax.random.key(10), (18,), 0,
+                                    cfg.vocab_size)
+        p_short = jax.random.randint(jax.random.key(11), (3,), 0,
+                                     cfg.vocab_size)
+        want_long = np.asarray(generate(params, p_long[None], cfg,
+                                        max_new=5))[0]
+        want_short = np.asarray(generate(params, p_short[None], cfg,
+                                         max_new=5))[0]
+        got = {}
+
+        def ask(name, p):
+            got[name] = b.submit(jnp.asarray(p), 5)
+
+        ts = [threading.Thread(target=ask, args=("long", p_long)),
+              threading.Thread(target=ask, args=("short", p_short))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        np.testing.assert_array_equal(got["long"], want_long)
+        np.testing.assert_array_equal(got["short"], want_short)
+    finally:
+        b.close()
+
+
+def test_batcher_composes_with_w8_weights():
+    """--quantize w8 --batch-slots: the slot decode runs through qmatmul,
+    so int8 weights serve batched exactly like they serve solo."""
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.ops.quant import quantize_params
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = quantize_params(init_params(cfg, jax.random.key(0)), "w8")
+    b = _Batcher(cfg, params, slots=1, max_len=32)
+    try:
+        p = jax.random.randint(jax.random.key(12), (6,), 0, cfg.vocab_size)
+        want = np.asarray(generate(params, p[None], cfg, max_new=4))[0]
+        got = b.submit(jnp.asarray(p), 4)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        b.close()
+
+
+def test_batcher_rejects_empty_prompt():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=16, prefill_chunk=4)
+    try:
+        with pytest.raises(ValueError, match="empty"):
+            b.submit(jnp.zeros((0,), jnp.int32), 4)
+    finally:
+        b.close()
