@@ -33,6 +33,21 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
+    # LR schedule: warmup_steps > 0 enables linear warmup; decay_steps > 0
+    # adds cosine decay to min_lr_ratio * peak after warmup (the standard
+    # LLM-pretraining shape). Both 0 = constant LR (the prior behavior).
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    min_lr_ratio: float = 0.1
+    # accumulate gradients over this many micro-slices of the batch before
+    # the optimizer update — big effective batches without the HBM (a
+    # lax.scan over slices; grads average). For llama this matches the
+    # full-batch step exactly (mean CE is linear in equal slices). For MoE
+    # the router aux loss is computed per slice — batch-statistics-
+    # nonlinear, so it differs slightly from a full-batch aux; that is the
+    # standard microbatched-MoE behavior (GShard computes aux per group),
+    # not an equivalence.
+    accum_steps: int = 1
     remat: bool = True   # per-layer jax.checkpoint of the scan body
     # "dots" saves matmul outputs across the remat boundary (backward skips
     # the MXU recompute — near-zero FLOP overhead, small HBM cost); "full"
@@ -49,10 +64,28 @@ def _pathkey(path) -> str:
     return "".join(str(p) for p in path)
 
 
+def make_schedule(tc: TrainConfig):
+    """Scalar-or-schedule for optax.adamw (constant when no schedule
+    fields are set, so older configs keep bit-identical behavior)."""
+    if not tc.warmup_steps and not tc.decay_steps:
+        return tc.learning_rate
+    peak = tc.learning_rate
+    parts, bounds = [], []
+    if tc.warmup_steps:
+        parts.append(optax.linear_schedule(0.0, peak, tc.warmup_steps))
+        bounds.append(tc.warmup_steps)
+    if tc.decay_steps:
+        parts.append(optax.cosine_decay_schedule(
+            peak, tc.decay_steps, alpha=tc.min_lr_ratio))
+    else:
+        parts.append(optax.constant_schedule(peak))
+    return optax.join_schedules(parts, bounds) if bounds else parts[0]
+
+
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
-        optax.adamw(tc.learning_rate, b1=tc.b1, b2=tc.b2,
+        optax.adamw(make_schedule(tc), b1=tc.b1, b2=tc.b2,
                     weight_decay=tc.weight_decay),
     )
 
@@ -244,21 +277,56 @@ class Trainer:
 
         mb = self.tc.n_microbatches if self._pipelined else 0
 
+        accum = max(self.tc.accum_steps, 1)
+
         def step(state, tokens):
-            def compute_loss(p):
+            def loss_of(p, toks):
                 # remat happens per-layer INSIDE the forward's scan body
                 # (models/remat.py) or per-stage inside the pipeline
                 # schedule — never around the whole loss, which would pay a
                 # full forward recompute AND still store every layer's
                 # residuals during it
-                return loss_fn(p, tokens, cfg, mesh=mesh, n_microbatches=mb,
+                return loss_fn(p, toks, cfg, mesh=mesh, n_microbatches=mb,
                                remat=self.tc.remat,
                                remat_policy=self.tc.remat_policy,
                                virtual_stages=self.tc.virtual_stages,
                                # Trainer state stores interleaved layers
                                # pre-grouped (see _init_fn)
                                pregrouped=self.tc.virtual_stages > 1)
-            loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+
+            if accum == 1:
+                loss, grads = jax.value_and_grad(loss_of)(
+                    state["params"], tokens)
+            else:
+                # gradient accumulation: scan equal micro-slices of the
+                # batch, average loss and grads — numerically the full
+                # batch's mean CE, at 1/accum the activation HBM
+                b = tokens.shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"batch {b} not divisible by accum_steps {accum}")
+                slices = tokens.reshape(accum, b // accum,
+                                        *tokens.shape[1:])
+
+                def acc_body(carry, toks):
+                    loss_sum, grad_sum = carry
+                    l, g = jax.value_and_grad(loss_of)(state["params"],
+                                                       toks)
+                    # accumulate in f32: summing bf16 micro-grads would
+                    # bleed precision across slices
+                    return (loss_sum + l, jax.tree.map(
+                        lambda a, x: a + x.astype(jnp.float32),
+                        grad_sum, g)), None
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    state["params"])
+                (loss, grads), _ = jax.lax.scan(
+                    acc_body, (jnp.zeros((), jnp.float32), zeros), slices)
+                loss = loss / accum
+                grads = jax.tree.map(
+                    lambda g, p: (g / accum).astype(p.dtype),
+                    grads, state["params"])
             updates, new_opt = self.optimizer.update(
                 grads, state["opt_state"], state["params"])
             new_params = optax.apply_updates(state["params"], updates)

@@ -32,7 +32,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--family", default="llama", choices=["llama", "moe"])
     p.add_argument("--config", default="tiny",
-                   choices=["tiny", "mini", "llama3_8b", "mixtral_8x7b"])
+                   help="named config for the family (models.NAMED_CONFIGS)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq", type=int, default=64)
@@ -54,6 +54,15 @@ def main(argv=None) -> int:
                         ".u32 suffix — the nanoGPT/llm.c format); empty = "
                         "synthetic random tokens")
     p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup (0 = constant)")
+    p.add_argument("--decay-steps", type=int, default=0,
+                   help="cosine decay horizon after warmup (0 = none)")
+    p.add_argument("--min-lr-ratio", type=float, default=0.1,
+                   help="cosine decay floor as a fraction of peak LR")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation micro-slices per step")
     args = p.parse_args(argv)
 
     # multi-host: when the control plane granted chips across TPU VM
@@ -83,7 +92,12 @@ def main(argv=None) -> int:
     plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp, pp=args.pp, ep=args.ep)
     trainer = Trainer.create(
         config, plan, tc=TrainConfig(n_microbatches=args.microbatches,
-                                     virtual_stages=args.virtual_stages))
+                                     virtual_stages=args.virtual_stages,
+                                     learning_rate=args.lr,
+                                     warmup_steps=args.warmup_steps,
+                                     decay_steps=args.decay_steps,
+                                     min_lr_ratio=args.min_lr_ratio,
+                                     accum_steps=args.accum_steps))
 
     # resume-first: restore against the ABSTRACT state template (no device
     # materialization); pay for a fresh sharded init only when there is no
