@@ -320,9 +320,11 @@ def flash_bench() -> dict:
     from gpu_docker_api_tpu.ops.attention import (
         flash_attention, reference_attention)
 
-    N = 10
     out = {}
     for seq in (1024, 2048, 4096):
+        # amortize tunnel RTT: short sequences need longer chains or the
+        # fetch latency swamps the ~ms kernel time and the ratio is noise
+        N = max(10, 32768 // seq)
         b, h, d = 4, 8, 128
         ks = jax.random.split(jax.random.key(seq), 3)
         q = jax.random.normal(ks[0], (b, seq, h, d), jnp.bfloat16)
@@ -380,9 +382,12 @@ def decode_bench() -> dict:
         t0 = time.perf_counter()
         jax.device_get(generate(p, prompt, cfg, max_new))
         compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        jax.device_get(generate(p, prompt, cfg, max_new))
-        return time.perf_counter() - t0, compile_s
+        best = float("inf")
+        for _ in range(3):            # min-of-3: the whole generate is
+            t0 = time.perf_counter()  # ~tens of ms, tunnel RTT noise must
+            jax.device_get(generate(p, prompt, cfg, max_new))  # not decide
+            best = min(best, time.perf_counter() - t0)         # the ratio
+        return best, compile_s
 
     dt, compile_s = run(params)
     rec = {
@@ -413,9 +418,12 @@ def decode_bench() -> dict:
         def go():
             return generate(lq, long_prompt, lcfg, 256, kv_quant=kv_quant)
         jax.device_get(go())
-        t0 = time.perf_counter()
-        jax.device_get(go())
-        return time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_get(go())
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     dt_l = run_long(False)
     dt_lq = run_long(True)

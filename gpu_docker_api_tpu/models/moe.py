@@ -217,13 +217,17 @@ def moe_block(x: jax.Array, layer: dict, config: MoEConfig,
             arr, NamedSharding(mesh, spec))
 
     from jax.sharding import PartitionSpec as P
+
+    from ..ops.quant import qeinsum
+
     xe = jnp.einsum("td,tec->ecd", ht, disp)                 # [E, C, D]
     xe = pin(xe, P("ep", None, "fsdp"))    # the dispatch a2a lands here
-    g = jnp.einsum("ecd,edf->ecf", xe, layer["we1"])
-    u = jnp.einsum("ecd,edf->ecf", xe, layer["we3"])
+    # qeinsum == einsum for dense banks; int8 w8 banks for serving
+    g = qeinsum("ecd,edf->ecf", xe, layer["we1"])
+    u = qeinsum("ecd,edf->ecf", xe, layer["we3"])
     y = jax.nn.silu(g) * u                                   # SwiGLU
     y = pin(y, P("ep", None, "tp"))
-    ye = jnp.einsum("ecf,efd->ecd", y, layer["we2"])         # [E, C, D]
+    ye = qeinsum("ecf,efd->ecd", y, layer["we2"])            # [E, C, D]
     ye = pin(ye, P("ep", None, None))
     out = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), comb)
 

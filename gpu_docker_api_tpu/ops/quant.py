@@ -30,9 +30,11 @@ import jax
 import jax.numpy as jnp
 
 MODES = ("w8", "w8a8")
-# weight keys quantize_params converts when present (llama projections/MLP;
-# MoE expert banks stay dense — their einsum layout is a later target)
+# weight keys quantize_params converts when present: llama projections/MLP
+# plus the MoE expert banks (w8 only — their einsums consume the int8 bank
+# via models/moe.py emm; the router stays f32)
 QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+MOE_EXPERT_KEYS = ("we1", "we2", "we3")
 
 
 @jax.tree_util.register_pytree_node_class
@@ -103,16 +105,38 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
     return (y * w.s).astype(x.dtype)
 
 
+def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
+    """Einsum accepting an int8-quantized weight bank (the MoE expert
+    tensors [E, in, out] / [L, E, in, out]): per-expert-per-out-channel
+    scale factors out of the contraction, so it applies to the einsum
+    OUTPUT — same numerics as dequantize-first, half the expert-weight
+    HBM reads. Weight-only (w8) only: activation-int8 banks would be
+    silently mis-computed here, so they are rejected."""
+    if not isinstance(w, QTensor):
+        return jnp.einsum(spec, a, w)
+    if w.mode != "w8":
+        raise ValueError(
+            f"qeinsum consumes weight-only banks; got mode {w.mode!r}")
+    y = jnp.einsum(spec, a, w.q.astype(a.dtype)) * w.s[:, None, :]
+    return y.astype(a.dtype)
+
+
 def quantize_params(params: dict, mode: str = "w8") -> dict:
     """Quantize the matmul weights of a family params tree for inference:
-    every QUANT_KEYS leaf under params["layers"] plus lm_head. Embedding
-    (gather), norms (f32 vectors), and MoE expert banks stay dense."""
+    every QUANT_KEYS leaf under params["layers"] plus lm_head, and MoE
+    expert banks when present (always weight-only — the expert einsum
+    consumes the int8 bank with output-side scaling; dynamic activation
+    int8 for the dispatched [E,C,D] tensor is a later target). Embedding
+    (gather), norms, and the MoE router stay dense."""
     if mode not in MODES:
         raise ValueError(f"mode {mode!r} not in {MODES}")
     layers = dict(params["layers"])
     for k in QUANT_KEYS:
         if k in layers:
             layers[k] = quantize(layers[k], mode)
+    for k in MOE_EXPERT_KEYS:
+        if k in layers:
+            layers[k] = quantize(layers[k], "w8")
     out = dict(params)
     out["layers"] = layers
     out["lm_head"] = quantize(params["lm_head"], mode)

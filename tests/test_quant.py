@@ -159,3 +159,36 @@ def test_kv_quant_composes_with_w8_weights():
     out = generate(params, prompt, cfg, max_new=4, kv_quant=True)
     assert out.shape == (1, 4)
     assert (np.asarray(out) >= 0).all()
+
+
+# ---- MoE expert-bank quantization ----
+
+def test_quantize_moe_expert_banks():
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.models.moe import init_params as moe_init
+
+    cfg = MoEConfig.tiny()
+    params = moe_init(cfg, jax.random.key(0))
+    qp = quantize_params(params, "w8")
+    we1 = qp["layers"]["we1"]
+    assert isinstance(we1, QTensor) and we1.q.dtype == jnp.int8
+    # [L, E, d, f] -> scales per layer, expert, out-channel
+    assert we1.s.shape == params["layers"]["we1"].shape[:2] + (
+        params["layers"]["we1"].shape[-1],)
+    assert not isinstance(qp["layers"]["router"], QTensor)   # router dense
+
+
+def test_quantized_moe_prefill_close_and_generate_runs():
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.models.moe import init_params as moe_init
+
+    cfg = MoEConfig.tiny()
+    params = moe_init(cfg, jax.random.key(0))
+    qp = quantize_params(params, "w8")
+    toks = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+    d, _ = prefill(params, toks, init_cache(cfg, 2, 32), cfg)
+    q, _ = prefill(qp, toks, init_cache(cfg, 2, 32), cfg)
+    d, q = np.asarray(d), np.asarray(q)
+    assert np.abs(q - d).max() / (np.abs(d).max() + 1e-9) < 0.1
+    out = generate(qp, toks[:1, :6], cfg, max_new=4)
+    assert out.shape == (1, 4)
