@@ -74,6 +74,35 @@ def slot_prefill(params, prompt, cache, slot, config, append: bool = False):
     }
 
 
+@partial(jax.jit, static_argnames=("length",))
+def slot_extract_kv(cache, slot, length: int):
+    """Copy the first `length` cache positions of slot row `slot` out as
+    standalone [L, length, Hkv, D] buffers (the prefix-cache store entry).
+    Static length — callers bucket lengths so the jit variety stays small."""
+    k = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)[:, 0]
+    v = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)[:, 0]
+    return k[:, :length], v[:, :length]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def slot_restore_kv(cache, slot, k_prefix, v_prefix, length):
+    """Write a stored prefix's K/V into slot row `slot` starting at 0 and
+    set the row length to `length` (data — positions past it are dead until
+    the remainder prefill overwrites them). The prefix buffers may be
+    bucket-padded; only [0, length) is ever attendable."""
+    k = jax.lax.dynamic_update_slice(
+        cache["k"], k_prefix[:, None].astype(cache["k"].dtype),
+        (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        cache["v"], v_prefix[:, None].astype(cache["v"].dtype),
+        (0, slot, 0, 0, 0))
+    return {
+        "k": k, "v": v,
+        "lengths": jax.lax.dynamic_update_slice(
+            cache["lengths"], jnp.asarray(length, jnp.int32)[None], (slot,)),
+    }
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
 def slot_decode(params, tokens, cache, active, config):
     """One decode step for every slot together. tokens [slots] (last token

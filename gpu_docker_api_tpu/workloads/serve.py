@@ -84,7 +84,8 @@ class _Batcher:
     throughput."""
 
     def __init__(self, config, params, slots: int, max_len: int,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, prefix_cache: int = 0):
+        import collections
         import queue
 
         from ..batching import init_slot_cache
@@ -95,6 +96,12 @@ class _Batcher:
         # one piece per loop tick, so a long prefill interleaves with
         # decode steps for the other slots instead of stalling them
         self.prefill_chunk = prefill_chunk
+        # > 0: keep the KV of the last N distinct prompts; a new request
+        # whose prompt extends a stored one restores that prefix's KV and
+        # prefills only the suffix (system-prompt reuse). LRU by prompt.
+        self.prefix_cache = prefix_cache
+        self._prefixes: "collections.OrderedDict" = collections.OrderedDict()
+        self.prefix_hits = 0
         self.queue: "queue.Queue" = queue.Queue()
         self.cache = init_slot_cache(config, slots, max_len)
         self.slots: list = [None] * slots
@@ -184,15 +191,16 @@ class _Batcher:
             except queue.Empty:
                 return
             try:
+                rem = self._restore_prefix(i, item)
                 if self.prefill_chunk > 0:
                     c = self.prefill_chunk
-                    p = item["prompt"]
-                    item["chunks"] = [p[j:j + c]
-                                      for j in range(0, p.shape[0], c)]
+                    item["chunks"] = [rem[j:j + c]
+                                      for j in range(0, rem.shape[0], c)]
                     item["stream"] = None        # not decodable yet
                     self.slots[i] = item
                 else:
-                    self._prefill_piece(i, item, item["prompt"], first=True)
+                    self._prefill_piece(i, item, rem,
+                                        first=not item.get("_restored"))
                     self._arm_or_finish(i, item)
             except Exception as e:
                 # the item is in neither the queue nor a slot here — fail
@@ -201,6 +209,71 @@ class _Batcher:
                 item["error"] = e
                 item["done"].set()
                 raise
+
+    # ---- prefix cache (system-prompt KV reuse) ----
+
+    def _restore_prefix(self, i, item):
+        """Longest stored prompt prefix -> restore its KV into the slot and
+        return only the tokens still needing prefill (always >= 1, so the
+        last position's logits come from a real forward)."""
+        prompt = item["prompt"]
+        if not self.prefix_cache:
+            return prompt
+        import jax
+        import jax.numpy as jnp
+
+        from ..batching import slot_restore_kv
+        # ONE device-to-host transfer; per-token int() would sync per
+        # element inside the loop that owns every decode stream
+        key = tuple(jax.device_get(prompt).tolist())
+        item["_key"] = key
+        best_key, best_use = None, 0
+        for pk in self._prefixes:
+            lcp = 0
+            for a, b in zip(pk, key):
+                if a != b:
+                    break
+                lcp += 1
+            usable = min(lcp, len(key) - 1)
+            if usable > best_use:
+                best_key, best_use = pk, usable
+        if best_key is None or best_use < 8:     # not worth a restore
+            return prompt
+        entry = self._prefixes[best_key]
+        self._prefixes.move_to_end(best_key)
+        self.cache = slot_restore_kv(self.cache, jnp.int32(i),
+                                     entry["k"], entry["v"],
+                                     best_use)
+        self.prefix_hits += 1
+        item["_restored"] = True
+        return prompt[best_use:]
+
+    def _store_prefix(self, i, item) -> None:
+        """After a full prefill, keep the prompt's KV for future requests
+        sharing the prefix (bucketed to 64 so the extract jit variety
+        stays small; LRU-bounded)."""
+        if not self.prefix_cache:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        from ..batching import slot_extract_kv
+        key = item.get("_key") or tuple(
+            jax.device_get(item["prompt"]).tolist())
+        if len(key) < 8:
+            # below the restore threshold: an entry this short can never
+            # be restored — storing it would only evict useful prefixes
+            return
+        if key in self._prefixes:
+            self._prefixes.move_to_end(key)
+            return
+        # ceil-to-64 never exceeds max_len here: submit() enforces
+        # len + max_new <= max_len with max_new >= 1
+        bucket = min(self.max_len, -(-len(key) // 64) * 64)
+        k, v = slot_extract_kv(self.cache, jnp.int32(i), bucket)
+        self._prefixes[key] = {"k": k, "v": v}
+        while len(self._prefixes) > self.prefix_cache:
+            self._prefixes.popitem(last=False)
 
     def _prefill_piece(self, i, item, piece, first: bool):
         import jax
@@ -218,6 +291,7 @@ class _Batcher:
         import jax
         import jax.numpy as jnp
 
+        self._store_prefix(i, item)   # slot row holds the full prompt's KV
         tok = int(jax.device_get(jnp.argmax(item.pop("_last_logits")[0])))
         item["stream"] = [tok]
         item["last"] = tok
@@ -236,8 +310,11 @@ class _Batcher:
             # no local error handling: the item is slot-resident, so a
             # crash propagating to _run hits _fail_all, which releases it
             piece = s["chunks"].pop(0)
+            # a prefix-restored item must APPEND from its first piece (the
+            # row already holds the restored prefix at its length)
             self._prefill_piece(i, s, piece,
-                                first="_last_logits" not in s)
+                                first=("_last_logits" not in s
+                                       and not s.get("_restored")))
             if not s["chunks"]:
                 del s["chunks"]
                 self._arm_or_finish(i, s)
@@ -367,6 +444,7 @@ def _handler_for(srv: _Server, model_name: str):
                         "queued": b.queue.qsize(),
                         "maxLen": b.max_len,
                         "alive": b.alive,
+                        "prefixHits": b.prefix_hits,
                     }
                 self._send(200, "Success", data)
             else:
@@ -447,6 +525,10 @@ def main(argv=None) -> int:
                         "tokens interleaved with decode steps, so a long "
                         "prompt doesn't stall running streams (0 = whole "
                         "prompt at once)")
+    p.add_argument("--prefix-cache", type=int, default=0,
+                   help="keep the KV of the last N distinct prompts; a "
+                        "request extending a cached prompt prefills only "
+                        "the suffix (system-prompt reuse; 0 = off)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -503,9 +585,13 @@ def main(argv=None) -> int:
         srv.batcher = _Batcher(config, params, slots=args.batch_slots,
                                max_len=args.batch_max_len
                                or config.max_seq_len,
-                               prefill_chunk=args.batch_prefill_chunk)
+                               prefill_chunk=args.batch_prefill_chunk,
+                               prefix_cache=args.prefix_cache)
         print(f"continuous batching: {args.batch_slots} slots x "
               f"{srv.batcher.max_len} tokens", flush=True)
+    elif args.prefix_cache:
+        raise SystemExit("--prefix-cache lives in the batching scheduler; "
+                         "it needs --batch-slots N")
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),

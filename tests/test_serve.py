@@ -295,3 +295,72 @@ def test_batcher_rejects_empty_prompt():
             b.submit(jnp.zeros((0,), jnp.int32), 4)
     finally:
         b.close()
+
+
+def test_prefix_cache_reuses_kv_and_streams_exact():
+    """Second request sharing a 16-token prefix must restore the stored KV
+    (only the suffix prefills) and still produce its exact solo stream."""
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=64, prefix_cache=4)
+    try:
+        base = jax.random.randint(jax.random.key(20), (16,), 0,
+                                  cfg.vocab_size)
+        p1 = jnp.concatenate([base, jnp.array([5, 9], jnp.int32)])
+        p2 = jnp.concatenate([base, jnp.array([7, 1, 3], jnp.int32)])
+        want1 = np.asarray(generate(params, p1[None], cfg, max_new=4))[0]
+        want2 = np.asarray(generate(params, p2[None], cfg, max_new=4))[0]
+        got1 = b.submit(p1, 4)
+        assert b.prefix_hits == 0
+        got2 = b.submit(p2, 4)
+        assert b.prefix_hits == 1                 # p1's KV prefix reused
+        np.testing.assert_array_equal(got1, want1)
+        np.testing.assert_array_equal(got2, want2)
+        # identical prompt resubmitted: restore covers all but the last
+        # token, stream still exact
+        got1b = b.submit(p1, 4)
+        assert b.prefix_hits == 2
+        np.testing.assert_array_equal(got1b, want1)
+    finally:
+        b.close()
+
+
+def test_prefix_cache_composes_with_chunked_prefill():
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=2, max_len=64, prefill_chunk=4,
+                 prefix_cache=2)
+    try:
+        base = jax.random.randint(jax.random.key(21), (12,), 0,
+                                  cfg.vocab_size)
+        p1 = jnp.concatenate([base, jnp.array([2], jnp.int32)])
+        p2 = jnp.concatenate([base, jnp.array([8, 4, 6, 1, 9], jnp.int32)])
+        want2 = np.asarray(generate(params, p2[None], cfg, max_new=5))[0]
+        b.submit(p1, 2)
+        got2 = b.submit(p2, 5)
+        assert b.prefix_hits == 1
+        np.testing.assert_array_equal(got2, want2)
+    finally:
+        b.close()
+
+
+def test_prefix_cache_lru_eviction():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=64, prefix_cache=2)
+    try:
+        for seed in range(4):                     # distinct 10-token prompts
+            p = jax.random.randint(jax.random.key(30 + seed), (10,), 0,
+                                   cfg.vocab_size)
+            b.submit(p, 2)
+        assert len(b._prefixes) == 2              # LRU-bounded
+    finally:
+        b.close()
