@@ -442,7 +442,6 @@ def serving_bench() -> dict:
     concurrent greedy streams through the batcher vs one stream. Decode is
     weight-HBM-bound, so occupied slots should be nearly free — the ratio
     IS the feature."""
-    import threading
 
     import jax
     import jax.numpy as jnp
@@ -455,6 +454,8 @@ def serving_bench() -> dict:
     max_new, prompt_len = 64, 32
 
     def run(n_streams: int, slots: int) -> float:
+        from concurrent.futures import ThreadPoolExecutor
+
         b = _Batcher(cfg, params, slots=slots, max_len=256)
         try:
             prompts = [jax.random.randint(jax.random.key(i),
@@ -462,13 +463,16 @@ def serving_bench() -> dict:
                                           jnp.int32) for i in range(n_streams)]
             b.submit(prompts[0], 2)          # compile prefill+decode
             t0 = time.perf_counter()
-            threads = [threading.Thread(target=b.submit,
-                                        args=(p, max_new)) for p in prompts]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=300)
-            return n_streams * max_new / (time.perf_counter() - t0)
+            with ThreadPoolExecutor(n_streams) as ex:
+                futs = [ex.submit(b.submit, p, max_new) for p in prompts]
+                # .result() re-raises batcher failures/timeouts — a dead
+                # scheduler must surface as an error in the extras, never
+                # as a fabricated near-zero elapsed time
+                streams = [f.result(timeout=300) for f in futs]
+            elapsed = time.perf_counter() - t0
+            assert all(len(s) == max_new for s in streams), \
+                "short stream — throughput would be overstated"
+            return n_streams * max_new / elapsed
         finally:
             b.close()
 
