@@ -463,18 +463,25 @@ def serving_bench() -> dict:
                                           jnp.int32) for i in range(n_streams)]
             b.submit(prompts[0], 2)          # compile prefill+decode
             t0 = time.perf_counter()
-            with ThreadPoolExecutor(n_streams) as ex:
+            ex = ThreadPoolExecutor(n_streams)
+            try:
                 futs = [ex.submit(b.submit, p, max_new) for p in prompts]
                 # .result() re-raises batcher failures/timeouts — a dead
                 # scheduler must surface as an error in the extras, never
                 # as a fabricated near-zero elapsed time
                 streams = [f.result(timeout=300) for f in futs]
+            finally:
+                # close() BEFORE joining the pool: workers stuck in
+                # submit's done.wait() are only woken by _fail_all — the
+                # executor exit would otherwise deadlock on them
+                b.close()
+                ex.shutdown(wait=True)
             elapsed = time.perf_counter() - t0
             assert all(len(s) == max_new for s in streams), \
                 "short stream — throughput would be overstated"
             return n_streams * max_new / elapsed
         finally:
-            b.close()
+            b.close()   # idempotent (no-op after the inner close)
 
     one = run(1, 1)
     four = run(4, 4)

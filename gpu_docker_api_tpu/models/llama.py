@@ -234,7 +234,7 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
             # K/V rotate around the ICI ring instead of being all-gathered —
             # no device holds full K/V or [S, S] scores
             from ..parallel.ring import ring_attention
-            out = ring_attention(q, k, v, mesh, causal=True)
+            out = ring_attention(q, k, v, mesh, causal=True, impl=impl)
     else:
         out = attention(q, k, v, causal=True, impl=impl,
                         window=c.sliding_window)           # [B, S, H, Dh]

@@ -50,14 +50,19 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kf = jnp.repeat(k.astype(jnp.float32), group, axis=2)
     vf = jnp.repeat(v.astype(jnp.float32), group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-    keep = jnp.ones((s, s), bool)
-    if causal:
-        keep &= cols <= rows
-    if window:
-        keep &= cols > rows - window
-    scores = jnp.where(keep[None, None], scores, -jnp.inf)
+    if causal or window:
+        # (s_q, s_k) iotas: kv may be longer/shorter than q (merge tests,
+        # cross-set partials) — only the causal/window cases assume the
+        # square same-position layout
+        sk = k.shape[1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s, sk), 1)
+        keep = jnp.ones((s, sk), bool)
+        if causal:
+            keep &= cols <= rows
+        if window:
+            keep &= cols > rows - window
+        scores = jnp.where(keep[None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
     return out.astype(q.dtype)
@@ -213,9 +218,15 @@ def _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
 #   dK_j = scale * sum_i dS_ij^T Q_i        (grid over kv blocks x GQA group)
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                         dq_ref, *, blk_q: int, blk_k: int, scale: float,
-                         causal: bool, seq_len: int, window: int = 0):
+                         *rest, blk_q: int, blk_k: int, scale: float,
+                         causal: bool, seq_len: int, window: int = 0,
+                         with_dlse: bool = False):
     import jax.experimental.pallas as pl
+    if with_dlse:
+        dlse_ref, dq_ref = rest
+    else:
+        dlse_ref = None
+        (dq_ref,) = rest
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
     q = q_ref[0].astype(jnp.float32) * scale             # [blk_q, D]
     do = do_ref[0].astype(jnp.float32)                   # [blk_q, D]
@@ -224,6 +235,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     # lane-replicated HBM delta array needed)
     delta = jnp.sum(do * o_ref[0].astype(jnp.float32),
                     axis=-1, keepdims=True)              # [blk_q, 1]
+    if with_dlse:
+        # lse cotangent: d score_ij += dlse_i * p_ij  (d lse / d s = p)
+        delta = delta - dlse_ref[0][:, 0:1]
 
     n_kv_total = seq_len // blk_k
     if causal:
@@ -261,10 +275,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                          dk_ref, dv_ref, *, blk_q: int, blk_k: int,
+                          *rest, blk_q: int, blk_k: int,
                           scale: float, causal: bool, seq_len: int,
-                          group: int, window: int = 0):
+                          group: int, window: int = 0,
+                          with_dlse: bool = False):
     import jax.experimental.pallas as pl
+    if with_dlse:
+        dlse_ref, dk_ref, dv_ref = rest
+    else:
+        dlse_ref = None
+        dk_ref, dv_ref = rest
     j = jax.lax.convert_element_type(_pid(1), jnp.int32)
     g = jax.lax.convert_element_type(_pid(2), jnp.int32)
     k = k_ref[0].astype(jnp.float32)                     # [blk_k, D]
@@ -287,6 +307,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         delta = jnp.sum(
             do * o_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32),
             axis=-1, keepdims=True)                      # [blk_q, 1]
+        if with_dlse:
+            delta = delta - dlse_ref[0, pl.ds(i * blk_q, blk_q), :][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal or window:
@@ -330,7 +352,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
-                   window: int = 0):
+                   window: int = 0, dlse=None):
     import jax.experimental.pallas as pl
 
     b, s, h, d = q.shape
@@ -339,21 +361,24 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
     blk_q = min(blk_q, s)
     blk_k = min(blk_k, s)
     scale = 1.0 / math.sqrt(d)
+    with_dlse = dlse is not None
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     dot = do.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    extra = (dlse,) if with_dlse else ()
 
     def kv_index(bh, i):
         del i
         return ((bh // h) * hkv + (bh % h) // group, 0, 0)
 
+    lse_spec_q = pl.BlockSpec((1, blk_q, LANES), lambda bh, i: (bh, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
                           scale=scale, causal=causal, seq_len=s,
-                          window=window),
+                          window=window, with_dlse=with_dlse),
         grid=(b * h, s // blk_q),
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
@@ -361,12 +386,12 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, blk_q, LANES), lambda bh, i: (bh, i, 0)),
-        ],
+            lse_spec_q,
+        ] + ([lse_spec_q] if with_dlse else []),
         out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, ot, dot, lse)
+    )(qt, kt, vt, ot, dot, lse, *extra)
 
     # dk/dv: grid over kv rows x kv blocks x the GQA group; `g` is the
     # fastest-varying dim, so consecutive steps revisit the same out block
@@ -378,7 +403,7 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
                           scale=scale, causal=causal, seq_len=s, group=group,
-                          window=window),
+                          window=window, with_dlse=with_dlse),
         grid=(b * hkv, s // blk_k, group),
         in_specs=[
             pl.BlockSpec((1, s, d), q_row),
@@ -387,7 +412,7 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
             pl.BlockSpec((1, s, d), q_row),
             pl.BlockSpec((1, s, d), q_row),
             pl.BlockSpec((1, s, LANES), q_row),
-        ],
+        ] + ([pl.BlockSpec((1, s, LANES), q_row)] if with_dlse else []),
         out_specs=[
             pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, j, g: (bh, j, 0)),
@@ -397,7 +422,7 @@ def _flash_bwd_raw(q, k, v, o, lse, do, causal, blk_q, blk_k, interpret,
             jax.ShapeDtypeStruct((b * hkv, s, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, ot, dot, lse)
+    )(qt, kt, vt, ot, dot, lse, *extra)
 
     dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     dk = dk.reshape(b, hkv, s, d).transpose(0, 2, 1, 3).astype(k.dtype)
@@ -483,6 +508,81 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # None here means "auto per path"; explicit sizes pin both paths
     return _flash(q, k, v, causal, blk_q or 0, blk_k or 0, interpret,
                   window)
+
+
+# ---- flash with logsumexp (ring attention's building block) ---------------
+
+def _lse_to_bhs(lse3, b, h, s):
+    """[B*H, S, LANES] lane-replicated -> [B, H, S] f32."""
+    return lse3[:, :, 0].reshape(b, h, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, blk_q, blk_k, interpret):
+    b, s, h, _ = q.shape
+    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    return out, _lse_to_bhs(lse3, b, h, s)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    b, s, h, _ = q.shape
+    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    return (out, _lse_to_bhs(lse3, b, h, s)), (q, k, v, out, lse3)
+
+
+def _flash_lse_vjp_bwd(causal, blk_q, blk_k, interpret, res, cts):
+    q, k, v, out, lse3 = res
+    do, dlse = cts                              # dlse [B, H, S]
+    b, s, h, _ = q.shape
+    dlse3 = jnp.broadcast_to(
+        dlse.reshape(b * h, s, 1).astype(jnp.float32), (b * h, s, LANES))
+    return _flash_bwd_raw(q, k, v, out, lse3, do, causal, blk_q, blk_k,
+                          interpret, dlse=dlse3)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        blk_q: int | None = None,
+                        blk_k: int | None = None,
+                        interpret: bool = False
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Flash attention that ALSO returns the per-row logsumexp of the
+    scaled scores, lse [B, H, S] f32 — and is differentiable in BOTH
+    outputs (the lse cotangent folds into the backward kernels' ds term:
+    d lse_i / d s_ij = p_ij). This is the building block for combining
+    partial attentions over disjoint key sets (ring attention: merge the
+    per-ring-step (out, lse) pairs with a numerically stable softmax-of-
+    softmaxes), where the merge weights differentiate through lse."""
+    s = q.shape[1]
+    blk_q = blk_q or _auto_block(s, training=True)
+    blk_k = blk_k or _auto_block(s, training=True)
+    return _flash_lse(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def merge_attention_partials(outs, lses):
+    """Combine attention outputs over DISJOINT key sets: outs [N][B,S,H,D]
+    (each softmax-normalized within its set), lses [N][B,H,S]. Returns the
+    attention over the union, exactly (online-softmax across partials).
+    Pure jnp — differentiates through both operands."""
+    m = lses[0]
+    for l in lses[1:]:
+        m = jnp.maximum(m, l)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    num = None
+    den = None
+    for o, l in zip(outs, lses):
+        w = jnp.where(jnp.isfinite(l), jnp.exp(l - m_safe), 0.0)  # [B,H,S]
+        wq = w.transpose(0, 2, 1)[..., None]                      # [B,S,H,1]
+        term = o.astype(jnp.float32) * wq
+        num = term if num is None else num + term
+        den = w if den is None else den + w
+    den_q = jnp.maximum(den.transpose(0, 2, 1)[..., None], 1e-30)
+    return (num / den_q).astype(outs[0].dtype)
 
 
 # ---- dispatcher ------------------------------------------------------------

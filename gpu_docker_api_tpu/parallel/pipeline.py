@@ -382,9 +382,11 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
             attn_core = functools.partial(_ulysses_local, axis="sp", sp=sp,
                                           causal=True, impl=impl)
         else:
-            from .ring import _ring_local
-            attn_core = functools.partial(_ring_local, axis="sp", ring=sp,
-                                          causal=True)
+            # flash kernels when on TPU with kernel-friendly shard shapes,
+            # einsum body otherwise (ring.ring_body_auto)
+            from .ring import ring_body_auto
+            attn_core = functools.partial(ring_body_auto, axis="sp", ring=sp,
+                                          causal=True, impl=impl)
 
         def layer_fn(h, layer):
             # inside manual {"pp","sp"}: h [b_mb, S/sp, D]. Same block as

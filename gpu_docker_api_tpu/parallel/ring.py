@@ -23,27 +23,127 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import attention as _local_attention
+from ..ops.attention import DEFAULT_BLOCK, _on_tpu, flash_attention_lse
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True, impl: str = "auto") -> jax.Array:
     """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over the sp mesh axis —
     returns [B,S,H,D] with the same sharding. Call from OUTSIDE shard_map;
-    global shapes in, global shapes out."""
+    global shapes in, global shapes out.
+
+    impl="auto" runs each ring step's pairwise attention through the
+    pallas flash kernel when on TPU with kernel-friendly shard shapes
+    (the per-step (out, lse) partials merge with an online softmax —
+    ring attention at flash speed); otherwise the fused-einsum
+    accumulation body runs."""
     axis = "sp"                      # the one sequence axis (mesh.AXES)
     n = mesh.shape[axis]
     if n == 1:
-        return _local_attention(q, k, v, causal=causal)
+        return _local_attention(q, k, v, causal=causal, impl=impl)
 
     from .mesh import qkv_spec
     spec_q = qkv_spec(mesh, q.shape[2], k.shape[2])
-    local = functools.partial(_ring_local, axis=axis, ring=n, causal=causal)
+    s_loc = q.shape[1] // n
+    use_flash = impl != "xla" and (impl == "flash" or (
+        _on_tpu()
+        and s_loc % DEFAULT_BLOCK == 0 and q.shape[3] % 128 == 0))
+    if use_flash:
+        local = functools.partial(_ring_local_flash, axis=axis, ring=n,
+                                  causal=causal,
+                                  # explicit impl="flash" off-TPU (tests)
+                                  # runs the kernels in the interpreter
+                                  interpret=not _on_tpu())
+    else:
+        local = functools.partial(_ring_local, axis=axis, ring=n,
+                                  causal=causal)
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(spec_q, spec_q, spec_q),
         out_specs=spec_q,
         check_vma=False,
     )(q, k, v)
+
+
+def ring_body_auto(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis: str, ring: int, causal: bool,
+                   impl: str = "auto") -> jax.Array:
+    """Per-device ring body with the same flash/einsum dispatch as
+    ring_attention — for callers already inside a manual collective
+    region (the pipelined sp trunk passes this as the attention core).
+    impl="xla" pins the einsum body (the numerics oracle must never
+    silently become the kernel it exists to check)."""
+    if impl != "xla" and (impl == "flash" or (
+            _on_tpu() and q.shape[1] % DEFAULT_BLOCK == 0
+            and q.shape[3] % 128 == 0)):
+        return _ring_local_flash(q, k, v, axis=axis, ring=ring,
+                                 causal=causal, interpret=not _on_tpu())
+    return _ring_local(q, k, v, axis=axis, ring=ring, causal=causal)
+
+
+def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis: str, ring: int, causal: bool,
+                      interpret: bool = False) -> jax.Array:
+    """Per-device body running the pallas flash kernel per ring step.
+
+    Each step holds one rank's K/V shard (disjoint key sets): compute that
+    pair's flash attention WITH its logsumexp, then merge the partials —
+    merge_attention_partials is exactly the online softmax across
+    disjoint sets, and flash_attention_lse differentiates through both
+    outputs, so the whole ring trains through the kernels. Visibility per
+    step (global causal order): src == my -> causal; src < my -> full;
+    src > my -> nothing (skipped as a zero/-inf partial)."""
+    b, s_loc, h, d = q.shape
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def pair(k_cur, v_cur, causal_step: bool):
+        return flash_attention_lse(q, k_cur, v_cur, causal=causal_step,
+                                   interpret=interpret)
+
+    def empty(kv):
+        del kv
+        return (jnp.zeros((b, s_loc, h, d), q.dtype),
+                jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))
+
+    def accumulate(i, k_cur, v_cur, num, den, m):
+        src = (my - i) % ring
+        if causal:
+            o, lse = jax.lax.cond(
+                src == my,
+                lambda kv: pair(kv[0], kv[1], True),
+                lambda kv: jax.lax.cond(
+                    src < my,
+                    lambda kv2: pair(kv2[0], kv2[1], False),
+                    empty, kv),
+                (k_cur, v_cur))
+        else:
+            o, lse = pair(k_cur, v_cur, False)
+        # online merge of the partial into (num, den, m) — same math as
+        # merge_attention_partials, streamed
+        m_new = jnp.maximum(m, lse)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        w = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
+        aq = alpha.transpose(0, 2, 1)[..., None]
+        wq = w.transpose(0, 2, 1)[..., None]
+        num = num * aq + o.astype(jnp.float32) * wq
+        den = den * alpha + w
+        return num, den, m_new
+
+    num = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    den = jnp.zeros((b, h, s_loc), jnp.float32)
+    m = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    k_cur, v_cur = k, v
+    # ring-1 (compute, rotate) steps, then a final compute with no
+    # rotation — the last hop's result would be discarded
+    for i in range(ring):
+        num, den, m = accumulate(i, k_cur, v_cur, num, den, m)
+        if i < ring - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    den_q = jnp.maximum(den.transpose(0, 2, 1)[..., None], 1e-30)
+    return (num / den_q).astype(q.dtype)
 
 
 def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
