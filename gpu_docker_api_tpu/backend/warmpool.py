@@ -58,12 +58,30 @@ os.dup2(_fd, 2)
 os.close(_fd)
 os.environ.clear()
 os.environ.update(_job["env"])
-# jax.config binds some values from env at import time; re-point the ones a
-# job may override (the forced-CPU bench fallback sets JAX_PLATFORMS=cpu)
-if "jax" in sys.modules and _job["env"].get("JAX_PLATFORMS"):
+# jax.config binds JAX_* env values at import time, which this worker has
+# already paid — re-point every JAX_* the job sets through jax.config (the
+# forced-CPU bench fallback sets JAX_PLATFORMS=cpu; jobs may set
+# JAX_ENABLE_X64 etc.). Vars jax.config can NOT re-point are refused by
+# supports() so those jobs cold-spawn. XLA_FLAGS/LIBTPU_* need no
+# re-pointing: the backend has not initialized yet, so the C++ runtime
+# reads them from the restored os.environ at first device use.
+if "jax" in sys.modules:
     try:
         import jax
-        jax.config.update("jax_platforms", _job["env"]["JAX_PLATFORMS"])
+        for _k, _v in _job["env"].items():
+            if not _k.startswith("JAX_"):
+                continue
+            _coerced = _v
+            if _v.lower() in ("true", "false"):
+                _coerced = _v.lower() == "true"
+            elif _v.isdigit():
+                _coerced = int(_v)
+            for _attempt in (_coerced, _v):
+                try:
+                    jax.config.update(_k.lower(), _attempt)
+                    break
+                except Exception:
+                    continue
     except Exception:
         pass
 os.chdir(_job["cwd"])
@@ -124,6 +142,12 @@ class WarmPool:
 
     # ---- dispatch ----
 
+    # env a warm worker cannot honor even via jax.config re-pointing:
+    # consumed once at import and never re-read (dtype canonicalization
+    # width; this repo's own module-level knobs, in case a pool preimports
+    # repo modules). Jobs setting these cold-spawn.
+    IMPORT_BAKED_ENV = ("JAX_DEFAULT_DTYPE_BITS", "TDAPI_FLASH_MIN_SEQ")
+
     @staticmethod
     def supports(cmd: list[str], env: Optional[list[str]] = None) -> bool:
         """True for `python [-u] (-c code | -m mod | script) [args...]`.
@@ -131,11 +155,15 @@ class WarmPool:
         env is the container spec's env list: a job that sets any PYTHON*
         variable (PYTHONPATH, PYTHONHASHSEED, ...) is refused — those are
         consumed at interpreter STARTUP, which the warm worker has already
-        paid, so os.environ.update can't honor them; it must cold-spawn."""
+        paid, so os.environ.update can't honor them; it must cold-spawn.
+        Same for the import-baked JAX vars in IMPORT_BAKED_ENV (other
+        JAX_* vars the worker re-points through jax.config; XLA_FLAGS and
+        LIBTPU_* are read at backend init, which hasn't happened yet)."""
         if not cmd or not os.path.basename(cmd[0]).startswith("python"):
             return False
         for kv in env or []:
-            if kv.partition("=")[0].startswith("PYTHON"):
+            key = kv.partition("=")[0]
+            if key.startswith("PYTHON") or key in WarmPool.IMPORT_BAKED_ENV:
                 return False
         args = cmd[1:]
         while args and args[0] == "-u":

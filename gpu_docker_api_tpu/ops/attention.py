@@ -21,11 +21,19 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK = 128
+# Flash-vs-XLA crossover, from the driver's real-v5e sweep (BENCH_r02:
+# fwd flash 0.59x XLA at S=1024, 2.31x at S=2048, 1.83x at S=4096): below
+# this sequence length the fused-XLA softmax wins — the [S, S] score tile
+# stays cheap and the pallas grid/scratch overhead dominates. The auto
+# dispatcher routes shorter sequences to XLA; override for retuning on
+# other chips via env.
+FLASH_MIN_SEQ = int(os.environ.get("TDAPI_FLASH_MIN_SEQ", "2048"))
 # TPU vector lanes. Per-row residuals (logsumexp) are stored lane-replicated
 # [.., S, LANES] because mosaic requires the last two dims of every block to
 # be (8k, 128m)-aligned — a [B*H, S] residual with (1, blk_q) blocks does not
@@ -598,13 +606,15 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True, impl: str = "auto",
               window: int = 0) -> jax.Array:
     """Dispatch: pallas flash on TPU when shapes are kernel-friendly
-    (128-aligned seq, head_dim a lane multiple), XLA reference otherwise.
-    window > 0 = sliding-window attention (both impls)."""
+    (128-aligned seq, head_dim a lane multiple) AND the sequence is past
+    the measured flash/XLA crossover (FLASH_MIN_SEQ); XLA reference
+    otherwise. window > 0 = sliding-window attention (both impls)."""
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, window=window)
     if impl == "xla":
         return reference_attention(q, k, v, causal=causal, window=window)
     s, d = q.shape[1], q.shape[3]
-    if _on_tpu() and s % DEFAULT_BLOCK == 0 and d % 128 == 0:
+    if (_on_tpu() and s >= FLASH_MIN_SEQ
+            and s % DEFAULT_BLOCK == 0 and d % 128 == 0):
         return flash_attention(q, k, v, causal=causal, window=window)
     return reference_attention(q, k, v, causal=causal, window=window)

@@ -117,6 +117,19 @@ def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
     if w.mode != "w8":
         raise ValueError(
             f"qeinsum consumes weight-only banks; got mode {w.mode!r}")
+    # the output-side scale below is w.s[:, None, :] — correct ONLY for a
+    # 3-dim bank whose expert axis leads the output and whose out axis
+    # ends it ([E, in, out] bank -> [E, C, out] output). Any other layout
+    # (a layer-stacked [L, E, in, out] bank scan didn't unstack, a
+    # reordered output) would silently mis-scale — fail loudly instead.
+    ins, outs = spec.replace(" ", "").split("->")
+    bank_spec = ins.split(",")[1]
+    if w.q.ndim != 3 or len(bank_spec) != 3 or len(outs) != 3 or \
+            outs[0] != bank_spec[0] or outs[-1] != bank_spec[-1]:
+        raise ValueError(
+            f"qeinsum scale layout: spec {spec!r} with bank shape "
+            f"{w.q.shape} must contract an [E, in, out] bank into an "
+            f"[E, ..., out] output")
     y = jnp.einsum(spec, a, w.q.astype(a.dtype)) * w.s[:, None, :]
     return y.astype(a.dtype)
 

@@ -84,7 +84,8 @@ class _Batcher:
     throughput."""
 
     def __init__(self, config, params, slots: int, max_len: int,
-                 prefill_chunk: int = 0, prefix_cache: int = 0):
+                 prefill_chunk: int = 0, prefix_cache: int = 0,
+                 restarts: int = 3):
         import collections
         import queue
 
@@ -92,6 +93,12 @@ class _Batcher:
         self.config = config
         self.params = params
         self.max_len = max_len
+        # scheduler crash budget: a transient device/XLA error fails the
+        # in-flight requests but the loop re-initializes its cache and
+        # keeps serving; after `restarts` crashes the batcher stays dead
+        # (a persistent fault must not retry forever)
+        self._restarts_left = restarts
+        self._prefill_cursor = 0
         # > 0: feed prompts to the model in pieces of this many tokens,
         # one piece per loop tick, so a long prefill interleaves with
         # decode steps for the other slots instead of stalling them
@@ -114,8 +121,9 @@ class _Batcher:
         """Blocking: returns the greedy stream for one sequence. Raises if
         the scheduler thread has died or the batcher is closed — a request
         must never hang on an event nobody will set."""
-        if self._dead is not None:
-            raise RuntimeError(f"batcher unavailable: {self._dead}")
+        if self._stop or self._dead is not None:
+            raise RuntimeError(
+                f"batcher unavailable: {self._dead or 'closed'}")
         if prompt_row.shape[0] == 0:
             # chunked admission would park an empty chunks list forever;
             # the plain path would crash the scheduler — reject up front
@@ -130,8 +138,9 @@ class _Batcher:
         # re-check AFTER the put: _fail_all may have drained the queue
         # between our _dead check and the put, leaving this item in a dead
         # queue that nobody will ever service
-        if self._dead is not None and not item["done"].is_set():
-            item["error"] = self._dead
+        if ((self._stop or self._dead is not None)
+                and not item["done"].is_set()):
+            item["error"] = self._dead or RuntimeError("batcher closed")
             item["done"].set()
         item["done"].wait()
         if item["error"] is not None:
@@ -141,7 +150,7 @@ class _Batcher:
     @property
     def alive(self) -> bool:
         """Scheduler thread is running and accepting work (/healthz)."""
-        return self._dead is None
+        return self._dead is None and not self._stop
 
     def close(self):
         self._stop = True
@@ -167,13 +176,34 @@ class _Batcher:
             item["done"].set()
 
     def _run(self):
-        try:
-            self._loop()
-        except Exception as e:  # noqa: BLE001 — device OOM/XLA errors land
-            # here; every waiter must be released, not left hanging
-            import traceback
-            traceback.print_exc()
-            self._fail_all(e)
+        from ..batching import init_slot_cache
+        while True:
+            try:
+                self._loop()
+                return
+            except Exception as e:  # noqa: BLE001 — device OOM/XLA errors
+                # land here; every waiter must be released, not left hanging
+                import traceback
+                traceback.print_exc()
+                self._fail_all(e)
+                if self._stop or self._restarts_left <= 0:
+                    return
+                # one transient device error must not disable continuous
+                # batching for the process lifetime: the crash failed every
+                # in-flight waiter above, so the cache holds only dead
+                # rows — rebuild it and resume accepting work
+                self._restarts_left -= 1
+                self.cache = init_slot_cache(
+                    self.config, len(self.slots), self.max_len)
+                self._prefixes.clear()
+                if self._stop:
+                    # close() ran while we rebuilt (its join can time out
+                    # mid-rebuild): clearing _dead now would make a batcher
+                    # that is about to exit report alive
+                    return
+                self._dead = None
+                print(f"batcher scheduler restarted after: {e!r} "
+                      f"({self._restarts_left} restarts left)", flush=True)
 
     # ---- the scheduler loop (single thread owns the cache) ----
 
@@ -303,10 +333,17 @@ class _Batcher:
             self.slots[i] = item
 
     def _prefill_tick(self) -> bool:
-        """Feed ONE pending prompt piece (chunked mode). True if fed."""
-        for i, s in enumerate(self.slots):
+        """Feed ONE pending prompt piece (chunked mode). True if fed.
+        Scans round-robin from a rotating cursor so a chunked prefill
+        parked in a high slot can't be starved by a stream of new chunked
+        admissions landing in lower-index slots."""
+        n = len(self.slots)
+        for off in range(n):
+            i = (self._prefill_cursor + off) % n
+            s = self.slots[i]
             if s is None or not s.get("chunks"):
                 continue
+            self._prefill_cursor = (i + 1) % n
             # no local error handling: the item is slot-resident, so a
             # crash propagating to _run hits _fail_all, which releases it
             piece = s["chunks"].pop(0)
@@ -386,9 +423,17 @@ class _Server:
         # continuous batching: greedy single-sequence requests join the
         # running slot batch WITHOUT the single-flight lock — concurrency
         # is the whole point; the batcher thread owns the cache
-        if (self.batcher is not None and float(temperature) == 0.0
-                and prompt.shape[0] == 1):
-            return [self.batcher.submit(prompt[0], int(max_new))]
+        if self.batcher is not None:
+            if float(temperature) == 0.0 and prompt.shape[0] == 1:
+                return [self.batcher.submit(prompt[0], int(max_new))]
+            # anything else would run generate() concurrently with the
+            # batcher's slot decode on the same chip — two full KV caches
+            # + programs live at once, an OOM on a chip where either mode
+            # alone fits. Refuse instead of racing the batcher for HBM.
+            raise ValueError(
+                "server runs in continuous-batching mode: send greedy "
+                "single-sequence requests (temperature 0, one row), or "
+                "start without --batch-slots for sampling/multi-row")
         with self.lock:
             # speculative path: greedy + single sequence + a draft loaded
             # (the greedy-case guarantee makes it transparent — the output

@@ -192,3 +192,29 @@ def test_quantized_moe_prefill_close_and_generate_runs():
     assert np.abs(q - d).max() / (np.abs(d).max() + 1e-9) < 0.1
     out = generate(qp, toks[:1, :6], cfg, max_new=4)
     assert out.shape == (1, 4)
+
+
+def test_qeinsum_rejects_unsupported_scale_layouts():
+    """qeinsum's output-side scale assumes an [E, in, out] bank feeding an
+    [E, ..., out] output; any other layout must fail loudly instead of
+    silently mis-scaling (ADVICE r2 low)."""
+    from gpu_docker_api_tpu.ops.quant import qeinsum
+
+    bank = quantize(jax.random.normal(jax.random.key(0), (2, 8, 4)), "w8")
+    a = jax.random.normal(jax.random.key(1), (2, 3, 8))
+    out = qeinsum("ecd,edf->ecf", a, bank)           # the supported shape
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(jnp.einsum("ecd,edf->ecf", a, dequantize(bank, a.dtype))),
+        rtol=1e-5)
+    # layer-stacked bank that scan didn't unstack
+    bank4 = quantize(
+        jax.random.normal(jax.random.key(2), (3, 2, 8, 4)), "w8")
+    with pytest.raises(ValueError, match="scale layout"):
+        qeinsum("lecd,ledf->lecf", jnp.zeros((3, 2, 3, 8)), bank4)
+    # output not ending with the bank's out axis
+    with pytest.raises(ValueError, match="scale layout"):
+        qeinsum("ecd,edf->efc", a, bank)
+    # output not led by the bank's expert axis
+    with pytest.raises(ValueError, match="scale layout"):
+        qeinsum("ecd,edf->cef", a, bank)

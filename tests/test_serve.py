@@ -170,13 +170,14 @@ def test_batcher_rejects_overlong_request():
 
 
 def test_batcher_crash_releases_waiters(monkeypatch):
-    """A dying scheduler thread must fail pending submits, not hang them."""
+    """A dying scheduler thread must fail pending submits, not hang them
+    (restarts=0 pins the no-retry behavior; restart path tested below)."""
     from gpu_docker_api_tpu.workloads import serve as serve_mod
     from gpu_docker_api_tpu.workloads.serve import _Batcher
 
     cfg = LlamaConfig.tiny()
     params = init_params(cfg, jax.random.key(0))
-    b = _Batcher(cfg, params, slots=1, max_len=32)
+    b = _Batcher(cfg, params, slots=1, max_len=32, restarts=0)
     import gpu_docker_api_tpu.batching as batching_mod
 
     def boom(*a, **k):
@@ -191,6 +192,119 @@ def test_batcher_crash_releases_waiters(monkeypatch):
     b.thread.join(timeout=10)
     with pytest.raises(RuntimeError, match="unavailable"):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
+
+
+def test_batcher_restarts_after_transient_crash(monkeypatch):
+    """One transient device error fails the in-flight request but the
+    scheduler rebuilds its cache and keeps serving (ADVICE r2 medium)."""
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+    import gpu_docker_api_tpu.batching as batching_mod
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    real = batching_mod.slot_prefill
+    fails = {"n": 1}
+
+    def flaky(*a, **k):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise RuntimeError("transient XLA error")
+        return real(*a, **k)
+
+    monkeypatch.setattr(batching_mod, "slot_prefill", flaky)
+    b = _Batcher(cfg, params, slots=1, max_len=32)
+    try:
+        with pytest.raises(RuntimeError, match="batcher"):
+            b.submit(jnp.zeros((4,), jnp.int32), 4)
+        # the restarted scheduler serves the next request normally and
+        # matches the direct greedy stream
+        prompt = jnp.array([5, 9, 2, 7], jnp.int32)
+        deadline = 50
+        out = None
+        for _ in range(deadline):
+            try:
+                out = b.submit(prompt, 4)
+                break
+            except RuntimeError:
+                import time
+                time.sleep(0.1)
+        assert out is not None, "batcher never came back after restart"
+        from gpu_docker_api_tpu.infer import generate
+        want = np.asarray(generate(params, prompt[None], cfg, 4)).tolist()[0]
+        assert out == want
+        assert b.alive
+    finally:
+        b.close()
+
+
+def test_batcher_restart_budget_exhausts(monkeypatch):
+    """A persistent fault must not retry forever: after the restart budget
+    the batcher stays dead and submits fail fast."""
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+    import gpu_docker_api_tpu.batching as batching_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("persistent device failure")
+
+    monkeypatch.setattr(batching_mod, "slot_prefill", boom)
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=32, restarts=2)
+    # a submit landing inside the restart window raises without reaching
+    # the scheduler, so crashes aren't 1:1 with submits — drive until the
+    # budget is actually spent and the thread exits
+    import time
+    for _ in range(40):
+        with pytest.raises(RuntimeError, match="batcher"):
+            b.submit(jnp.zeros((4,), jnp.int32), 4)
+        if not b.thread.is_alive():
+            break
+        time.sleep(0.05)
+    b.thread.join(timeout=10)
+    assert not b.thread.is_alive()
+    assert not b.alive
+    with pytest.raises(RuntimeError, match="unavailable"):
+        b.submit(jnp.zeros((4,), jnp.int32), 4)
+
+
+def test_server_rejects_sampling_when_batching():
+    """With --batch-slots active, a sampling or multi-row request must be
+    refused instead of racing the batcher for HBM (ADVICE r2 low)."""
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    srv = _Server(cfg, params)
+    srv.batcher = _Batcher(cfg, params, slots=1, max_len=32)
+    try:
+        with pytest.raises(ValueError, match="continuous-batching"):
+            srv.generate([[1, 2, 3]], 4, temperature=0.8)
+        with pytest.raises(ValueError, match="continuous-batching"):
+            srv.generate([[1, 2, 3], [4, 5, 6]], 4, temperature=0.0)
+        out = srv.generate([[1, 2, 3]], 4, temperature=0.0)
+        assert len(out) == 1 and len(out[0]) == 4
+    finally:
+        srv.batcher.close()
+
+
+def test_prefill_tick_round_robin_is_fair():
+    """Chunked prefill must rotate across slots: a parked prefill in a
+    high slot is not starved by lower-index slots (ADVICE r2 low)."""
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=3, max_len=32, prefill_chunk=4)
+    b._stop = True
+    b.thread.join(timeout=10)
+    fed = []
+    b._prefill_piece = lambda i, item, piece, first: fed.append(i)
+    for i in range(3):
+        b.slots[i] = {"chunks": [jnp.zeros((4,), jnp.int32)] * 8,
+                      "done": threading.Event()}
+    for _ in range(6):
+        assert b._prefill_tick()
+    assert fed == [0, 1, 2, 0, 1, 2]
 
 
 def test_batcher_close_fails_fast():

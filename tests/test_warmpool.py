@@ -40,6 +40,38 @@ def test_supports_classification():
     assert not WarmPool.supports([py, "-c", "pass"], ["PYTHONPATH=/x"])
     assert not WarmPool.supports([py, "-c", "pass"], ["PYTHONHASHSEED=0"])
     assert WarmPool.supports([py, "-c", "pass"], ["FOO=bar", "PY=1"])
+    # import-baked JAX env can't be re-pointed post-import — must cold-spawn
+    assert not WarmPool.supports([py, "-c", "pass"],
+                                 ["JAX_DEFAULT_DTYPE_BITS=32"])
+    # re-pointable JAX env is fine (the worker routes it via jax.config)
+    assert WarmPool.supports([py, "-c", "pass"], ["JAX_ENABLE_X64=1"])
+    assert WarmPool.supports([py, "-c", "pass"], ["XLA_FLAGS=--xla_foo"])
+
+
+def test_warm_worker_repoints_jax_env(tmp_path):
+    """A warm worker that already imported jax must honor a job's JAX_*
+    env through jax.config (ADVICE r2: JAX_ENABLE_X64 et al. were silently
+    ignored before)."""
+    b = ProcessBackend(str(tmp_path / "b"), warm_pool=1,
+                       warm_preimport="jax")
+    try:
+        wait_for(lambda: len(b._pool._idle) >= 1, timeout=60,
+                 msg="jax warm worker")
+        pool_pids = {w.pid for w in b._pool._idle}
+        st = _run(b, "cx", (
+            "import os, json, jax, jax.numpy as jnp\n"
+            "rec = {'pid': os.getpid(),\n"
+            "       'x64': str(jnp.arange(3.0).dtype)}\n"
+            "open('marker.json', 'w').write(json.dumps(rec))\n"
+        ), env=["JAX_ENABLE_X64=true", "JAX_PLATFORMS=cpu"])
+        marker = os.path.join(st.upper_dir, "marker.json")
+        wait_for(lambda: os.path.exists(marker), timeout=60, msg="marker")
+        import json as _json
+        rec = _json.loads(open(marker).read())
+        assert rec["pid"] in pool_pids      # ran warm, not cold-spawned
+        assert rec["x64"] == "float64"      # x64 re-pointed post-import
+    finally:
+        b.close()
 
 
 @pytest.fixture()
