@@ -5,7 +5,8 @@ from .process import ProcessBackend  # noqa: F401
 
 def make_backend(kind: str, state_dir: str,
                  volume_tiers: dict | None = None,
-                 warm_pool: int = 0) -> Backend:
+                 warm_pool: int = 0,
+                 supervise: bool = False) -> Backend:
     """Runtime backend selection — the reference does this at compile time
     with Go build tags (`-tags mock` vs `-tags nvidia`, Makefile:25-47);
     a runtime seam keeps one binary and makes CI trivial. volume_tiers maps
@@ -17,7 +18,8 @@ def make_backend(kind: str, state_dir: str,
     if kind == "mock":
         b = MockBackend(state_dir)
     elif kind == "process":
-        b = ProcessBackend(state_dir, warm_pool=warm_pool)
+        b = ProcessBackend(state_dir, warm_pool=warm_pool,
+                           supervise=supervise)
     elif kind == "docker":
         from .docker import DockerBackend
         b = DockerBackend(state_dir)

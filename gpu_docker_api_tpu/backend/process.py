@@ -37,6 +37,32 @@ from ..dtos import ContainerSpec
 from .base import Backend, ContainerState, VolumeState
 
 
+def _run_quiet(cmd: list[str], timeout: float = 30.0) -> bool:
+    """Run a host tool, True on rc 0; missing binary / failure = False."""
+    try:
+        return subprocess.run(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=timeout).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _quota_bytes(quota: str) -> int:
+    """'30G'/'30GB' -> bytes; 0 for empty/unparseable (no enforcement).
+    Accepts docker StorageOpt-style single-letter units (the reference's
+    `size=30G`, replicaset.go:67-71) on top of utils ToBytes units."""
+    s = (quota or "").strip().upper()
+    if not s:
+        return 0
+    if s[-1] in "KMGT" and (len(s) < 2 or s[-2] not in "KMGT"):
+        s += "B"
+    from ..utils.file import to_bytes
+    try:
+        return to_bytes(s)
+    except ValueError:
+        return 0
+
+
 class _Proc:
     def __init__(self, name: str, spec: ContainerSpec, rootfs: str, log_path: str):
         self.id = uuid.uuid4().hex[:12]
@@ -48,11 +74,18 @@ class _Proc:
         self.paused = False
         self.started_at = 0.0
         self.exit_code: Optional[int] = None
+        # supervision state (restart policy + storage watchdog)
+        self.user_stopped = False     # stop() was asked for — no restart
+        self.restart_count = 0
+        self.restart_at = 0.0         # 0 = no restart pending
+        self.quota_check_at = 0.0     # next rootfs usage poll
+        self.quota_exceeded = False
 
 
 class ProcessBackend(Backend):
     def __init__(self, state_dir: str, warm_pool: int = 0,
-                 warm_preimport: str = "jax"):
+                 warm_preimport: str = "jax", supervise: bool = False,
+                 supervise_interval: float = 0.3):
         self.state_dir = state_dir
         self._lock = threading.RLock()
         self._procs: dict[str, _Proc] = {}
@@ -65,6 +98,28 @@ class ProcessBackend(Backend):
         if warm_pool > 0:
             from .warmpool import WarmPool
             self._pool = WarmPool(size=warm_pool, preimport=warm_preimport)
+        # loopback-fs volume quota capability: None = not probed yet
+        self._loopfs: Optional[bool] = None
+        self._closed = False
+        # supervision (the daemon turns this on; unit substrates keep it
+        # off so exited test containers stay exited): restart_policy
+        # enforcement — the reference gets `unless-stopped` from dockerd
+        # (replicaset.go:73-75), a host-process substrate must supervise
+        # itself — plus the rootfs storage-quota watchdog (the fallback
+        # enforcement where no filesystem quota exists for a plain dir).
+        self._interval = supervise_interval
+        self._supervisor = None
+        self._remount_quota_volumes()
+        if supervise:
+            self._supervisor = threading.Thread(
+                target=self._supervise, daemon=True,
+                name="process-backend-supervisor")
+            self._supervisor.start()
+            # rootfs-quota polling walks whole rootfs trees (IO-bound) —
+            # its own thread, so a slow walk never delays crash detection
+            # or a scheduled restart
+            threading.Thread(target=self._quota_watch, daemon=True,
+                             name="process-backend-quota-watch").start()
 
     # ---- containers ----
 
@@ -122,6 +177,8 @@ class ProcessBackend(Backend):
             p.started_at = time.time()
             p.paused = False
             p.exit_code = None
+            p.user_stopped = False
+            p.restart_at = 0.0
 
     def _start_warm(self, p: _Proc, cmd: list[str], env: dict):
         """Try to run the container on a warm pool worker; None -> cold
@@ -149,6 +206,7 @@ class ProcessBackend(Backend):
     def stop(self, name: str, timeout: float = 10.0) -> None:
         with self._lock:
             p = self._get(name)
+            p.user_stopped = True   # an explicit stop never auto-restarts
             po = p.popen
         if po is None or po.poll() is not None:
             if po is not None:
@@ -186,6 +244,100 @@ class ProcessBackend(Backend):
                 return
         self.stop(name, timeout=5)
         self.start(name)
+
+    # ---- supervision (restart policy + storage watchdog) ----
+
+    def _supervise(self) -> None:
+        while not self._closed:
+            time.sleep(self._interval)
+            with self._lock:
+                items = list(self._procs.items())
+            for name, p in items:
+                try:
+                    self._supervise_one(name, p)
+                except Exception:  # noqa: BLE001 — supervision must outlive
+                    pass           # any single container's weirdness
+
+    def _supervise_one(self, name: str, p: _Proc) -> None:
+        po = p.popen
+        if po is None:
+            return
+        now = time.time()
+        rc = po.poll()
+        if rc is None:
+            # running healthily for a stretch: forgive the backoff history
+            if p.restart_count and now - p.started_at > 10.0:
+                p.restart_count = 0
+            return
+        if p.user_stopped or p.quota_exceeded:
+            return
+        pol = p.spec.restart_policy or "no"
+        if pol == "no" or (pol == "on-failure" and rc == 0):
+            return
+        if pol not in ("always", "unless-stopped", "on-failure"):
+            return
+        if not p.restart_at:                       # death just observed
+            delay = min(30.0, 0.25 * (2 ** min(p.restart_count, 7)))
+            p.restart_at = now + delay
+            return
+        if now < p.restart_at:
+            return
+        with self._lock:
+            cur = self._procs.get(name)
+            if cur is not p or p.user_stopped or p.popen.poll() is None:
+                return                             # raced a user action
+            p.restart_at = 0.0
+            p.restart_count += 1
+            self._log_line(p, f"supervisor: restarting (policy={pol}, "
+                              f"exit={rc}, attempt={p.restart_count})")
+            self.start(name)
+
+    def _quota_watch(self) -> None:
+        while not self._closed:
+            time.sleep(min(1.0, self._interval * 4))
+            with self._lock:
+                items = list(self._procs.items())
+            for name, p in items:
+                try:
+                    if p.popen is not None and p.popen.poll() is None:
+                        self._enforce_rootfs_quota(name, p, time.time())
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _enforce_rootfs_quota(self, name: str, p: _Proc, now: float) -> None:
+        """Storage-quota watchdog for the rootfs dir. The reference gets
+        hard rootfs quota from overlay2-on-XFS (`StorageOpt size=30G`,
+        replicaset.go:67-71); a plain host directory has no filesystem
+        quota, so enforcement here is supervisory: poll usage (throttled),
+        kill the workload on breach, and never restart it (a restart would
+        be killed again at the same frontier). Volumes get REAL ENOSPC
+        quota via loopback images (volume_create)."""
+        if now < p.quota_check_at:
+            return
+        p.quota_check_at = now + 2.0
+        limit = _quota_bytes(p.spec.rootfs_quota)
+        if not limit:
+            return
+        from ..utils.file import dir_size
+        used = dir_size(p.rootfs)
+        if used <= limit:
+            return
+        p.quota_exceeded = True
+        self._log_line(
+            p, f"supervisor: rootfs storage quota exceeded "
+               f"({used} > {limit} bytes) — killing container")
+        try:
+            self.stop(name, timeout=2.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @staticmethod
+    def _log_line(p: _Proc, msg: str) -> None:
+        try:
+            with open(p.log_path, "ab") as f:
+                f.write((msg + "\n").encode())
+        except OSError:
+            pass
 
     def remove(self, name: str, force: bool = False) -> None:
         with self._lock:
@@ -269,8 +421,10 @@ class ProcessBackend(Backend):
             if size_bytes:
                 # quota lives in its OWN namespace (a volume named
                 # ".quotas" must not collide). The overlay2-XFS `size=`
-                # analog; a plain directory can't hard-enforce it, so the
-                # SERVICE layer guards shrink/patch against used vs limit.
+                # analog (volume.go:36-38); hard-enforced below via a
+                # loopback ext4 image when the host allows mounts, else
+                # the SERVICE layer's used-vs-limit guard is the
+                # documented fallback.
                 os.makedirs(self._quota_dir, exist_ok=True)
                 with open(os.path.join(self._quota_dir, name), "w") as f:
                     f.write(str(int(size_bytes)))
@@ -285,9 +439,103 @@ class ProcessBackend(Backend):
                     except OSError:
                         pass
                 raise
+        # mkfs/mount run OUTSIDE the lock: the name is already reserved
+        # (mp exists), and a slow mkfs must not stall every container op
+        # and the supervisor behind the backend lock
+        enforced = bool(size_bytes) and self._mount_quota_fs(
+            name, mp, int(size_bytes))
         return VolumeState(name=name, exists=True, mountpoint=mp,
                            size_limit_bytes=size_bytes, tier=tier,
-                           driver_opts={"size": size_bytes})
+                           driver_opts={"size": size_bytes,
+                                        "enforced": enforced})
+
+    # ---- loopback quota filesystems (hard ENOSPC enforcement) ----
+
+    def _loopfs_capable(self) -> bool:
+        """One-time probe: can this host mkfs+loop-mount? (Root on a TPU
+        VM: yes. Sandboxed CI: usually no — fall back to the advisory
+        service-layer guard.)"""
+        if self._loopfs is None:
+            probe = os.path.join(self.state_dir, ".loopfs-probe")
+            img, mnt = probe + ".img", probe + ".mnt"
+            ok = False
+            try:
+                os.makedirs(mnt, exist_ok=True)
+                with open(img, "wb") as f:
+                    f.truncate(8 << 20)
+                ok = (_run_quiet(["mkfs.ext4", "-q", "-F", img])
+                      and _run_quiet(["mount", "-o", "loop", img, mnt]))
+                if ok:
+                    _run_quiet(["umount", mnt])
+            finally:
+                for path in (img,):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                try:
+                    os.rmdir(mnt)
+                except OSError:
+                    pass
+            self._loopfs = ok
+        return self._loopfs
+
+    # smallest loopback image ext4 can lay metadata out in; a quota below
+    # this can't be hard-enforced (the fs would allow ~8MB regardless), so
+    # it honestly stays advisory instead of reporting a wrong limit
+    _LOOPFS_FLOOR = 8 << 20
+
+    def _mount_quota_fs(self, name: str, mp: str, size_bytes: int) -> bool:
+        """Back the volume dir with a loop-mounted ext4 image of exactly
+        the quota size: a workload writing past the limit gets a real
+        ENOSPC from the kernel — the TPU-VM-native analog of the
+        reference's overlay2-XFS `size=` option. False -> stay a plain
+        dir (advisory quota)."""
+        if size_bytes < self._LOOPFS_FLOOR or not self._loopfs_capable():
+            return False
+        os.makedirs(self._volimg_dir, exist_ok=True)
+        img = os.path.join(self._volimg_dir, f"{name}.img")
+        try:
+            with open(img, "wb") as f:
+                # sparse image: disk is consumed as the volume fills, the
+                # fs SIZE (the quota) is fixed
+                f.truncate(size_bytes)
+            if not _run_quiet(["mkfs.ext4", "-q", "-F", img]):
+                raise OSError("mkfs.ext4 failed")
+            if not _run_quiet(["mount", "-o", "loop", img, mp]):
+                raise OSError("loop mount failed")
+            # the workload writes as the container's uid; lost+found stays
+            os.chmod(mp, 0o777)
+            return True
+        except OSError:
+            try:
+                os.unlink(img)
+            except OSError:
+                pass
+            return False
+
+    def _remount_quota_volumes(self) -> None:
+        """Daemon restart: close() unmounted every quota volume, so remount
+        any image whose volume dir still exists — otherwise prior data
+        stays trapped in the image and new writes land unquota'd."""
+        if not os.path.isdir(self._volimg_dir):
+            return
+        for f in os.listdir(self._volimg_dir):
+            if not f.endswith(".img"):
+                continue
+            found = self._find_volume(f[:-4])
+            if found and not os.path.ismount(found[0]):
+                img = os.path.join(self._volimg_dir, f)
+                _run_quiet(["mount", "-o", "loop", img, found[0]])
+
+    def _unmount_quota_fs(self, mp: str, name: str) -> None:
+        if os.path.ismount(mp):
+            if not _run_quiet(["umount", mp]):
+                _run_quiet(["umount", "-l", mp])   # lazy: busy writer
+        try:
+            os.unlink(os.path.join(self._volimg_dir, f"{name}.img"))
+        except OSError:
+            pass
 
     def _find_volume(self, name: str):
         """(mountpoint, tier) across the default root and every configured
@@ -304,6 +552,7 @@ class ProcessBackend(Backend):
     def volume_remove(self, name: str) -> None:
         found = self._find_volume(name)
         if found:
+            self._unmount_quota_fs(found[0], name)
             shutil.rmtree(found[0], ignore_errors=True)
         try:
             os.unlink(os.path.join(self._quota_dir, name))
@@ -329,6 +578,7 @@ class ProcessBackend(Backend):
     # ---- lifecycle ----
 
     def close(self) -> None:
+        self._closed = True
         if self._pool is not None:
             self._pool.close()
         for name in self.list_names():
@@ -336,6 +586,16 @@ class ProcessBackend(Backend):
                 self.stop(name, timeout=2)
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
+        # release loop mounts (the images and volume dirs persist — a
+        # restarted daemon's volume_create/--state-dir reuse finds them)
+        if os.path.isdir(self._volimg_dir):
+            for f in os.listdir(self._volimg_dir):
+                if not f.endswith(".img"):
+                    continue
+                found = self._find_volume(f[:-4])
+                if found and os.path.ismount(found[0]):
+                    if not _run_quiet(["umount", found[0]]):
+                        _run_quiet(["umount", "-l", found[0]])
 
     # ---- helpers ----
 
@@ -359,6 +619,10 @@ class ProcessBackend(Backend):
     @property
     def _quota_dir(self) -> str:
         return os.path.join(self.state_dir, "volume_quotas")
+
+    @property
+    def _volimg_dir(self) -> str:
+        return os.path.join(self.state_dir, "volume_images")
 
     @staticmethod
     def _build_env(p: _Proc) -> dict:

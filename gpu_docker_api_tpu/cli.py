@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warm-pool", type=int, default=1, metavar="N",
                    help="pre-imported Python workers for fast workload "
                         "start (process backend; 0 disables; default 1)")
+    p.add_argument("--no-supervise", action="store_true",
+                   help="disable the process-backend supervisor (restart "
+                        "policy enforcement + rootfs storage-quota "
+                        "watchdog; on by default for the daemon)")
     return p
 
 
@@ -75,7 +79,8 @@ def main(argv=None) -> int:
         tiers[tname] = path
     app = App(state_dir=args.state_dir, backend=args.backend, addr=args.addr,
               port_range=parse_port_range(args.portRange), topology=topology,
-              volume_tiers=tiers, warm_pool=args.warm_pool)
+              volume_tiers=tiers, warm_pool=args.warm_pool,
+              supervise=not args.no_supervise)
     app.start()
 
     status = app.tpu.get_status()

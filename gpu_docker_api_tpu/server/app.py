@@ -47,7 +47,8 @@ class App:
                  store_engine: str = "auto",
                  store_maint_records: int = 5000,
                  volume_tiers: Optional[dict] = None,
-                 warm_pool: int = 0):
+                 warm_pool: int = 0,
+                 supervise: bool = False):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         # WAL maintenance trigger: when the record count crosses this,
@@ -65,7 +66,8 @@ class App:
         self.wq.start()
         self.backend = make_backend(backend, os.path.join(state_dir, "backend"),
                                     volume_tiers=volume_tiers,
-                                    warm_pool=warm_pool)
+                                    warm_pool=warm_pool,
+                                    supervise=supervise)
         # an explicit topology overrides the store; otherwise boot from stored
         # state (crash-resume) and only probe the host on first run
         if topology is None and self.client.get("tpus", "tpuStatusMap") is None:

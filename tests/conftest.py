@@ -1,7 +1,19 @@
 """Test fixtures. Platform forcing lives in pytest_force_cpu.py (loaded
 via pytest.ini addopts before capture starts)."""
 
+import time
+
 import pytest  # noqa: E402
+
+
+def wait_for(pred, timeout=10.0, msg="condition"):
+    """Poll until pred() or timeout (shared by process-backend suites)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {msg}")
 
 
 def _engines():

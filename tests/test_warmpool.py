@@ -10,18 +10,10 @@ import time
 
 import pytest
 
+from conftest import wait_for
 from gpu_docker_api_tpu.backend.process import ProcessBackend
 from gpu_docker_api_tpu.backend.warmpool import WarmPool
 from gpu_docker_api_tpu.dtos import ContainerSpec
-
-
-def wait_for(pred, timeout=10.0, msg="condition"):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return
-        time.sleep(0.02)
-    raise TimeoutError(f"timed out waiting for {msg}")
 
 
 def test_supports_classification():
@@ -65,9 +57,18 @@ def test_warm_worker_repoints_jax_env(tmp_path):
             "open('marker.json', 'w').write(json.dumps(rec))\n"
         ), env=["JAX_ENABLE_X64=true", "JAX_PLATFORMS=cpu"])
         marker = os.path.join(st.upper_dir, "marker.json")
-        wait_for(lambda: os.path.exists(marker), timeout=60, msg="marker")
         import json as _json
-        rec = _json.loads(open(marker).read())
+        rec = {}
+
+        def parsed():
+            nonlocal rec
+            try:
+                rec = _json.loads(open(marker).read())
+                return True
+            except (OSError, ValueError):
+                return False    # not yet written / mid-write
+
+        wait_for(parsed, timeout=60, msg="marker")
         assert rec["pid"] in pool_pids      # ran warm, not cold-spawned
         assert rec["x64"] == "float64"      # x64 re-pointed post-import
     finally:
