@@ -146,7 +146,8 @@ class WarmPool:
     # consumed once at import and never re-read (dtype canonicalization
     # width; this repo's own module-level knobs, in case a pool preimports
     # repo modules). Jobs setting these cold-spawn.
-    IMPORT_BAKED_ENV = ("JAX_DEFAULT_DTYPE_BITS", "TDAPI_FLASH_MIN_SEQ")
+    IMPORT_BAKED_ENV = ("JAX_DEFAULT_DTYPE_BITS", "TDAPI_FLASH_MIN_SEQ",
+                        "TDAPI_FLASH_MIN_SEQ_GRAD")
 
     @staticmethod
     def supports(cmd: list[str], env: Optional[list[str]] = None) -> bool:

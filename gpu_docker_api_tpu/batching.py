@@ -32,17 +32,24 @@ from .models.llama import rms_norm, rope_frequencies
 from .ops.quant import qmatmul
 
 
-def init_slot_cache(config, slots: int, max_len: int) -> dict:
+def init_slot_cache(config, slots: int, max_len: int,
+                    quantized: bool = False) -> dict:
     """Cache of `slots` rows, each up to max_len tokens, with per-row
-    lengths. (Dense only: the int8 cache composes with the per-request
-    paths; slot serving keeps bf16 K/V for now.)"""
+    lengths. quantized=True stores K/V as int8 with per-token-per-head
+    f32 scales ("ks"/"vs") — same layout as infer.init_cache, so slot
+    decode reads half the cache bytes (the decode loop's HBM bound)."""
     c = _llama_view(config)
     shape = (config.n_layers, slots, max_len, c.n_kv_heads, c.head_dim)
-    return {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
+    out = {
+        "k": jnp.zeros(shape, c.dtype if not quantized else jnp.int8),
+        "v": jnp.zeros(shape, c.dtype if not quantized else jnp.int8),
         "lengths": jnp.zeros((slots,), jnp.int32),
     }
+    if quantized:
+        sshape = shape[:-1] + (1,)
+        out["ks"] = jnp.ones(sshape, jnp.float32)
+        out["vs"] = jnp.ones(sshape, jnp.float32)
+    return out
 
 
 @partial(jax.jit, static_argnames=("config", "append"), donate_argnums=(2,))
@@ -58,49 +65,50 @@ def slot_prefill(params, prompt, cache, slot, config, append: bool = False):
     forward)."""
     cur = jax.lax.dynamic_slice(cache["lengths"], (slot,), (1,))[0]
     start = cur if append else jnp.zeros((), jnp.int32)
-    row = {
-        "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
-        "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
-        "length": start,
-    }
+    bufs = _buf_keys(cache)
+    row = {kk: jax.lax.dynamic_slice_in_dim(cache[kk], slot, 1, axis=1)
+           for kk in bufs}
+    row["length"] = start
     logits, row = _forward_cached(params, prompt, row, config)
-    return logits[:, -1], {
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], row["k"], (0, slot, 0, 0, 0)),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], row["v"], (0, slot, 0, 0, 0)),
-        "lengths": jax.lax.dynamic_update_slice(
-            cache["lengths"], (start + prompt.shape[1])[None], (slot,)),
-    }
+    out = {kk: jax.lax.dynamic_update_slice(
+               cache[kk], row[kk], (0, slot, 0, 0, 0)) for kk in bufs}
+    out["lengths"] = jax.lax.dynamic_update_slice(
+        cache["lengths"], (start + prompt.shape[1])[None], (slot,))
+    return logits[:, -1], out
+
+
+def _buf_keys(cache) -> tuple:
+    """The per-slot device buffers, in a fixed order ("k","v"[,"ks","vs"])."""
+    return tuple(kk for kk in ("k", "v", "ks", "vs") if kk in cache)
 
 
 @partial(jax.jit, static_argnames=("length",))
 def slot_extract_kv(cache, slot, length: int):
     """Copy the first `length` cache positions of slot row `slot` out as
-    standalone [L, length, Hkv, D] buffers (the prefix-cache store entry).
-    Static length — callers bucket lengths so the jit variety stays small."""
-    k = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)[:, 0]
-    v = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)[:, 0]
-    return k[:, :length], v[:, :length]
+    standalone [L, length, Hkv, ...] buffers, one per cache buffer key
+    (2 dense, 4 quantized) — the prefix-cache store entry. Static length —
+    callers bucket lengths so the jit variety stays small."""
+    return tuple(
+        jax.lax.dynamic_slice_in_dim(cache[kk], slot, 1,
+                                     axis=1)[:, 0][:, :length]
+        for kk in _buf_keys(cache))
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def slot_restore_kv(cache, slot, k_prefix, v_prefix, length):
-    """Write a stored prefix's K/V into slot row `slot` starting at 0 and
-    set the row length to `length` (data — positions past it are dead until
-    the remainder prefill overwrites them). The prefix buffers may be
-    bucket-padded; only [0, length) is ever attendable."""
-    k = jax.lax.dynamic_update_slice(
-        cache["k"], k_prefix[:, None].astype(cache["k"].dtype),
-        (0, slot, 0, 0, 0))
-    v = jax.lax.dynamic_update_slice(
-        cache["v"], v_prefix[:, None].astype(cache["v"].dtype),
-        (0, slot, 0, 0, 0))
-    return {
-        "k": k, "v": v,
-        "lengths": jax.lax.dynamic_update_slice(
-            cache["lengths"], jnp.asarray(length, jnp.int32)[None], (slot,)),
-    }
+def slot_restore_kv(cache, slot, prefix_bufs, length):
+    """Write a stored prefix's buffers (the slot_extract_kv tuple) into
+    slot row `slot` starting at 0 and set the row length to `length`
+    (data — positions past it are dead until the remainder prefill
+    overwrites them). The prefix buffers may be bucket-padded; only
+    [0, length) is ever attendable."""
+    out = dict(cache)
+    for kk, buf in zip(_buf_keys(cache), prefix_bufs):
+        out[kk] = jax.lax.dynamic_update_slice(
+            cache[kk], buf[:, None].astype(cache[kk].dtype),
+            (0, slot, 0, 0, 0))
+    out["lengths"] = jax.lax.dynamic_update_slice(
+        cache["lengths"], jnp.asarray(length, jnp.int32)[None], (slot,))
+    return out
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
@@ -115,18 +123,18 @@ def slot_decode(params, tokens, cache, active, config):
     x = jnp.take(params["embed"], tokens[:, None], axis=0)   # [slots,1,D]
     cos, sin = rope_frequencies(c, pos)                      # [slots, d/2]
     cos, sin = cos[:, None, :], sin[:, None, :]              # per-row [B,1,:]
+    bufs = _buf_keys(cache)
 
     def body(x, scanned):
-        layer, ck, cv = scanned
-        x, ck, cv = _layer_step(x, layer, ck, cv, pos, config, cos, sin,
-                                active=active)
-        return x, (ck, cv)
+        layer, *kv = scanned
+        x, *kv = _layer_step(x, layer, *kv[:2], pos, config, cos, sin,
+                             *kv[2:], active=active)
+        return x, tuple(kv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    x, kv_out = jax.lax.scan(
+        body, x, (params["layers"],) + tuple(cache[kk] for kk in bufs))
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
-    return logits[:, -1], {
-        "k": ks, "v": vs,
-        "lengths": pos + active.astype(jnp.int32),
-    }
+    out = dict(zip(bufs, kv_out))
+    out["lengths"] = pos + active.astype(jnp.int32)
+    return logits[:, -1], out

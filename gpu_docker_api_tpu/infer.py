@@ -395,19 +395,33 @@ def generate(params, prompt, config, max_new: int,
 # ---- speculative decoding --------------------------------------------------
 
 @partial(jax.jit, static_argnames=("config", "draft_config", "max_new",
-                                   "gamma", "kv_quant"))
+                                   "gamma", "kv_quant", "temperature",
+                                   "top_k", "top_p"))
 def speculative_generate(params, draft_params, prompt, config, draft_config,
                          max_new: int, gamma: int = 4,
-                         kv_quant: bool = False):
-    """Greedy speculative decoding (Leviathan et al. 2211.17192, greedy
-    case): a cheap draft model proposes `gamma` tokens autoregressively,
-    the target verifies all of them in ONE cached forward of gamma+1
-    positions — decode is weight-HBM-bound, so the verify forward costs
-    about one decode step while scoring gamma+1 positions. Greedy
-    acceptance keeps the longest proposal prefix matching the target's
-    argmax and takes the target's token at the first divergence, so the
-    OUTPUT IS EXACTLY the target-only greedy stream for ANY draft — the
-    draft's quality only changes the speed (accepted tokens/round).
+                         kv_quant: bool = False,
+                         temperature: float = 0.0,
+                         top_k: int = 0, top_p: float = 1.0,
+                         key: Optional[jax.Array] = None):
+    """Speculative decoding (Leviathan et al. 2211.17192): a cheap draft
+    model proposes `gamma` tokens autoregressively, the target verifies
+    all of them in ONE cached forward of gamma+1 positions — decode is
+    weight-HBM-bound, so the verify forward costs about one decode step
+    while scoring gamma+1 positions.
+
+    temperature == 0 — greedy case: acceptance keeps the longest proposal
+    prefix matching the target's argmax and takes the target's token at
+    the first divergence, so the OUTPUT IS EXACTLY the target-only greedy
+    stream for ANY draft.
+
+    temperature > 0 — rejection sampling: the draft SAMPLES its proposals
+    from q (after the same temperature/top-k/top-p filtering the target
+    uses); token x_j is accepted with prob min(1, p_j(x_j)/q_j(x_j)), and
+    the first rejection resamples from norm(max(0, p_j - q_j)); when all
+    gamma are accepted the bonus token samples from p. The marginal
+    distribution of the output is EXACTLY the target-only sampling
+    distribution — the draft's quality only changes the speed
+    (accepted tokens/round), never the statistics.
 
     B=1 (latency-oriented; rows would need per-row cache lengths). The
     whole thing is one jitted lax.while_loop over rounds: no host
@@ -418,6 +432,22 @@ def speculative_generate(params, draft_params, prompt, config, draft_config,
     if b != 1:
         raise ValueError("speculative_generate is B=1 (per-row cache "
                          "lengths diverge otherwise)")
+    sampling = temperature != 0.0
+    if key is None:
+        key = jax.random.key(0)
+
+    def filtered_logp(logits):
+        """The per-position sampling distribution BOTH models use: logits
+        -> log-probs after temperature + top-k + top-p. Rejection
+        sampling is exact for whatever (p, q) pair it tests, so the
+        filters must be baked into both."""
+        logits = logits / temperature
+        if top_k:
+            logits = _filter_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _filter_top_p(logits, top_p)
+        return jax.nn.log_softmax(logits, axis=-1)
+
     cap = t + max_new + gamma + 2          # verify block may overshoot
     t_cache = init_cache(config, 1, cap, quantized=kv_quant)
     d_cache = init_cache(draft_config, 1, cap, quantized=kv_quant)
@@ -427,39 +457,76 @@ def speculative_generate(params, draft_params, prompt, config, draft_config,
     t_logits, t_cache = _forward_cached(params, prompt, t_cache, config)
     _, d_cache = _forward_cached(draft_params, prompt, d_cache,
                                  draft_config)
-    last = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)   # [1]
+    key, k0 = jax.random.split(key)
+    if sampling:
+        last = jax.random.categorical(
+            k0, filtered_logp(t_logits[:, -1])).astype(jnp.int32)   # [1]
+    else:
+        last = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
     buf = jnp.zeros((1, max_new + gamma + 1), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, last[:, None], (0, 0))
 
     def round_body(carry):
-        buf, count, last, t_cache, d_cache, rounds, accepted = carry
+        buf, count, last, t_cache, d_cache, rounds, accepted, key = carry
+        key, kd, ka, kr = jax.random.split(key, 4)
 
-        # draft proposes gamma tokens from `last`
-        def d_step(c, _):
+        # draft proposes gamma tokens from `last` (argmax when greedy;
+        # sampled from its filtered distribution q when sampling — and q
+        # is kept for the acceptance test)
+        def d_step(c, k):
             tok, dc = c
             lg, dc = _forward_cached(draft_params, tok[:, None], dc,
                                      draft_config)
+            if sampling:
+                lp = filtered_logp(lg[:, -1])                   # [1, V]
+                nxt = jax.random.categorical(k, lp).astype(jnp.int32)
+                return (nxt, dc), (nxt, lp[0])
             nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-            return (nxt, dc), nxt
+            return (nxt, dc), (nxt, jnp.zeros((), jnp.float32))
 
-        (_, d_cache), drafts = jax.lax.scan(
-            d_step, (last, d_cache), None, length=gamma)
+        (_, d_cache), (drafts, dlogp) = jax.lax.scan(
+            d_step, (last, d_cache), jax.random.split(kd, gamma))
         drafts = drafts[:, 0]                                   # [gamma]
 
         # target scores last + the gamma proposals in one forward
         block = jnp.concatenate([last, drafts])[None, :]        # [1, g+1]
         lg, t_cache = _forward_cached(params, block, t_cache, config)
-        greedy = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)   # [g+1]
 
-        # longest accepted prefix: drafts[j] == greedy[j] for j < a
-        ok = drafts == greedy[:-1]
-        a = jnp.argmin(jnp.concatenate([ok, jnp.zeros(1, bool)]))
-        # emit drafts[0..a-1] then the target's token at the divergence
+        if not sampling:
+            greedy = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)  # [g+1]
+            # longest accepted prefix: drafts[j] == greedy[j] for j < a
+            ok = drafts == greedy[:-1]
+            a = jnp.argmin(jnp.concatenate([ok, jnp.zeros(1, bool)]))
+            new_tok = greedy[a]
+        else:
+            tlogp = filtered_logp(lg[0])                        # [g+1, V]
+            # accept x_j with prob min(1, p_j(x_j)/q_j(x_j))
+            p_tok = jnp.take_along_axis(
+                tlogp[:-1], drafts[:, None], axis=-1)[:, 0]     # log p_j(x_j)
+            q_tok = jnp.take_along_axis(
+                dlogp, drafts[:, None], axis=-1)[:, 0]          # log q_j(x_j)
+            u = jax.random.uniform(ka, (gamma,))
+            ok = u < jnp.exp(jnp.minimum(p_tok - q_tok, 0.0))
+            a = jnp.argmin(jnp.concatenate([ok, jnp.zeros(1, bool)]))
+            # replacement at the first rejection: sample from the residual
+            # norm(max(0, p_a - q_a)); all-accepted: bonus sample from
+            # p_gamma (q contributes nothing there)
+            p_a = jnp.exp(tlogp[a])                             # [V]
+            q_a = jnp.where(a < gamma,
+                            jnp.exp(dlogp[jnp.minimum(a, gamma - 1)]), 0.0)
+            resid = jnp.maximum(p_a - q_a, 0.0)
+            total = jnp.sum(resid)
+            # f32 edge: an (impossibly) empty residual falls back to p_a
+            resid = jnp.where(total > 0, resid / total, p_a)
+            new_tok = jax.random.categorical(
+                kr, jnp.log(resid + 1e-38)).astype(jnp.int32)
+
+        # emit drafts[0..a-1] then the replacement/divergence token
         emit = jnp.where(jnp.arange(gamma + 1) < a,
                          jnp.concatenate([drafts, jnp.zeros(1, jnp.int32)]),
-                         jnp.broadcast_to(greedy[a], (gamma + 1,)))
-        new_last = greedy[a][None]                              # [1]
+                         jnp.broadcast_to(new_tok, (gamma + 1,)))
+        new_last = new_tok[None]                                # [1]
         buf = jax.lax.dynamic_update_slice(buf, emit[None, :],
                                            (0, count + 1))
 
@@ -479,14 +546,14 @@ def speculative_generate(params, draft_params, prompt, config, draft_config,
 
         d_cache = jax.lax.cond(a == gamma, fill, lambda dc: dc, d_cache)
         return (buf, count + 1 + a, new_last, t_cache, d_cache,
-                rounds + 1, accepted + a)
+                rounds + 1, accepted + a, key)
 
     def cond(carry):
         # buf[0..count] already holds count+1 valid tokens
         return carry[1] + 1 < max_new
 
     init = (buf, jnp.zeros((), jnp.int32), last, t_cache, d_cache,
-            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32), key)
     buf, count, *_rest = jax.lax.while_loop(cond, round_body, init)
-    rounds, accepted = _rest[-2], _rest[-1]
+    rounds, accepted = _rest[-3], _rest[-2]
     return buf[:, :max_new], {"rounds": rounds, "accepted": accepted}

@@ -50,6 +50,7 @@ NAMED_CONFIGS = {
     "llama": {"tiny": _llama.LlamaConfig.tiny,
               "mini": _llama.LlamaConfig.llama_mini,
               "250m": _llama.LlamaConfig.llama_250m,
+              "1b": _llama.LlamaConfig.llama_1b,
               "llama3_8b": _llama.LlamaConfig.llama3_8b,
               "mistral_7b": _llama.LlamaConfig.mistral_7b},
     "moe": {"tiny": _moe.MoEConfig.tiny,

@@ -27,13 +27,21 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK = 128
-# Flash-vs-XLA crossover, from the driver's real-v5e sweep (BENCH_r02:
-# fwd flash 0.59x XLA at S=1024, 2.31x at S=2048, 1.83x at S=4096): below
-# this sequence length the fused-XLA softmax wins — the [S, S] score tile
-# stays cheap and the pallas grid/scratch overhead dominates. The auto
-# dispatcher routes shorter sequences to XLA; override for retuning on
-# other chips via env.
-FLASH_MIN_SEQ = int(os.environ.get("TDAPI_FLASH_MIN_SEQ", "2048"))
+# Flash-vs-XLA crossovers, measured on the real v5e (round 3, interleaved
+# A/B arms over 64-call chains, repeated across fresh processes — the
+# BENCH_r02 "flash 0.59x at S=1024" that round 2 acted on was an artifact
+# of sequential min-of-3 through tunnel drift):
+# - forward: flash 1.19x at S=1024 (1.35 vs 1.61 ms), 2.37x at S=2048,
+#   3.35x at S=4096. Below 1024 is unmeasured — XLA stays the default.
+# - under grad (fwd+bwd): flash 1.23x at S=1024 (6.97 vs 8.58 ms/step,
+#   llama_mini B=8) and 1.84x at S=2048 (47.7 vs 87.7 ms, llama_250m) —
+#   the pallas backward avoids the [S, S] rematerialization XLA's bwd
+#   pays.
+# `impl="auto"` uses the fwd crossover; the training path passes
+# `impl="auto_grad"` (train.loss_fn). Both env-overridable for retuning
+# on other chips.
+FLASH_MIN_SEQ = int(os.environ.get("TDAPI_FLASH_MIN_SEQ", "1024"))
+FLASH_MIN_SEQ_GRAD = int(os.environ.get("TDAPI_FLASH_MIN_SEQ_GRAD", "1024"))
 # TPU vector lanes. Per-row residuals (logsumexp) are stored lane-replicated
 # [.., S, LANES] because mosaic requires the last two dims of every block to
 # be (8k, 128m)-aligned — a [B*H, S] residual with (1, blk_q) blocks does not
@@ -602,19 +610,33 @@ def _on_tpu() -> bool:
         return False
 
 
+def auto_impl_for(s: int, d: int, grad: bool = False) -> str:
+    """What the auto dispatcher picks for a [*, s, *, d] shape — THE
+    predicate (attention() and the bench's `auto_picks` column both call
+    it, so they can never desynchronize)."""
+    min_seq = FLASH_MIN_SEQ_GRAD if grad else FLASH_MIN_SEQ
+    if (_on_tpu() and s >= min_seq
+            and s % DEFAULT_BLOCK == 0 and d % 128 == 0):
+        return "flash"
+    return "xla"
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True, impl: str = "auto",
               window: int = 0) -> jax.Array:
     """Dispatch: pallas flash on TPU when shapes are kernel-friendly
     (128-aligned seq, head_dim a lane multiple) AND the sequence is past
-    the measured flash/XLA crossover (FLASH_MIN_SEQ); XLA reference
-    otherwise. window > 0 = sliding-window attention (both impls)."""
+    the measured flash/XLA crossover; XLA reference otherwise.
+    impl="auto" = forward-only crossover (FLASH_MIN_SEQ); "auto_grad" =
+    the earlier fwd+bwd crossover (FLASH_MIN_SEQ_GRAD) — what the
+    training path passes. window > 0 = sliding-window (both impls)."""
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, window=window)
     if impl == "xla":
         return reference_attention(q, k, v, causal=causal, window=window)
-    s, d = q.shape[1], q.shape[3]
-    if (_on_tpu() and s >= FLASH_MIN_SEQ
-            and s % DEFAULT_BLOCK == 0 and d % 128 == 0):
+    if impl not in ("auto", "auto_grad"):
+        raise ValueError(f"impl {impl!r}: flash|xla|auto|auto_grad")
+    if auto_impl_for(q.shape[1], q.shape[3],
+                     grad=impl == "auto_grad") == "flash":
         return flash_attention(q, k, v, causal=causal, window=window)
     return reference_attention(q, k, v, causal=causal, window=window)

@@ -90,7 +90,7 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(params, tokens, config, impl: str = "auto", mesh=None,
+def loss_fn(params, tokens, config, impl: str = "auto_grad", mesh=None,
             n_microbatches: int = 0, remat: bool = True,
             virtual_stages: int = 1, pregrouped: bool = False,
             remat_policy: str = "dots"):

@@ -85,7 +85,7 @@ class _Batcher:
 
     def __init__(self, config, params, slots: int, max_len: int,
                  prefill_chunk: int = 0, prefix_cache: int = 0,
-                 restarts: int = 3):
+                 restarts: int = 3, kv_quant: bool = False):
         import collections
         import queue
 
@@ -93,6 +93,9 @@ class _Batcher:
         self.config = config
         self.params = params
         self.max_len = max_len
+        # int8 slot cache: half the decode-loop HBM reads (same numerics
+        # as infer.py's kv_quant path — per-token-per-head scales)
+        self.kv_quant = kv_quant
         # scheduler crash budget: a transient device/XLA error fails the
         # in-flight requests but the loop re-initializes its cache and
         # keeps serving; after `restarts` crashes the batcher stays dead
@@ -110,7 +113,8 @@ class _Batcher:
         self._prefixes: "collections.OrderedDict" = collections.OrderedDict()
         self.prefix_hits = 0
         self.queue: "queue.Queue" = queue.Queue()
-        self.cache = init_slot_cache(config, slots, max_len)
+        self.cache = init_slot_cache(config, slots, max_len,
+                                     quantized=kv_quant)
         self.slots: list = [None] * slots
         self._stop = False
         self._dead: Exception | None = None   # loop crash / close reason
@@ -194,7 +198,8 @@ class _Batcher:
                 # rows — rebuild it and resume accepting work
                 self._restarts_left -= 1
                 self.cache = init_slot_cache(
-                    self.config, len(self.slots), self.max_len)
+                    self.config, len(self.slots), self.max_len,
+                    quantized=self.kv_quant)
                 self._prefixes.clear()
                 if self._stop:
                     # close() ran while we rebuilt (its join can time out
@@ -272,8 +277,7 @@ class _Batcher:
         entry = self._prefixes[best_key]
         self._prefixes.move_to_end(best_key)
         self.cache = slot_restore_kv(self.cache, jnp.int32(i),
-                                     entry["k"], entry["v"],
-                                     best_use)
+                                     entry["bufs"], best_use)
         self.prefix_hits += 1
         item["_restored"] = True
         return prompt[best_use:]
@@ -300,8 +304,8 @@ class _Batcher:
         # ceil-to-64 never exceeds max_len here: submit() enforces
         # len + max_new <= max_len with max_new >= 1
         bucket = min(self.max_len, -(-len(key) // 64) * 64)
-        k, v = slot_extract_kv(self.cache, jnp.int32(i), bucket)
-        self._prefixes[key] = {"k": k, "v": v}
+        bufs = slot_extract_kv(self.cache, jnp.int32(i), bucket)
+        self._prefixes[key] = {"bufs": bufs}
         while len(self._prefixes) > self.prefix_cache:
             self._prefixes.popitem(last=False)
 
@@ -435,17 +439,21 @@ class _Server:
                 "single-sequence requests (temperature 0, one row), or "
                 "start without --batch-slots for sampling/multi-row")
         with self.lock:
-            # speculative path: greedy + single sequence + a draft loaded
-            # (the greedy-case guarantee makes it transparent — the output
-            # is exactly the target-only greedy stream)
-            if (self.draft is not None and float(temperature) == 0.0
-                    and prompt.shape[0] == 1):
+            # speculative path: single sequence + a draft loaded. Greedy
+            # is exactly the target-only greedy stream; sampling keeps the
+            # draft speedup via rejection sampling (the marginal output
+            # distribution is exactly the target-only sampling one).
+            if self.draft is not None and prompt.shape[0] == 1:
                 from ..infer import speculative_generate
                 dcfg, dparams = self.draft
                 out, _ = speculative_generate(
                     self.params, dparams, prompt, self.config, dcfg,
                     int(max_new), gamma=self.gamma,
-                    kv_quant=self.kv_quant)
+                    kv_quant=self.kv_quant,
+                    temperature=float(temperature),
+                    top_k=int(top_k), top_p=float(top_p),
+                    key=jax.random.key(int.from_bytes(
+                        os.urandom(4), "big")))
             else:
                 out = generate(self.params, prompt, self.config,
                                int(max_new),
@@ -618,20 +626,18 @@ def main(argv=None) -> int:
                   gamma=args.gamma)
     if args.batch_slots > 0:
         # keep the serving-mode matrix explicit: the batcher owns greedy
-        # B=1 traffic, which is exactly what --draft-config targets, and
-        # its slot cache is dense — refuse ambiguous combinations instead
-        # of silently disabling a configured feature
+        # B=1 traffic, which is exactly what --draft-config targets —
+        # refuse the ambiguous combination instead of silently disabling
+        # a configured feature. --kv-quant composes (int8 slot cache).
         if args.draft_config:
             raise SystemExit("--batch-slots and --draft-config both claim "
                              "greedy single-sequence requests; pick one")
-        if args.kv_quant:
-            raise SystemExit("--batch-slots serves a dense slot cache; "
-                             "--kv-quant is not supported with it yet")
         srv.batcher = _Batcher(config, params, slots=args.batch_slots,
                                max_len=args.batch_max_len
                                or config.max_seq_len,
                                prefill_chunk=args.batch_prefill_chunk,
-                               prefix_cache=args.prefix_cache)
+                               prefix_cache=args.prefix_cache,
+                               kv_quant=args.kv_quant)
         print(f"continuous batching: {args.batch_slots} slots x "
               f"{srv.batcher.max_len} tokens", flush=True)
     elif args.prefix_cache:

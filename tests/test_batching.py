@@ -122,3 +122,76 @@ def test_inactive_rows_do_not_advance(setup):
     lens = np.asarray(cache["lengths"])
     assert lens[0] == lens_before[0] + 1
     assert lens[1] == 0
+
+
+@pytest.mark.slow
+def test_kv_quant_slot_cache_matches_generate():
+    """The int8 slot cache (batcher x kv-quant — VERDICT r2 hole #3) must
+    reproduce infer.generate's kv_quant greedy stream exactly: identical
+    quantization math, slot layout is just a batched view."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpu_docker_api_tpu.batching import (
+        init_slot_cache, slot_decode, slot_prefill,
+    )
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.array([[5, 9, 2, 7, 11, 3]], jnp.int32)
+    max_new = 8
+
+    want = np.asarray(
+        generate(params, prompt, cfg, max_new, kv_quant=True))[0].tolist()
+
+    cache = init_slot_cache(cfg, slots=2, max_len=32, quantized=True)
+    assert cache["k"].dtype == jnp.int8 and "ks" in cache
+    logits, cache = slot_prefill(params, prompt, cache, jnp.int32(1), cfg)
+    toks = [int(jnp.argmax(logits[0]))]
+    active = jnp.array([False, True])
+    while len(toks) < max_new:
+        step = jnp.array([0, toks[-1]], jnp.int32)
+        logits, cache = slot_decode(params, step, cache, active, cfg)
+        toks.append(int(jnp.argmax(logits[1])))
+    assert toks == want
+
+
+@pytest.mark.slow
+def test_kv_quant_slot_cache_independent_rows():
+    """Two quantized slots decode independently (no cross-row scale
+    bleed): each matches its own single-row run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpu_docker_api_tpu.batching import (
+        init_slot_cache, slot_decode, slot_prefill,
+    )
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    prompts = [jnp.array([[4, 8, 15]], jnp.int32),
+               jnp.array([[16, 23, 42, 108, 7]], jnp.int32)]
+    max_new = 6
+    wants = [np.asarray(generate(params, p, cfg, max_new,
+                                 kv_quant=True))[0].tolist()
+             for p in prompts]
+
+    cache = init_slot_cache(cfg, slots=2, max_len=32, quantized=True)
+    streams = []
+    lg0, cache = slot_prefill(params, prompts[0], cache, jnp.int32(0), cfg)
+    lg1, cache = slot_prefill(params, prompts[1], cache, jnp.int32(1), cfg)
+    streams = [[int(jnp.argmax(lg0[0]))], [int(jnp.argmax(lg1[0]))]]
+    active = jnp.array([True, True])
+    while len(streams[0]) < max_new:
+        step = jnp.array([streams[0][-1], streams[1][-1]], jnp.int32)
+        logits, cache = slot_decode(params, step, cache, active, cfg)
+        streams[0].append(int(jnp.argmax(logits[0])))
+        streams[1].append(int(jnp.argmax(logits[1])))
+    assert streams[0] == wants[0]
+    assert streams[1] == wants[1]

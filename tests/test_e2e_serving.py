@@ -15,6 +15,10 @@ import pytest
 from gpu_docker_api_tpu.server.app import App
 from gpu_docker_api_tpu.topology import make_topology
 
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

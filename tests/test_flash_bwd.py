@@ -11,6 +11,10 @@ from gpu_docker_api_tpu.ops.attention import (
     flash_attention, reference_attention,
 )
 
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
+
 
 def _grads(b, s, h, hkv, d, causal, blk=64):
     q = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)

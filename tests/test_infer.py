@@ -29,6 +29,7 @@ def _prompt(cfg, b=2, t=8):
                               cfg.vocab_size, dtype=jnp.int32)
 
 
+@pytest.mark.slow
 def test_generate_matches_full_forward_oracle(llama):
     cfg, params = llama
     prompt = _prompt(cfg)
@@ -43,6 +44,7 @@ def test_generate_matches_full_forward_oracle(llama):
     assert bool(jnp.all(out == jnp.stack(oracle, axis=1)))
 
 
+@pytest.mark.slow
 def test_generate_moe_matches_oracle():
     # generous capacity so routing drops nothing — decode (1 token/step) and
     # full forward (T tokens) then agree exactly

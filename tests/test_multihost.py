@@ -43,6 +43,10 @@ from gpu_docker_api_tpu.models.llama import LlamaConfig
 from gpu_docker_api_tpu.parallel.mesh import MeshPlan
 from gpu_docker_api_tpu.train import Trainer
 
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
+
 cfg = LlamaConfig.tiny()
 trainer = Trainer.create(cfg, MeshPlan.auto(8, tp=2))
 state = trainer.init(jax.random.key(0))

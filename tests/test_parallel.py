@@ -81,6 +81,7 @@ def test_sharded_params_placement():
         for l in leaves if hasattr(l, "sharding"))
 
 
+@pytest.mark.slow
 def test_train_step_loss_decreases():
     cfg = LlamaConfig.tiny()
     trainer = Trainer.create(
@@ -109,6 +110,7 @@ def test_train_step_with_remat_matches():
 
 
 @pytest.mark.parametrize("family", ["llama", "moe"])
+@pytest.mark.slow
 def test_remat_policies_identical_numerics(family):
     """Per-layer remat ("full" min-HBM and "dots" save-matmul-outputs) must
     not change the step's loss or gradients vs no remat — rematerialization
@@ -189,6 +191,7 @@ def test_forward_uses_ring_under_sp_mesh():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_full_batch():
     """accum_steps slices must reproduce the full-batch step: same loss,
     same post-update params (tiny config is f32, so exact to fp tolerance)."""
@@ -221,6 +224,7 @@ def test_grad_accumulation_rejects_indivisible_batch():
         tr.step(st, toks)
 
 
+@pytest.mark.slow
 def test_lr_schedule_warmup_cosine():
     """make_schedule: 0 at step 0, peak at warmup end, min ratio at the
     decay horizon; bare TrainConfig stays a plain constant."""

@@ -24,6 +24,10 @@ from gpu_docker_api_tpu.parallel.pipeline import pipeline_forward, pipeline_trun
 from gpu_docker_api_tpu.parallel.ulysses import ulysses_attention
 from gpu_docker_api_tpu.train import Trainer, TrainConfig, param_specs
 
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
+
 
 # ---- model family registry -------------------------------------------------
 

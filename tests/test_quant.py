@@ -178,6 +178,7 @@ def test_quantize_moe_expert_banks():
     assert not isinstance(qp["layers"]["router"], QTensor)   # router dense
 
 
+@pytest.mark.slow
 def test_quantized_moe_prefill_close_and_generate_runs():
     from gpu_docker_api_tpu.models.moe import MoEConfig
     from gpu_docker_api_tpu.models.moe import init_params as moe_init

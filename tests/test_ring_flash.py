@@ -11,6 +11,10 @@ import pytest
 from gpu_docker_api_tpu.ops.attention import (
     flash_attention_lse, merge_attention_partials, reference_attention,
 )
+
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
 from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
 from gpu_docker_api_tpu.parallel.ring import (
     _ring_local_flash, ring_attention,

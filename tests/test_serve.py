@@ -92,6 +92,7 @@ def test_maybe_ungroup_roundtrip():
     assert jax.tree.leaves(same)[0] is jax.tree.leaves(params)[0]
 
 
+@pytest.mark.slow
 def test_generate_sampling_params_over_http(served):
     """The REST surface accepts top_k/top_p, and top_k=1 at any temperature
     is greedy (proves the kwargs actually reach generate)."""
@@ -121,6 +122,7 @@ def test_generate_sampling_validation(served):
                  {**base, "temperature": 99.0})["code"] == 400
 
 
+@pytest.mark.slow
 def test_continuous_batching_concurrent_requests():
     """Three concurrent greedy requests through the batcher (2 slots, so
     one waits for a free slot) must each equal their solo greedy stream —
@@ -194,6 +196,7 @@ def test_batcher_crash_releases_waiters(monkeypatch):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
 
 
+@pytest.mark.slow
 def test_batcher_restarts_after_transient_crash(monkeypatch):
     """One transient device error fails the in-flight request but the
     scheduler rebuilds its cache and keeps serving (ADVICE r2 medium)."""
@@ -342,6 +345,7 @@ def test_healthz_reports_batching_stats():
         srv.batcher.close()
 
 
+@pytest.mark.slow
 def test_chunked_prefill_streams_exact():
     """Chunked prefill (pieces interleaved with decode for other slots)
     must produce the same greedy streams as whole-prompt prefill."""
@@ -379,6 +383,7 @@ def test_chunked_prefill_streams_exact():
         b.close()
 
 
+@pytest.mark.slow
 def test_batcher_composes_with_w8_weights():
     """--quantize w8 --batch-slots: the slot decode runs through qmatmul,
     so int8 weights serve batched exactly like they serve solo."""
@@ -411,6 +416,7 @@ def test_batcher_rejects_empty_prompt():
         b.close()
 
 
+@pytest.mark.slow
 def test_prefix_cache_reuses_kv_and_streams_exact():
     """Second request sharing a 16-token prefix must restore the stored KV
     (only the suffix prefills) and still produce its exact solo stream."""
@@ -442,6 +448,7 @@ def test_prefix_cache_reuses_kv_and_streams_exact():
         b.close()
 
 
+@pytest.mark.slow
 def test_prefix_cache_composes_with_chunked_prefill():
     from gpu_docker_api_tpu.infer import generate
     from gpu_docker_api_tpu.workloads.serve import _Batcher

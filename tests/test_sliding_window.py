@@ -11,6 +11,10 @@ from gpu_docker_api_tpu.infer import generate
 from gpu_docker_api_tpu.models.llama import (
     LlamaConfig, init_params, llama_forward,
 )
+
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
 from gpu_docker_api_tpu.ops.attention import (
     flash_attention, reference_attention,
 )

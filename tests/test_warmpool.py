@@ -40,6 +40,7 @@ def test_supports_classification():
     assert WarmPool.supports([py, "-c", "pass"], ["XLA_FLAGS=--xla_foo"])
 
 
+@pytest.mark.slow
 def test_warm_worker_repoints_jax_env(tmp_path):
     """A warm worker that already imported jax must honor a job's JAX_*
     env through jax.config (ADVICE r2: JAX_ENABLE_X64 et al. were silently
