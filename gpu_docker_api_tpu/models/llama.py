@@ -52,8 +52,10 @@ class LlamaConfig:
     sp_attn: str = "ring"
     # > 0 = sliding-window attention (Mistral-style): each position
     # attends its last `sliding_window` keys only; prefill/decode cost
-    # becomes O(window) per token instead of O(S). Not composed with
-    # sp-sharded attention (ring/ulysses) yet.
+    # becomes O(window) per token instead of O(S). Composes with
+    # sp-sharded attention: the ring stops rotating at the window edge
+    # (parallel/ring.py _ring_local_windowed); Ulysses windows the
+    # gathered sequence unchanged.
     sliding_window: int = 0
 
     @property
@@ -224,28 +226,24 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, impl: str,
     q, k, v = pin_qkv(q, k, v, mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    # the guard must fire for BOTH sp-sharded shapes: the in-mesh dispatch
-    # below AND a pipelined trunk's attn_fn override (ring/ulysses local
-    # bodies know nothing of windows — silently running full attention
-    # would diverge from the single-chip windowed model)
-    if c.sliding_window and (
-            attn_fn is not None
-            or (mesh is not None and mesh.shape.get("sp", 1) > 1)):
-        raise NotImplementedError(
-            "sliding_window with sp-sharded attention (ring/ulysses) "
-            "is not composed yet — use sp=1 for windowed models")
     if attn_fn is not None:
+        # a pipelined trunk's core (ring/ulysses local body) — the caller
+        # configured it with this config's window (pipeline_forward)
         out = attn_fn(q, k, v)
     elif mesh is not None and mesh.shape.get("sp", 1) > 1:
         if c.sp_attn == "ulysses":
             # all-to-all head scatter: full-seq kernel on H/sp heads
+            # (windows apply unchanged on the gathered sequence)
             from ..parallel.ulysses import ulysses_attention
-            out = ulysses_attention(q, k, v, mesh, causal=True, impl=impl)
+            out = ulysses_attention(q, k, v, mesh, causal=True, impl=impl,
+                                    window=c.sliding_window)
         else:
             # K/V rotate around the ICI ring instead of being all-gathered —
-            # no device holds full K/V or [S, S] scores
+            # no device holds full K/V or [S, S] scores; with a window the
+            # ring stops at the shards the window can see
             from ..parallel.ring import ring_attention
-            out = ring_attention(q, k, v, mesh, causal=True, impl=impl)
+            out = ring_attention(q, k, v, mesh, causal=True, impl=impl,
+                                 window=c.sliding_window)
     else:
         out = attention(q, k, v, causal=True, impl=impl,
                         window=c.sliding_window)           # [B, S, H, Dh]

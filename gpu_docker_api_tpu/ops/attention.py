@@ -533,39 +533,43 @@ def _lse_to_bhs(lse3, b, h, s):
     return lse3[:, :, 0].reshape(b, h, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, blk_q, blk_k, interpret, window):
     b, s, h, _ = q.shape
-    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
+                               window=window)
     return out, _lse_to_bhs(lse3, b, h, s)
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+def _flash_lse_vjp_fwd(q, k, v, causal, blk_q, blk_k, interpret, window):
     b, s, h, _ = q.shape
-    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret)
+    out, lse3 = _flash_fwd_raw(q, k, v, causal, blk_q, blk_k, interpret,
+                               window=window)
     return (out, _lse_to_bhs(lse3, b, h, s)), (q, k, v, out, lse3)
 
 
-def _flash_lse_vjp_bwd(causal, blk_q, blk_k, interpret, res, cts):
+def _flash_lse_vjp_bwd(causal, blk_q, blk_k, interpret, window, res, cts):
     q, k, v, out, lse3 = res
     do, dlse = cts                              # dlse [B, H, S]
     b, s, h, _ = q.shape
     dlse3 = jnp.broadcast_to(
         dlse.reshape(b * h, s, 1).astype(jnp.float32), (b * h, s, LANES))
     return _flash_bwd_raw(q, k, v, out, lse3, do, causal, blk_q, blk_k,
-                          interpret, dlse=dlse3)
+                          interpret, window=window, dlse=dlse3)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "blk_q", "blk_k", "interpret"))
+                   static_argnames=("causal", "blk_q", "blk_k", "interpret",
+                                    "window"))
 def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
                         blk_q: int | None = None,
                         blk_k: int | None = None,
-                        interpret: bool = False
+                        interpret: bool = False,
+                        window: int = 0
                         ) -> tuple[jax.Array, jax.Array]:
     """Flash attention that ALSO returns the per-row logsumexp of the
     scaled scores, lse [B, H, S] f32 — and is differentiable in BOTH
@@ -573,11 +577,15 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     d lse_i / d s_ij = p_ij). This is the building block for combining
     partial attentions over disjoint key sets (ring attention: merge the
     per-ring-step (out, lse) pairs with a numerically stable softmax-of-
-    softmaxes), where the merge weights differentiate through lse."""
+    softmaxes), where the merge weights differentiate through lse.
+    window > 0 = sliding-window on the DIAGONAL (same-position) layout —
+    the windowed ring's local step."""
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     s = q.shape[1]
     blk_q = blk_q or _auto_block(s, training=True)
     blk_k = blk_k or _auto_block(s, training=True)
-    return _flash_lse(q, k, v, causal, blk_q, blk_k, interpret)
+    return _flash_lse(q, k, v, causal, blk_q, blk_k, interpret, window)
 
 
 def merge_attention_partials(outs, lses):

@@ -163,12 +163,6 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
             return (h, aux + a), None
         return (layer_fn(h, layer), aux), None
 
-    if seq_shard and with_aux:
-        # the aux psum below reduces over pp only and declares the scalar
-        # replicated — under a {pp, sp} manual region each sp shard would
-        # hold a DIFFERENT partial sum and the claim would be silently
-        # false (no caller composes these yet; moe+sp is refused upstream)
-        raise ValueError("seq_shard with with_aux is not composed yet")
     npp = mesh.shape["pp"]
     if npp == 1:
         if pregrouped:
@@ -254,7 +248,12 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         # (each stage holds its own chunks' contributions) — one f32 —
         # and averages over microbatches so it matches the sequential
         # full-batch semantics (a sum would scale the router losses by M).
-        return outputs[None], jax.lax.psum(aux_acc, "pp") / m
+        # Under seq_shard the sp ranks each computed router statistics
+        # over their OWN sequence shard: average those too (mean of
+        # shard-aux — one more pool split, same documented semantics as
+        # the microbatch split), and the scalar is genuinely replicated
+        # over the whole {pp, sp} manual region as declared below.
+        return outputs[None], jax.lax.psum(aux_acc, aux_axes) / aux_denom
 
     # interleaved trainers pass layers already in group_layers layout (no
     # per-step reshard); ungrouped callers pay one regroup here
@@ -270,10 +269,12 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         x_spec = P(None, None, "sp", None)
         out_spec = P("pp", None, None, "sp", None)
         manual = {"pp", "sp"}
+        aux_axes, aux_denom = ("pp", "sp"), m * n_sp
     else:
         x_spec = P()
         out_spec = P("pp")
         manual = {"pp"}
+        aux_axes, aux_denom = ("pp",), m
     out, aux = jax.shard_map(
         staged, mesh=mesh,
         in_specs=(P(None, "pp"), x_spec),
@@ -355,10 +356,6 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     c = config
     moe = family_for(config).returns_extra_loss
     sp = mesh.shape.get("sp", 1)
-    if sp > 1 and moe:
-        raise ValueError(
-            "pipelined MoE with sequence parallelism not composed yet — "
-            "use pp x ep with sp=1 for MoE")
     if sp > 1 and mesh.shape.get("pp", 1) == 1:
         raise ValueError(
             "mesh has sp>1 but pp=1 — use the non-pipelined forward "
@@ -376,35 +373,51 @@ def pipeline_forward(params: dict, tokens: jax.Array, config,
     cos, sin = rope_frequencies(lc, jnp.arange(s))
 
     if sp > 1:
+        window = getattr(lc, "sliding_window", 0)
         if sp_attn == "ulysses":
             # all-to-all head scatter inside the manual {pp, sp} region
             from .ulysses import _ulysses_local
             attn_core = functools.partial(_ulysses_local, axis="sp", sp=sp,
-                                          causal=True, impl=impl)
+                                          causal=True, impl=impl,
+                                          window=window)
         else:
             # flash kernels when on TPU with kernel-friendly shard shapes,
             # einsum body otherwise (ring.ring_body_auto)
             from .ring import ring_body_auto
             attn_core = functools.partial(ring_body_auto, axis="sp", ring=sp,
-                                          causal=True, impl=impl)
+                                          causal=True, impl=impl,
+                                          window=window)
+
+        if moe:
+            from ..models.moe import moe_block, weighted_router_loss
 
         def layer_fn(h, layer):
             # inside manual {"pp","sp"}: h [b_mb, S/sp, D]. Same block as
             # every other path (_attention_block), with RoPE tables sliced
             # to this shard's GLOBAL positions and the configured sequence-
-            # parallel attention body (ring or ulysses) as the core.
+            # parallel attention body (ring or ulysses) as the core. MoE
+            # layers route their OWN sequence shard's tokens (router
+            # statistics and static capacity see s_loc tokens — one more
+            # pool split on top of the microbatch split, same documented
+            # semantics); the expert banks stay ep-auto-sharded.
             s_loc = h.shape[1]
             sp_idx = jax.lax.axis_index("sp")
             cos_l = jax.lax.dynamic_slice_in_dim(cos, sp_idx * s_loc, s_loc)
             sin_l = jax.lax.dynamic_slice_in_dim(sin, sp_idx * s_loc, s_loc)
-            h = _attention_block(h, layer, c, cos_l, sin_l, impl, None,
-                                 attn_fn=attn_core)
+            h = _attention_block(h, layer, lc if moe else c, cos_l, sin_l,
+                                 impl, None, attn_fn=attn_core)
+            if moe:
+                h, aux, z = moe_block(h, layer, c, mesh=mesh)
+                return h, weighted_router_loss(aux, z, c)
             return _mlp_block(h, layer, c)
 
         x = pipeline_trunk(params["layers"], x, layer_fn, mesh,
                            n_microbatches, remat=remat,
                            virtual_stages=virtual_stages,
-                           pregrouped=pregrouped, seq_shard=True)
+                           pregrouped=pregrouped, seq_shard=True,
+                           with_aux=moe)
+        if moe:
+            x, router_loss = x
     elif moe:
         from ..models.moe import moe_block, weighted_router_loss
 

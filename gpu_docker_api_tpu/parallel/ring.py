@@ -26,8 +26,14 @@ from ..ops.attention import attention as _local_attention
 from ..ops.attention import DEFAULT_BLOCK, _on_tpu, flash_attention_lse
 
 
+def _use_flash(impl: str, s_loc: int, d: int) -> bool:
+    return impl != "xla" and (impl == "flash" or (
+        _on_tpu() and s_loc % DEFAULT_BLOCK == 0 and d % 128 == 0))
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   causal: bool = True, impl: str = "auto") -> jax.Array:
+                   causal: bool = True, impl: str = "auto",
+                   window: int = 0) -> jax.Array:
     """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over the sp mesh axis —
     returns [B,S,H,D] with the same sharding. Call from OUTSIDE shard_map;
     global shapes in, global shapes out.
@@ -36,19 +42,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     pallas flash kernel when on TPU with kernel-friendly shard shapes
     (the per-step (out, lse) partials merge with an online softmax —
     ring attention at flash speed); otherwise the fused-einsum
-    accumulation body runs."""
+    accumulation body runs.
+
+    window > 0 = sliding-window attention (causal): the ring stops
+    rotating once K/V shards leave the window — ceil((window-1)/s_loc)
+    hops instead of ring-1, so long-context SWA pays ICI only for the
+    shards it can actually see (the whole point of SWA x sp)."""
     axis = "sp"                      # the one sequence axis (mesh.AXES)
     n = mesh.shape[axis]
     if n == 1:
-        return _local_attention(q, k, v, causal=causal, impl=impl)
+        return _local_attention(q, k, v, causal=causal, impl=impl,
+                                window=window)
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
 
     from .mesh import qkv_spec
     spec_q = qkv_spec(mesh, q.shape[2], k.shape[2])
     s_loc = q.shape[1] // n
-    use_flash = impl != "xla" and (impl == "flash" or (
-        _on_tpu()
-        and s_loc % DEFAULT_BLOCK == 0 and q.shape[3] % 128 == 0))
-    if use_flash:
+    use_flash = _use_flash(impl, s_loc, q.shape[3])
+    if window:
+        local = functools.partial(_ring_local_windowed, axis=axis, ring=n,
+                                  window=window, use_flash=use_flash,
+                                  interpret=not _on_tpu())
+    elif use_flash:
         local = functools.partial(_ring_local_flash, axis=axis, ring=n,
                                   causal=causal,
                                   # explicit impl="flash" off-TPU (tests)
@@ -67,15 +83,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
 def ring_body_auto(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis: str, ring: int, causal: bool,
-                   impl: str = "auto") -> jax.Array:
+                   impl: str = "auto", window: int = 0) -> jax.Array:
     """Per-device ring body with the same flash/einsum dispatch as
     ring_attention — for callers already inside a manual collective
     region (the pipelined sp trunk passes this as the attention core).
     impl="xla" pins the einsum body (the numerics oracle must never
     silently become the kernel it exists to check)."""
-    if impl != "xla" and (impl == "flash" or (
-            _on_tpu() and q.shape[1] % DEFAULT_BLOCK == 0
-            and q.shape[3] % 128 == 0)):
+    use_flash = _use_flash(impl, q.shape[1], q.shape[3])
+    if window:
+        return _ring_local_windowed(q, k, v, axis=axis, ring=ring,
+                                    window=window, use_flash=use_flash,
+                                    interpret=not _on_tpu())
+    if use_flash:
         return _ring_local_flash(q, k, v, axis=axis, ring=ring,
                                  causal=causal, interpret=not _on_tpu())
     return _ring_local(q, k, v, axis=axis, ring=ring, causal=causal)
@@ -119,17 +138,7 @@ def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 (k_cur, v_cur))
         else:
             o, lse = pair(k_cur, v_cur, False)
-        # online merge of the partial into (num, den, m) — same math as
-        # merge_attention_partials, streamed
-        m_new = jnp.maximum(m, lse)
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        w = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
-        aq = alpha.transpose(0, 2, 1)[..., None]
-        wq = w.transpose(0, 2, 1)[..., None]
-        num = num * aq + o.astype(jnp.float32) * wq
-        den = den * alpha + w
-        return num, den, m_new
+        return _merge_partial(num, den, m, o, lse)
 
     num = jnp.zeros((b, s_loc, h, d), jnp.float32)
     den = jnp.zeros((b, h, s_loc), jnp.float32)
@@ -140,6 +149,98 @@ def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
     for i in range(ring):
         num, den, m = accumulate(i, k_cur, v_cur, num, den, m)
         if i < ring - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    den_q = jnp.maximum(den.transpose(0, 2, 1)[..., None], 1e-30)
+    return (num / den_q).astype(q.dtype)
+
+
+def _merge_partial(num, den, m, o, lse):
+    """Online merge of one disjoint-key-set partial (o softmax-normalized
+    within its set, lse [b,h,q]) into the (num, den, m) accumulator —
+    same math as merge_attention_partials, streamed."""
+    m_new = jnp.maximum(m, lse)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    w = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
+    aq = alpha.transpose(0, 2, 1)[..., None]
+    wq = w.transpose(0, 2, 1)[..., None]
+    num = num * aq + o.astype(jnp.float32) * wq
+    den = den * alpha + w
+    return num, den, m_new
+
+
+def _pair_lse_banded(q, k_cur, v_cur, offset: int, window: int):
+    """(out, lse) of q against ONE K/V shard sitting `offset` positions
+    behind it in global order (offset = hop * s_loc; 0 = the diagonal
+    shard). Causal + sliding-window mask at global positions; out is
+    softmax-normalized within the pair, lse [b,h,q] merges it with the
+    other shards' partials. Pure-einsum body (f32) — differentiable; the
+    pallas kernel covers the diagonal, bands use this."""
+    b, s_loc, h, d = q.shape
+    group = h // k_cur.shape[2]
+    kf = jnp.repeat(k_cur, group, axis=2).astype(jnp.float32)
+    vf = jnp.repeat(v_cur, group, axis=2).astype(jnp.float32)
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    r = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    delta = r - c + offset               # row_global - col_global
+    keep = (delta >= 0) & (delta < window)
+    s = jnp.where(keep[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                              # [b,h,q]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)                              # [b,h,q]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vf) / jnp.maximum(
+        l, 1e-30).transpose(0, 2, 1)[..., None]
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    return out.astype(q.dtype), lse
+
+
+def _ring_local_windowed(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis: str, ring: int, window: int,
+                         use_flash: bool, interpret: bool) -> jax.Array:
+    """Per-device body for sliding-window ring attention. The payoff:
+    only ceil((window-1)/s_loc) ring hops happen AT ALL — K/V shards
+    wholly outside the window are never rotated in (a 32k-token Mistral
+    run on an 8-way sp ring with window=4096=s_loc pays ONE hop, not 7).
+    The diagonal shard runs the windowed pallas flash kernel (einsum
+    fallback off-TPU); behind-shards use the banded einsum pair, whose
+    mask keeps at most `window` columns."""
+    b, s_loc, h, d = q.shape
+    my = jax.lax.axis_index(axis)
+    n_back = min(ring - 1, -(-(window - 1) // s_loc)) if window > 1 else 0
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def empty(kv):
+        del kv
+        return (jnp.zeros((b, s_loc, h, d), q.dtype),
+                jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))
+
+    num = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    den = jnp.zeros((b, h, s_loc), jnp.float32)
+    m = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n_back + 1):
+        if i == 0:
+            if use_flash:
+                o, lse = flash_attention_lse(q, k_cur, v_cur, causal=True,
+                                             interpret=interpret,
+                                             window=window)
+            else:
+                o, lse = _pair_lse_banded(q, k_cur, v_cur, 0, window)
+        else:
+            # the shard i hops back — real only when it exists (my >= i;
+            # wrapped shards are FUTURE positions under global causal)
+            o, lse = jax.lax.cond(
+                my >= i,
+                lambda kv, off=i * s_loc: _pair_lse_banded(
+                    q, kv[0], kv[1], off, window),
+                empty, (k_cur, v_cur))
+        num, den, m = _merge_partial(num, den, m, o, lse)
+        if i < n_back:
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
     den_q = jnp.maximum(den.transpose(0, 2, 1)[..., None], 1e-30)

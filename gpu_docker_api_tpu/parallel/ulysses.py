@@ -30,15 +30,21 @@ from ..ops.attention import attention as _local_attention
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                      causal: bool = True, impl: str = "auto") -> jax.Array:
+                      causal: bool = True, impl: str = "auto",
+                      window: int = 0) -> jax.Array:
     """q [B,S,H,D], k/v [B,S,Hkv,D], S sharded over the sp mesh axis —
     returns [B,S,H,D] same sharding. Call from OUTSIDE shard_map; global
     shapes in/out. Requires H % sp == 0 (KV heads are replicated up to the
-    group size first when Hkv % sp != 0)."""
+    group size first when Hkv % sp != 0).
+
+    window > 0 composes trivially: after the head scatter each device
+    holds the FULL sequence for its head group, so the ordinary windowed
+    kernel applies unchanged."""
     axis = "sp"                      # the one sequence axis (mesh.AXES)
     n = mesh.shape[axis]
     if n == 1:
-        return _local_attention(q, k, v, causal=causal, impl=impl)
+        return _local_attention(q, k, v, causal=causal, impl=impl,
+                                window=window)
 
     from .mesh import head_axis_for, qkv_spec
     head_ax = head_axis_for(mesh, q.shape[2], k.shape[2])
@@ -48,7 +54,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"n_heads {q.shape[2]}/tp={tp_n} must divide by sp {n} for Ulysses")
     spec = qkv_spec(mesh, q.shape[2], k.shape[2])
     local = functools.partial(_ulysses_local, axis=axis, sp=n, causal=causal,
-                              impl=impl)
+                              impl=impl, window=window)
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -57,7 +63,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     )(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis: str, sp: int, causal: bool, impl: str):
+def _ulysses_local(q, k, v, *, axis: str, sp: int, causal: bool, impl: str,
+                   window: int = 0):
     """Per-device body. q [b, s/sp, H, D]; k/v [b, s/sp, Hkv, D]."""
     hkv = k.shape[2]
     if hkv % sp != 0:
@@ -74,7 +81,8 @@ def _ulysses_local(q, k, v, *, axis: str, sp: int, causal: bool, impl: str):
     qh = scatter_heads(q)          # [b, S, H/sp, D]
     kh = scatter_heads(k)
     vh = scatter_heads(v)
-    out = _local_attention(qh, kh, vh, causal=causal, impl=impl)
+    out = _local_attention(qh, kh, vh, causal=causal, impl=impl,
+                           window=window)
     # head-sharded -> seq-sharded: split sequence, gather heads back
     return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
                               tiled=True)

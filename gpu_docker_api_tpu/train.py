@@ -179,10 +179,6 @@ class Trainer:
         # fail unsupported/ill-formed pipeline x sp combos HERE, before
         # init materializes checkpoint-scale state (clear errors up front)
         if plan.pp > 1 and plan.sp > 1:
-            if family_for(config).returns_extra_loss:
-                raise ValueError(
-                    "pipelined MoE with sequence parallelism not composed "
-                    "yet — use pp x ep with sp=1 for MoE")
             if (getattr(config, "sp_attn", "ring") == "ulysses"
                     and config.n_heads % plan.sp):
                 raise ValueError(

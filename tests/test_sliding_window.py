@@ -107,7 +107,134 @@ def test_windowed_training_step_runs():
     assert np.isfinite(float(m["loss"]))
 
 
-def test_window_with_sp_raises():
+# ---- SWA x sequence parallelism (VERDICT r2 hole #3) -----------------------
+
+def test_windowed_ring_matches_reference():
+    """Ring attention with a window == full-sequence windowed reference,
+    including GQA, on the 8-device CPU mesh."""
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+    from gpu_docker_api_tpu.parallel.ring import ring_attention
+
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=4),
+                     jax.devices()[:4])
+    q, k, v = qkv(jax.random.key(3), b=2, s=64, h=4, hkv=2, d=16)
+    for window in (5, 16, 40, 64):
+        with mesh:
+            got = ring_attention(q, k, v, mesh, causal=True, impl="xla",
+                                 window=window)
+        want = reference_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_windowed_ring_flash_kernels_match_reference():
+    """The flash path (windowed pallas diagonal + banded einsum behind
+    shards, interpreter mode) agrees with the reference too."""
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+    from gpu_docker_api_tpu.parallel.ring import ring_attention
+
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=2),
+                     jax.devices()[:2])
+    q, k, v = qkv(jax.random.key(4), b=1, s=256, h=2, hkv=2, d=128,
+                  dtype=jnp.float32)
+    with mesh:
+        got = ring_attention(q, k, v, mesh, causal=True, impl="flash",
+                             window=100)
+    want = reference_attention(q, k, v, causal=True, window=100)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_windowed_ring_gradients_match_reference():
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+    from gpu_docker_api_tpu.parallel.ring import ring_attention
+
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=4),
+                     jax.devices()[:4])
+    q, k, v = qkv(jax.random.key(5), b=1, s=32, h=2, hkv=2, d=8)
+
+    def loss_ring(q, k, v):
+        with mesh:
+            o = ring_attention(q, k, v, mesh, causal=True, impl="xla",
+                               window=10)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True, window=10)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_windowed_ring_skips_out_of_window_rotations():
+    """THE payoff: K/V shards wholly outside the window are never
+    rotated in — the compiled HLO has fewer collective-permutes than the
+    full causal ring (which pays ring-1 hops)."""
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+    from gpu_docker_api_tpu.parallel.ring import ring_attention
+
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))
+    q, k, v = qkv(jax.random.key(6), b=1, s=64, h=2, hkv=2, d=8)
+
+    def count_permutes(window):
+        # impl="flash": both the windowed and the full-causal flash
+        # bodies UNROLL their hop loop, so the compiled HLO's
+        # collective-permute count equals 2 x hops (k and v) — an exact
+        # communication-shape assertion (the einsum body hides its hops
+        # in a fori_loop, where text counts can't see the trip count)
+        def f(q, k, v):
+            with mesh:
+                return ring_attention(q, k, v, mesh, causal=True,
+                                      impl="flash", window=window)
+        txt = jax.jit(f).lower(q, k, v).compile().as_text()
+        return txt.count(" collective-permute(")
+
+    # s_loc = 8: window=8 sees at most 1 shard back -> 1 hop (2 permutes);
+    # the full causal ring rotates ring-1 = 7 times (14 permutes)
+    assert count_permutes(8) == 2
+    assert count_permutes(0) == 14
+
+
+def test_windowed_forward_under_sp_matches_single_device():
+    """llama_forward with sliding_window on an sp mesh == the same model
+    on one device (the guard this replaces used to raise here)."""
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=8)
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(6), (2, 32), 0,
+                              cfg.vocab_size, jnp.int32)
+    want = llama_forward(params, toks, cfg, impl="xla")
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=4),
+                     jax.devices()[:4])
+    got = llama_forward(params, toks, cfg, impl="xla", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_windowed_ulysses_matches_single_device():
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=8,
+                              sp_attn="ulysses")
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(7), (2, 32), 0,
+                              cfg.vocab_size, jnp.int32)
+    want = llama_forward(params, toks, cfg, impl="xla")
+    mesh = make_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=2),
+                     jax.devices()[:2])
+    got = llama_forward(params, toks, cfg, impl="xla", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_windowed_training_step_under_sp_mesh():
+    """End-to-end: a windowed model TRAINS on a pp-free sp mesh (the
+    combination the r2 guard refused); loss finite and decreasing-ish."""
     from gpu_docker_api_tpu.parallel.mesh import MeshPlan
     from gpu_docker_api_tpu.train import Trainer, TrainConfig
 
@@ -117,8 +244,31 @@ def test_window_with_sp_raises():
     st = tr.init(jax.random.key(0))
     toks = tr.shard_batch(jax.random.randint(jax.random.key(6), (4, 32), 0,
                                              cfg.vocab_size, jnp.int32))
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        tr.step(st, toks)
+    losses = []
+    for _ in range(4):
+        st, metrics = tr.step(st, toks)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_windowed_pipeline_sp_loss_matches_unsharded():
+    """pp x sp with a windowed config: pipelined loss == plain loss."""
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan, make_mesh
+    from gpu_docker_api_tpu.train import loss_fn
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), n_layers=4,
+                              sliding_window=8)
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(8), (4, 32), 0,
+                              cfg.vocab_size, jnp.int32)
+    want = float(loss_fn(params, toks, cfg, impl="xla", remat=False))
+    mesh = make_mesh(MeshPlan(pp=2, sp=2, tp=2))
+    with mesh:
+        got = float(jax.jit(lambda p, t: loss_fn(
+            p, t, cfg, impl="xla", mesh=mesh, n_microbatches=2,
+            remat=False))(params, toks))
+    np.testing.assert_allclose(got, want, rtol=5e-4)
 
 
 def test_mistral_7b_canned_config():
