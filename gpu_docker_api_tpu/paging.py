@@ -1,0 +1,253 @@
+"""Paged KV cache: a shared block pool for continuous batching.
+
+The dense slot cache (batching.py) reserves `slots x max_len` tokens of
+KV up front — HBM pays for the worst case of every slot at once. This
+module is the vLLM/PagedAttention idea in XLA-native form: ONE pool of
+`n_blocks` fixed-size blocks ([L, n_blocks, block, Hkv, D]); each slot
+holds a PAGE TABLE (block indices, data not shape) and consumes only the
+blocks its request actually needs. Admission becomes a free-block
+question, and cache memory is proportional to resident tokens, not to
+slots x max_len (VERDICT r2 weak #4 / next #6).
+
+XLA-native means: the pool, page tables, and lengths are all arrays;
+attention walks a slot's pages with a dynamic-trip-count fori_loop of
+gathers (`jnp.take` on the block axis — same HBM traffic as the dense
+cache's contiguous reads), and writes scatter at (block, offset) pairs
+computed from the page table. Everything compiles ONCE; block allocation
+is host-side bookkeeping between steps (the batcher already syncs per
+decode step for the argmax).
+
+Block 0 is a SCRATCH block: never allocated, the write target for
+inactive rows (their junk lands there instead of clobbering live pages).
+
+Quantized pools (int8 K/V + per-token-per-head f32 scales) mirror
+infer.init_cache's kv8 layout — the paged batcher composes with
+--kv-quant the same way the dense one does.
+
+No reference counterpart (SURVEY §2 — the reference never opens a
+tensor); serving-runtime surface of the TPU build.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .infer import _llama_view, _quantize_kv
+from .models.llama import apply_rope, rms_norm, rope_frequencies
+from .ops.quant import qmatmul
+
+
+def init_paged_cache(config, n_blocks: int, block_size: int, slots: int,
+                     max_pages: int, quantized: bool = False) -> dict:
+    """Block pool + per-slot page tables. Pool memory = n_blocks x
+    block_size tokens of KV per layer — independent of slots/max_len."""
+    c = _llama_view(config)
+    shape = (config.n_layers, n_blocks, block_size,
+             c.n_kv_heads, c.head_dim)
+    out = {
+        "k": jnp.zeros(shape, jnp.int8 if quantized else c.dtype),
+        "v": jnp.zeros(shape, jnp.int8 if quantized else c.dtype),
+        # page tables: pages[s, j] = pool block backing token positions
+        # [j*block, (j+1)*block) of slot s; 0 = the scratch block
+        "pages": jnp.zeros((slots, max_pages), jnp.int32),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+    if quantized:
+        sshape = shape[:-1] + (1,)
+        out["ks"] = jnp.ones(sshape, jnp.float32)
+        out["vs"] = jnp.ones(sshape, jnp.float32)
+    return out
+
+
+def _buf_keys(cache) -> tuple:
+    return tuple(kk for kk in ("k", "v", "ks", "vs") if kk in cache)
+
+
+def _paged_attend(q, pool_k, pool_v, pages, pos, scale_k=None,
+                  scale_v=None, active=None):
+    """q [B,T,H,D] at per-row absolute positions pos [B]; pool_k/v
+    [n_blocks, blk, Hkv, D]; pages [B, P]. Blockwise online-softmax over
+    each row's pages up to its causal frontier — the paged twin of
+    infer._attend_cached (dynamic trip count = the furthest row's page
+    count; per-row masks; GQA without materializing repeated K/V)."""
+    b, t, h, d = q.shape
+    blk = pool_k.shape[1]
+    hkv = pool_k.shape[2]
+    group = h // hkv
+    qf = (q.astype(jnp.float32) / math.sqrt(d)).reshape(b, t, hkv, group, d)
+    rows = pos[:, None] + jnp.arange(t)                      # [B, T]
+    if active is not None:
+        far = jnp.max(jnp.where(active, pos, 0)) + t
+    else:
+        far = jnp.max(pos) + t
+    trips = (far + blk - 1) // blk
+
+    def _deq(xb, pool_scale, pid):
+        if pool_scale is None:
+            return xb.astype(jnp.float32)
+        return xb.astype(jnp.float32) * jnp.take(pool_scale, pid, axis=0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        pid = jax.lax.dynamic_slice_in_dim(pages, j, 1, axis=1)[:, 0]  # [B]
+        kb = _deq(jnp.take(pool_k, pid, axis=0), scale_k, pid)
+        vb = _deq(jnp.take(pool_v, pid, axis=0), scale_v, pid)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kb)
+        cols = j * blk + jnp.arange(blk)
+        mask = (cols[None, None, :] <= rows[:, :, None])     # [B, T, blk]
+        mask = mask[:, None, None]                           # [B,1,1,T,blk]
+        s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p, vb)
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((b, hkv, group, t, d), jnp.float32)
+    m0 = jnp.full((b, hkv, group, t, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, group, t, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, trips, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, d)
+    return out.astype(q.dtype)
+
+
+def _paged_write(pool, new, pages, pos, active=None):
+    """Scatter new [B,T,...] into the pool at each row's next positions.
+    pos [B]; inactive rows are routed to the scratch block 0."""
+    b, t = new.shape[:2]
+    p = pos[:, None] + jnp.arange(t)                          # [B, T]
+    blk = pool.shape[1]
+    bidx = jnp.take_along_axis(pages, p // blk, axis=1)       # [B, T]
+    off = p % blk
+    if active is not None:
+        bidx = jnp.where(active[:, None], bidx, 0)
+    return pool.at[bidx, off].set(new.astype(pool.dtype))
+
+
+def _paged_layer_step(x, layer, pool_k, pool_v, pages, pos, config,
+                      cos, sin, scale_k=None, scale_v=None, active=None):
+    """One decoder layer over a T-token slice with paged cache
+    read+write — the paged twin of infer._layer_step."""
+    c = _llama_view(config)
+    b, t, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    q = qmatmul(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = qmatmul(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = qmatmul(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if scale_k is not None:
+        k, ks_new = _quantize_kv(k)
+        v, vs_new = _quantize_kv(v)
+        scale_k = _paged_write(scale_k, ks_new, pages, pos, active)
+        scale_v = _paged_write(scale_v, vs_new, pages, pos, active)
+    pool_k = _paged_write(pool_k, k, pages, pos, active)
+    pool_v = _paged_write(pool_v, v, pages, pos, active)
+    out = _paged_attend(q, pool_k, pool_v, pages, pos, scale_k, scale_v,
+                        active=active)
+    x = x + qmatmul(out.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
+    if "we1" in layer:
+        from .models.moe import moe_block
+        x, _, _ = moe_block(x, layer, config)
+    else:
+        hm = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        x = x + qmatmul(jax.nn.silu(qmatmul(hm, layer["w1"]))
+                        * qmatmul(hm, layer["w3"]), layer["w2"])
+    if scale_k is not None:
+        return x, pool_k, pool_v, scale_k, scale_v
+    return x, pool_k, pool_v
+
+
+@partial(jax.jit, static_argnames=("config", "append"), donate_argnums=(2,))
+def paged_prefill(params, prompt, cache, slot, config,
+                  append: bool = False):
+    """Run prompt [1, T] through the model into slot `slot`'s pages
+    (which the host allocator must already cover through start+T).
+    Returns (last logits [1, V], cache). append=True continues at the
+    slot's current length (chunked prefill)."""
+    c = _llama_view(config)
+    cur = jax.lax.dynamic_slice(cache["lengths"], (slot,), (1,))[0]
+    start = cur if append else jnp.zeros((), jnp.int32)
+    pages_row = jax.lax.dynamic_slice_in_dim(cache["pages"], slot, 1,
+                                             axis=0)          # [1, P]
+    b, t = prompt.shape
+    x = jnp.take(params["embed"], prompt, axis=0)
+    cos, sin = rope_frequencies(c, start + jnp.arange(t))
+    bufs = _buf_keys(cache)
+
+    def body(x, scanned):
+        layer, *pools = scanned
+        x, *pools = _paged_layer_step(x, layer, *pools[:2], pages_row,
+                                      start[None], config, cos, sin,
+                                      *pools[2:])
+        return x, tuple(pools)
+
+    x, pools_out = jax.lax.scan(
+        body, x, (params["layers"],) + tuple(cache[kk] for kk in bufs))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    out = dict(zip(bufs, pools_out))
+    out["pages"] = cache["pages"]
+    out["lengths"] = jax.lax.dynamic_update_slice(
+        cache["lengths"], (start + t)[None], (slot,))
+    return logits[:, -1], out
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_decode(params, tokens, cache, active, config):
+    """One decode step for every slot together over the shared pool.
+    tokens [slots], active [slots] bool. Inactive rows write to the
+    scratch block and do not advance."""
+    c = _llama_view(config)
+    pos = cache["lengths"]
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)
+    cos, sin = rope_frequencies(c, pos)
+    cos, sin = cos[:, None, :], sin[:, None, :]
+    bufs = _buf_keys(cache)
+
+    def body(x, scanned):
+        layer, *pools = scanned
+        x, *pools = _paged_layer_step(x, layer, *pools[:2],
+                                      cache["pages"], pos, config,
+                                      cos, sin, *pools[2:], active=active)
+        return x, tuple(pools)
+
+    x, pools_out = jax.lax.scan(
+        body, x, (params["layers"],) + tuple(cache[kk] for kk in bufs))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    out = dict(zip(bufs, pools_out))
+    out["pages"] = cache["pages"]
+    out["lengths"] = pos + active.astype(jnp.int32)
+    return logits[:, -1], out
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's blocks (block 0 = scratch,
+    never handed out). The batcher's admission control: a request is
+    admitted only when its full reservation fits."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (block 0 is scratch)")
+        self._free = list(range(n_blocks - 1, 0, -1))   # pop() -> low ids
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int):
+        """n blocks or None (caller keeps the request queued)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks) -> None:
+        self._free.extend(blocks)

@@ -91,6 +91,8 @@ def ring_body_auto(q: jax.Array, k: jax.Array, v: jax.Array, *,
     silently become the kernel it exists to check)."""
     use_flash = _use_flash(impl, q.shape[1], q.shape[3])
     if window:
+        if not causal:
+            raise ValueError("sliding window requires causal attention")
         return _ring_local_windowed(q, k, v, axis=axis, ring=ring,
                                     window=window, use_flash=use_flash,
                                     interpret=not _on_tpu())

@@ -85,17 +85,33 @@ class _Batcher:
 
     def __init__(self, config, params, slots: int, max_len: int,
                  prefill_chunk: int = 0, prefix_cache: int = 0,
-                 restarts: int = 3, kv_quant: bool = False):
+                 restarts: int = 3, kv_quant: bool = False,
+                 kv_block: int = 0, kv_pool_blocks: int = 0):
         import collections
         import queue
 
-        from ..batching import init_slot_cache
         self.config = config
         self.params = params
         self.max_len = max_len
         # int8 slot cache: half the decode-loop HBM reads (same numerics
         # as infer.py's kv_quant path — per-token-per-head scales)
         self.kv_quant = kv_quant
+        # kv_block > 0: PAGED cache (paging.py) — slots share a pool of
+        # kv_pool_blocks blocks of kv_block tokens instead of dense
+        # slots x max_len reservations; admission waits on free blocks.
+        # Default pool = full capacity (operators shrink it to cap HBM).
+        self._paged = kv_block > 0
+        self.kv_block = kv_block
+        if self._paged:
+            if prefix_cache:
+                raise ValueError(
+                    "--prefix-cache needs the dense slot cache; paged KV "
+                    "(--kv-block) does not support prefix reuse yet")
+            self._max_pages = -(-max_len // kv_block)
+            self.kv_pool_blocks = (kv_pool_blocks
+                                   or 1 + slots * self._max_pages)
+        else:
+            self.kv_pool_blocks = 0
         # scheduler crash budget: a transient device/XLA error fails the
         # in-flight requests but the loop re-initializes its cache and
         # keeps serving; after `restarts` crashes the batcher stays dead
@@ -113,13 +129,53 @@ class _Batcher:
         self._prefixes: "collections.OrderedDict" = collections.OrderedDict()
         self.prefix_hits = 0
         self.queue: "queue.Queue" = queue.Queue()
-        self.cache = init_slot_cache(config, slots, max_len,
-                                     quantized=kv_quant)
         self.slots: list = [None] * slots
+        self._waiting = None      # paged: head-of-line item short on blocks
+        self._make_cache()
         self._stop = False
         self._dead: Exception | None = None   # loop crash / close reason
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
+
+    def _make_cache(self) -> None:
+        """(Re)build the device cache + host allocator state — init and
+        the crash-restart path share it."""
+        if self._paged:
+            from ..paging import BlockAllocator, init_paged_cache
+            self.cache = init_paged_cache(
+                self.config, self.kv_pool_blocks, self.kv_block,
+                len(self.slots), self._max_pages, quantized=self.kv_quant)
+            self._alloc = BlockAllocator(self.kv_pool_blocks)
+            self._slot_blocks: list = [None] * len(self.slots)
+        else:
+            from ..batching import init_slot_cache
+            self.cache = init_slot_cache(self.config, len(self.slots),
+                                         self.max_len,
+                                         quantized=self.kv_quant)
+
+    # the cache entry points, dispatched on dense vs paged mode (the
+    # import + attribute lookup per call is trivia next to the jitted
+    # call itself; _loop hoists decode only because it's per-token-hot)
+    def _fn_prefill(self):
+        if self._paged:
+            from ..paging import paged_prefill
+            return paged_prefill
+        from ..batching import slot_prefill
+        return slot_prefill
+
+    def _fn_decode(self):
+        if self._paged:
+            from ..paging import paged_decode
+            return paged_decode
+        from ..batching import slot_decode
+        return slot_decode
+
+    def _release_slot(self, i: int) -> None:
+        """Free a slot AND (paged) return its blocks to the pool."""
+        self.slots[i] = None
+        if self._paged and self._slot_blocks[i]:
+            self._alloc.free(self._slot_blocks[i])
+            self._slot_blocks[i] = None
 
     def submit(self, prompt_row, max_new: int) -> list[int]:
         """Blocking: returns the greedy stream for one sequence. Raises if
@@ -136,6 +192,13 @@ class _Batcher:
             raise ValueError(
                 f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "
                 f"the batcher's max_len {self.max_len}")
+        if self._paged:
+            needed = -(-(prompt_row.shape[0] + max_new) // self.kv_block)
+            if needed > self.kv_pool_blocks - 1:    # block 0 is scratch
+                raise ValueError(
+                    f"request needs {needed} KV blocks but the pool only "
+                    f"has {self.kv_pool_blocks - 1} — it could never be "
+                    f"admitted")
         item = {"prompt": prompt_row, "max_new": int(max_new),
                 "done": threading.Event(), "out": None, "error": None}
         self.queue.put(item)
@@ -162,15 +225,20 @@ class _Batcher:
         self._fail_all(RuntimeError("batcher closed"))
 
     def _fail_all(self, exc: Exception) -> None:
-        """Release every waiter — in-flight slots and queued items; the
-        scheduler is gone, so blocking forever is the only alternative."""
+        """Release every waiter — in-flight slots, the parked head-of-line
+        item, and queued items; the scheduler is gone, so blocking forever
+        is the only alternative."""
         import queue
         self._dead = self._dead or exc
         for i, s in enumerate(self.slots):
             if s is not None:
                 s["error"] = exc
                 s["done"].set()
-                self.slots[i] = None
+                self._release_slot(i)
+        if self._waiting is not None:
+            self._waiting["error"] = exc
+            self._waiting["done"].set()
+            self._waiting = None
         while True:
             try:
                 item = self.queue.get_nowait()
@@ -180,7 +248,6 @@ class _Batcher:
             item["done"].set()
 
     def _run(self):
-        from ..batching import init_slot_cache
         while True:
             try:
                 self._loop()
@@ -197,9 +264,7 @@ class _Batcher:
                 # in-flight waiter above, so the cache holds only dead
                 # rows — rebuild it and resume accepting work
                 self._restarts_left -= 1
-                self.cache = init_slot_cache(
-                    self.config, len(self.slots), self.max_len,
-                    quantized=self.kv_quant)
+                self._make_cache()
                 self._prefixes.clear()
                 if self._stop:
                     # close() ran while we rebuilt (its join can time out
@@ -212,19 +277,46 @@ class _Batcher:
 
     # ---- the scheduler loop (single thread owns the cache) ----
 
+    def _next_item(self):
+        """FIFO head: the parked head-of-line item (paged admission short
+        on blocks) before anything newly queued. None = nothing waiting."""
+        import queue
+        if self._waiting is not None:
+            item, self._waiting = self._waiting, None
+            return item
+        try:
+            return self.queue.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit(self):
         """Claim free slots for queued items. Without chunking, the whole
         prompt prefills here; with chunking, the item parks in the slot
-        with its remaining pieces and _prefill_tick feeds them."""
-        import queue
+        with its remaining pieces and _prefill_tick feeds them. Paged
+        mode additionally reserves the request's blocks from the shared
+        pool — short on blocks, the item waits at the head of the line
+        (FIFO: later small requests must not starve it)."""
+        import jax.numpy as jnp
 
         for i, s in enumerate(self.slots):
             if s is not None:
                 continue
-            try:
-                item = self.queue.get_nowait()
-            except queue.Empty:
+            item = self._next_item()
+            if item is None:
                 return
+            if self._paged:
+                needed = -(-(item["prompt"].shape[0] + item["max_new"])
+                           // self.kv_block)
+                blocks = self._alloc.alloc(needed)
+                if blocks is None:
+                    # not enough pool: park and retry when slots finish
+                    self._waiting = item
+                    return
+                self._slot_blocks[i] = blocks
+                row = [0] * self._max_pages
+                row[:needed] = blocks
+                self.cache["pages"] = self.cache["pages"].at[i].set(
+                    jnp.array(row, jnp.int32))
             try:
                 rem = self._restore_prefix(i, item)
                 if self.prefill_chunk > 0:
@@ -313,8 +405,7 @@ class _Batcher:
         import jax
         import jax.numpy as jnp
 
-        from ..batching import slot_prefill
-        logits, self.cache = slot_prefill(
+        logits, self.cache = self._fn_prefill()(
             self.params, piece[None], self.cache, jnp.int32(i),
             self.config, append=not first)
         item["_last_logits"] = logits
@@ -332,7 +423,7 @@ class _Batcher:
         if item["max_new"] <= 1:
             item["out"] = item["stream"]
             item["done"].set()
-            self.slots[i] = None
+            self._release_slot(i)     # also frees (paged) blocks
         else:
             self.slots[i] = item
 
@@ -368,7 +459,7 @@ class _Batcher:
         import jax
         import jax.numpy as jnp
 
-        from ..batching import slot_decode
+        slot_decode = self._fn_decode()
         while not self._stop:
             self._admit()
             fed = self._prefill_tick()      # one prompt piece per tick
@@ -396,7 +487,8 @@ class _Batcher:
                 if len(s["stream"]) >= s["max_new"]:
                     s["out"] = s["stream"]
                     s["done"].set()
-                    self.slots[i] = None   # slot free; stale KV is dead
+                    # slot free; stale KV dead; (paged) blocks back to pool
+                    self._release_slot(i)
 
 
 class _Server:
@@ -494,11 +586,18 @@ def _handler_for(srv: _Server, model_name: str):
                     data["batching"] = {
                         "slots": len(b.slots),
                         "active": sum(s is not None for s in b.slots),
-                        "queued": b.queue.qsize(),
+                        "queued": b.queue.qsize()
+                                  + (b._waiting is not None),
                         "maxLen": b.max_len,
                         "alive": b.alive,
                         "prefixHits": b.prefix_hits,
                     }
+                    if b._paged:
+                        data["batching"]["paged"] = {
+                            "blockSize": b.kv_block,
+                            "poolBlocks": b.kv_pool_blocks,
+                            "freeBlocks": b._alloc.free_blocks,
+                        }
                 self._send(200, "Success", data)
             else:
                 self._send(404, "route not found", None)
@@ -581,7 +680,17 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-cache", type=int, default=0,
                    help="keep the KV of the last N distinct prompts; a "
                         "request extending a cached prompt prefills only "
-                        "the suffix (system-prompt reuse; 0 = off)")
+                        "the suffix (system-prompt reuse; 0 = off; dense "
+                        "slot cache only)")
+    p.add_argument("--kv-block", type=int, default=0,
+                   help="PAGED slot cache: block size in tokens — slots "
+                        "share a block pool instead of dense slots x "
+                        "max_len reservations; admission waits on free "
+                        "blocks (0 = dense)")
+    p.add_argument("--kv-pool", type=int, default=0,
+                   help="paged pool size in blocks (default: full "
+                        "capacity, slots x ceil(max_len/block) + scratch; "
+                        "shrink to cap KV HBM)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -637,12 +746,19 @@ def main(argv=None) -> int:
                                or config.max_seq_len,
                                prefill_chunk=args.batch_prefill_chunk,
                                prefix_cache=args.prefix_cache,
-                               kv_quant=args.kv_quant)
+                               kv_quant=args.kv_quant,
+                               kv_block=args.kv_block,
+                               kv_pool_blocks=args.kv_pool)
+        mode = (f"paged ({srv.batcher.kv_pool_blocks} x {args.kv_block} "
+                f"token blocks)" if args.kv_block else "dense")
         print(f"continuous batching: {args.batch_slots} slots x "
-              f"{srv.batcher.max_len} tokens", flush=True)
+              f"{srv.batcher.max_len} tokens, {mode} KV", flush=True)
     elif args.prefix_cache:
         raise SystemExit("--prefix-cache lives in the batching scheduler; "
                          "it needs --batch-slots N")
+    elif args.kv_block or args.kv_pool:
+        raise SystemExit("--kv-block/--kv-pool configure the batching "
+                         "scheduler's cache; they need --batch-slots N")
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),

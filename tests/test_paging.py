@@ -1,0 +1,184 @@
+"""Paged KV cache (paging.py): block-pool primitives, batcher
+integration, admission control (VERDICT r2 weak #4 / next #6)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_docker_api_tpu.infer import generate
+from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+from gpu_docker_api_tpu.paging import (
+    BlockAllocator, init_paged_cache, paged_decode, paged_prefill,
+)
+from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def _run_slot(cfg, params, cache, slot, prompt, max_new, slots=2):
+    """Drive one slot's stream through the paged primitives."""
+    logits, cache = paged_prefill(params, prompt, cache, jnp.int32(slot),
+                                  cfg)
+    toks = [int(jnp.argmax(logits[0]))]
+    active = jnp.array([i == slot for i in range(slots)])
+    while len(toks) < max_new:
+        step = jnp.array([toks[-1] if i == slot else 0
+                          for i in range(slots)], jnp.int32)
+        logits, cache = paged_decode(params, step, cache, active, cfg)
+        toks.append(int(jnp.argmax(logits[slot])))
+    return toks, cache
+
+
+def _pages_for(alloc, blk, n_tokens, max_pages):
+    need = -(-n_tokens // blk)
+    blocks = alloc.alloc(need)
+    row = np.zeros(max_pages, np.int32)
+    row[:need] = blocks
+    return jnp.array(row), blocks
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_stream_matches_generate(tiny, quantized):
+    """The paged cache reproduces infer.generate's greedy stream exactly
+    (dense pool and int8 pool) — non-contiguous blocks included."""
+    cfg, params = tiny
+    prompt = jnp.array([[5, 9, 2, 7, 11, 3]], jnp.int32)
+    max_new = 8
+    want = np.asarray(generate(params, prompt, cfg, max_new,
+                               kv_quant=quantized))[0].tolist()
+    blk = 4
+    cache = init_paged_cache(cfg, n_blocks=16, block_size=blk, slots=2,
+                             max_pages=8, quantized=quantized)
+    alloc = BlockAllocator(16)
+    alloc.alloc(3)     # burn a few so slot pages are NOT contiguous
+    row, _ = _pages_for(alloc, blk, prompt.shape[1] + max_new, 8)
+    cache["pages"] = cache["pages"].at[1].set(row)
+    toks, _ = _run_slot(cfg, params, cache, 1, prompt, max_new)
+    assert toks == want
+
+
+def test_pool_memory_is_independent_of_slots_times_max_len(tiny):
+    """THE point: cache memory ∝ pool blocks, not slots x max_len. A
+    16-slot, 128-token-max batcher with a 9-block pool holds 9x8 = 72
+    tokens of KV — 17x less than the dense 16x128; and it still serves
+    correctly within that budget."""
+    cfg, params = tiny
+    blk, pool = 8, 9
+    b = _Batcher(cfg, params, slots=16, max_len=128, kv_block=blk,
+                 kv_pool_blocks=pool)
+    try:
+        dense_tokens = 16 * 128
+        paged_tokens = pool * blk
+        assert b.cache["k"].shape[1] * b.cache["k"].shape[2] == paged_tokens
+        assert paged_tokens * 17 <= dense_tokens
+        prompt = jnp.array([5, 9, 2, 7], jnp.int32)
+        want = np.asarray(generate(params, prompt[None], cfg, 6))[0].tolist()
+        assert b.submit(prompt, 6) == want
+    finally:
+        b.close()
+
+
+def test_paged_batcher_streams_match_dense(tiny):
+    """Concurrent streams through the PAGED batcher equal their solo
+    greedy streams (the dense batcher's equality contract, unchanged)."""
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=3, max_len=64, kv_block=8)
+    try:
+        prompts = [jax.random.randint(jax.random.key(i), (4 + 3 * i,), 0,
+                                      cfg.vocab_size) for i in range(3)]
+        want = [np.asarray(generate(params, p[None], cfg, 5))[0].tolist()
+                for p in prompts]
+        got = [None] * 3
+
+        def ask(i):
+            got[i] = b.submit(prompts[i], 5)
+
+        ts = [threading.Thread(target=ask, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert got == want
+    finally:
+        b.close()
+
+
+def test_admission_waits_for_free_blocks(tiny):
+    """A pool too small for two concurrent requests serializes them:
+    the second waits for the first's blocks, then completes correctly —
+    admission by free blocks, not by slot count."""
+    cfg, params = tiny
+    blk = 8
+    # pool fits exactly ONE (prompt 4 + max_new 12 -> 2 blocks) + scratch
+    b = _Batcher(cfg, params, slots=2, max_len=32, kv_block=blk,
+                 kv_pool_blocks=3)
+    try:
+        prompts = [jnp.array([5, 9, 2, 7], jnp.int32),
+                   jnp.array([1, 3, 3, 8], jnp.int32)]
+        want = [np.asarray(generate(params, p[None], cfg, 12))[0].tolist()
+                for p in prompts]
+        got = [None] * 2
+
+        def ask(i):
+            got[i] = b.submit(prompts[i], 12)
+
+        ts = [threading.Thread(target=ask, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert sorted(map(tuple, got)) == sorted(map(tuple, want))
+        assert b._alloc.free_blocks == 2      # everything returned
+    finally:
+        b.close()
+
+
+def test_oversized_request_rejected_up_front(tiny):
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=1, max_len=64, kv_block=8,
+                 kv_pool_blocks=3)
+    try:
+        with pytest.raises(ValueError, match="never be admitted"):
+            b.submit(jnp.zeros((30,), jnp.int32), 20)
+    finally:
+        b.close()
+
+
+def test_paged_chunked_prefill_stream_exact(tiny):
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=2, max_len=64, kv_block=8,
+                 prefill_chunk=4)
+    try:
+        prompt = jax.random.randint(jax.random.key(9), (11,), 0,
+                                    cfg.vocab_size)
+        want = np.asarray(generate(params, prompt[None], cfg, 6))[0].tolist()
+        assert b.submit(prompt, 6) == want
+    finally:
+        b.close()
+
+
+def test_paged_refuses_prefix_cache(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="prefix"):
+        _Batcher(cfg, params, slots=1, max_len=32, kv_block=8,
+                 prefix_cache=2)
+
+
+def test_block_allocator_bookkeeping():
+    a = BlockAllocator(5)          # blocks 1..4 allocatable
+    assert a.free_blocks == 4
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.alloc(2) is None      # only 1 left
+    assert a.free_blocks == 1
+    a.free(got)
+    assert a.free_blocks == 4
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
