@@ -111,13 +111,9 @@ def slot_restore_kv(cache, slot, prefix_bufs, length):
     return out
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
-def slot_decode(params, tokens, cache, active, config):
-    """One decode step for every slot together. tokens [slots] (last token
-    per row; anything for inactive rows), active [slots] bool. Returns
-    (logits [slots, V], cache) — inactive rows write junk at their frozen
-    frontier (harmlessly overwritten by their next prefill) and do NOT
-    advance their length."""
+def _slot_decode_core(params, tokens, cache, active, config):
+    """Unjitted single-step body shared by slot_decode (one step per
+    host sync) and slot_decode_multi (a device-side scan of steps)."""
     c = _llama_view(config)
     pos = cache["lengths"]                                   # [slots]
     x = jnp.take(params["embed"], tokens[:, None], axis=0)   # [slots,1,D]
@@ -138,3 +134,46 @@ def slot_decode(params, tokens, cache, active, config):
     out = dict(zip(bufs, kv_out))
     out["lengths"] = pos + active.astype(jnp.int32)
     return logits[:, -1], out
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def slot_decode(params, tokens, cache, active, config):
+    """One decode step for every slot together. tokens [slots] (last token
+    per row; anything for inactive rows), active [slots] bool. Returns
+    (logits [slots, V], cache) — inactive rows write junk at their frozen
+    frontier (harmlessly overwritten by their next prefill) and do NOT
+    advance their length."""
+    return _slot_decode_core(params, tokens, cache, active, config)
+
+
+def make_decode_multi(core):
+    """Build a jitted `steps` greedy decode steps as ONE device-side
+    lax.scan over `core` (a _slot_decode_core-shaped body) — one dispatch
+    + one host fetch for the whole chunk instead of a sync per token (the
+    per-step argmax fetch dominates wall time through high-RTT links like
+    the axon tunnel, and is pure dispatch overhead on a real TPU VM).
+
+    remaining [slots]: per-row budget; a row stops advancing after its
+    budget (its tokens beyond that are junk the caller must discard).
+    Returns (tokens [steps, slots], cache)."""
+
+    @partial(jax.jit, static_argnames=("config", "steps"),
+             donate_argnums=(2,))
+    def decode_multi(params, tokens, cache, active, remaining, config,
+                     steps: int):
+        def body(carry, t):
+            toks, cache = carry
+            act = active & (t < remaining)
+            logits, cache = core(params, toks, cache, act, config)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks = jnp.where(act, nxt, toks)
+            return (toks, cache), nxt
+
+        (_, cache), out = jax.lax.scan(body, (tokens, cache),
+                                       jnp.arange(steps))
+        return out, cache
+
+    return decode_multi
+
+
+slot_decode_multi = make_decode_multi(_slot_decode_core)

@@ -36,6 +36,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .batching import make_decode_multi
 from .infer import _llama_view, _quantize_kv
 from .models.llama import apply_rope, rms_norm, rope_frequencies
 from .ops.quant import qmatmul
@@ -200,11 +201,8 @@ def paged_prefill(params, prompt, cache, slot, config,
     return logits[:, -1], out
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
-def paged_decode(params, tokens, cache, active, config):
-    """One decode step for every slot together over the shared pool.
-    tokens [slots], active [slots] bool. Inactive rows write to the
-    scratch block and do not advance."""
+def _paged_decode_core(params, tokens, cache, active, config):
+    """Unjitted single-step body (see batching._slot_decode_core)."""
     c = _llama_view(config)
     pos = cache["lengths"]
     x = jnp.take(params["embed"], tokens[:, None], axis=0)
@@ -227,6 +225,17 @@ def paged_decode(params, tokens, cache, active, config):
     out["pages"] = cache["pages"]
     out["lengths"] = pos + active.astype(jnp.int32)
     return logits[:, -1], out
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_decode(params, tokens, cache, active, config):
+    """One decode step for every slot together over the shared pool.
+    tokens [slots], active [slots] bool. Inactive rows write to the
+    scratch block and do not advance."""
+    return _paged_decode_core(params, tokens, cache, active, config)
+
+
+paged_decode_multi = make_decode_multi(_paged_decode_core)
 
 
 class BlockAllocator:

@@ -86,13 +86,20 @@ class _Batcher:
     def __init__(self, config, params, slots: int, max_len: int,
                  prefill_chunk: int = 0, prefix_cache: int = 0,
                  restarts: int = 3, kv_quant: bool = False,
-                 kv_block: int = 0, kv_pool_blocks: int = 0):
+                 kv_block: int = 0, kv_pool_blocks: int = 0,
+                 decode_chunk: int = 1):
         import collections
         import queue
 
         self.config = config
         self.params = params
         self.max_len = max_len
+        # > 1: when nothing is waiting to join, decode up to this many
+        # steps as ONE device-side scan per host sync — the per-step
+        # argmax fetch is pure dispatch/RTT overhead (VERDICT r2 weak
+        # #6); chunking amortizes it. Waiting work drops the loop back
+        # to single steps so admission latency stays one step.
+        self.decode_chunk = max(int(decode_chunk), 1)
         # int8 slot cache: half the decode-loop HBM reads (same numerics
         # as infer.py's kv_quant path — per-token-per-head scales)
         self.kv_quant = kv_quant
@@ -169,6 +176,13 @@ class _Batcher:
             return paged_decode
         from ..batching import slot_decode
         return slot_decode
+
+    def _fn_decode_multi(self):
+        if self._paged:
+            from ..paging import paged_decode_multi
+            return paged_decode_multi
+        from ..batching import slot_decode_multi
+        return slot_decode_multi
 
     def _release_slot(self, i: int) -> None:
         """Free a slot AND (paged) return its blocks to the pool."""
@@ -460,6 +474,7 @@ class _Batcher:
         import jax.numpy as jnp
 
         slot_decode = self._fn_decode()
+        decode_multi = self._fn_decode_multi()
         while not self._stop:
             self._admit()
             fed = self._prefill_tick()      # one prompt piece per tick
@@ -474,6 +489,35 @@ class _Batcher:
             toks = jnp.array(
                 [s["last"] if active[i] else 0
                  for i, s in enumerate(self.slots)], jnp.int32)
+            # chunked decode only when nothing is waiting to join (and no
+            # prefill mid-flight — implied by `not fed`, which scanned all
+            # slots) — otherwise single steps keep admission/interleave
+            # latency at one step. Stream tails also drop to single steps:
+            # every chunk step must advance at least the longest stream,
+            # or masked passes would burn device time past every budget.
+            rem_host = [s["max_new"] - len(s["stream"]) if active[i] else 0
+                        for i, s in enumerate(self.slots)]
+            idle = (self.decode_chunk > 1 and not fed
+                    and self._waiting is None and self.queue.empty()
+                    and max(rem_host) >= self.decode_chunk)
+            if idle:
+                remaining = jnp.array(rem_host, jnp.int32)
+                steps, self.cache = decode_multi(
+                    self.params, toks, self.cache, jnp.array(active),
+                    remaining, self.config, self.decode_chunk)
+                steps = jax.device_get(steps)           # [K, slots]
+                for i, s in enumerate(self.slots):
+                    if not active[i]:
+                        continue
+                    take = min(self.decode_chunk,
+                               s["max_new"] - len(s["stream"]))
+                    s["stream"].extend(int(t) for t in steps[:take, i])
+                    s["last"] = s["stream"][-1]
+                    if len(s["stream"]) >= s["max_new"]:
+                        s["out"] = s["stream"]
+                        s["done"].set()
+                        self._release_slot(i)
+                continue
             logits, self.cache = slot_decode(
                 self.params, toks, self.cache,
                 jnp.array(active), self.config)
@@ -691,6 +735,11 @@ def main(argv=None) -> int:
                    help="paged pool size in blocks (default: full "
                         "capacity, slots x ceil(max_len/block) + scratch; "
                         "shrink to cap KV HBM)")
+    p.add_argument("--decode-chunk", type=int, default=1,
+                   help="decode up to N steps per host sync as one "
+                        "device-side scan when no request is waiting to "
+                        "join (amortizes per-token dispatch/RTT; 1 = "
+                        "sync every step)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -748,7 +797,8 @@ def main(argv=None) -> int:
                                prefix_cache=args.prefix_cache,
                                kv_quant=args.kv_quant,
                                kv_block=args.kv_block,
-                               kv_pool_blocks=args.kv_pool)
+                               kv_pool_blocks=args.kv_pool,
+                               decode_chunk=args.decode_chunk)
         mode = (f"paged ({srv.batcher.kv_pool_blocks} x {args.kv_block} "
                 f"token blocks)" if args.kv_block else "dense")
         print(f"continuous batching: {args.batch_slots} slots x "

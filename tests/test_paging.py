@@ -182,3 +182,83 @@ def test_block_allocator_bookkeeping():
     assert a.free_blocks == 4
     with pytest.raises(ValueError):
         BlockAllocator(1)
+
+
+# ---- chunked decode (device-side multi-step scan) --------------------------
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_decode_chunk_streams_match_generate(tiny, paged):
+    """decode_chunk > 1 must not change any stream: K steps per host
+    sync, per-row budgets stop rows mid-chunk (K does not divide
+    max_new), late-joining requests still correct."""
+    cfg, params = tiny
+    kw = {"kv_block": 8} if paged else {}
+    b = _Batcher(cfg, params, slots=2, max_len=64, decode_chunk=5, **kw)
+    try:
+        prompts = [jnp.array([5, 9, 2, 7], jnp.int32),
+                   jnp.array([1, 3, 3, 8, 2], jnp.int32)]
+        new = [12, 7]          # neither divisible by the chunk of 5
+        want = [np.asarray(generate(params, p[None], cfg, n))[0].tolist()
+                for p, n in zip(prompts, new)]
+        got = [None, None]
+
+        def ask(i):
+            got[i] = b.submit(prompts[i], new[i])
+
+        ts = [threading.Thread(target=ask, args=(i,)) for i in range(2)]
+        ts[0].start()
+        import time
+        time.sleep(0.3)        # second request joins mid-stream
+        ts[1].start()
+        for t in ts:
+            t.join(timeout=120)
+        assert got == want
+    finally:
+        b.close()
+
+
+def test_decode_multi_primitive_matches_single_steps(tiny):
+    """slot_decode_multi == K sequential slot_decode calls exactly,
+    including a row whose budget ends mid-chunk."""
+    from gpu_docker_api_tpu.batching import (
+        init_slot_cache, slot_decode, slot_decode_multi, slot_prefill,
+    )
+
+    cfg, params = tiny
+    prompts = [jnp.array([[4, 8, 15]], jnp.int32),
+               jnp.array([[16, 23, 42]], jnp.int32)]
+    K = 6
+    remaining = jnp.array([K, 3], jnp.int32)    # row 1 stops after 3
+
+    def prefilled():
+        cache = init_slot_cache(cfg, slots=2, max_len=32)
+        lg0, cache = slot_prefill(params, prompts[0], cache,
+                                  jnp.int32(0), cfg)
+        lg1, cache = slot_prefill(params, prompts[1], cache,
+                                  jnp.int32(1), cfg)
+        toks = jnp.array([int(jnp.argmax(lg0[0])),
+                          int(jnp.argmax(lg1[0]))], jnp.int32)
+        return toks, cache
+
+    toks, cache = prefilled()
+    active = jnp.array([True, True])
+    multi, cache_m = slot_decode_multi(params, toks, cache, active,
+                                       remaining, cfg, K)
+    multi = np.asarray(multi)                   # [K, 2]
+
+    toks, cache = prefilled()
+    singles = []
+    for t in range(K):
+        act = np.array([t < int(remaining[0]), t < int(remaining[1])])
+        logits, cache = slot_decode(params, toks, cache,
+                                    jnp.array(act), cfg)
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        toks = jnp.where(jnp.array(act), nxt, toks)
+        singles.append(np.asarray(toks))
+    # rows within budget agree step by step
+    for t in range(K):
+        assert multi[t, 0] == singles[t][0]
+        if t < 3:
+            assert multi[t, 1] == singles[t][1]
+    # the stopped row's length froze at its budget
+    assert int(cache_m["lengths"][1]) == prompts[1].shape[1] + 3
