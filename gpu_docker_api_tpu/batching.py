@@ -13,8 +13,11 @@ attend loop, trip count = the furthest row), RoPE runs at per-row positions,
 and cache writes scatter at per-row offsets (vmapped dynamic_update_slice).
 Everything compiles ONCE: slot index, lengths, and the active mask are data.
 
-Greedy per-step decode (the batching server's mode); sampling requests fall
-back to the per-request scan path in serve.py.
+Per-step decode picks each row's token with ITS OWN sampling parameters
+(rowwise_pick: temperature 0 = greedy, else temperature/top-k/top-p as
+DATA vectors) — the batching server admits mixed greedy/sampling traffic
+in one compiled program, with a pure-argmax fast path when nothing
+samples.
 
 No reference counterpart (SURVEY §2 — the reference never opens a tensor);
 serving-side runtime the TPU build adds.
@@ -146,6 +149,41 @@ def slot_decode(params, tokens, cache, active, config):
     return _slot_decode_core(params, tokens, cache, active, config)
 
 
+def rowwise_pick(logits, temps, top_ks, top_ps, key):
+    """Per-ROW next-token selection: row i is greedy when temps[i] == 0,
+    else categorical over logits[i]/temps[i] filtered by ITS top_ks[i]
+    (0 = off) and top_ps[i]. All parameters are DATA ([slots] vectors) —
+    one compiled program serves every per-request sampling configuration
+    (the serving batcher admits mixed greedy/sampling traffic; a static
+    per-combination compile would explode the program cache).
+
+    Same filter semantics as infer._filter_top_k/_filter_top_p, done
+    per row via one descending sort: the k-th largest is the top-k
+    cutoff; the nucleus cutoff is the smallest sorted logit whose
+    cumulative probability (within the k-filtered set) stays inside
+    top_p, with the top token always surviving."""
+    v = logits.shape[-1]
+    temps = jnp.asarray(temps, jnp.float32)
+    lt = logits.astype(jnp.float32) / jnp.where(temps > 0, temps,
+                                                1.0)[:, None]
+    sl = jnp.sort(lt, axis=-1)[:, ::-1]                    # desc per row
+    k_eff = jnp.where(top_ks > 0, top_ks, v)
+    kth = jnp.take_along_axis(
+        sl, jnp.clip(k_eff - 1, 0, v - 1)[:, None], axis=-1)
+    ranks = jnp.arange(v)[None, :]
+    sl_k = jnp.where(ranks < k_eff[:, None], sl, -jnp.inf)
+    p_sorted = jax.nn.softmax(sl_k, axis=-1)
+    cum = jnp.cumsum(p_sorted, axis=-1)
+    inside = cum - p_sorted < top_ps[:, None]
+    cutoff = jnp.min(jnp.where(inside, sl_k, jnp.inf), axis=-1,
+                     keepdims=True)
+    keep = (lt >= kth) & (lt >= cutoff)
+    sampled = jax.random.categorical(
+        key, jnp.where(keep, lt, -jnp.inf))                # per-row indep.
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
 def make_decode_multi(core):
     """Build a jitted `steps` greedy decode steps as ONE device-side
     lax.scan over `core` (a _slot_decode_core-shaped body) — one dispatch
@@ -155,17 +193,24 @@ def make_decode_multi(core):
 
     remaining [slots]: per-row budget; a row stops advancing after its
     budget (its tokens beyond that are junk the caller must discard).
-    Returns (tokens [steps, slots], cache)."""
+    With `sample` (temps, top_ks, top_ps, key), rows pick their token via
+    rowwise_pick (temp 0 = greedy) with a per-step folded key; without
+    it, pure greedy. Returns (tokens [steps, slots], cache)."""
 
     @partial(jax.jit, static_argnames=("config", "steps"),
              donate_argnums=(2,))
     def decode_multi(params, tokens, cache, active, remaining, config,
-                     steps: int):
+                     steps: int, sample=None):
         def body(carry, t):
             toks, cache = carry
             act = active & (t < remaining)
             logits, cache = core(params, toks, cache, act, config)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if sample is None:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                temps, tks, tps, key = sample
+                nxt = rowwise_pick(logits, temps, tks, tps,
+                                   jax.random.fold_in(key, t))
             toks = jnp.where(act, nxt, toks)
             return (toks, cache), nxt
 
@@ -176,4 +221,20 @@ def make_decode_multi(core):
     return decode_multi
 
 
+def make_decode_pick(core):
+    """Single decode step that picks the next token ON DEVICE with
+    per-row sampling parameters (rowwise_pick) — the serving batcher's
+    step: mixed greedy/sampling traffic in one compiled program, one
+    [slots]-int fetch per sync instead of a [slots, V] logits fetch."""
+
+    @partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+    def decode_pick(params, tokens, cache, active, temps, top_ks, top_ps,
+                    key, config):
+        logits, cache = core(params, tokens, cache, active, config)
+        return rowwise_pick(logits, temps, top_ks, top_ps, key), cache
+
+    return decode_pick
+
+
 slot_decode_multi = make_decode_multi(_slot_decode_core)
+slot_decode_pick = make_decode_pick(_slot_decode_core)

@@ -36,7 +36,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .batching import make_decode_multi
+from .batching import make_decode_multi, make_decode_pick
 from .infer import _llama_view, _quantize_kv
 from .models.llama import apply_rope, rms_norm, rope_frequencies
 from .ops.quant import qmatmul
@@ -236,6 +236,7 @@ def paged_decode(params, tokens, cache, active, config):
 
 
 paged_decode_multi = make_decode_multi(_paged_decode_core)
+paged_decode_pick = make_decode_pick(_paged_decode_core)
 
 
 class BlockAllocator:

@@ -87,7 +87,7 @@ class _Batcher:
                  prefill_chunk: int = 0, prefix_cache: int = 0,
                  restarts: int = 3, kv_quant: bool = False,
                  kv_block: int = 0, kv_pool_blocks: int = 0,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1, seed: int | None = None):
         import collections
         import queue
 
@@ -100,6 +100,13 @@ class _Batcher:
         # #6); chunking amortizes it. Waiting work drops the loop back
         # to single steps so admission latency stays one step.
         self.decode_chunk = max(int(decode_chunk), 1)
+        # PRNG for per-request sampling rows (rowwise_pick: temp 0 rows
+        # stay exactly greedy); one base key folded by a step counter so
+        # every decode step / admission pick gets a fresh subkey. A fixed
+        # seed makes a batcher's sampled streams reproducible (tests).
+        self._seed = (seed if seed is not None
+                      else int.from_bytes(os.urandom(4), "big"))
+        self._step_counter = 0
         # int8 slot cache: half the decode-loop HBM reads (same numerics
         # as infer.py's kv_quant path — per-token-per-head scales)
         self.kv_quant = kv_quant
@@ -177,6 +184,13 @@ class _Batcher:
         from ..batching import slot_decode
         return slot_decode
 
+    def _fn_decode_pick(self):
+        if self._paged:
+            from ..paging import paged_decode_pick
+            return paged_decode_pick
+        from ..batching import slot_decode_pick
+        return slot_decode_pick
+
     def _fn_decode_multi(self):
         if self._paged:
             from ..paging import paged_decode_multi
@@ -191,10 +205,14 @@ class _Batcher:
             self._alloc.free(self._slot_blocks[i])
             self._slot_blocks[i] = None
 
-    def submit(self, prompt_row, max_new: int) -> list[int]:
-        """Blocking: returns the greedy stream for one sequence. Raises if
-        the scheduler thread has died or the batcher is closed — a request
-        must never hang on an event nobody will set."""
+    def submit(self, prompt_row, max_new: int, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> list[int]:
+        """Blocking: returns the stream for one sequence — greedy at
+        temperature 0, else per-request sampling (the row picks its token
+        via rowwise_pick inside the shared decode step; other rows'
+        streams are untouched). Raises if the scheduler thread has died
+        or the batcher is closed — a request must never hang on an event
+        nobody will set."""
         if self._stop or self._dead is not None:
             raise RuntimeError(
                 f"batcher unavailable: {self._dead or 'closed'}")
@@ -214,6 +232,8 @@ class _Batcher:
                     f"has {self.kv_pool_blocks - 1} — it could never be "
                     f"admitted")
         item = {"prompt": prompt_row, "max_new": int(max_new),
+                "temperature": float(temperature), "top_k": int(top_k),
+                "top_p": float(top_p),
                 "done": threading.Event(), "out": None, "error": None}
         self.queue.put(item)
         # re-check AFTER the put: _fail_all may have drained the queue
@@ -424,14 +444,43 @@ class _Batcher:
             self.config, append=not first)
         item["_last_logits"] = logits
 
+    def _sample_key(self):
+        import jax
+        self._step_counter += 1
+        return jax.random.fold_in(jax.random.key(self._seed),
+                                  self._step_counter)
+
+    def _sample_vectors(self):
+        """Per-slot sampling parameter vectors for the shared decode
+        step (idle/greedy rows: temp 0 = argmax)."""
+        import jax.numpy as jnp
+        temps, tks, tps = [], [], []
+        for s in self.slots:
+            temps.append(s["temperature"] if s else 0.0)
+            tks.append(s["top_k"] if s else 0)
+            tps.append(s["top_p"] if s else 1.0)
+        return (jnp.array(temps, jnp.float32), jnp.array(tks, jnp.int32),
+                jnp.array(tps, jnp.float32))
+
     def _arm_or_finish(self, i, item):
         """Prefill complete: first token comes off the last piece's
-        logits; one-token requests answer immediately."""
+        logits (greedy fast path, or the request's sampling params);
+        one-token requests answer immediately."""
         import jax
         import jax.numpy as jnp
 
         self._store_prefix(i, item)   # slot row holds the full prompt's KV
-        tok = int(jax.device_get(jnp.argmax(item.pop("_last_logits")[0])))
+        logits = item.pop("_last_logits")
+        if item["temperature"] == 0.0:
+            tok = int(jax.device_get(jnp.argmax(logits[0])))
+        else:
+            from ..batching import rowwise_pick
+            tok = int(jax.device_get(rowwise_pick(
+                logits,
+                jnp.array([item["temperature"]], jnp.float32),
+                jnp.array([item["top_k"]], jnp.int32),
+                jnp.array([item["top_p"]], jnp.float32),
+                self._sample_key())[0]))
         item["stream"] = [tok]
         item["last"] = tok
         if item["max_new"] <= 1:
@@ -474,6 +523,7 @@ class _Batcher:
         import jax.numpy as jnp
 
         slot_decode = self._fn_decode()
+        decode_pick = self._fn_decode_pick()
         decode_multi = self._fn_decode_multi()
         while not self._stop:
             self._admit()
@@ -500,11 +550,18 @@ class _Batcher:
             idle = (self.decode_chunk > 1 and not fed
                     and self._waiting is None and self.queue.empty()
                     and max(rem_host) >= self.decode_chunk)
+            # greedy fast path: no sampling row active -> the pure-argmax
+            # programs (no per-step full-vocab sort for traffic that
+            # doesn't need it)
+            sampling = any(s is not None and s["temperature"] > 0
+                           for s in self.slots)
             if idle:
                 remaining = jnp.array(rem_host, jnp.int32)
                 steps, self.cache = decode_multi(
                     self.params, toks, self.cache, jnp.array(active),
-                    remaining, self.config, self.decode_chunk)
+                    remaining, self.config, self.decode_chunk,
+                    sample=((*self._sample_vectors(), self._sample_key())
+                            if sampling else None))
                 steps = jax.device_get(steps)           # [K, slots]
                 for i, s in enumerate(self.slots):
                     if not active[i]:
@@ -518,10 +575,17 @@ class _Batcher:
                         s["done"].set()
                         self._release_slot(i)
                 continue
-            logits, self.cache = slot_decode(
-                self.params, toks, self.cache,
-                jnp.array(active), self.config)
-            nxt = jax.device_get(jnp.argmax(logits, axis=-1))
+            if sampling:
+                picked, self.cache = decode_pick(
+                    self.params, toks, self.cache, jnp.array(active),
+                    *self._sample_vectors(), self._sample_key(),
+                    self.config)
+                nxt = jax.device_get(picked)
+            else:
+                logits, self.cache = slot_decode(
+                    self.params, toks, self.cache,
+                    jnp.array(active), self.config)
+                nxt = jax.device_get(jnp.argmax(logits, axis=-1))
             for i, s in enumerate(self.slots):
                 if not active[i]:
                     continue
@@ -560,20 +624,24 @@ class _Server:
         lo, hi = jax.device_get((jnp.min(prompt), jnp.max(prompt)))
         if hi >= self.config.vocab_size or lo < 0:
             raise ValueError("token id out of range")
-        # continuous batching: greedy single-sequence requests join the
-        # running slot batch WITHOUT the single-flight lock — concurrency
-        # is the whole point; the batcher thread owns the cache
+        # continuous batching: single-sequence requests (greedy OR
+        # sampling — per-request temperature/top-k/top-p ride the shared
+        # decode step via rowwise_pick) join the running slot batch
+        # WITHOUT the single-flight lock — concurrency is the whole
+        # point; the batcher thread owns the cache
         if self.batcher is not None:
-            if float(temperature) == 0.0 and prompt.shape[0] == 1:
-                return [self.batcher.submit(prompt[0], int(max_new))]
-            # anything else would run generate() concurrently with the
-            # batcher's slot decode on the same chip — two full KV caches
-            # + programs live at once, an OOM on a chip where either mode
-            # alone fits. Refuse instead of racing the batcher for HBM.
+            if prompt.shape[0] == 1:
+                return [self.batcher.submit(
+                    prompt[0], int(max_new), temperature=float(temperature),
+                    top_k=int(top_k), top_p=float(top_p))]
+            # a multi-row request would run generate() concurrently with
+            # the batcher's slot decode on the same chip — two full KV
+            # caches + programs live at once, an OOM on a chip where
+            # either mode alone fits. Refuse instead of racing for HBM.
             raise ValueError(
-                "server runs in continuous-batching mode: send greedy "
-                "single-sequence requests (temperature 0, one row), or "
-                "start without --batch-slots for sampling/multi-row")
+                "server runs in continuous-batching mode: send "
+                "single-sequence requests (one row; greedy or sampling), "
+                "or start without --batch-slots for multi-row batches")
         with self.lock:
             # speculative path: single sequence + a draft loaded. Greedy
             # is exactly the target-only greedy stream; sampling keeps the
@@ -666,14 +734,18 @@ def _handler_for(srv: _Server, model_name: str):
                     raise ValueError("top_k must be >= 0")
                 if not 0.0 <= temperature <= 10.0:
                     raise ValueError("temperature must be in [0, 10]")
-                # sampling params are jit-STATIC: quantize ALL of them so a
-                # client sweeping float values can't force a fresh XLA
-                # compile per request (each held under the single-flight
-                # lock) or grow the program cache without bound — bounded
-                # buckets: 201 temperatures x 20 top_p x 129 top_k
-                temperature = round(temperature * 20) / 20
-                top_p = round(top_p * 20) / 20 or 0.05
-                top_k = min(top_k, 128)
+                # on the non-batcher path sampling params are jit-STATIC:
+                # quantize them so a client sweeping float values can't
+                # force a fresh XLA compile per request (each held under
+                # the single-flight lock) or grow the program cache
+                # without bound — bounded buckets: 201 temperatures x
+                # 20 top_p x 129 top_k. The batcher path takes them as
+                # DATA (rowwise_pick) with zero compile variety, so it
+                # serves exactly what the client asked.
+                if srv.batcher is None:
+                    temperature = round(temperature * 20) / 20
+                    top_p = round(top_p * 20) / 20 or 0.05
+                    top_k = min(top_k, 128)
                 out = srv.generate(tokens, max_new, temperature,
                                    top_k=top_k, top_p=top_p)
                 self._send(200, "Success", {"tokens": out})

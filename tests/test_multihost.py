@@ -23,6 +23,10 @@ import pytest
 
 from gpu_docker_api_tpu.distributed import cluster_spec_from_env
 
+# slow tier: long-compile / multi-process e2e — quick CI runs
+# -m 'not slow' (<3 min); the full suite stays the default
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER_SCRIPT = r"""
@@ -42,10 +46,6 @@ assert jax.local_device_count() == 4
 from gpu_docker_api_tpu.models.llama import LlamaConfig
 from gpu_docker_api_tpu.parallel.mesh import MeshPlan
 from gpu_docker_api_tpu.train import Trainer
-
-# slow tier: long-compile / multi-process e2e — quick CI runs
-# -m 'not slow' (<3 min); the full suite stays the default
-pytestmark = pytest.mark.slow
 
 cfg = LlamaConfig.tiny()
 trainer = Trainer.create(cfg, MeshPlan.auto(8, tp=2))

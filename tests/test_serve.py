@@ -270,9 +270,10 @@ def test_batcher_restart_budget_exhausts(monkeypatch):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
 
 
-def test_server_rejects_sampling_when_batching():
-    """With --batch-slots active, a sampling or multi-row request must be
-    refused instead of racing the batcher for HBM (ADVICE r2 low)."""
+def test_server_batching_accepts_sampling_rejects_multirow():
+    """With --batch-slots active, single-row requests (greedy OR
+    sampling) ride the batcher; only multi-row batches are refused
+    (they would race the batcher for HBM — ADVICE r2 low)."""
     from gpu_docker_api_tpu.workloads.serve import _Batcher
 
     cfg = LlamaConfig.tiny()
@@ -281,13 +282,95 @@ def test_server_rejects_sampling_when_batching():
     srv.batcher = _Batcher(cfg, params, slots=1, max_len=32)
     try:
         with pytest.raises(ValueError, match="continuous-batching"):
-            srv.generate([[1, 2, 3]], 4, temperature=0.8)
-        with pytest.raises(ValueError, match="continuous-batching"):
             srv.generate([[1, 2, 3], [4, 5, 6]], 4, temperature=0.0)
         out = srv.generate([[1, 2, 3]], 4, temperature=0.0)
         assert len(out) == 1 and len(out[0]) == 4
+        out = srv.generate([[1, 2, 3]], 4, temperature=0.9, top_k=8)
+        assert len(out) == 1 and len(out[0]) == 4
+        assert all(0 <= t < cfg.vocab_size for t in out[0])
     finally:
         srv.batcher.close()
+
+
+def test_batcher_sampling_row_does_not_perturb_greedy():
+    """A sampling request decoding alongside a greedy one must leave the
+    greedy stream EXACTLY its solo stream (per-row pick isolation)."""
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=2, max_len=64, seed=7)
+    try:
+        gp = jnp.array([5, 9, 2, 7], jnp.int32)
+        want = np.asarray(generate(params, gp[None], cfg, 10))[0].tolist()
+        out = [None, None]
+
+        def greedy():
+            out[0] = b.submit(gp, 10)
+
+        def sampled():
+            out[1] = b.submit(jnp.array([1, 3, 3, 8], jnp.int32), 10,
+                              temperature=1.0, top_k=16)
+
+        ts = [threading.Thread(target=greedy),
+              threading.Thread(target=sampled)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert out[0] == want
+        assert len(out[1]) == 10
+        assert all(0 <= t < cfg.vocab_size for t in out[1])
+    finally:
+        b.close()
+
+
+def test_batcher_sampling_deterministic_per_seed():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.array([5, 9, 2, 7], jnp.int32)
+
+    def run(seed):
+        b = _Batcher(cfg, params, slots=1, max_len=32, seed=seed)
+        try:
+            return b.submit(prompt, 12, temperature=1.5)
+        finally:
+            b.close()
+
+    a, b2, c = run(11), run(11), run(12)
+    assert a == b2                      # same seed, same stream
+    # different seed: 12 high-temperature tokens colliding across two
+    # independent key chains is ~impossible — a real equality here means
+    # the seed is being ignored
+    assert a != c
+
+
+def test_rowwise_pick_semantics():
+    from gpu_docker_api_tpu.batching import rowwise_pick
+
+    key = jax.random.key(0)
+    logits = jax.random.normal(jax.random.key(1), (3, 32)) * 3.0
+    temps = jnp.array([0.0, 1.0, 1.0], jnp.float32)
+    # row 0 greedy; row 1 top_k=1 == greedy at ANY temperature; row 2
+    # top_k=4 must land inside its top-4 set
+    tks = jnp.array([0, 1, 4], jnp.int32)
+    tps = jnp.array([1.0, 1.0, 1.0], jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    for i in range(20):
+        out = np.asarray(rowwise_pick(logits, temps, tks, tps,
+                                      jax.random.fold_in(key, i)))
+        assert out[0] == greedy[0]
+        assert out[1] == greedy[1]
+        top4 = set(np.asarray(jax.lax.top_k(logits[2], 4)[1]).tolist())
+        assert int(out[2]) in top4
+    # top_p tiny -> only the argmax survives the nucleus
+    tps = jnp.array([1.0, 1.0, 1e-6], jnp.float32)
+    tks = jnp.array([0, 0, 0], jnp.int32)
+    out = np.asarray(rowwise_pick(logits, temps, tks, tps, key))
+    assert out[2] == greedy[2]
 
 
 def test_prefill_tick_round_robin_is_fair():
