@@ -240,24 +240,42 @@ paged_decode_pick = make_decode_pick(_paged_decode_core)
 
 
 class BlockAllocator:
-    """Host-side free-list over the pool's blocks (block 0 = scratch,
-    never handed out). The batcher's admission control: a request is
-    admitted only when its full reservation fits."""
+    """Host-side REFCOUNTED free-list over the pool's blocks (block 0 =
+    scratch, never handed out). The batcher's admission control: a
+    request is admitted only when its full reservation fits. Refcounts
+    enable zero-copy prefix sharing — a cached prompt prefix's blocks
+    appear in many page tables at once and return to the free list only
+    when the last reference drops."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
             raise ValueError("pool needs >= 2 blocks (block 0 is scratch)")
         self._free = list(range(n_blocks - 1, 0, -1))   # pop() -> low ids
+        self._rc = [0] * n_blocks
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int):
-        """n blocks or None (caller keeps the request queued)."""
+        """n fresh blocks (rc 1 each) or None (caller keeps queueing)."""
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._rc[b] = 1
+        return out
+
+    def share(self, blocks) -> None:
+        """One more reference to already-live blocks (prefix reuse)."""
+        for b in blocks:
+            assert self._rc[b] > 0, f"sharing dead block {b}"
+            self._rc[b] += 1
 
     def free(self, blocks) -> None:
-        self._free.extend(blocks)
+        """Drop one reference each; blocks return at refcount zero."""
+        for b in blocks:
+            self._rc[b] -= 1
+            assert self._rc[b] >= 0, f"double free of block {b}"
+            if self._rc[b] == 0:
+                self._free.append(b)

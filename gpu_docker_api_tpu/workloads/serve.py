@@ -117,10 +117,6 @@ class _Batcher:
         self._paged = kv_block > 0
         self.kv_block = kv_block
         if self._paged:
-            if prefix_cache:
-                raise ValueError(
-                    "--prefix-cache needs the dense slot cache; paged KV "
-                    "(--kv-block) does not support prefix reuse yet")
             self._max_pages = -(-max_len // kv_block)
             self.kv_pool_blocks = (kv_pool_blocks
                                    or 1 + slots * self._max_pages)
@@ -145,6 +141,7 @@ class _Batcher:
         self.queue: "queue.Queue" = queue.Queue()
         self.slots: list = [None] * slots
         self._waiting = None      # paged: head-of-line item short on blocks
+        self._sample_vec = None   # per-slot sampling vectors (cached)
         self._make_cache()
         self._stop = False
         self._dead: Exception | None = None   # loop crash / close reason
@@ -201,6 +198,7 @@ class _Batcher:
     def _release_slot(self, i: int) -> None:
         """Free a slot AND (paged) return its blocks to the pool."""
         self.slots[i] = None
+        self._sample_vec = None
         if self._paged and self._slot_blocks[i]:
             self._alloc.free(self._slot_blocks[i])
             self._slot_blocks[i] = None
@@ -220,6 +218,14 @@ class _Batcher:
             # chunked admission would park an empty chunks list forever;
             # the plain path would crash the scheduler — reject up front
             raise ValueError("empty prompt")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            # top_p <= 0 would empty the nucleus and silently degrade to
+            # a stream of token 0 — fail loudly instead
+            raise ValueError("top_p must be in (0, 1]")
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0")
         if prompt_row.shape[0] + max_new > self.max_len:
             raise ValueError(
                 f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "
@@ -339,26 +345,42 @@ class _Batcher:
             if item is None:
                 return
             if self._paged:
-                needed = -(-(item["prompt"].shape[0] + item["max_new"])
-                           // self.kv_block)
-                blocks = self._alloc.alloc(needed)
+                prompt_len = item["prompt"].shape[0]
+                # ZERO-COPY prefix reuse: a cached prompt prefix's FULL
+                # blocks go straight into this slot's page table (rc++).
+                # Writes can never touch them — the first private
+                # position starts the first private block — so no copy
+                # and no copy-on-write are ever needed.
+                shared, shared_tok = self._paged_prefix_lookup(item)
+                total = -(-(prompt_len + item["max_new"]) // self.kv_block)
+                blocks = self._alloc.alloc(total - len(shared))
                 if blocks is None:
                     # not enough pool: park and retry when slots finish
                     self._waiting = item
                     return
-                self._slot_blocks[i] = blocks
+                if shared:
+                    self._alloc.share(shared)
+                    self.prefix_hits += 1
+                    item["_restored"] = True
+                row_blocks = shared + blocks
+                self._slot_blocks[i] = row_blocks
                 row = [0] * self._max_pages
-                row[:needed] = blocks
+                row[:len(row_blocks)] = row_blocks
                 self.cache["pages"] = self.cache["pages"].at[i].set(
                     jnp.array(row, jnp.int32))
+                if shared_tok:
+                    self.cache["lengths"] = self.cache["lengths"].at[
+                        i].set(shared_tok)
             try:
-                rem = self._restore_prefix(i, item)
+                rem = (item["prompt"][shared_tok:] if self._paged
+                       else self._restore_prefix(i, item))
                 if self.prefill_chunk > 0:
                     c = self.prefill_chunk
                     item["chunks"] = [rem[j:j + c]
                                       for j in range(0, rem.shape[0], c)]
                     item["stream"] = None        # not decodable yet
                     self.slots[i] = item
+                    self._sample_vec = None
                 else:
                     self._prefill_piece(i, item, rem,
                                         first=not item.get("_restored"))
@@ -373,20 +395,14 @@ class _Batcher:
 
     # ---- prefix cache (system-prompt KV reuse) ----
 
-    def _restore_prefix(self, i, item):
-        """Longest stored prompt prefix -> restore its KV into the slot and
-        return only the tokens still needing prefill (always >= 1, so the
-        last position's logits come from a real forward)."""
-        prompt = item["prompt"]
-        if not self.prefix_cache:
-            return prompt
+    def _lcp_lookup(self, item):
+        """(best stored key, usable token count) for the item's prompt —
+        usable is capped at len-1 so the last position's logits always
+        come from a real forward. Caches the host prompt tuple on the
+        item (ONE device-to-host transfer)."""
         import jax
-        import jax.numpy as jnp
-
-        from ..batching import slot_restore_kv
-        # ONE device-to-host transfer; per-token int() would sync per
-        # element inside the loop that owns every decode stream
-        key = tuple(jax.device_get(prompt).tolist())
+        key = item.get("_key") or tuple(
+            jax.device_get(item["prompt"]).tolist())
         item["_key"] = key
         best_key, best_use = None, 0
         for pk in self._prefixes:
@@ -398,6 +414,18 @@ class _Batcher:
             usable = min(lcp, len(key) - 1)
             if usable > best_use:
                 best_key, best_use = pk, usable
+        return best_key, best_use
+
+    def _restore_prefix(self, i, item):
+        """Dense mode: longest stored prompt prefix -> COPY its KV into
+        the slot row, return only the tokens still needing prefill."""
+        prompt = item["prompt"]
+        if not self.prefix_cache:
+            return prompt
+        import jax.numpy as jnp
+
+        from ..batching import slot_restore_kv
+        best_key, best_use = self._lcp_lookup(item)
         if best_key is None or best_use < 8:     # not worth a restore
             return prompt
         entry = self._prefixes[best_key]
@@ -408,24 +436,55 @@ class _Batcher:
         item["_restored"] = True
         return prompt[best_use:]
 
+    def _paged_prefix_lookup(self, item):
+        """Paged mode: (shared block list, shared token count) — the
+        stored prefix's FULL blocks whose tokens prefix this prompt.
+        No data moves; the caller puts the block ids straight into the
+        slot's page table and rc++ them."""
+        if not (self.prefix_cache and self._prefixes):
+            return [], 0
+        best_key, best_use = self._lcp_lookup(item)
+        if best_key is None:
+            return [], 0
+        entry = self._prefixes[best_key]
+        n_blk = min(best_use // self.kv_block, len(entry["blocks"]))
+        if n_blk < 1:
+            return [], 0
+        self._prefixes.move_to_end(best_key)
+        return entry["blocks"][:n_blk], n_blk * self.kv_block
+
     def _store_prefix(self, i, item) -> None:
         """After a full prefill, keep the prompt's KV for future requests
-        sharing the prefix (bucketed to 64 so the extract jit variety
-        stays small; LRU-bounded)."""
+        sharing the prefix (LRU-bounded). Dense mode copies the rows out
+        (bucketed to 64 so the extract jit variety stays small); paged
+        mode just rc++'s the prompt's FULL blocks — zero copy (those
+        blocks are never written again: decode writes start at
+        prompt_len, inside the first private block)."""
         if not self.prefix_cache:
             return
         import jax
         import jax.numpy as jnp
 
-        from ..batching import slot_extract_kv
         key = item.get("_key") or tuple(
             jax.device_get(item["prompt"]).tolist())
+        if key in self._prefixes:
+            self._prefixes.move_to_end(key)
+            return
+        if self._paged:
+            n_store = len(key) // self.kv_block
+            if n_store < 1:
+                return
+            blocks = self._slot_blocks[i][:n_store]
+            self._alloc.share(blocks)            # survive the slot release
+            self._prefixes[key] = {"blocks": blocks}
+            while len(self._prefixes) > self.prefix_cache:
+                _, ev = self._prefixes.popitem(last=False)
+                self._alloc.free(ev["blocks"])
+            return
+        from ..batching import slot_extract_kv
         if len(key) < 8:
             # below the restore threshold: an entry this short can never
             # be restored — storing it would only evict useful prefixes
-            return
-        if key in self._prefixes:
-            self._prefixes.move_to_end(key)
             return
         # ceil-to-64 never exceeds max_len here: submit() enforces
         # len + max_new <= max_len with max_new >= 1
@@ -452,15 +511,20 @@ class _Batcher:
 
     def _sample_vectors(self):
         """Per-slot sampling parameter vectors for the shared decode
-        step (idle/greedy rows: temp 0 = argmax)."""
-        import jax.numpy as jnp
-        temps, tks, tps = [], [], []
-        for s in self.slots:
-            temps.append(s["temperature"] if s else 0.0)
-            tks.append(s["top_k"] if s else 0)
-            tps.append(s["top_p"] if s else 1.0)
-        return (jnp.array(temps, jnp.float32), jnp.array(tks, jnp.int32),
-                jnp.array(tps, jnp.float32))
+        step (idle/greedy rows: temp 0 = argmax). Cached — they change
+        only on admit/release, not per token, so the per-step loop pays
+        zero host->device transfers for them."""
+        if self._sample_vec is None:
+            import jax.numpy as jnp
+            temps, tks, tps = [], [], []
+            for s in self.slots:
+                temps.append(s["temperature"] if s else 0.0)
+                tks.append(s["top_k"] if s else 0)
+                tps.append(s["top_p"] if s else 1.0)
+            self._sample_vec = (jnp.array(temps, jnp.float32),
+                                jnp.array(tks, jnp.int32),
+                                jnp.array(tps, jnp.float32))
+        return self._sample_vec
 
     def _arm_or_finish(self, i, item):
         """Prefill complete: first token comes off the last piece's
@@ -489,6 +553,7 @@ class _Batcher:
             self._release_slot(i)     # also frees (paged) blocks
         else:
             self.slots[i] = item
+            self._sample_vec = None
 
     def _prefill_tick(self) -> bool:
         """Feed ONE pending prompt piece (chunked mode). True if fed.
@@ -550,11 +615,12 @@ class _Batcher:
             idle = (self.decode_chunk > 1 and not fed
                     and self._waiting is None and self.queue.empty()
                     and max(rem_host) >= self.decode_chunk)
-            # greedy fast path: no sampling row active -> the pure-argmax
-            # programs (no per-step full-vocab sort for traffic that
-            # doesn't need it)
-            sampling = any(s is not None and s["temperature"] > 0
-                           for s in self.slots)
+            # greedy fast path: no sampling row DECODING -> the
+            # pure-argmax programs (no per-step full-vocab sort for
+            # traffic that doesn't need it; a sampler still mid-prefill
+            # has stream=None and must not tax the running greedy rows)
+            sampling = any(s is not None and s.get("stream") is not None
+                           and s["temperature"] > 0 for s in self.slots)
             if idle:
                 remaining = jnp.array(rem_host, jnp.int32)
                 steps, self.cache = decode_multi(
@@ -796,8 +862,9 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-cache", type=int, default=0,
                    help="keep the KV of the last N distinct prompts; a "
                         "request extending a cached prompt prefills only "
-                        "the suffix (system-prompt reuse; 0 = off; dense "
-                        "slot cache only)")
+                        "the suffix (system-prompt reuse; 0 = off). With "
+                        "paged KV (--kv-block) the reuse is ZERO-COPY: "
+                        "shared blocks enter the new request's page table")
     p.add_argument("--kv-block", type=int, default=0,
                    help="PAGED slot cache: block size in tokens — slots "
                         "share a block pool instead of dense slots x "

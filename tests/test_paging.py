@@ -164,11 +164,69 @@ def test_paged_chunked_prefill_stream_exact(tiny):
         b.close()
 
 
-def test_paged_refuses_prefix_cache(tiny):
+def test_paged_prefix_sharing_zero_copy(tiny):
+    """Zero-copy prefix reuse: a second request extending a cached
+    prompt points its page table at the SHARED blocks (no new blocks
+    for the prefix, no data copy) and its stream still exactly equals
+    the solo greedy stream."""
     cfg, params = tiny
-    with pytest.raises(ValueError, match="prefix"):
-        _Batcher(cfg, params, slots=1, max_len=32, kv_block=8,
-                 prefix_cache=2)
+    blk = 4
+    b = _Batcher(cfg, params, slots=2, max_len=64, kv_block=blk,
+                 kv_pool_blocks=24, prefix_cache=4)
+    try:
+        sys_prompt = [5, 9, 2, 7, 11, 3, 1, 4]          # 2 full blocks
+        p1 = jnp.array(sys_prompt + [8, 6], jnp.int32)
+        p2 = jnp.array(sys_prompt + [2, 13, 10], jnp.int32)
+        want1 = np.asarray(generate(params, p1[None], cfg, 6))[0].tolist()
+        want2 = np.asarray(generate(params, p2[None], cfg, 6))[0].tolist()
+
+        assert b.submit(p1, 6) == want1
+        free_after_1 = b._alloc.free_blocks
+        assert b.prefix_hits == 0
+        # second request shares the 2-block prefix: allocates blocks for
+        # ceil((11+6)/4)=5 pages MINUS the 2 shared -> 3 new
+        assert b.submit(p2, 6) == want2
+        assert b.prefix_hits == 1
+        # everything private returned; the 2 stored blocks stay live
+        assert b._alloc.free_blocks == free_after_1
+    finally:
+        b.close()
+
+
+def test_paged_prefix_eviction_returns_blocks(tiny):
+    """LRU eviction of a stored prefix drops its block references —
+    the pool never leaks."""
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=1, max_len=32, kv_block=4,
+                 kv_pool_blocks=16, prefix_cache=1)
+    try:
+        total = b._alloc.free_blocks
+        for seed in range(3):                  # distinct prompts
+            p = jax.random.randint(jax.random.key(seed), (8,), 0,
+                                   cfg.vocab_size)
+            b.submit(p, 4)
+        # exactly ONE stored prefix (2 blocks) outstanding
+        assert b._alloc.free_blocks == total - 2
+        assert len(b._prefixes) == 1
+    finally:
+        b.close()
+
+
+def test_paged_prefix_composes_with_kv_quant(tiny):
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=1, max_len=64, kv_block=4,
+                 prefix_cache=2, kv_quant=True)
+    try:
+        sys_prompt = [5, 9, 2, 7, 11, 3, 1, 4]
+        p1 = jnp.array(sys_prompt + [8], jnp.int32)
+        p2 = jnp.array(sys_prompt + [2, 13], jnp.int32)
+        want2 = np.asarray(generate(params, p2[None], cfg, 6,
+                                    kv_quant=True))[0].tolist()
+        b.submit(p1, 4)
+        assert b.submit(p2, 6) == want2
+        assert b.prefix_hits == 1
+    finally:
+        b.close()
 
 
 def test_block_allocator_bookkeeping():

@@ -568,3 +568,21 @@ def test_prefix_cache_lru_eviction():
         assert len(b._prefixes) == 2              # LRU-bounded
     finally:
         b.close()
+
+
+def test_batcher_submit_validates_sampling_params():
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    b = _Batcher(cfg, params, slots=1, max_len=32)
+    try:
+        with pytest.raises(ValueError, match="top_p"):
+            b.submit(jnp.zeros((4,), jnp.int32), 4, temperature=1.0,
+                     top_p=0.0)
+        with pytest.raises(ValueError, match="temperature"):
+            b.submit(jnp.zeros((4,), jnp.int32), 4, temperature=-1.0)
+        with pytest.raises(ValueError, match="top_k"):
+            b.submit(jnp.zeros((4,), jnp.int32), 4, top_k=-3)
+    finally:
+        b.close()
