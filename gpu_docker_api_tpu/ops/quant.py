@@ -156,5 +156,48 @@ def quantize_params(params: dict, mode: str = "w8") -> dict:
     return out
 
 
+def quantize_params_streaming(params_host: dict, mode: str = "w8",
+                              device=None) -> dict:
+    """quantize_params for models whose BF16 weights don't fit the chip:
+    `params_host` lives on the HOST (CPU arrays); each leaf is quantized
+    on host and transferred individually, so device HBM only ever holds
+    the int8 tree plus one leaf in flight — llama3-8B (16GB bf16) serves
+    from a 16GB v5e as ~8GB int8 this way, where the all-on-device
+    quantize path OOMs before it can even start."""
+    if mode not in MODES:
+        raise ValueError(f"mode {mode!r} not in {MODES}")
+    device = device or jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+
+    def put(x):
+        # build on HOST explicitly: a bare jnp.asarray would commit the
+        # numpy leaf to the DEFAULT device (the chip) and quantize there
+        # — shipping the bf16 bytes we exist to avoid and spiking HBM
+        # with per-leaf f32 intermediates
+        with jax.default_device(cpu):
+            arr = jnp.asarray(x)
+        return jax.device_put(arr, device)
+
+    def put_q(w, m):
+        with jax.default_device(cpu):
+            qt = quantize(jnp.asarray(w), m)         # host math
+        return QTensor(q=jax.device_put(qt.q, device),
+                       s=jax.device_put(qt.s, device), mode=m)
+
+    layers = {}
+    for k, w in params_host["layers"].items():
+        if k in QUANT_KEYS:
+            layers[k] = put_q(w, mode)
+        elif k in MOE_EXPERT_KEYS:
+            layers[k] = put_q(w, "w8")
+        else:
+            layers[k] = put(w)
+    out = {k: put(v) for k, v in params_host.items()
+           if k not in ("layers", "lm_head")}
+    out["layers"] = layers
+    out["lm_head"] = put_q(params_host["lm_head"], mode)
+    return out
+
+
 def is_quantized(params: dict) -> bool:
     return isinstance(params.get("lm_head"), QTensor)

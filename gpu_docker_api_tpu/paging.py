@@ -269,13 +269,18 @@ class BlockAllocator:
     def share(self, blocks) -> None:
         """One more reference to already-live blocks (prefix reuse)."""
         for b in blocks:
-            assert self._rc[b] > 0, f"sharing dead block {b}"
+            if self._rc[b] <= 0:     # real raise: python -O strips asserts
+                raise RuntimeError(f"sharing dead block {b}")
             self._rc[b] += 1
 
     def free(self, blocks) -> None:
         """Drop one reference each; blocks return at refcount zero."""
         for b in blocks:
+            if self._rc[b] <= 0:
+                # a double free would re-list a block a stored prefix
+                # still references -> cross-request KV corruption; fail
+                # loudly even under python -O
+                raise RuntimeError(f"double free of block {b}")
             self._rc[b] -= 1
-            assert self._rc[b] >= 0, f"double free of block {b}"
             if self._rc[b] == 0:
                 self._free.append(b)

@@ -218,8 +218,11 @@ class _Batcher:
             # chunked admission would park an empty chunks list forever;
             # the plain path would crash the scheduler — reject up front
             raise ValueError("empty prompt")
-        if temperature < 0:
-            raise ValueError("temperature must be >= 0")
+        import math
+        if not (math.isfinite(temperature) and temperature >= 0):
+            # NaN slips through a bare `< 0` check (json accepts the NaN
+            # literal) and would silently stream garbage
+            raise ValueError("temperature must be finite and >= 0")
         if not 0.0 < top_p <= 1.0:
             # top_p <= 0 would empty the nucleus and silently degrade to
             # a stream of token 0 — fail loudly instead
@@ -352,14 +355,30 @@ class _Batcher:
                 # position starts the first private block — so no copy
                 # and no copy-on-write are ever needed.
                 shared, shared_tok = self._paged_prefix_lookup(item)
+                if shared:
+                    # take OUR reference first: any eviction below (even
+                    # of the entry we share from) then can't return these
+                    # blocks to the free list under us
+                    self._alloc.share(shared)
                 total = -(-(prompt_len + item["max_new"]) // self.kv_block)
                 blocks = self._alloc.alloc(total - len(shared))
+                # pool pressure: stored prefixes are a CACHE, not a
+                # reservation — evict LRU entries until the request fits
+                # (their blocks free once nothing else references them).
+                # Without this a parked request could deadlock behind
+                # pinned prefixes that only admissions would ever evict.
+                while blocks is None and self._prefixes:
+                    _, ev = self._prefixes.popitem(last=False)
+                    self._alloc.free(ev["blocks"])
+                    blocks = self._alloc.alloc(total - len(shared))
                 if blocks is None:
+                    if shared:
+                        self._alloc.free(shared)    # release our claim
+                    item.pop("_key", None)
                     # not enough pool: park and retry when slots finish
                     self._waiting = item
                     return
                 if shared:
-                    self._alloc.share(shared)
                     self.prefix_hits += 1
                     item["_restored"] = True
                 row_blocks = shared + blocks
@@ -834,6 +853,12 @@ def main(argv=None) -> int:
                    help="int8 post-load quantization of the matmul weights "
                         "(ops/quant.py): w8 = weight-only (HBM-bound "
                         "decode), w8a8 = +dynamic activation int8 (MXU)")
+    p.add_argument("--host-load", action="store_true",
+                   help="load/init the model on HOST memory and stream "
+                        "per-leaf int8 quantization to the chip — serves "
+                        "models whose bf16 weights exceed HBM (llama3_8b "
+                        "= 16GB bf16 -> ~8GB int8 on a 16GB v5e); "
+                        "requires --quantize")
     p.add_argument("--kv-quant", action="store_true",
                    help="int8 KV cache: half the decode-loop HBM traffic "
                         "(per-token-per-head scales, dequantized in the "
@@ -897,17 +922,49 @@ def main(argv=None) -> int:
         p.error(str(e))
 
     import jax
-    trainer = Trainer.create(config, MeshPlan(), devices=jax.devices()[:1])
-    params = _maybe_ungroup(_load_params(trainer, args.checkpoint), config)
-    if args.quantize:
-        from ..ops.quant import quantize_params
-        # donate the dense tree: without it the bf16 params AND the int8
-        # copy are live together and the llama3_8b-on-16GB case this flag
-        # exists for OOMs during startup
-        params = jax.jit(lambda p: quantize_params(p, args.quantize),
-                         donate_argnums=0)(params)
-        print(f"quantized matmul weights to int8 ({args.quantize})",
-              flush=True)
+    if args.host_load:
+        if not args.quantize:
+            raise SystemExit("--host-load exists to serve models whose "
+                             "bf16 weights exceed HBM; it requires "
+                             "--quantize w8|w8a8")
+        from ..models import family_for
+        from ..ops.quant import quantize_params_streaming
+        # the bf16 tree never touches the chip: init/restore on HOST
+        # (raw orbax restore lands on host; fresh init runs on the cpu
+        # backend — params only, no throwaway optimizer state), then
+        # stream per-leaf int8 to the device — HBM holds the int8 tree
+        # plus one leaf in flight
+        if args.checkpoint:
+            from ..train import restore_checkpoint
+            state, step = restore_checkpoint(os.path.abspath(
+                args.checkpoint))
+            print(f"restored checkpoint step {step} (host)", flush=True)
+            host = state["params"]
+        else:
+            with jax.default_device(jax.devices("cpu")[0]):
+                # jit the init: XLA:CPU parallelizes the 8B random init
+                # that eager mode would grind through single-threaded
+                host = jax.jit(lambda k: family_for(config).init_params(
+                    config, k))(jax.random.key(0))
+        host = _maybe_ungroup(host, config)
+        params = quantize_params_streaming(host, args.quantize,
+                                           device=jax.devices()[0])
+        del host
+        print(f"host-loaded + streamed int8 ({args.quantize}) to "
+              f"{jax.devices()[0].device_kind}", flush=True)
+    else:
+        trainer = Trainer.create(config, MeshPlan(),
+                                 devices=jax.devices()[:1])
+        params = _maybe_ungroup(_load_params(trainer, args.checkpoint),
+                                config)
+        if args.quantize:
+            from ..ops.quant import quantize_params
+            # donate the dense tree so the bf16 params and the int8 copy
+            # are not both fully live during the convert
+            params = jax.jit(lambda p: quantize_params(p, args.quantize),
+                             donate_argnums=0)(params)
+            print(f"quantized matmul weights to int8 ({args.quantize})",
+                  flush=True)
     draft = None
     if args.draft_config:
         dcfg = named_config(args.family, args.draft_config)

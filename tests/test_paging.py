@@ -320,3 +320,77 @@ def test_decode_multi_primitive_matches_single_steps(tiny):
             assert multi[t, 1] == singles[t][1]
     # the stopped row's length froze at its budget
     assert int(cache_m["lengths"][1]) == prompts[1].shape[1] + 3
+
+
+@pytest.mark.slow
+def test_batcher_stress_mixed_traffic(tiny):
+    """Robustness hammer: 12 concurrent requests (greedy + sampled,
+    varied lengths) through a small paged pool with chunked prefill,
+    prefix cache, and chunked decode all on — every greedy stream must
+    equal its solo oracle, every sampled stream must be well-formed,
+    and the pool must account to zero leaks afterwards."""
+    import random
+
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=3, max_len=64, kv_block=8,
+                 kv_pool_blocks=12, prefill_chunk=4, prefix_cache=2,
+                 decode_chunk=4, seed=3)
+    try:
+        rng = random.Random(0)
+        sys_prompt = [5, 9, 2, 7, 11, 3, 1, 4]
+        jobs = []
+        for i in range(12):
+            body = [rng.randrange(cfg.vocab_size) for _ in
+                    range(rng.randrange(1, 6))]
+            prompt = jnp.array(sys_prompt + body, jnp.int32)
+            temp = 0.0 if i % 3 else 0.9
+            jobs.append((prompt, rng.randrange(3, 9), temp))
+        oracles = {}
+        for i, (p, n, temp) in enumerate(jobs):
+            if temp == 0.0:
+                oracles[i] = np.asarray(
+                    generate(params, p[None], cfg, n))[0].tolist()
+        got = [None] * len(jobs)
+
+        def ask(i):
+            p, n, temp = jobs[i]
+            got[i] = b.submit(p, n, temperature=temp, top_k=12)
+
+        ts = [threading.Thread(target=ask, args=(i,)) for i in
+              range(len(jobs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        for i, (p, n, temp) in enumerate(jobs):
+            assert got[i] is not None and len(got[i]) == n, (i, got[i])
+            if i in oracles:
+                assert got[i] == oracles[i], f"greedy stream {i} diverged"
+            assert all(0 <= t < cfg.vocab_size for t in got[i])
+        # zero block leaks: only stored prefixes may stay live (stored
+        # entries can SHARE blocks — a longer prompt stored after reusing
+        # a shorter stored prefix aliases its blocks — so count uniques)
+        live = (b.kv_pool_blocks - 1) - b._alloc.free_blocks
+        stored = {blk for e in b._prefixes.values() for blk in e["blocks"]}
+        assert live == len(stored)
+    finally:
+        b.close()
+
+
+def test_pool_pressure_evicts_stored_prefixes(tiny):
+    """Stored prefixes are a cache, not a reservation: a request that
+    needs their blocks evicts LRU entries instead of deadlocking behind
+    them (pool sized so free blocks alone can't fit the request)."""
+    cfg, params = tiny
+    b = _Batcher(cfg, params, slots=1, max_len=64, kv_block=4,
+                 kv_pool_blocks=8, prefix_cache=4)
+    try:
+        # store a prefix pinning 2 of the 7 usable blocks
+        b.submit(jnp.array([5, 9, 2, 7, 11, 3, 1, 4], jnp.int32), 4)
+        assert len(b._prefixes) == 1
+        # needs ceil((9+16)/4)=7 blocks > 5 free -> must evict the store
+        p = jax.random.randint(jax.random.key(1), (9,), 0, cfg.vocab_size)
+        want = np.asarray(generate(params, p[None], cfg, 16))[0].tolist()
+        assert b.submit(p, 16) == want
+    finally:
+        b.close()

@@ -219,3 +219,22 @@ def test_qeinsum_rejects_unsupported_scale_layouts():
     # output not led by the bank's expert axis
     with pytest.raises(ValueError, match="scale layout"):
         qeinsum("ecd,edf->cef", a, bank)
+
+
+def test_quantize_params_streaming_matches_on_device():
+    """Host-side per-leaf streaming quantization (the llama3_8b-on-16GB
+    serving path) produces the same numerics as the all-on-device
+    quantize: identical greedy streams."""
+    from gpu_docker_api_tpu.ops.quant import quantize_params_streaming
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.array([[5, 9, 2, 7]], jnp.int32)
+    want = np.asarray(generate(
+        jax.jit(lambda p: quantize_params(p, "w8"))(params),
+        prompt, cfg, 8))[0].tolist()
+    host = jax.tree.map(np.asarray, params)        # "host-loaded" tree
+    qs = quantize_params_streaming(host, "w8")
+    assert is_quantized(qs)
+    got = np.asarray(generate(qs, prompt, cfg, 8))[0].tolist()
+    assert got == want
