@@ -626,14 +626,16 @@ class _Batcher:
             # chunked decode only when nothing is waiting to join (and no
             # prefill mid-flight — implied by `not fed`, which scanned all
             # slots) — otherwise single steps keep admission/interleave
-            # latency at one step. Stream tails also drop to single steps:
-            # every chunk step must advance at least the longest stream,
-            # or masked passes would burn device time past every budget.
-            rem_host = [s["max_new"] - len(s["stream"]) if active[i] else 0
-                        for i, s in enumerate(self.slots)]
-            idle = (self.decode_chunk > 1 and not fed
-                    and self._waiting is None and self.queue.empty()
-                    and max(rem_host) >= self.decode_chunk)
+            # latency at one step. The chunk size stays FIXED so exactly
+            # one extra program exists: stream tails run masked passes
+            # (bounded waste: < chunk steps per stream END, a few percent
+            # of a long stream). The alternatives both measured worse on
+            # chip: dropping to single steps pays a host sync per tail
+            # token (the whole wall through a high-RTT link), and a
+            # power-of-two chunk ladder pays one XLA compile per rung.
+            chunk = self.decode_chunk
+            idle = (chunk > 1 and not fed
+                    and self._waiting is None and self.queue.empty())
             # greedy fast path: no sampling row DECODING -> the
             # pure-argmax programs (no per-step full-vocab sort for
             # traffic that doesn't need it; a sampler still mid-prefill
@@ -641,18 +643,19 @@ class _Batcher:
             sampling = any(s is not None and s.get("stream") is not None
                            and s["temperature"] > 0 for s in self.slots)
             if idle:
-                remaining = jnp.array(rem_host, jnp.int32)
+                remaining = jnp.array(
+                    [s["max_new"] - len(s["stream"]) if active[i] else 0
+                     for i, s in enumerate(self.slots)], jnp.int32)
                 steps, self.cache = decode_multi(
                     self.params, toks, self.cache, jnp.array(active),
-                    remaining, self.config, self.decode_chunk,
+                    remaining, self.config, chunk,
                     sample=((*self._sample_vectors(), self._sample_key())
                             if sampling else None))
-                steps = jax.device_get(steps)           # [K, slots]
+                steps = jax.device_get(steps)           # [chunk, slots]
                 for i, s in enumerate(self.slots):
                     if not active[i]:
                         continue
-                    take = min(self.decode_chunk,
-                               s["max_new"] - len(s["stream"]))
+                    take = min(chunk, s["max_new"] - len(s["stream"]))
                     s["stream"].extend(int(t) for t in steps[:take, i])
                     s["last"] = s["stream"][-1]
                     if len(s["stream"]) >= s["max_new"]:

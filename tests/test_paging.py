@@ -44,6 +44,7 @@ def _pages_for(alloc, blk, n_tokens, max_pages):
     return jnp.array(row), blocks
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("quantized", [False, True])
 def test_paged_stream_matches_generate(tiny, quantized):
     """The paged cache reproduces infer.generate's greedy stream exactly
@@ -64,6 +65,7 @@ def test_paged_stream_matches_generate(tiny, quantized):
     assert toks == want
 
 
+@pytest.mark.slow
 def test_pool_memory_is_independent_of_slots_times_max_len(tiny):
     """THE point: cache memory ∝ pool blocks, not slots x max_len. A
     16-slot, 128-token-max batcher with a 9-block pool holds 9x8 = 72
@@ -85,6 +87,7 @@ def test_pool_memory_is_independent_of_slots_times_max_len(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_paged_batcher_streams_match_dense(tiny):
     """Concurrent streams through the PAGED batcher equal their solo
     greedy streams (the dense batcher's equality contract, unchanged)."""
@@ -110,6 +113,7 @@ def test_paged_batcher_streams_match_dense(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_admission_waits_for_free_blocks(tiny):
     """A pool too small for two concurrent requests serializes them:
     the second waits for the first's blocks, then completes correctly —
@@ -151,6 +155,7 @@ def test_oversized_request_rejected_up_front(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_paged_chunked_prefill_stream_exact(tiny):
     cfg, params = tiny
     b = _Batcher(cfg, params, slots=2, max_len=64, kv_block=8,
@@ -164,6 +169,7 @@ def test_paged_chunked_prefill_stream_exact(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_paged_prefix_sharing_zero_copy(tiny):
     """Zero-copy prefix reuse: a second request extending a cached
     prompt points its page table at the SHARED blocks (no new blocks
@@ -193,6 +199,7 @@ def test_paged_prefix_sharing_zero_copy(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_paged_prefix_eviction_returns_blocks(tiny):
     """LRU eviction of a stored prefix drops its block references —
     the pool never leaks."""
@@ -212,6 +219,7 @@ def test_paged_prefix_eviction_returns_blocks(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_paged_prefix_composes_with_kv_quant(tiny):
     cfg, params = tiny
     b = _Batcher(cfg, params, slots=1, max_len=64, kv_block=4,
@@ -244,6 +252,7 @@ def test_block_allocator_bookkeeping():
 
 # ---- chunked decode (device-side multi-step scan) --------------------------
 
+@pytest.mark.slow
 @pytest.mark.parametrize("paged", [False, True])
 def test_decode_chunk_streams_match_generate(tiny, paged):
     """decode_chunk > 1 must not change any stream: K steps per host
@@ -275,6 +284,7 @@ def test_decode_chunk_streams_match_generate(tiny, paged):
         b.close()
 
 
+@pytest.mark.slow
 def test_decode_multi_primitive_matches_single_steps(tiny):
     """slot_decode_multi == K sequential slot_decode calls exactly,
     including a row whose budget ends mid-chunk."""
@@ -377,6 +387,7 @@ def test_batcher_stress_mixed_traffic(tiny):
         b.close()
 
 
+@pytest.mark.slow
 def test_pool_pressure_evicts_stored_prefixes(tiny):
     """Stored prefixes are a cache, not a reservation: a request that
     needs their blocks evicts LRU entries instead of deadlocking behind

@@ -221,6 +221,7 @@ def test_qeinsum_rejects_unsupported_scale_layouts():
         qeinsum("ecd,edf->cef", a, bank)
 
 
+@pytest.mark.slow
 def test_quantize_params_streaming_matches_on_device():
     """Host-side per-leaf streaming quantization (the llama3_8b-on-16GB
     serving path) produces the same numerics as the all-on-device

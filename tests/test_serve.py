@@ -270,6 +270,7 @@ def test_batcher_restart_budget_exhausts(monkeypatch):
         b.submit(jnp.zeros((4,), jnp.int32), 4)
 
 
+@pytest.mark.slow
 def test_server_batching_accepts_sampling_rejects_multirow():
     """With --batch-slots active, single-row requests (greedy OR
     sampling) ride the batcher; only multi-row batches are refused
@@ -292,6 +293,7 @@ def test_server_batching_accepts_sampling_rejects_multirow():
         srv.batcher.close()
 
 
+@pytest.mark.slow
 def test_batcher_sampling_row_does_not_perturb_greedy():
     """A sampling request decoding alongside a greedy one must leave the
     greedy stream EXACTLY its solo stream (per-row pick isolation)."""
@@ -326,6 +328,7 @@ def test_batcher_sampling_row_does_not_perturb_greedy():
         b.close()
 
 
+@pytest.mark.slow
 def test_batcher_sampling_deterministic_per_seed():
     from gpu_docker_api_tpu.workloads.serve import _Batcher
 
