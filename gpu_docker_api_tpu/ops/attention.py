@@ -609,6 +609,112 @@ def merge_attention_partials(outs, lses):
     return (num / den_q).astype(outs[0].dtype)
 
 
+def _pair_lse_banded(q, k_cur, v_cur, offset: int, window: int):
+    """(out, lse) of q against ONE K/V chunk sitting `offset` positions
+    behind it in global order (0 = the diagonal chunk). Causal +
+    sliding-window mask at global positions; out is softmax-normalized
+    within the pair, lse [b,h,q] merges it with other chunks' partials.
+    Pure-einsum body (f32) — differentiable; the pallas kernel covers
+    diagonals, offset bands use this (the kernel has no offset-window
+    mode). Shared by the windowed ring (parallel/ring.py) and the
+    long-sequence chunked flash below."""
+    b, s_loc, h, d = q.shape
+    group = h // k_cur.shape[2]
+    kf = jnp.repeat(k_cur, group, axis=2).astype(jnp.float32)
+    vf = jnp.repeat(v_cur, group, axis=2).astype(jnp.float32)
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    r = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    delta = r - c + offset               # row_global - col_global
+    keep = (delta >= 0) & (delta < window)
+    s = jnp.where(keep[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                              # [b,h,q]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)                              # [b,h,q]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vf) / jnp.maximum(
+        l, 1e-30).transpose(0, 2, 1)[..., None]
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    return out.astype(q.dtype), lse
+
+
+# ---- long-sequence chunked flash -------------------------------------------
+
+# single-call flash is VMEM-bounded — the kernels stream full-S rows
+# (fwd: the K/V operands; bwd: q/o/do + the lane-replicated lse
+# residuals), which blows the ~16MB scoped-vmem stack. Measured v5e
+# ceilings: grad works at 4096 and compile-OOMs at 8192; the
+# lse-carrying bwd variant OOMs already at 4096 (0.6MB over), so the
+# decomposition below uses 2048 chunks. The forward alone streams only
+# K/V (bf16) and is safe well past that — 8192 is measured, kept as the
+# conservative single-call bound. Past the ceiling, attention()
+# decomposes into chunk-pair kernel calls merged by online softmax
+# (blockwise_attention); non-decomposable lengths fall back to XLA
+# rather than take a known compile OOM.
+FLASH_SINGLE_MAX_FWD = int(os.environ.get("TDAPI_FLASH_SINGLE_FWD", "8192"))
+FLASH_SINGLE_MAX_GRAD = int(os.environ.get("TDAPI_FLASH_SINGLE_GRAD", "4096"))
+FLASH_CHUNK_SEQ = int(os.environ.get("TDAPI_FLASH_CHUNK_SEQ", "2048"))
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, window: int = 0,
+                        chunk: int = 0,
+                        interpret: bool = False) -> jax.Array:
+    """Flash attention for sequences too LONG for one kernel call: the
+    sequence splits into chunks; each (q-chunk, kv-chunk) pair runs the
+    flash kernel (diagonal pairs causal/windowed, past pairs full), and
+    the per-pair (out, lse) partials merge with the online softmax
+    (merge_attention_partials) — the same decomposition ring attention
+    uses ACROSS devices, applied within one device. Every kernel call
+    (forward and backward) sees chunk-sized tensors, so VMEM stays
+    bounded at any S; differentiable end-to-end (flash_attention_lse
+    carries grads through both outputs).
+
+    window > 0: diagonal chunks run the windowed kernel; chunks wholly
+    INSIDE the window run the plain flash pair; only the partially
+    masked boundary chunk needs the banded einsum pair (the kernel has
+    no offset-window mode); chunks wholly outside are SKIPPED —
+    O(S·window) compute, same as the single-call windowed kernel."""
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
+    b, s, h, d = q.shape
+    chunk = chunk or FLASH_CHUNK_SEQ
+    if s <= chunk:
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               interpret=interpret)
+    if s % chunk:
+        raise ValueError(f"seq {s} not divisible by chunk {chunk}")
+    n = s // chunk
+    out_chunks = []
+    for i in range(n):
+        qi = q[:, i * chunk:(i + 1) * chunk]
+        outs, lses = [], []
+        for j in range(i + 1 if causal else n):
+            offset = (i - j) * chunk
+            if window and offset >= window + chunk - 1:
+                continue                      # wholly outside the window
+            kj = k[:, j * chunk:(j + 1) * chunk]
+            vj = v[:, j * chunk:(j + 1) * chunk]
+            if causal and j == i:
+                o, l = flash_attention_lse(qi, kj, vj, causal=True,
+                                           window=window,
+                                           interpret=interpret)
+            elif window and offset > window - chunk:
+                # partially masked boundary chunk: offset band, einsum
+                o, l = _pair_lse_banded(qi, kj, vj, offset, window)
+            else:
+                # past chunk wholly inside the window (or no window, or
+                # non-causal): full pair through the kernel
+                o, l = flash_attention_lse(qi, kj, vj, causal=False,
+                                           interpret=interpret)
+            outs.append(o)
+            lses.append(l)
+        out_chunks.append(merge_attention_partials(outs, lses))
+    return jnp.concatenate(out_chunks, axis=1)
+
+
 # ---- dispatcher ------------------------------------------------------------
 
 def _on_tpu() -> bool:
@@ -644,7 +750,15 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return reference_attention(q, k, v, causal=causal, window=window)
     if impl not in ("auto", "auto_grad"):
         raise ValueError(f"impl {impl!r}: flash|xla|auto|auto_grad")
-    if auto_impl_for(q.shape[1], q.shape[3],
-                     grad=impl == "auto_grad") == "flash":
-        return flash_attention(q, k, v, causal=causal, window=window)
+    s = q.shape[1]
+    grad = impl == "auto_grad"
+    if auto_impl_for(s, q.shape[3], grad=grad) == "flash":
+        ceiling = FLASH_SINGLE_MAX_GRAD if grad else FLASH_SINGLE_MAX_FWD
+        if s <= ceiling:
+            return flash_attention(q, k, v, causal=causal, window=window)
+        if s % FLASH_CHUNK_SEQ == 0:
+            # past the single-call VMEM ceiling: chunk-pair decomposition
+            return blockwise_attention(q, k, v, causal=causal,
+                                       window=window)
+        # non-decomposable long length: XLA beats a known compile OOM
     return reference_attention(q, k, v, causal=causal, window=window)

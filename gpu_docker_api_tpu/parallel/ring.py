@@ -23,7 +23,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import attention as _local_attention
-from ..ops.attention import DEFAULT_BLOCK, _on_tpu, flash_attention_lse
+from ..ops.attention import (
+    DEFAULT_BLOCK, _on_tpu, _pair_lse_banded, flash_attention_lse,
+)
 
 
 def _use_flash(impl: str, s_loc: int, d: int) -> bool:
@@ -170,35 +172,6 @@ def _merge_partial(num, den, m, o, lse):
     num = num * aq + o.astype(jnp.float32) * wq
     den = den * alpha + w
     return num, den, m_new
-
-
-def _pair_lse_banded(q, k_cur, v_cur, offset: int, window: int):
-    """(out, lse) of q against ONE K/V shard sitting `offset` positions
-    behind it in global order (offset = hop * s_loc; 0 = the diagonal
-    shard). Causal + sliding-window mask at global positions; out is
-    softmax-normalized within the pair, lse [b,h,q] merges it with the
-    other shards' partials. Pure-einsum body (f32) — differentiable; the
-    pallas kernel covers the diagonal, bands use this."""
-    b, s_loc, h, d = q.shape
-    group = h // k_cur.shape[2]
-    kf = jnp.repeat(k_cur, group, axis=2).astype(jnp.float32)
-    vf = jnp.repeat(v_cur, group, axis=2).astype(jnp.float32)
-    qf = q.astype(jnp.float32) / math.sqrt(d)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
-    r = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
-    c = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
-    delta = r - c + offset               # row_global - col_global
-    keep = (delta >= 0) & (delta < window)
-    s = jnp.where(keep[None, None], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                              # [b,h,q]
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
-    l = jnp.sum(p, axis=-1)                              # [b,h,q]
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vf) / jnp.maximum(
-        l, 1e-30).transpose(0, 2, 1)[..., None]
-    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)),
-                    -jnp.inf)
-    return out.astype(q.dtype), lse
 
 
 def _ring_local_windowed(q: jax.Array, k: jax.Array, v: jax.Array, *,
