@@ -315,6 +315,22 @@ def mfu_bench() -> dict:
                                 K=4, **kw)
         except Exception as e:  # OOM/tunnel hiccup must not kill headline
             out[key] = {"error": f"{type(e).__name__}: {e}"}
+    # long-context single-chip: S=16384 full-causal — runs through the
+    # chunk-pair flash decomposition (blockwise_attention; a single
+    # kernel call at this length compile-OOMs VMEM), proving 16k-token
+    # training on one chip every round
+    import dataclasses
+    for key, extra in (("long16k", {}),
+                       # windowed variant: exercises the banded boundary
+                       # pair + window-skip of the decomposition
+                       ("long16k_w1024", {"sliding_window": 1024})):
+        try:
+            lcfg = dataclasses.replace(LlamaConfig.llama_250m(),
+                                       max_seq_len=16384, **extra)
+            out[key] = _mfu_one(f"llama_250m_s16k{'_w' if extra else ''}",
+                                lcfg, batch=1, seq=16384, K=2)
+        except Exception as e:  # noqa: BLE001
+            out[key] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
 
