@@ -90,9 +90,9 @@ class LlamaConfig:
         """~1.1B-param config: the largest dense trainer that fits one
         v5e chip's 16GB HBM (params bf16 + AdamW f32 moments + "dots"
         remat activations at accum_steps=4). The serious single-chip MFU
-        datapoint: 50.0% MFU measured on v5e at B=8, S=2048 (round-3
-        chip scan; 250m reaches 39.5%, its d_model=1024 matmuls underfeed
-        the 128x128 MXU)."""
+        datapoint: 54.7% MFU measured on v5e at B=8, S=2048 (round-3,
+        corrected attention-FLOP accounting; 250m reaches ~44%, its
+        d_model=1024 matmuls underfeed the 128x128 MXU)."""
         return cls(vocab_size=32000, d_model=2048, n_layers=20, n_heads=16,
                    n_kv_heads=8, d_ff=5632, max_seq_len=4096)
 

@@ -589,3 +589,71 @@ def test_batcher_submit_validates_sampling_params():
             b.submit(jnp.zeros((4,), jnp.int32), 4, top_k=-3)
     finally:
         b.close()
+
+
+@pytest.mark.slow
+def test_host_load_serving_over_http():
+    """--host-load --quantize w8: the model inits on HOST and streams
+    int8 to the device, then serves normally — the llama3-8B-on-16GB
+    path, exercised end-to-end at tiny scale."""
+    import re
+    import subprocess
+    import sys
+    import time
+    import urllib.error
+    import urllib.request
+
+    # --port 0 lets serve pick a free port itself (no bind race); it
+    # prints the bound address on startup
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpu_docker_api_tpu.workloads.serve",
+         "--family", "llama", "--config", "tiny", "--quantize", "w8",
+         "--host-load", "--host", "127.0.0.1", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline and port is None:
+            line = proc.stdout.readline()
+            assert line or proc.poll() is None, "server died before binding"
+            m = re.search(r"serving .* on 127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+        assert port is not None, "never saw the bound address"
+        out = None
+        last_err = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate",
+                    data=json.dumps({"tokens": [[5, 9, 2, 7]],
+                                     "max_new": 6}).encode(),
+                    headers={"Content-Type": "application/json"})
+                out = json.loads(urllib.request.urlopen(
+                    req, timeout=30).read())
+                break
+            except urllib.error.HTTPError as e:
+                # the server answered: a 4xx/5xx is a real failure, not
+                # a not-ready state — surface it instead of spinning
+                raise AssertionError(
+                    f"/generate failed: {e.code} "
+                    f"{e.read().decode(errors='replace')[:500]}")
+            except Exception as e:           # not up yet
+                last_err = e
+                time.sleep(1)
+        assert out is not None and out["code"] == 200, (out, last_err)
+        # matches the in-process streamed-quantized oracle exactly
+        from gpu_docker_api_tpu.infer import generate
+        from gpu_docker_api_tpu.ops.quant import (
+            quantize_params_streaming,
+        )
+        cfg = LlamaConfig.tiny()
+        qs = quantize_params_streaming(
+            jax.tree.map(np.asarray, init_params(cfg, jax.random.key(0))),
+            "w8")
+        want = np.asarray(generate(
+            qs, jnp.array([[5, 9, 2, 7]], jnp.int32), cfg, 6))[0].tolist()
+        assert out["data"]["tokens"][0] == want
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
