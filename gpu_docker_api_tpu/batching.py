@@ -149,37 +149,44 @@ def slot_decode(params, tokens, cache, active, config):
     return _slot_decode_core(params, tokens, cache, active, config)
 
 
+def _rowwise_filter(lt, top_ks, top_ps):
+    """Per-row top-k/top-p filtering of temperature-scaled logits lt
+    [..., V]; top_ks/top_ps broadcast over the leading dims ([slots] for
+    one position per row, [slots, 1] for a [slots, T, V] block). Filtered
+    entries go to -inf; the top token always survives.
+
+    Same filter semantics as infer._filter_top_k/_filter_top_p, done
+    per row via one descending sort: the k-th largest is the top-k
+    cutoff; the nucleus cutoff is the smallest sorted logit whose
+    cumulative probability (within the k-filtered set) stays inside
+    top_p."""
+    v = lt.shape[-1]
+    sl = jnp.sort(lt, axis=-1)[..., ::-1]                  # desc per row
+    k_eff = jnp.where(top_ks > 0, top_ks, v)
+    kth = jnp.take_along_axis(
+        sl, jnp.clip(k_eff - 1, 0, v - 1)[..., None], axis=-1)
+    ranks = jnp.arange(v)
+    sl_k = jnp.where(ranks < k_eff[..., None], sl, -jnp.inf)
+    p_sorted = jax.nn.softmax(sl_k, axis=-1)
+    cum = jnp.cumsum(p_sorted, axis=-1)
+    inside = cum - p_sorted < top_ps[..., None]
+    cutoff = jnp.min(jnp.where(inside, sl_k, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where((lt >= kth) & (lt >= cutoff), lt, -jnp.inf)
+
+
 def rowwise_pick(logits, temps, top_ks, top_ps, key):
     """Per-ROW next-token selection: row i is greedy when temps[i] == 0,
     else categorical over logits[i]/temps[i] filtered by ITS top_ks[i]
     (0 = off) and top_ps[i]. All parameters are DATA ([slots] vectors) —
     one compiled program serves every per-request sampling configuration
     (the serving batcher admits mixed greedy/sampling traffic; a static
-    per-combination compile would explode the program cache).
-
-    Same filter semantics as infer._filter_top_k/_filter_top_p, done
-    per row via one descending sort: the k-th largest is the top-k
-    cutoff; the nucleus cutoff is the smallest sorted logit whose
-    cumulative probability (within the k-filtered set) stays inside
-    top_p, with the top token always surviving."""
-    v = logits.shape[-1]
+    per-combination compile would explode the program cache)."""
     temps = jnp.asarray(temps, jnp.float32)
     lt = logits.astype(jnp.float32) / jnp.where(temps > 0, temps,
                                                 1.0)[:, None]
-    sl = jnp.sort(lt, axis=-1)[:, ::-1]                    # desc per row
-    k_eff = jnp.where(top_ks > 0, top_ks, v)
-    kth = jnp.take_along_axis(
-        sl, jnp.clip(k_eff - 1, 0, v - 1)[:, None], axis=-1)
-    ranks = jnp.arange(v)[None, :]
-    sl_k = jnp.where(ranks < k_eff[:, None], sl, -jnp.inf)
-    p_sorted = jax.nn.softmax(sl_k, axis=-1)
-    cum = jnp.cumsum(p_sorted, axis=-1)
-    inside = cum - p_sorted < top_ps[:, None]
-    cutoff = jnp.min(jnp.where(inside, sl_k, jnp.inf), axis=-1,
-                     keepdims=True)
-    keep = (lt >= kth) & (lt >= cutoff)
     sampled = jax.random.categorical(
-        key, jnp.where(keep, lt, -jnp.inf))                # per-row indep.
+        key, _rowwise_filter(lt, top_ks, top_ps))          # per-row indep.
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
@@ -238,3 +245,163 @@ def make_decode_pick(core):
 
 slot_decode_multi = make_decode_multi(_slot_decode_core)
 slot_decode_pick = make_decode_pick(_slot_decode_core)
+
+
+# ---- speculative decoding inside the slot batch ----------------------------
+#
+# The standalone speculative path (infer.speculative_generate) is B=1; the
+# batcher runs it PER SLOT on the shared step: a draft model (its own slot
+# cache) proposes gamma tokens for every active row, the target verifies all
+# rows' gamma+1 positions in ONE multi-token forward (decode is weight-HBM-
+# bound: the verify forward reads the weights once for the whole batch), and
+# acceptance/rollback is per row — greedy rows emit exactly the target-only
+# greedy stream; sampling rows keep exact target statistics via per-row
+# rejection sampling (same math as infer.speculative_generate, vectorized
+# with the sampling parameters as data).
+
+def _slot_verify_core(params, blocks, cache, active, config):
+    """Multi-token forward at each row's OWN frontier: blocks [slots, T]
+    append T tokens per row starting at that row's length (per-row RoPE
+    positions, per-row causal mask inside the block — _attend_cached
+    handles [slots, T] query rows over a lengths vector). Active rows
+    advance T; inactive rows write junk at their frozen frontier and do
+    not advance (overwritten by their next prefill/append, exactly like
+    _slot_decode_core's junk writes). Returns (logits [slots, T, V] f32,
+    cache) — the speculative VERIFY step."""
+    c = _llama_view(config)
+    pos = cache["lengths"]                                  # [slots]
+    slots, t = blocks.shape
+    x = jnp.take(params["embed"], blocks, axis=0)           # [slots,T,D]
+    rows = pos[:, None] + jnp.arange(t)                     # [slots, T]
+    cos, sin = rope_frequencies(c, rows.reshape(-1))
+    cos = cos.reshape(slots, t, -1)
+    sin = sin.reshape(slots, t, -1)
+    bufs = _buf_keys(cache)
+
+    def body(x, scanned):
+        layer, *kv = scanned
+        x, *kv = _layer_step(x, layer, *kv[:2], pos, config, cos, sin,
+                             *kv[2:], active=active)
+        return x, tuple(kv)
+
+    x, kv_out = jax.lax.scan(
+        body, x, (params["layers"],) + tuple(cache[kk] for kk in bufs))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    out = dict(zip(bufs, kv_out))
+    out["lengths"] = pos + t * active.astype(jnp.int32)
+    return logits, out
+
+
+slot_verify = jax.jit(_slot_verify_core,
+                      static_argnames=("config",), donate_argnums=(2,))
+
+
+@partial(jax.jit, static_argnames=("config", "gamma"), donate_argnums=(2,))
+def slot_spec_draft(params, tokens, cache, active, config, gamma: int,
+                    sample=None):
+    """The draft model proposes `gamma` tokens per active row,
+    autoregressively over its own slot cache. Greedy rows take argmax;
+    with `sample` (temps, top_ks, top_ps, key), sampling rows draw from
+    the draft's FILTERED distribution q — whose log-probs are returned
+    for the acceptance test (rejection sampling is exact for whatever
+    (p, q) pair it tests, so the filters must be baked into q exactly as
+    the target bakes them into p). Returns (drafts [slots, gamma], dlogp
+    [gamma, slots, V] or per-step zeros when greedy, cache)."""
+    keys = (jax.random.split(sample[3], gamma) if sample is not None
+            else jnp.zeros((gamma,), jnp.uint32))
+
+    def body(carry, k):
+        toks, cache = carry
+        logits, cache = _slot_decode_core(params, toks, cache, active,
+                                          config)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sample is None:
+            nxt, lp = greedy, jnp.zeros((), jnp.float32)
+        else:
+            temps, tks, tps, _ = sample
+            lt = logits.astype(jnp.float32) / jnp.where(
+                temps > 0, temps, 1.0)[:, None]
+            lp = jax.nn.log_softmax(_rowwise_filter(lt, tks, tps), axis=-1)
+            nxt = jnp.where(temps > 0,
+                            jax.random.categorical(k, lp).astype(jnp.int32),
+                            greedy)
+        toks = jnp.where(active, nxt, toks)
+        return (toks, cache), (nxt, lp)
+
+    (_, cache), (drafts, dlogp) = jax.lax.scan(body, (tokens, cache), keys)
+    return jnp.swapaxes(drafts, 0, 1), dlogp, cache
+
+
+@jax.jit
+def spec_accept_greedy(tlogits, drafts):
+    """Greedy acceptance for every row: keep the longest proposal prefix
+    matching the target's argmax, then the target's token at the first
+    divergence — the emitted stream is EXACTLY the target-only greedy
+    stream for any draft. tlogits [slots, g+1, V], drafts [slots, g].
+    Returns (a [slots] accepted counts, emit [slots, g+1] — positions
+    >= a[i]+1 in row i are padding the caller discards)."""
+    s, g1, _ = tlogits.shape
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [slots,g+1]
+    ok = drafts == greedy[:, :-1]
+    a = jnp.argmin(jnp.concatenate([ok, jnp.zeros((s, 1), bool)], axis=1),
+                   axis=1)                                   # [slots]
+    new_tok = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+    emit = jnp.where(jnp.arange(g1)[None, :] < a[:, None],
+                     jnp.concatenate([drafts, jnp.zeros((s, 1), jnp.int32)],
+                                     axis=1),
+                     new_tok[:, None])
+    return a, emit
+
+
+@jax.jit
+def rowwise_spec_accept(tlogits, drafts, dlogp, temps, top_ks, top_ps, key):
+    """Mixed-traffic acceptance: greedy rows (temps 0) use the exact-
+    prefix rule; sampling rows run per-row rejection sampling — token j
+    accepted with prob min(1, p_j(x_j)/q_j(x_j)) against the draft's
+    dlogp, first rejection resampled from norm(max(0, p - q)), bonus
+    token from p when all gamma accepted. The marginal output
+    distribution per row is exactly the target-only one (same math as
+    infer.speculative_generate, with per-row sampling params as data).
+    dlogp [gamma, slots, V] (slot_spec_draft's scan layout). Returns
+    (a [slots], emit [slots, g+1])."""
+    s, g1, v = tlogits.shape
+    g = g1 - 1
+    a_g, emit_g = spec_accept_greedy(tlogits, drafts)
+
+    # target's filtered log-probs at every verified position
+    lt = tlogits / jnp.where(temps > 0, temps, 1.0)[:, None, None]
+    tlp = jax.nn.log_softmax(
+        _rowwise_filter(lt, top_ks[:, None], top_ps[:, None]), axis=-1)
+    dlp = jnp.swapaxes(dlogp, 0, 1)                         # [slots,g,V]
+    p_tok = jnp.take_along_axis(tlp[:, :-1], drafts[..., None],
+                                axis=-1)[..., 0]            # log p_j(x_j)
+    q_tok = jnp.take_along_axis(dlp, drafts[..., None],
+                                axis=-1)[..., 0]            # log q_j(x_j)
+    ka, kr = jax.random.split(key)
+    u = jax.random.uniform(ka, (s, g))
+    ok = u < jnp.exp(jnp.minimum(p_tok - q_tok, 0.0))
+    a_s = jnp.argmin(jnp.concatenate([ok, jnp.zeros((s, 1), bool)], axis=1),
+                     axis=1)
+    # replacement at the first rejection: sample from the residual
+    # norm(max(0, p_a - q_a)); all-accepted: bonus from p_gamma
+    p_a = jnp.exp(jnp.take_along_axis(
+        tlp, jnp.broadcast_to(a_s[:, None, None], (s, 1, v)),
+        axis=1)[:, 0])                                      # [slots, V]
+    q_row = jnp.exp(jnp.take_along_axis(
+        dlp, jnp.broadcast_to(jnp.minimum(a_s, g - 1)[:, None, None],
+                              (s, 1, v)), axis=1)[:, 0])
+    q_a = jnp.where((a_s < g)[:, None], q_row, 0.0)
+    resid = jnp.maximum(p_a - q_a, 0.0)
+    total = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(total > 0, resid / jnp.maximum(total, 1e-38), p_a)
+    tok_s = jax.random.categorical(
+        kr, jnp.log(resid + 1e-38)).astype(jnp.int32)       # per-row indep.
+    a = jnp.where(temps > 0, a_s, a_g)
+    new_tok_s = jnp.broadcast_to(tok_s[:, None], (s, g1))
+    emit_s = jnp.where(jnp.arange(g1)[None, :] < a_s[:, None],
+                       jnp.concatenate(
+                           [drafts, jnp.zeros((s, 1), jnp.int32)], axis=1),
+                       new_tok_s)
+    emit = jnp.where((temps > 0)[:, None], emit_s, emit_g)
+    return a, emit

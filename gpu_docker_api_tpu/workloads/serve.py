@@ -87,13 +87,35 @@ class _Batcher:
                  prefill_chunk: int = 0, prefix_cache: int = 0,
                  restarts: int = 3, kv_quant: bool = False,
                  kv_block: int = 0, kv_pool_blocks: int = 0,
-                 decode_chunk: int = 1, seed: int | None = None):
+                 decode_chunk: int = 1, seed: int | None = None,
+                 draft: tuple | None = None, gamma: int = 4):
         import collections
         import queue
 
         self.config = config
         self.params = params
         self.max_len = max_len
+        # speculative decoding INSIDE the batch: a draft model (own slot
+        # cache) proposes gamma tokens per active row each round; the
+        # target verifies every row's gamma+1 positions in ONE multi-token
+        # forward (slot_verify); acceptance/rollback is per row. Greedy
+        # rows emit exactly the target-only greedy stream; sampling rows
+        # keep exact target statistics (rowwise_spec_accept). The slot
+        # caches get gamma+1 positions of headroom: the verify step may
+        # overshoot a row's budget before its rollback.
+        self._draft = draft                  # (draft_config, draft_params)
+        self.gamma = int(gamma)
+        if draft is not None and kv_block > 0:
+            raise ValueError(
+                "--draft-config composes with the DENSE slot cache; the "
+                "paged cache (--kv-block) needs a block-aware multi-token "
+                "verify — drop --kv-block or --draft-config")
+        if draft is not None and draft[0].vocab_size != config.vocab_size:
+            raise ValueError("draft and target must share a vocab")
+        self._cache_len = max_len + (self.gamma + 1 if draft else 0)
+        self.spec_rounds = 0                 # spec telemetry (healthz/bench)
+        self.spec_accepted = 0               # draft tokens accepted
+        self.spec_emitted = 0                # tokens emitted by spec rounds
         # > 1: when nothing is waiting to join, decode up to this many
         # steps as ONE device-side scan per host sync — the per-step
         # argmax fetch is pure dispatch/RTT overhead (VERDICT r2 weak
@@ -161,8 +183,13 @@ class _Batcher:
         else:
             from ..batching import init_slot_cache
             self.cache = init_slot_cache(self.config, len(self.slots),
-                                         self.max_len,
+                                         self._cache_len,
                                          quantized=self.kv_quant)
+        if self._draft is not None:
+            from ..batching import init_slot_cache
+            self.d_cache = init_slot_cache(self._draft[0], len(self.slots),
+                                           self._cache_len,
+                                           quantized=self.kv_quant)
 
     # the cache entry points, dispatched on dense vs paged mode (the
     # import + attribute lookup per call is trivia next to the jitted
@@ -347,6 +374,7 @@ class _Batcher:
             item = self._next_item()
             if item is None:
                 return
+            shared_tok, donor = 0, None
             if self._paged:
                 prompt_len = item["prompt"].shape[0]
                 # ZERO-COPY prefix reuse: a cached prompt prefix's FULL
@@ -354,7 +382,7 @@ class _Batcher:
                 # Writes can never touch them — the first private
                 # position starts the first private block — so no copy
                 # and no copy-on-write are ever needed.
-                shared, shared_tok = self._paged_prefix_lookup(item)
+                shared, shared_tok, donor = self._paged_prefix_lookup(item)
                 if shared:
                     # take OUR reference first: any eviction below (even
                     # of the entry we share from) then can't return these
@@ -393,16 +421,36 @@ class _Batcher:
             try:
                 rem = (item["prompt"][shared_tok:] if self._paged
                        else self._restore_prefix(i, item))
-                if self.prefill_chunk > 0:
-                    c = self.prefill_chunk
+                # an in-flight donor still mid-prefill hasn't written the
+                # shared positions yet: park the suffix (even unchunked)
+                # and let _prefill_tick start it once the donor's write
+                # frontier passes shared_tok. _written stays 0 until then
+                # so a third request sharing from THIS item waits too.
+                awaiting = (self._paged and donor is not None)
+                if awaiting:
+                    item["_await"] = (donor, shared_tok)
+                else:
+                    item["_written"] = shared_tok
+                if self.prefill_chunk > 0 or awaiting:
+                    c = self.prefill_chunk or rem.shape[0]
                     item["chunks"] = [rem[j:j + c]
                                       for j in range(0, rem.shape[0], c)]
+                    if self._draft is not None:
+                        # the draft sees the FULL prompt (no stored draft
+                        # prefixes), chunked the same way
+                        item["dchunks"] = [
+                            item["prompt"][j:j + c]
+                            for j in range(0, item["prompt"].shape[0], c)]
                     item["stream"] = None        # not decodable yet
                     self.slots[i] = item
                     self._sample_vec = None
                 else:
                     self._prefill_piece(i, item, rem,
                                         first=not item.get("_restored"))
+                    if self._draft is not None:
+                        # full prompt even when the target restored a
+                        # prefix: only the target has a prefix store
+                        self._draft_prefill(i, item["prompt"], first=True)
                     self._arm_or_finish(i, item)
             except Exception as e:
                 # the item is in neither the queue nor a slot here — fail
@@ -414,23 +462,34 @@ class _Batcher:
 
     # ---- prefix cache (system-prompt KV reuse) ----
 
-    def _lcp_lookup(self, item):
-        """(best stored key, usable token count) for the item's prompt —
-        usable is capped at len-1 so the last position's logits always
-        come from a real forward. Caches the host prompt tuple on the
-        item (ONE device-to-host transfer)."""
+    @staticmethod
+    def _prompt_key(item) -> tuple:
+        """Host prompt tuple, cached on the item (ONE device-to-host
+        transfer per request, shared by every lookup that needs it)."""
         import jax
         key = item.get("_key") or tuple(
             jax.device_get(item["prompt"]).tolist())
         item["_key"] = key
+        return key
+
+    @staticmethod
+    def _usable_lcp(a: tuple, b: tuple) -> int:
+        """Longest common prefix usable for KV reuse when serving prompt
+        `b` — capped at len(b)-1 so the last position's logits always
+        come from a real forward."""
+        lcp = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            lcp += 1
+        return min(lcp, len(b) - 1)
+
+    def _lcp_lookup(self, item):
+        """(best stored key, usable token count) for the item's prompt."""
+        key = self._prompt_key(item)
         best_key, best_use = None, 0
         for pk in self._prefixes:
-            lcp = 0
-            for a, b in zip(pk, key):
-                if a != b:
-                    break
-                lcp += 1
-            usable = min(lcp, len(key) - 1)
+            usable = self._usable_lcp(pk, key)
             if usable > best_use:
                 best_key, best_use = pk, usable
         return best_key, best_use
@@ -456,21 +515,52 @@ class _Batcher:
         return prompt[best_use:]
 
     def _paged_prefix_lookup(self, item):
-        """Paged mode: (shared block list, shared token count) — the
-        stored prefix's FULL blocks whose tokens prefix this prompt.
-        No data moves; the caller puts the block ids straight into the
-        slot's page table and rc++ them."""
-        if not (self.prefix_cache and self._prefixes):
-            return [], 0
-        best_key, best_use = self._lcp_lookup(item)
-        if best_key is None:
-            return [], 0
-        entry = self._prefixes[best_key]
-        n_blk = min(best_use // self.kv_block, len(entry["blocks"]))
-        if n_blk < 1:
-            return [], 0
-        self._prefixes.move_to_end(best_key)
-        return entry["blocks"][:n_blk], n_blk * self.kv_block
+        """Paged mode: (shared block list, shared token count, donor item
+        or None). Two sources, best (longest) wins:
+
+        - the prefix STORE (completed prompts kept by --prefix-cache):
+          the stored prefix's FULL blocks go straight into the new slot's
+          page table (rc++), no data movement, no waiting;
+        - IN-FLIGHT slots (always on in paged mode): a running/mid-
+          prefill request whose prompt shares a block-aligned prefix
+          donates its prefix blocks the same zero-copy way — N identical
+          prompts arriving in one burst allocate ~one prompt's blocks
+          (VERDICT r3 next #5). A donor still mid-prefill hasn't written
+          the shared positions yet, so the follower is returned WITH the
+          donor item and parks until the donor's write frontier
+          (_written) passes the shared token count — acyclic by
+          construction (a follower only awaits an earlier admission).
+
+        Sharing is safe because shared blocks are never written again:
+        the donor's decode writes start at its prompt length (>= the
+        shared tokens, which are FULL prompt blocks), and the follower's
+        prefill starts at shared_tok — both inside private blocks."""
+        best_blocks, best_tok, best_donor = [], 0, None
+        if self.prefix_cache and self._prefixes:
+            best_key, best_use = self._lcp_lookup(item)
+            if best_key is not None:
+                entry = self._prefixes[best_key]
+                n_blk = min(best_use // self.kv_block,
+                            len(entry["blocks"]))
+                if n_blk >= 1:
+                    self._prefixes.move_to_end(best_key)
+                    best_blocks = entry["blocks"][:n_blk]
+                    best_tok = n_blk * self.kv_block
+        # in-flight donors: any occupied slot with a longer common prefix
+        key = self._prompt_key(item)
+        for j, sj in enumerate(self.slots):
+            if sj is None or self._slot_blocks[j] is None:
+                continue
+            usable = self._usable_lcp(self._prompt_key(sj), key)
+            n_blk = min(usable // self.kv_block,
+                        len(self._slot_blocks[j]))
+            if n_blk * self.kv_block > best_tok:
+                best_blocks = self._slot_blocks[j][:n_blk]
+                best_tok = n_blk * self.kv_block
+                # no wait needed once the donor's writes cover the prefix
+                best_donor = (sj if sj.get("_written", 0) < best_tok
+                              else None)
+        return best_blocks, best_tok, best_donor
 
     def _store_prefix(self, i, item) -> None:
         """After a full prefill, keep the prompt's KV for future requests
@@ -521,6 +611,23 @@ class _Batcher:
             self.params, piece[None], self.cache, jnp.int32(i),
             self.config, append=not first)
         item["_last_logits"] = logits
+        # host-side write frontier: how many of this item's prompt tokens
+        # are IN the cache — in-flight paged prefix sharing gates a
+        # follower's prefill on its donor's frontier
+        item["_written"] = item.get("_written", 0) + int(piece.shape[0])
+
+    def _draft_prefill(self, i, piece, first: bool):
+        """Feed a prompt piece into the DRAFT's slot cache (speculative
+        mode keeps the two caches in lock-step: both hold y_1..y_{m-1}
+        between rounds). The draft's logits are unused at prefill — its
+        first proposal comes off the first spec round."""
+        import jax.numpy as jnp
+
+        from ..batching import slot_prefill
+        dcfg, dparams = self._draft
+        _, self.d_cache = slot_prefill(dparams, piece[None], self.d_cache,
+                                       jnp.int32(i), dcfg,
+                                       append=not first)
 
     def _sample_key(self):
         import jax
@@ -583,22 +690,110 @@ class _Batcher:
         for off in range(n):
             i = (self._prefill_cursor + off) % n
             s = self.slots[i]
-            if s is None or not s.get("chunks"):
+            if s is None or not (s.get("chunks") or s.get("dchunks")):
                 continue
+            if "_await" in s:
+                # paged in-flight prefix share: the donor hasn't written
+                # the shared positions yet — skip this slot (the donor's
+                # own prefill progresses every tick, so this resolves;
+                # acyclic because a follower only awaits an EARLIER
+                # admission). The donor item dict outlives its slot, so
+                # a released donor (prefill necessarily complete) passes.
+                d_item, need = s["_await"]
+                if d_item.get("_written", 0) < need:
+                    continue
+                del s["_await"]
+                s["_written"] = need     # donor wrote [0, need) for us
             self._prefill_cursor = (i + 1) % n
             # no local error handling: the item is slot-resident, so a
             # crash propagating to _run hits _fail_all, which releases it
-            piece = s["chunks"].pop(0)
-            # a prefix-restored item must APPEND from its first piece (the
-            # row already holds the restored prefix at its length)
-            self._prefill_piece(i, s, piece,
-                                first=("_last_logits" not in s
-                                       and not s.get("_restored")))
-            if not s["chunks"]:
-                del s["chunks"]
+            if s.get("chunks"):
+                piece = s["chunks"].pop(0)
+                # a prefix-restored item must APPEND from its first piece
+                # (the row already holds the restored prefix at its length)
+                self._prefill_piece(i, s, piece,
+                                    first=("_last_logits" not in s
+                                           and not s.get("_restored")))
+            if s.get("dchunks"):
+                # one draft piece per tick too: the draft forward is cheap
+                # next to the target's, and arming waits for both
+                dpiece = s["dchunks"].pop(0)
+                self._draft_prefill(i, dpiece,
+                                    first=not s.get("_d_started"))
+                s["_d_started"] = True
+            if not s.get("chunks") and not s.get("dchunks"):
+                s.pop("chunks", None)
+                s.pop("dchunks", None)
+                s.pop("_d_started", None)
                 self._arm_or_finish(i, s)
             return True
         return False
+
+    def _spec_round(self, active: list, toks) -> None:
+        """One speculative round over the whole slot batch: draft proposes
+        gamma per active row, target verifies all rows in one multi-token
+        forward, per-row accept + cache rollback, emit 1..gamma+1 tokens
+        per row. One host sync per round (the accept fetch) — speculative
+        decoding amortizes the per-token dispatch/RTT like decode_chunk
+        does, while also cutting target forwards per token."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..batching import (rowwise_spec_accept, slot_decode,
+                                slot_spec_draft, slot_verify,
+                                spec_accept_greedy)
+        dcfg, dparams = self._draft
+        g = self.gamma
+        act = jnp.array(active)
+        sampling = any(s is not None and s.get("stream") is not None
+                       and s["temperature"] > 0 for s in self.slots)
+        if sampling:
+            sample = (*self._sample_vectors(), self._sample_key())
+            drafts, dlogp, self.d_cache = slot_spec_draft(
+                dparams, toks, self.d_cache, act, dcfg, g, sample)
+        else:
+            drafts, dlogp, self.d_cache = slot_spec_draft(
+                dparams, toks, self.d_cache, act, dcfg, g)
+        blocks = jnp.concatenate([toks[:, None], drafts], axis=1)
+        tlogits, self.cache = slot_verify(self.params, blocks, self.cache,
+                                          act, self.config)
+        if sampling:
+            temps, tks, tps = self._sample_vectors()
+            a, emit = rowwise_spec_accept(tlogits, drafts, dlogp, temps,
+                                          tks, tps, self._sample_key())
+        else:
+            a, emit = spec_accept_greedy(tlogits, drafts)
+        a_host, emit_host = jax.device_get((a, emit))  # ONE host sync
+        # all-gamma-accepted rows are missing the draft's entry for the
+        # last proposal (the draft never forwarded it) — one draft step
+        # for exactly those rows fills it before the rollback
+        fill = [bool(active[i]) and int(a_host[i]) == g
+                for i in range(len(self.slots))]
+        if any(fill):
+            _, self.d_cache = slot_decode(dparams, drafts[:, -1],
+                                          self.d_cache, jnp.array(fill),
+                                          dcfg)
+        # roll both caches back to exactly the accepted entries: target
+        # wrote gamma+1 (keep 1+a); draft wrote gamma, +1 for filled rows
+        self.cache["lengths"] = (self.cache["lengths"]
+                                 - jnp.where(act, g - a, 0))
+        self.d_cache["lengths"] = (
+            self.d_cache["lengths"]
+            - jnp.where(act, jnp.where(a == g, 0, g - 1 - a), 0))
+        self.spec_rounds += 1
+        for i, s in enumerate(self.slots):
+            if not active[i]:
+                continue
+            take = min(1 + int(a_host[i]),
+                       s["max_new"] - len(s["stream"]))
+            s["stream"].extend(int(t) for t in emit_host[i, :take])
+            s["last"] = s["stream"][-1]
+            self.spec_accepted += int(a_host[i])
+            self.spec_emitted += take
+            if len(s["stream"]) >= s["max_new"]:
+                s["out"] = s["stream"]
+                s["done"].set()
+                self._release_slot(i)
 
     def _loop(self):
         import time as _time
@@ -623,6 +818,9 @@ class _Batcher:
             toks = jnp.array(
                 [s["last"] if active[i] else 0
                  for i, s in enumerate(self.slots)], jnp.int32)
+            if self._draft is not None:
+                self._spec_round(active, toks)
+                continue
             # chunked decode only when nothing is waiting to join (and no
             # prefill mid-flight — implied by `not fed`, which scanned all
             # slots) — otherwise single steps keep admission/interleave
@@ -792,6 +990,16 @@ def _handler_for(srv: _Server, model_name: str):
                         "alive": b.alive,
                         "prefixHits": b.prefix_hits,
                     }
+                    if b._draft is not None:
+                        data["batching"]["speculative"] = {
+                            "gamma": b.gamma,
+                            "rounds": b.spec_rounds,
+                            "accepted": b.spec_accepted,
+                            "emitted": b.spec_emitted,
+                            "acceptRate": round(
+                                b.spec_accepted
+                                / max(b.spec_rounds * b.gamma, 1), 3),
+                        }
                     if b._paged:
                         data["batching"]["paged"] = {
                             "blockSize": b.kv_block,
@@ -843,6 +1051,180 @@ def _handler_for(srv: _Server, model_name: str):
     return Handler
 
 
+class _MultihostServer:
+    """Rank-0 facade the HTTP handler drives in multi-host mode: generate
+    enqueues the request for the lock-step engine loop and blocks on its
+    result (single-flight falls out of the single consumer)."""
+
+    def __init__(self, config, n_params: int, work_q, kv_quant: bool,
+                 b_max: int, t_max: int):
+        self.config = config
+        self.n_params = n_params
+        self.kv_quant = kv_quant
+        self.batcher = None          # healthz compatibility
+        self.draft = None
+        self._q = work_q
+        self.b_max = b_max
+        self.t_max = t_max
+
+    def generate(self, tokens, max_new: int, temperature: float,
+                 top_k: int = 0, top_p: float = 1.0):
+        import jax
+        import jax.numpy as jnp
+        prompt = jnp.asarray(tokens, jnp.int32)
+        if prompt.ndim != 2:
+            raise ValueError("tokens must be [batch, prompt_len]")
+        # request-shape limits reject HERE (a 400 to the client) — an
+        # invalid item must never reach the engine loop, where a rank-0
+        # failure before the broadcast would strand the other ranks, and
+        # an unbounded max_new would park every rank in one scan for the
+        # single-flight engine's lifetime
+        if prompt.shape[0] > self.b_max or prompt.shape[1] >= self.t_max:
+            raise ValueError(f"batch <= {self.b_max} and prompt < "
+                             f"{self.t_max} in multihost mode")
+        if prompt.shape[1] + int(max_new) > self.t_max:
+            raise ValueError(
+                f"prompt + max_new exceeds the model's max_seq_len "
+                f"({self.t_max})")
+        lo, hi = jax.device_get((jnp.min(prompt), jnp.max(prompt)))
+        if hi >= self.config.vocab_size or lo < 0:
+            raise ValueError("token id out of range")
+        item = {"prompt": prompt, "max_new": int(max_new),
+                "temperature": float(temperature), "top_k": int(top_k),
+                "top_p": float(top_p),
+                "done": threading.Event(), "out": None, "error": None}
+        self._q.put(item)
+        item["done"].wait()
+        if item["error"] is not None:
+            raise RuntimeError(f"multihost engine failed: {item['error']}")
+        return item["out"]
+
+
+def _serve_multihost(args, config) -> int:
+    """Lock-step SPMD serving over a multi-process cluster (SURVEY §5.8,
+    VERDICT r3 weak #6): every process builds the SAME sharded params
+    over one global mesh (tp over ICI); rank 0 owns the HTTP endpoint
+    and BROADCASTS each request (tokens + sampling params + a shared PRNG
+    seed) to the other ranks, so all processes execute the identical
+    jitted generate — the SPMD contract. Non-zero ranks run the engine
+    loop only. Shutdown broadcasts a sentinel so no rank is left blocked
+    in a collective."""
+    import queue as _queue
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from ..infer import generate
+    from ..parallel.mesh import MeshPlan, best_tp_for
+    from ..train import Trainer, restore_checkpoint
+
+    n_dev = jax.device_count()
+    tp = args.tp or best_tp_for(n_dev)
+    trainer = Trainer.create(config, MeshPlan.auto(n_dev, tp=tp))
+    if args.checkpoint:
+        # abstract-template restore: orbax reshards the checkpoint onto
+        # THIS cluster's mesh, whatever shape the writer's mesh had
+        abstract = trainer.abstract_state(jax.random.key(0))
+        state, step = restore_checkpoint(os.path.abspath(args.checkpoint),
+                                         abstract)
+        print(f"restored checkpoint step {step} (sharded)", flush=True)
+        params = state["params"]
+    else:
+        params = trainer.init(jax.random.key(0))["params"]
+    params = _maybe_ungroup(params, config)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    rank = jax.process_index()
+    b_max, t_max = 8, config.max_seq_len
+
+    work_q: "_queue.Queue" = _queue.Queue()
+    httpd = None
+    if rank == 0:
+        srv = _MultihostServer(config, n_params, work_q, args.kv_quant,
+                               b_max, t_max)
+        name = f"{args.family}/{args.config}"
+        httpd = ThreadingHTTPServer((args.host, args.port),
+                                    _handler_for(srv, name))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        print(f"multihost serving {name} ({n_params:,} params) on "
+              f"{args.host}:{httpd.server_address[1]} — rank 0 of "
+              f"{jax.process_count()}, mesh tp={tp} over {n_dev} devices",
+              flush=True)
+    else:
+        print(f"multihost engine rank {rank}/{jax.process_count()} "
+              "following", flush=True)
+
+    def engine_round(item) -> None:
+        """One broadcast + one lock-step generate. item is None on
+        follower ranks (they receive everything from rank 0)."""
+        if item is not None:
+            p = np.asarray(jax.device_get(item["prompt"]), np.int32)
+            b, t = p.shape
+            pad = np.zeros((b_max, t_max), np.int32)
+            pad[:b, :t] = p
+            ints = np.array([1, b, t, item["max_new"], item["top_k"],
+                             int.from_bytes(os.urandom(3), "big")],
+                            np.int32)
+            floats = np.array([item["temperature"], item["top_p"]],
+                              np.float32)
+        else:
+            pad = np.zeros((b_max, t_max), np.int32)
+            ints = np.zeros((6,), np.int32)
+            floats = np.zeros((2,), np.float32)
+        ints, floats, pad = multihost_utils.broadcast_one_to_all(
+            (ints, floats, pad))
+        op, b, t, max_new, top_k, seed = (int(x) for x in ints)
+        if op == 0:
+            return "stop"
+        prompt = jnp.asarray(pad[:b, :t])
+        with trainer.mesh:
+            out = generate(params, prompt, config, max_new,
+                           temperature=float(floats[0]), top_k=top_k,
+                           top_p=float(floats[1]),
+                           kv_quant=args.kv_quant,
+                           key=jax.random.key(seed))
+            out = jax.device_get(out)
+        if item is not None:
+            item["out"] = np.asarray(out).tolist()
+        return None
+
+    try:
+        while True:
+            if rank == 0:
+                item = work_q.get()
+                if item is None:              # shutdown sentinel
+                    engine_round(None)        # broadcast op=0
+                    break
+                try:
+                    if engine_round(item) == "stop":
+                        break
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    item["error"] = e
+                    # the followers may be waiting in (or past) this
+                    # round's collective; a best-effort sentinel keeps a
+                    # rank-0 failure from stranding them in a broadcast
+                    # nobody will complete
+                    try:
+                        engine_round(None)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise
+                finally:
+                    item["done"].set()
+            else:
+                if engine_round(None) == "stop":
+                    break
+    except KeyboardInterrupt:
+        if rank == 0:
+            engine_round(None)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--family", default="llama", choices=["llama", "moe"])
@@ -868,8 +1250,12 @@ def main(argv=None) -> int:
                         "attend loop)")
     p.add_argument("--draft-config", default="",
                    help="named config of a draft model for speculative "
-                        "decoding (greedy B=1 requests; output is exactly "
-                        "the target's greedy stream)")
+                        "decoding. Alone: B=1 requests (greedy stream "
+                        "bit-exact; sampling exact via rejection "
+                        "sampling). With --batch-slots: speculative "
+                        "rounds run INSIDE the continuous batcher (per-"
+                        "slot proposals, one shared verify forward, "
+                        "same exactness per row)")
     p.add_argument("--draft-checkpoint", default="",
                    help="orbax checkpoint for the draft (fresh init when "
                         "empty — useful only for testing)")
@@ -897,7 +1283,11 @@ def main(argv=None) -> int:
                    help="PAGED slot cache: block size in tokens — slots "
                         "share a block pool instead of dense slots x "
                         "max_len reservations; admission waits on free "
-                        "blocks (0 = dense)")
+                        "blocks (0 = dense). Paged admission also shares "
+                        "block-aligned common prompt prefixes with "
+                        "IN-FLIGHT requests zero-copy (a burst of "
+                        "identical prompts allocates ~one prompt's "
+                        "blocks), independent of --prefix-cache")
     p.add_argument("--kv-pool", type=int, default=0,
                    help="paged pool size in blocks (default: full "
                         "capacity, slots x ceil(max_len/block) + scratch; "
@@ -907,6 +1297,9 @@ def main(argv=None) -> int:
                         "device-side scan when no request is waiting to "
                         "join (amortizes per-token dispatch/RTT; 1 = "
                         "sync every step)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel width for MULTI-HOST serving "
+                        "(0 = auto); single-host serving ignores it")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -923,6 +1316,24 @@ def main(argv=None) -> int:
         config = named_config(args.family, args.config)
     except KeyError as e:
         p.error(str(e))
+
+    # multi-host: a spanning grant's env contract describes the cluster —
+    # join it BEFORE touching any jax API (same flow as the training
+    # workload), then run the lock-step SPMD serving engine
+    from ..distributed import maybe_initialize_from_env
+    cluster = maybe_initialize_from_env()
+    if cluster is not None:
+        for flag, msg in (
+                (args.batch_slots, "--batch-slots"),
+                (args.draft_config, "--draft-config"),
+                (args.quantize, "--quantize"),
+                (args.host_load, "--host-load")):
+            if flag:
+                raise SystemExit(
+                    f"{msg} is single-host serving for now; the "
+                    "multi-host engine runs plain sharded generate "
+                    "(drop the flag, or serve per-host)")
+        return _serve_multihost(args, config)
 
     import jax
     if args.host_load:
@@ -982,26 +1393,33 @@ def main(argv=None) -> int:
     srv = _Server(config, params, kv_quant=args.kv_quant, draft=draft,
                   gamma=args.gamma)
     if args.batch_slots > 0:
-        # keep the serving-mode matrix explicit: the batcher owns greedy
-        # B=1 traffic, which is exactly what --draft-config targets —
-        # refuse the ambiguous combination instead of silently disabling
-        # a configured feature. --kv-quant composes (int8 slot cache).
-        if args.draft_config:
-            raise SystemExit("--batch-slots and --draft-config both claim "
-                             "greedy single-sequence requests; pick one")
-        srv.batcher = _Batcher(config, params, slots=args.batch_slots,
-                               max_len=args.batch_max_len
-                               or config.max_seq_len,
-                               prefill_chunk=args.batch_prefill_chunk,
-                               prefix_cache=args.prefix_cache,
-                               kv_quant=args.kv_quant,
-                               kv_block=args.kv_block,
-                               kv_pool_blocks=args.kv_pool,
-                               decode_chunk=args.decode_chunk)
+        # --draft-config composes: the batcher runs speculative rounds
+        # over the whole slot batch (per-slot proposals, one shared
+        # verify forward; greedy rows bit-exact, sampling rows exact via
+        # per-row rejection sampling). --kv-quant composes (int8 slot
+        # caches, both models). --kv-block does not (paged multi-token
+        # verify is future work; _Batcher refuses it with the same
+        # message). decode_chunk is superseded in speculative mode: a
+        # spec round already emits up to gamma+1 tokens per host sync.
+        try:
+            srv.batcher = _Batcher(config, params, slots=args.batch_slots,
+                                   max_len=args.batch_max_len
+                                   or config.max_seq_len,
+                                   prefill_chunk=args.batch_prefill_chunk,
+                                   prefix_cache=args.prefix_cache,
+                                   kv_quant=args.kv_quant,
+                                   kv_block=args.kv_block,
+                                   kv_pool_blocks=args.kv_pool,
+                                   decode_chunk=args.decode_chunk,
+                                   draft=draft, gamma=args.gamma)
+        except ValueError as e:
+            raise SystemExit(str(e))
         mode = (f"paged ({srv.batcher.kv_pool_blocks} x {args.kv_block} "
                 f"token blocks)" if args.kv_block else "dense")
+        spec = (f", speculative (draft {args.draft_config}, gamma "
+                f"{args.gamma})" if draft else "")
         print(f"continuous batching: {args.batch_slots} slots x "
-              f"{srv.batcher.max_len} tokens, {mode} KV", flush=True)
+              f"{srv.batcher.max_len} tokens, {mode} KV{spec}", flush=True)
     elif args.prefix_cache:
         raise SystemExit("--prefix-cache lives in the batching scheduler; "
                          "it needs --batch-slots N")

