@@ -17,10 +17,16 @@ Extras (recorded in the same JSON line under "extra"):
 - attention_fwd: pallas flash vs fused-XLA attention timings (on-chip),
 - decode: end-to-end generate throughput, prefill + decode scan (on-chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "platform",
-"extra"}. "platform" is read back from each workload's marker (the backend
+Prints the full JSON record line, then a compact headline JSON line LAST
+(same required keys, extras condensed under "summary") — the driver keeps a
+bounded stdout tail, so the final line must always carry the p50/platform/
+top ratios. "platform" is read back from each workload's marker (the backend
 JAX actually initialized), so a cpu-fallback round can never masquerade as a
 TPU round; vs_baseline only compares rounds whose recorded platform matches.
+
+Every run also diffs its fresh ratios against BASELINE.json's "claims" table
+(the numbers BASELINE.md publishes) and flags >tol drift loudly — a headline
+the harness can't reproduce must not survive in the docs (check_claims).
 """
 
 from __future__ import annotations
@@ -227,13 +233,23 @@ def _train_step_flops(config, batch: int, seq: int) -> float:
     2*S*keys_avg*D per head, keys_avg = S/2 causal (half masked) or the
     window — tripled for fwd+bwd. Rounds 1-2 dropped the n_layers factor
     on the attention term, UNDERSTATING every recorded MFU; at 1B/S=2048
-    the correction is ~+4 points."""
+    the correction is ~+4 points.
+
+    MoE configs count the ACTIVE params per token (top_k experts +
+    router), the standard sparse-MFU convention — the GShard dense
+    dispatch actually executes capacity_factor x that on the MXU, so
+    hardware occupancy is ~cf x the reported MFU."""
     c = config
     kq = c.n_heads * c.head_dim
     kv = c.n_kv_heads * c.head_dim
+    if hasattr(c, "n_experts"):
+        ffn = (c.top_k * 3 * c.d_model * c.d_ff     # active experts
+               + c.d_model * c.n_experts)           # router
+    else:
+        ffn = 3 * c.d_model * c.d_ff                # w1 w3 w2
     per_layer = (c.d_model * (kq + 2 * kv)        # wq wk wv
                  + kq * c.d_model                 # wo
-                 + 3 * c.d_model * c.d_ff)        # w1 w3 w2
+                 + ffn)
     n_matmul = (c.n_layers * per_layer
                 + c.vocab_size * c.d_model)       # lm_head (embed gather ~ free)
     tokens = batch * seq
@@ -307,16 +323,22 @@ def mfu_bench() -> dict:
     number (round-3 scan: 54.7% vs 250m's ~44%, corrected accounting;
     bigger matmuls feed the 128x128 MXU properly)."""
     from gpu_docker_api_tpu.models.llama import LlamaConfig
+    from gpu_docker_api_tpu.models.moe import MoEConfig
     from gpu_docker_api_tpu.train import TrainConfig
     out = {"mini": _mfu_one("llama_mini", LlamaConfig.llama_mini(),
                             batch=8, seq=1024, K=8)}
     for key, cfg, kw in (
             ("250m", LlamaConfig.llama_250m(), {}),
             ("1b", LlamaConfig.llama_1b(),
-             {"tc": TrainConfig(accum_steps=4)})):
+             {"tc": TrainConfig(accum_steps=4)}),
+            # the sparse half of the ladder ON the chip (VERDICT r3 weak
+            # #4): largest mixtral-style trainer fitting 16GB; MFU counts
+            # active-expert FLOPs (see _train_step_flops)
+            ("moe", MoEConfig.moe_1b(),
+             {"name": "moe_1b", "tc": TrainConfig(accum_steps=4)})):
         try:
-            out[key] = _mfu_one(f"llama_{key}", cfg, batch=8, seq=2048,
-                                K=4, **kw)
+            out[key] = _mfu_one(kw.pop("name", f"llama_{key}"), cfg,
+                                batch=8, seq=2048, K=4, **kw)
         except Exception as e:  # OOM/tunnel hiccup must not kill headline
             out[key] = {"error": f"{type(e).__name__}: {e}"}
     # long-context single-chip: S=16384 full-causal — runs through the
@@ -324,15 +346,24 @@ def mfu_bench() -> dict:
     # kernel call at this length compile-OOMs VMEM), proving 16k-token
     # training on one chip every round
     import dataclasses
-    for key, extra in (("long16k", {}),
-                       # windowed variant: exercises the banded boundary
-                       # pair + window-skip of the decomposition
-                       ("long16k_w1024", {"sliding_window": 1024})):
+    for key, seq, extra in (
+            ("long16k", 16384, {}),
+            # windowed variant: exercises the banded boundary pair +
+            # window-skip of the decomposition
+            ("long16k_w1024", 16384, {"sliding_window": 1024}),
+            # 32k: double the ladder — the stacked-pair decomposition
+            # keeps the program count bounded while the pair count grows.
+            # remat "full" is REQUIRED here: the default "dots" policy
+            # saves all-layer x full-sequence matmul outputs (2.75GB each
+            # at 32k) and compile-OOMs 21.3G > 15.75G hbm (measured)
+            ("long32k", 32768, {"_tc": TrainConfig(remat_policy="full")})):
         try:
+            tc = extra.pop("_tc", None)
             lcfg = dataclasses.replace(LlamaConfig.llama_250m(),
-                                       max_seq_len=16384, **extra)
-            out[key] = _mfu_one(f"llama_250m_s16k{'_w' if extra else ''}",
-                                lcfg, batch=1, seq=16384, K=2)
+                                       max_seq_len=seq, **extra)
+            out[key] = _mfu_one(
+                f"llama_250m_s{seq // 1024}k{'_w' if extra else ''}",
+                lcfg, batch=1, seq=seq, K=2, tc=tc)
         except Exception as e:  # noqa: BLE001
             out[key] = {"error": f"{type(e).__name__}: {e}"}
     return out
@@ -486,7 +517,60 @@ def decode_bench() -> dict:
         "w8_speedup": round(da["best"] / wa["best"], 2),
         "spread": max(da["spread"], wa["spread"]),
     }
-    del lparams
+
+    # ---- w8a8 evidence row (VERDICT r3 weak #3): on v5e through this
+    # XLA, an int8 x int8 -> int32 dot_general is SLOWER than bf16 (the
+    # native int8 MXU mode is not what the lowering produces), so w8a8
+    # is an accuracy/memory option, not a speed path — this row records
+    # the proof every round: the pure-dot TF/s A/B plus a prefill-bound
+    # serving A/B of w8 vs w8a8 at 250m scale.
+    def dot_tfs(dtype, pref):
+        m = 4096
+        a = jax.random.normal(jax.random.key(7), (m, m),
+                              jnp.bfloat16).astype(dtype)
+        w = jax.random.normal(jax.random.key(8), (m, m),
+                              jnp.bfloat16).astype(dtype)
+
+        @jax.jit
+        def chain(x):
+            def body(c, _):
+                o = jax.lax.dot_general(
+                    c, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=pref)
+                return o.astype(dtype), None
+            c, _ = jax.lax.scan(body, x, None, length=16)
+            return jnp.sum(c.astype(jnp.float32))
+        float(chain(a))
+        t0 = time.perf_counter()
+        float(chain(a))
+        dt = (time.perf_counter() - t0) / 16
+        return round(2 * m ** 3 / dt / 1e12, 1)
+
+    lq8 = jax.jit(lambda p: quantize_params(p, "w8a8"))(lparams)
+    a_prompt = jax.random.randint(jax.random.key(10), (16, 2048), 0,
+                                  lcfg.vocab_size, jnp.int32)
+
+    def w8_prefill():
+        jax.device_get(generate(lq, a_prompt, lcfg, 8))
+
+    def w8a8_prefill():
+        jax.device_get(generate(lq8, a_prompt, lcfg, 8))
+
+    w8_prefill(), w8a8_prefill()                # compile both arms first
+    pa, pb = _ab_interleaved(w8_prefill, w8a8_prefill)
+    rec["w8a8"] = {
+        "note": "int8 dot lowering is slower than bf16 on this chip — "
+                "w8a8 is an accuracy/memory option, not a speed path",
+        "dot_tflops_bf16": dot_tfs(jnp.bfloat16, jnp.float32),
+        "dot_tflops_int8_i32": dot_tfs(jnp.int8, jnp.int32),
+        "prefill_model": "llama_250m", "batch": 16, "prompt_len": 2048,
+        "max_new": 8,
+        "w8_wall_s": round(pa["best"], 3),
+        "w8a8_wall_s": round(pb["best"], 3),
+        "w8a8_vs_w8": round(pa["best"] / pb["best"], 2),
+        "spread": max(pa["spread"], pb["spread"]),
+    }
+    del lparams, lq8
 
     # long-context decode on llama_250m: there the KV cache (~300MB at
     # B=8, S=2304) rivals the int8 weights in per-step HBM traffic, so the
@@ -510,6 +594,36 @@ def decode_bench() -> dict:
         "kv8_tokens_per_sec": round(8 * 256 / ka["best"]),
         "kv8_speedup": round(la["best"] / ka["best"], 2),
         "spread": max(la["spread"], ka["spread"]),
+    }
+    del lq, long_prompt
+
+    # ---- int8 EXPERT BANKS on the chip (VERDICT r3 weak #4): moe_1b
+    # decode A/B — the expert banks dominate the weight bytes (8 experts
+    # resident, 2 active per token), so w8 (which quantizes we1/we2/we3,
+    # ops/quant.MOE_EXPERT_KEYS) halves the decode loop's HBM reads the
+    # way it does for dense weights. Wall is ~1s+ (ratio-grade).
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.models.moe import init_params as moe_init
+    mcfg = MoEConfig.moe_1b()
+    mparams = moe_init(mcfg, jax.random.key(5))
+    mq = jax.jit(lambda p: quantize_params(p, "w8"))(mparams)
+    m_prompt = jax.random.randint(jax.random.key(6), (8, 128), 0,
+                                  mcfg.vocab_size, jnp.int32)
+
+    def m_dense():
+        jax.device_get(generate(mparams, m_prompt, mcfg, 256))
+
+    def m_w8():
+        jax.device_get(generate(mq, m_prompt, mcfg, 256))
+
+    m_dense(), m_w8()                           # compile both arms first
+    ma, mw = _ab_interleaved(m_dense, m_w8)
+    rec["moe_w8"] = {
+        "model": "moe_1b", "batch": 8, "prompt_len": 128, "max_new": 256,
+        "dense_tokens_per_sec": round(8 * 256 / ma["best"]),
+        "w8_tokens_per_sec": round(8 * 256 / mw["best"]),
+        "w8_speedup": round(ma["best"] / mw["best"], 2),
+        "spread": max(ma["spread"], mw["spread"]),
     }
     return rec
 
@@ -579,6 +693,95 @@ def serving_bench() -> dict:
         # chunking ratio are the features; absolutes remain RTT-colored
         "note": "absolute rates are tunnel-RTT-bound; ratios are the metric",
     }
+
+
+def host8b_bench() -> dict:
+    """The flagship serving record, driver-captured (VERDICT r3 weak #2):
+    llama3-8B on ONE 16GB v5e via the --host-load path — the bf16 tree
+    (16GB) is initialized on HOST and streamed per-leaf as int8 to the
+    chip (~8.6GB resident), then decode throughput is measured at B=1 and
+    B=8 plus one warm REST request through the real serve handler. Runs
+    LAST so the 8GB of weights never squeezes the other extras."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.infer import generate
+    from gpu_docker_api_tpu.models import family_for, named_config
+    from gpu_docker_api_tpu.ops.quant import quantize_params_streaming
+    from gpu_docker_api_tpu.workloads.serve import (_Server, _handler_for,
+                                                    _maybe_ungroup)
+
+    cfg = named_config("llama", "llama3_8b")
+    cpu = jax.devices("cpu")[0]
+    t0 = time.perf_counter()
+    with jax.default_device(cpu):
+        # structural init: the real shapes/dtypes (eval_shape of the
+        # family init) materialized as HOST zeros — matmul/attention
+        # timing does not depend on weight VALUES, and the real random
+        # init costs ~13 min of CPU (measured) the bench must not spend.
+        # serve.py --host-load keeps the real init/restore; the streaming
+        # path below is byte-for-byte the production one.
+        import numpy as np
+        tree = jax.eval_shape(
+            lambda: family_for(cfg).init_params(cfg, jax.random.key(0)))
+        host = jax.tree.map(
+            lambda sd: jnp.asarray(np.zeros(sd.shape, sd.dtype)), tree)
+    init_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    params = quantize_params_streaming(_maybe_ungroup(host, cfg), "w8",
+                                       device=jax.devices()[0])
+    jax.block_until_ready(params)
+    del host
+    stream_s = time.perf_counter() - t0
+    log(f"8b host init {init_s:.0f}s, int8 stream-to-chip {stream_s:.0f}s")
+
+    rec: dict = {
+        "model": "llama3_8b", "quantize": "w8", "prompt_len": 128,
+        "host_init_s": round(init_s, 1),
+        "int8_stream_to_chip_s": round(stream_s, 1),
+    }
+    max_new = 64
+    for batch, key in ((1, "b1"), (8, "b8")):
+        prompt = jax.random.randint(jax.random.key(batch), (batch, 128), 0,
+                                    cfg.vocab_size, jnp.int32)
+        t0 = time.perf_counter()
+        jax.device_get(generate(params, prompt, cfg, max_new))
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_get(generate(params, prompt, cfg, max_new))
+            best = min(best, time.perf_counter() - t0)
+        rec[key] = {
+            "batch": batch, "max_new": max_new,
+            "tokens_per_sec": round(batch * max_new / best, 1),
+            "wall_s": round(best, 2), "compile_s": round(compile_s, 1),
+        }
+
+    # warm REST request through the real serve handler (what a client of
+    # BASELINE config 5 feels): first request pays the (1,128,32) compile,
+    # the timed second is the warm path
+    srv = _Server(cfg, params)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _handler_for(srv, "8b"))
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        port = httpd.server_address[1]
+        body = {"tokens": [[7] * 128], "max_new": 32}
+        call(port, "POST", "/generate", body)             # compile + warm
+        t0 = time.perf_counter()
+        out = call(port, "POST", "/generate", body)
+        rest_s = time.perf_counter() - t0
+        assert len(out["tokens"][0]) == 32   # generate returns new tokens
+        rec["warm_rest_s_32tok"] = round(rest_s, 2)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        th.join(timeout=10)
+    return rec
 
 
 def store_bench() -> dict:
@@ -653,6 +856,50 @@ def scheduling_bench() -> dict:
         app.stop()
 
 
+def check_claims(extra: dict) -> dict:
+    """Diff this run's extras against BASELINE.json's machine-readable
+    claims table (the same numbers BASELINE.md publishes). Any ratio
+    drifting >tol from its claim is flagged LOUDLY — on stderr and in the
+    record — so a headline the driver can't reproduce cannot rot in the
+    docs unnoticed again (the round-3 2.37x lesson)."""
+    try:
+        claims = json.loads(
+            open(os.path.join(REPO, "BASELINE.json")).read()).get(
+                "claims", {})
+    except (OSError, json.JSONDecodeError) as e:
+        return {"error": f"claims table unreadable: {e}"}
+    checked, failed, missing = [], [], []
+    for path, spec in claims.items():
+        if path.startswith("_"):
+            continue
+        node = extra
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if not isinstance(node, (int, float)):
+            missing.append(path)
+            continue
+        drift = abs(node / spec["value"] - 1.0)
+        row = {"path": path, "claim": spec["value"],
+               "measured": node, "drift": round(drift, 3)}
+        checked.append(row)
+        if drift > spec.get("tol", 0.2):
+            failed.append(row)
+    for row in failed:
+        log(f"CLAIM DRIFT >{row['drift']:.0%}: {row['path']} claimed "
+            f"{row['claim']}, measured {row['measured']} — BASELINE.md "
+            f"must be updated to the reproduced value")
+    if failed:
+        log("=" * 66)
+        log(f"CLAIMS CHECK FAILED: {len(failed)}/{len(checked)} claims "
+            "outside tolerance (see rows above)")
+        log("=" * 66)
+    return {"checked": len(checked), "ok": not failed,
+            "failed": failed, "unmeasured": missing}
+
+
 # ---- headline ---------------------------------------------------------------
 
 def prior_round_value(platform: str) -> float | None:
@@ -720,6 +967,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — never kill the headline
             log(f"on-chip extras failed: {type(e).__name__}: {e}")
             extra["error"] = f"{type(e).__name__}: {e}"
+        try:
+            # last: its 8.6GB of weights must not squeeze the other extras
+            log("8B host-load serving record (init+stream takes minutes)...")
+            extra["host8b"] = host8b_bench()
+        except Exception as e:  # noqa: BLE001
+            log(f"host8b bench failed: {type(e).__name__}: {e}")
+            extra["host8b"] = {"error": f"{type(e).__name__}: {e}"}
+        extra["claims"] = check_claims(extra)
     else:
         log(f"platform is {platform}; skipping on-chip extras")
 
@@ -733,6 +988,36 @@ def main() -> None:
         "platform": platform,
         "extra": extra,
     }))
+
+    # compact headline as the FINAL stdout line: the driver keeps only a
+    # 2,000-char tail, which the full record overflows (BENCH_r03's tail
+    # started mid-record and parsed as null) — this line always carries
+    # the p50, the platform, and the top ratios, and is itself the
+    # required one-JSON-line shape
+    def _dig(*path, default=None):
+        node: object = extra
+        for p in path:
+            if not isinstance(node, dict) or p not in node:
+                return default
+            node = node[p]
+        return node
+    summary = {
+        "metric": "replicaSet p50 cold-start->first-XLA-step",
+        "value": round(p50, 3), "unit": "s",
+        "vs_baseline": round(vs, 3), "platform": platform,
+        "summary": {
+            "mfu_1b": _dig("train", "1b", "mfu"),
+            "flash_speedup_s2048": _dig("attention_fwd", "s2048", "speedup"),
+            "w8_speedup": _dig("decode", "w8", "w8_speedup"),
+            "decode_chunk_speedup": _dig("serving", "decode_chunk_speedup"),
+            "host8b_b1_tok_s": _dig("host8b", "b1", "tokens_per_sec"),
+            "host8b_b8_tok_s": _dig("host8b", "b8", "tokens_per_sec"),
+            "host8b_warm_rest_s": _dig("host8b", "warm_rest_s_32tok"),
+            "claims_ok": _dig("claims", "ok"),
+            "claims_failed": len(_dig("claims", "failed", default=[]) or []),
+        },
+    }
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

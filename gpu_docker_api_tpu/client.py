@@ -1,0 +1,197 @@
+"""Spec-generated typed client.
+
+The reference distributes its OpenAPI document for client generation; this
+module IS that generator, in-process: `ApiClient` builds one method per
+`operationId` from the served (or on-disk) api/openapi.json — request bodies
+are validated against the spec's schemas BEFORE anything hits the wire, path
+parameters are typed, and app-level envelope errors raise `ApiError` with
+the code table's name. tests/test_openapi.py drives the live server with it,
+which keeps the generated document honest: a schema that drifts from the
+handlers fails the client smoke test.
+
+Usage:
+    c = ApiClient("127.0.0.1", 2378)         # fetches /openapi.json
+    c.runReplicaSet(body={"imageName": "python", "replicaSetName": "t"})
+    c.getReplicaSet(name="t")
+    c.deleteReplicaSet(name="t")
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import re
+from typing import Any, Optional
+
+
+class ApiError(RuntimeError):
+    """App-level envelope error (code != 200)."""
+
+    def __init__(self, code: int, msg: str, op: str):
+        super().__init__(f"{op}: code {code} ({msg})")
+        self.code = code
+        self.msg = msg
+
+
+class SchemaError(ValueError):
+    """Request body rejected by the spec BEFORE sending."""
+
+
+def _resolve(spec: dict, schema: dict) -> dict:
+    while "$ref" in schema:
+        name = schema["$ref"].rsplit("/", 1)[-1]
+        schema = spec["components"]["schemas"][name]
+    return schema
+
+
+def validate(spec: dict, schema: dict, value: Any, path: str = "$") -> None:
+    """Minimal JSON-Schema subset validator covering what the generated
+    document uses: type, required, properties, additionalProperties,
+    items, $ref, allOf, nullable, enum, minimum. Raises SchemaError with
+    the JSON path of the first violation."""
+    schema = _resolve(spec, schema)
+    if value is None:
+        if schema.get("nullable") or not schema.get("type"):
+            return
+        raise SchemaError(f"{path}: null not allowed")
+    for sub in schema.get("allOf", []):
+        validate(spec, sub, value, path)
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(value, dict):
+            raise SchemaError(f"{path}: expected object, got "
+                              f"{type(value).__name__}")
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in value:
+                raise SchemaError(f"{path}: missing required '{req}'")
+        extra = schema.get("additionalProperties")
+        for k, v in value.items():
+            if k in props:
+                validate(spec, props[k], v, f"{path}.{k}")
+            elif isinstance(extra, dict):
+                validate(spec, extra, v, f"{path}.{k}")
+            elif extra is False:
+                raise SchemaError(f"{path}: unknown field '{k}'")
+    elif t == "array":
+        if not isinstance(value, list):
+            raise SchemaError(f"{path}: expected array")
+        for idx, v in enumerate(value):
+            validate(spec, schema.get("items", {}), v, f"{path}[{idx}]")
+    elif t == "string":
+        if not isinstance(value, str):
+            raise SchemaError(f"{path}: expected string")
+    elif t == "integer":
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise SchemaError(f"{path}: expected integer")
+        if "minimum" in schema and value < schema["minimum"]:
+            raise SchemaError(f"{path}: {value} < minimum "
+                              f"{schema['minimum']}")
+    elif t == "number":
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            raise SchemaError(f"{path}: expected number")
+    elif t == "boolean":
+        if not isinstance(value, bool):
+            raise SchemaError(f"{path}: expected boolean")
+    if "enum" in schema and value not in schema["enum"]:
+        raise SchemaError(f"{path}: {value!r} not in {schema['enum']}")
+
+
+class ApiClient:
+    """One method per operationId, generated from the spec at init."""
+
+    def __init__(self, host: str, port: int,
+                 spec: Optional[dict] = None, api_key: str = "",
+                 timeout: float = 60.0):
+        self.host, self.port = host, port
+        self.api_key = api_key
+        self.timeout = timeout
+        if spec is None:
+            spec = json.loads(self._raw("GET", "/openapi.json"))
+        self.spec = spec
+        self.operations: dict[str, dict] = {}
+        for path, methods in spec["paths"].items():
+            for method, op in methods.items():
+                if method not in ("get", "post", "patch", "delete", "put"):
+                    continue
+                self.operations[op["operationId"]] = {
+                    "method": method.upper(), "path": path, "op": op}
+
+    def __getattr__(self, name: str):
+        ops = self.__dict__.get("operations") or {}
+        if name not in ops:
+            raise AttributeError(
+                f"no operation {name!r}; spec defines: "
+                f"{', '.join(sorted(ops))}")
+        entry = ops[name]
+
+        def call(body: Any = None, **params):
+            return self._invoke(name, entry, body, params)
+        call.__name__ = name
+        call.__doc__ = entry["op"].get("summary", "")
+        return call
+
+    # ---- wire ----
+
+    def _raw(self, method: str, path: str, payload: bytes | None = None,
+             content_type: str = "application/json") -> bytes:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            headers = {"Content-Type": content_type}
+            if self.api_key:
+                headers["Authorization"] = f"Bearer {self.api_key}"
+            conn.request(method, path, payload, headers)
+            return conn.getresponse().read()
+        finally:
+            conn.close()
+
+    def _invoke(self, op_id: str, entry: dict, body: Any,
+                params: dict) -> Any:
+        op = entry["op"]
+        path = entry["path"]
+        query = []
+        for p in op.get("parameters", []):
+            val = params.pop(p["name"], None)
+            if p.get("required") and val is None:
+                raise SchemaError(f"{op_id}: missing path parameter "
+                                  f"'{p['name']}'")
+            if val is None:
+                continue
+            validate(self.spec, p.get("schema", {}), val,
+                     f"${{{p['name']}}}")
+            if p["in"] == "path":
+                path = path.replace("{" + p["name"] + "}", str(val))
+            elif p.get("schema", {}).get("type") == "boolean":
+                # flag params are PRESENCE-based server-side
+                # (http.query_flag): sending 'x=False' would read as set
+                if val:
+                    query.append(p["name"])
+            else:
+                query.append(f"{p['name']}={val}")
+        if params:
+            raise SchemaError(f"{op_id}: unknown parameters "
+                              f"{sorted(params)}")
+        if re.search(r"\{[^}]+\}", path):
+            raise SchemaError(f"{op_id}: unresolved path params in {path}")
+        if query:
+            path += "?" + "&".join(query)
+        payload = None
+        rb = op.get("requestBody")
+        if rb is not None:
+            if body is None and rb.get("required"):
+                raise SchemaError(f"{op_id}: request body required")
+            if body is not None:
+                schema = rb["content"]["application/json"]["schema"]
+                validate(self.spec, schema, body, "body")
+                payload = json.dumps(body).encode()
+        elif body is not None:
+            raise SchemaError(f"{op_id} takes no request body")
+        raw = self._raw(entry["method"], path, payload)
+        ok = op["responses"].get("200", {})
+        if "application/json" not in ok.get("content", {}):
+            return raw                       # /metrics, /openapi.json
+        out = json.loads(raw)
+        if out.get("code") != 200:
+            raise ApiError(out.get("code", -1), out.get("msg", ""), op_id)
+        return out.get("data")

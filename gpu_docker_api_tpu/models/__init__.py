@@ -55,6 +55,7 @@ NAMED_CONFIGS = {
               "mistral_7b": _llama.LlamaConfig.mistral_7b},
     "moe": {"tiny": _moe.MoEConfig.tiny,
             "mini": _moe.MoEConfig.moe_mini,
+            "1b": _moe.MoEConfig.moe_1b,
             "mixtral_8x7b": _moe.MoEConfig.mixtral_8x7b},
 }
 

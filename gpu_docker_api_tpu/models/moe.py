@@ -87,6 +87,17 @@ class MoEConfig:
         return cls()
 
     @classmethod
+    def moe_1b(cls) -> "MoEConfig":
+        """~1.12B-param mixtral-style config — the largest sparse trainer
+        fitting one v5e's 16GB HBM (bf16 params + f32 AdamW moments +
+        dots remat at accum_steps=4), mirroring llama_1b's role in the
+        dense ladder. head_dim 128 keeps the flash path; top-2 of 8
+        experts -> ~376M active params/token."""
+        return cls(vocab_size=32000, d_model=1024, n_layers=16, n_heads=8,
+                   n_kv_heads=4, d_ff=2560, n_experts=8, top_k=2,
+                   max_seq_len=2048)
+
+    @classmethod
     def moe_mini(cls) -> "MoEConfig":
         """~100M-param 1-chip config, head_dim 128 for the flash path."""
         return cls(vocab_size=32000, d_model=512, n_layers=4, n_heads=4,

@@ -27,12 +27,15 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK = 128
-# Flash-vs-XLA crossovers, measured on the real v5e (round 3, interleaved
-# A/B arms over 64-call chains, repeated across fresh processes — the
-# BENCH_r02 "flash 0.59x at S=1024" that round 2 acted on was an artifact
-# of sequential min-of-3 through tunnel drift):
-# - forward: flash 1.19x at S=1024 (1.35 vs 1.61 ms), 2.37x at S=2048,
-#   3.35x at S=4096. Below 1024 is unmeasured — XLA stays the default.
+# Flash-vs-XLA crossovers, measured on the real v5e (interleaved A/B arms
+# over 64-call chains — the BENCH_r02 "flash 0.59x at S=1024" that round 2
+# acted on was an artifact of sequential min-of-3 through tunnel drift):
+# - forward (BENCH_r03, the driver's evidence of record): flash 1.21x at
+#   S=1024, 1.38x at S=2048, 3.64x at S=4096. The XLA arm's absolute wall
+#   swings up to ~1.5x BETWEEN processes (r02 measured 2.37x at S=2048 the
+#   same way), so only driver-captured ratios are quoted; bench.py diffs
+#   each fresh run against these claims and flags >20% drift. Below 1024
+#   is unmeasured — XLA stays the default.
 # - under grad (fwd+bwd): flash 1.23x at S=1024 (6.97 vs 8.58 ms/step,
 #   llama_mini B=8) and 1.84x at S=2048 (47.7 vs 87.7 ms, llama_250m) —
 #   the pallas backward avoids the [S, S] rematerialization XLA's bwd
@@ -656,6 +659,17 @@ def _pair_lse_banded(q, k_cur, v_cur, offset: int, window: int):
 FLASH_SINGLE_MAX_FWD = int(os.environ.get("TDAPI_FLASH_SINGLE_FWD", "8192"))
 FLASH_SINGLE_MAX_GRAD = int(os.environ.get("TDAPI_FLASH_SINGLE_GRAD", "4096"))
 FLASH_CHUNK_SEQ = int(os.environ.get("TDAPI_FLASH_CHUNK_SEQ", "2048"))
+# The decomposition's (q-chunk, kv-chunk) pairs all share one shape, so
+# they STACK along the kernel's batch axis: every diagonal pair runs as ONE
+# causal launch and the off-diagonal pairs run in a few big non-causal
+# launches (pow2-capped groups keep the program variety bounded at any S)
+# — 2048-long per-pair grids underfeed the launch pipeline (the round-3
+# one-pair-per-call ladder measured ~19% MFU on the attention term; 36
+# launches at S=16k), while a stacked launch is one grid of
+# pairs x heads x blocks. VMEM per kernel instance is unchanged (batch is
+# the outer grid axis); the only cost is materializing the gathered
+# q/k/v stacks, which is small next to the step's HBM traffic.
+FLASH_PAIR_STACK = int(os.environ.get("TDAPI_FLASH_PAIR_STACK", "32"))
 
 
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -676,7 +690,16 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     INSIDE the window run the plain flash pair; only the partially
     masked boundary chunk needs the banded einsum pair (the kernel has
     no offset-window mode); chunks wholly outside are SKIPPED —
-    O(S·window) compute, same as the single-call windowed kernel."""
+    O(S·window) compute, same as the single-call windowed kernel.
+
+    FULL-causal pairs are BATCHED: all n diagonal (qi, ki) pairs run as
+    one causal kernel launch stacked along the batch axis, and the
+    n(n-1)/2 unmasked past pairs run in ceil(P / FLASH_PAIR_STACK)
+    non-causal launches (pow2-capped group sizes bound program variety)
+    — at S=16k that is 36 launches -> ~3, with each launch a full
+    pairs x heads x blocks grid instead of a 2048-row sliver (the
+    round-3 one-pair-per-call ladder measured ~19% MFU on the attention
+    term)."""
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     b, s, h, d = q.shape
@@ -687,16 +710,62 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if s % chunk:
         raise ValueError(f"seq {s} not divisible by chunk {chunk}")
     n = s // chunk
+
+    def piece(x, i):
+        return x[:, i * chunk:(i + 1) * chunk]
+
+    if causal and not window:
+        # stacked-batch plan: one causal launch for the n diagonals...
+        qs = q.reshape(b, n, chunk, h, -1)
+        ks = k.reshape(b, n, chunk, k.shape[2], -1)
+        vs = v.reshape(b, n, chunk, v.shape[2], -1)
+
+        def stack(x, idx):          # [b, n, c, H, D] -> [len(idx)*b, c, H, D]
+            g = x[:, jnp.array(idx)]            # [b, P, c, H, D]
+            return g.swapaxes(0, 1).reshape(len(idx) * b, chunk,
+                                            x.shape[3], x.shape[4])
+
+        diag_o, diag_l = flash_attention_lse(
+            stack(qs, list(range(n))), stack(ks, list(range(n))),
+            stack(vs, list(range(n))), causal=True, interpret=interpret)
+        # ...and the past pairs in a few big non-causal launches
+        pairs = [(i, j) for i in range(n) for j in range(i)]
+        cap = max(FLASH_PAIR_STACK, 1)
+        sizes = [g for g in (cap, cap // 2, cap // 4, cap // 8, 4, 2, 1)
+                 if g >= 1]
+        past_o: dict = {}
+        past_l: dict = {}
+        pos = 0
+        while pos < len(pairs):
+            g = next(gg for gg in sizes if gg <= len(pairs) - pos)
+            grp = pairs[pos:pos + g]
+            pos += g
+            po, plse = flash_attention_lse(
+                stack(qs, [i for i, _ in grp]),
+                stack(ks, [j for _, j in grp]),
+                stack(vs, [j for _, j in grp]),
+                causal=False, interpret=interpret)
+            for t, (i, j) in enumerate(grp):
+                past_o[(i, j)] = po[t * b:(t + 1) * b]
+                past_l[(i, j)] = plse[t * b:(t + 1) * b]
+        out_chunks = []
+        for i in range(n):
+            outs = [past_o[(i, j)] for j in range(i)]
+            lses = [past_l[(i, j)] for j in range(i)]
+            outs.append(diag_o[i * b:(i + 1) * b])
+            lses.append(diag_l[i * b:(i + 1) * b])
+            out_chunks.append(merge_attention_partials(outs, lses))
+        return jnp.concatenate(out_chunks, axis=1)
+
     out_chunks = []
     for i in range(n):
-        qi = q[:, i * chunk:(i + 1) * chunk]
+        qi = piece(q, i)
         outs, lses = [], []
         for j in range(i + 1 if causal else n):
             offset = (i - j) * chunk
             if window and offset >= window + chunk - 1:
                 continue                      # wholly outside the window
-            kj = k[:, j * chunk:(j + 1) * chunk]
-            vj = v[:, j * chunk:(j + 1) * chunk]
+            kj, vj = piece(k, j), piece(v, j)
             if causal and j == i:
                 o, l = flash_attention_lse(qi, kj, vj, causal=True,
                                            window=window,
@@ -705,8 +774,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 # partially masked boundary chunk: offset band, einsum
                 o, l = _pair_lse_banded(qi, kj, vj, offset, window)
             else:
-                # past chunk wholly inside the window (or no window, or
-                # non-causal): full pair through the kernel
+                # past chunk wholly inside the window (or non-causal):
+                # full pair through the kernel
                 o, l = flash_attention_lse(qi, kj, vj, causal=False,
                                            interpret=interpret)
             outs.append(o)

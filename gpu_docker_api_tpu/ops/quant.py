@@ -9,9 +9,12 @@ Two modes, chosen per deployment (workloads/serve.py --quantize):
   factors out of the contraction). Decode is HBM-bandwidth-bound — halving
   weight bytes is the win that matters there.
 - "w8a8" — dynamic per-row activation quantization on top of w8: both
-  operands int8, accumulated in int32 on the MXU's int8 path (2x the bf16
-  peak on v5e/v6e), rescaled by (row_scale x col_scale). The compute-bound
-  prefill's mode.
+  operands int8, int32-accumulated, rescaled by (row_scale x col_scale).
+  An ACCURACY/MEMORY option, not a speed path on current v5e XLA: the
+  int8 x int8 -> int32 dot_general lowering measures ~30 TF/s vs ~72 TF/s
+  for the same-shape bf16 dot (the MXU's native int8 mode is not what the
+  lowering produces; bench.py extra.decode.w8a8 re-measures this every
+  round so the claim tracks the toolchain).
 
 Symmetric quantization (no zero point): scale = amax/127 over the
 contraction axis, per output channel — the standard recipe (e.g. AQT,

@@ -87,11 +87,19 @@ class Router:
 
     def __init__(self) -> None:
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        self._patterns: list[tuple[str, str]] = []
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         regex = re.compile(
             "^" + re.sub(r":([a-zA-Z_]+)", r"(?P<\1>[^/]+)", pattern) + "$")
         self._routes.append((method.upper(), regex, handler))
+        self._patterns.append((method.upper(), pattern))
+
+    def routes(self) -> list[tuple[str, str]]:
+        """(METHOD, original /path/with/:params) pairs — lets the OpenAPI
+        coverage test assert the document describes every registered
+        route."""
+        return list(self._patterns)
 
     def resolve(self, method: str, path: str):
         path_matched = False

@@ -1,0 +1,486 @@
+#!/usr/bin/env python3
+"""Generate api/openapi.json — the FULL-schema API document.
+
+The reference ships a 4,761-line generated OpenAPI file with complete
+request/response schemas per endpoint (reference
+api/gpu-docker-api-en.openapi.json); this repo's spec is generated too, from
+this script, so the document can't rot apart from the handlers: the schemas
+below mirror dtos.py (wire DTOs), services/replicaset.py `_run_response` /
+`get_container_info` / `get_container_history`, services/volume.py,
+schedulers/*.get_status, and events.py — each schema cites its source. A
+typed client can be generated from it (gpu_docker_api_tpu/client.py builds
+one at runtime and tests/test_openapi.py drives the live server with it).
+
+Run: python scripts/gen_openapi.py   (writes api/openapi.json)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def ref(name: str) -> dict:
+    return {"$ref": f"#/components/schemas/{name}"}
+
+
+def obj(props: dict, required: list | None = None, desc: str = "",
+        additional=None) -> dict:
+    out: dict = {"type": "object", "properties": props}
+    if required:
+        out["required"] = required
+    if desc:
+        out["description"] = desc
+    if additional is not None:
+        out["additionalProperties"] = additional
+    return out
+
+
+def arr(items: dict, desc: str = "") -> dict:
+    out: dict = {"type": "array", "items": items}
+    if desc:
+        out["description"] = desc
+    return out
+
+
+def s(desc: str = "", **kw) -> dict:
+    out: dict = {"type": "string"}
+    if desc:
+        out["description"] = desc
+    out.update(kw)
+    return out
+
+
+def i(desc: str = "", **kw) -> dict:
+    out: dict = {"type": "integer"}
+    if desc:
+        out["description"] = desc
+    out.update(kw)
+    return out
+
+
+def b(desc: str = "") -> dict:
+    out: dict = {"type": "boolean"}
+    if desc:
+        out["description"] = desc
+    return out
+
+
+def envelope(data_schema: dict | None, example_data=None,
+             desc: str = "") -> dict:
+    """Every endpoint answers HTTP 200 with the {code, msg, data} envelope;
+    app-level errors ride `code` (server/codes.py table)."""
+    data = data_schema if data_schema is not None else {"nullable": True}
+    schema = {
+        "allOf": [ref("Envelope"),
+                  {"type": "object", "properties": {"data": data}}]}
+    content: dict = {"schema": schema}
+    if example_data is not None:
+        content["example"] = {"code": 200, "msg": "Success",
+                              "data": example_data}
+    return {"200": {
+        "description": desc or "Envelope (code 200 on success; app error "
+                               "codes otherwise — see Envelope.code)",
+        "content": {"application/json": content}}}
+
+
+def op(op_id: str, summary: str, responses: dict, body: dict | None = None,
+       params: list | None = None, tags: list | None = None,
+       desc: str = "") -> dict:
+    out: dict = {"operationId": op_id, "summary": summary,
+                 "responses": responses}
+    if desc:
+        out["description"] = desc
+    if body is not None:
+        out["requestBody"] = {"required": True, "content": {
+            "application/json": {"schema": body}}}
+    if params:
+        out["parameters"] = params
+    if tags:
+        out["tags"] = tags
+    return out
+
+
+NAME_PARAM = {"name": "name", "in": "path", "required": True,
+              "schema": {"type": "string"},
+              "description": "replicaSet / volume base name (unversioned; "
+                             "must not contain '-')"}
+
+
+def build_codes_desc() -> str:
+    from gpu_docker_api_tpu.server.codes import ResCode
+    rows = [f"{c.value} {c.name}" for c in ResCode]
+    return ("Application status code (wire-compatible with the reference's "
+            "internal/routers/code.go table): " + "; ".join(rows))
+
+
+def build_spec() -> dict:
+    run_example = {
+        "imageName": "python", "replicaSetName": "train",
+        "tpuCount": 4, "cpuCount": 8, "memory": "16GB",
+        "binds": [{"src": "data-1", "dest": "/data"}],
+        "env": ["JAX_COMPILATION_CACHE_DIR=/tmp/jax-cache"],
+        "cmd": ["python", "-m",
+                "gpu_docker_api_tpu.workloads.train_llama"],
+        "containerPorts": ["8000"],
+    }
+    run_resp_example = {
+        "name": "train-1", "version": 1, "tpuChips": [0, 1, 2, 3],
+        "cpuset": "0-7", "portBindings": {"8000": 40001},
+    }
+    spec_example = {
+        "image": "python", "env": ["TPU_VISIBLE_CHIPS=0,1,2,3"],
+        "cmd": ["python", "-c", "import jax"],
+        "binds": ["data-1:/data"], "cpuset": "0-7", "cpu_count": 8,
+        "memory_bytes": 17179869184, "shm_bytes": 274877906944,
+        "rootfs_quota": "30G", "restart_policy": "unless-stopped",
+        "port_bindings": {"8000": 40001}, "tpu_chips": [0, 1, 2, 3],
+        "tpu_env": {"TPU_VISIBLE_CHIPS": "0,1,2,3"},
+        "devices": ["/dev/accel0"],
+    }
+
+    schemas = {
+        "Envelope": obj(
+            {"code": i(build_codes_desc()),
+             "msg": s("Human-readable status"),
+             "data": {"nullable": True,
+                      "description": "Operation payload (endpoint-specific; "
+                                     "null on errors and bare acks)"}},
+            required=["code", "msg"],
+            desc="Every endpoint answers HTTP 200 with this envelope "
+                 "(server/http.py); failures ride the `code` field."),
+        "Bind": obj(
+            {"src": s("Volume base name OR host path"),
+             "dest": s("Mount point inside the container")},
+            desc="Volume/host-dir mount (dtos.Bind; wire format of the "
+                 "reference models/container.go Bind)"),
+        "ContainerRun": obj(
+            {"imageName": s("Image to run (required)"),
+             "replicaSetName": s("Base name (required; no '-'; versions "
+                                 "are named {name}-{v})"),
+             "tpuCount": i("ICI-contiguous TPU chips to grant "
+                           "(gpuCount accepted as a legacy alias)",
+                           minimum=0),
+             "gpuCount": i("Legacy alias for tpuCount", minimum=0),
+             "cpuCount": i("CPU cores to pin (cpuset)", minimum=0),
+             "memory": s("Memory limit, e.g. '16GB' (units KB/MB/GB/TB)"),
+             "binds": arr(ref("Bind")),
+             "env": arr(s(), "KEY=VALUE environment entries"),
+             "cmd": arr(s(), "Container entrypoint command"),
+             "containerPorts": arr(s(), "Container ports; each gets a "
+                                        "host port from the port "
+                                        "scheduler")},
+            required=["imageName", "replicaSetName"],
+            desc="POST /api/v1/replicaSet body (dtos.ContainerRun; "
+                 "reference models/container.go ContainerRun)"),
+        "TpuPatch": obj({"tpuCount": i(minimum=0),
+                         "gpuCount": i("Legacy alias", minimum=0)}),
+        "CpuPatch": obj({"cpuCount": i(minimum=0)}),
+        "MemoryPatch": obj({"memory": s("e.g. '32GB'")}),
+        "VolumePatch": obj({"oldBind": ref("Bind"),
+                            "newBind": ref("Bind")}),
+        "PatchRequest": obj(
+            {"tpuPatch": ref("TpuPatch"), "gpuPatch": ref("TpuPatch"),
+             "cpuPatch": ref("CpuPatch"),
+             "memoryPatch": ref("MemoryPatch"),
+             "volumePatch": ref("VolumePatch")},
+            desc="PATCH /api/v1/replicaSet/{name} body (dtos.PatchRequest)"
+                 " — at least one sub-patch; rolling replacement creates "
+                 "version {name}-{v+1}"),
+        "RollbackRequest": obj({"version": i("Target version (>= 0)",
+                                             minimum=0)},
+                               required=["version"]),
+        "ContainerExecute": obj(
+            {"workDir": s("Working directory inside the container"),
+             "cmd": arr(s(), "Command to exec")},
+            desc="POST .../execute body (dtos.ContainerExecute)"),
+        "ContainerCommit": obj({"newImageName": s("required")},
+                               required=["newImageName"]),
+        "VolumeCreate": obj(
+            {"name": s("Base name (no '-', no leading '/')"),
+             "size": s("e.g. '20GB'; empty = unbounded"),
+             "tier": s("Storage tier ('' = default/local-SSD; e.g. 'nfs' "
+                       "when the operator configured one)")},
+            required=["name"],
+            desc="POST /api/v1/volumes body (dtos.VolumeCreate + tier)"),
+        "VolumeSize": obj({"size": s("New size, e.g. '40GB'")},
+                          required=["size"]),
+        "ContainerSpec": obj(
+            {"image": s(), "env": arr(s()), "cmd": arr(s()),
+             "binds": arr(s(), "'src:dest' strings"),
+             "cpuset": s("Pinned cores, e.g. '0-7'"),
+             "cpu_count": i(), "memory_bytes": i(), "shm_bytes": i(),
+             "rootfs_quota": s(), "restart_policy": s(),
+             "port_bindings": obj({}, additional=i(),
+                                  desc="containerPort -> hostPort"),
+             "tpu_chips": arr(i(), "Granted global chip indices"),
+             "tpu_env": obj({}, additional=s(),
+                            desc="TPU env injected into the container "
+                                 "(TPU_VISIBLE_CHIPS etc.)"),
+             "devices": arr(s(), "/dev/accel* passthrough")},
+            desc="Substrate-facing creation spec (dtos.ContainerSpec; the "
+                 "reference stores docker Config+HostConfig here)"),
+        "StoredContainerInfo": obj(
+            {"version": i(), "createTime": s(),
+             "containerName": s("Versioned name {rs}-{version}"),
+             "spec": ref("ContainerSpec"),
+             "resourcesReleased": b("Whether the grants were returned to "
+                                    "the pool (stop sets this)")},
+            desc="Persisted container version (dtos.StoredContainerInfo; "
+                 "reference EtcdContainerInfo)"),
+        "StoredVolumeInfo": obj(
+            {"version": i(), "createTime": s(),
+             "volumeName": s("Versioned name {name}-{version}"),
+             "size": s(), "tier": s()},
+            desc="Persisted volume version (dtos.StoredVolumeInfo)"),
+        "RunResponse": obj(
+            {"name": s("Versioned container name"), "version": i(),
+             "tpuChips": arr(i()), "cpuset": s(),
+             "portBindings": obj({}, additional=i())},
+            desc="run/patch/rollback/restart payload "
+                 "(services/replicaset.py _run_response)"),
+        "ExecuteResponse": obj({"output": s("Captured stdout+stderr")}),
+        "CommitResponse": obj({"imageId": s(), "imageName": s()}),
+        "ContainerInfo": obj(
+            {"version": i(), "createTime": s(), "containerName": s(),
+             "running": b(), "paused": b(), "resourcesReleased": b(),
+             "spec": ref("ContainerSpec"),
+             "multihost": obj(
+                 {}, additional=obj({}, additional=s()),
+                 desc="Present when the grant spans TPU-VM hosts: "
+                      "workerId -> env the worker's container needs so "
+                      "the libtpu processes form one slice "
+                      "(topology.multihost_env)")},
+            desc="GET replicaSet info payload "
+                 "(services/replicaset.py get_container_info)"),
+        "ContainerHistoryItem": obj(
+            {"version": i(), "createTime": s(),
+             "status": ref("StoredContainerInfo")}),
+        "VolumeCreateResponse": obj(
+            {"name": s("Versioned volume name"), "version": i(),
+             "mountpoint": s(), "size": s()}),
+        "VolumeInfo": obj(
+            {"version": i(), "createTime": s(), "volumeName": s(),
+             "size": s(), "tier": s(), "mountpoint": s(),
+             "usedBytes": i()},
+            desc="GET volume info payload (services/volume.py)"),
+        "VolumeHistoryItem": obj(
+            {"version": i(), "createTime": s(),
+             "status": ref("StoredVolumeInfo")}),
+        "TpuChip": obj(
+            {"index": i("Global chip index"), "id": s(),
+             "device": s("/dev/accel* path"),
+             "coord": arr(i(), "ICI mesh coordinate"),
+             "used": b(), "owner": s("Granting replicaSet ('' = free)")}),
+        "TpuTopology": obj(
+            {"acceleratorType": s("e.g. 'v5p-8'"), "generation": s(),
+             "shape": arr(i(), "ICI mesh shape"), "wraparound": b(),
+             "workerId": i(), "numWorkers": i(), "chipsPerHost": i(),
+             "iciConnected": b()},
+            desc="topology.Topology.serialize()"),
+        "TpuStatus": obj(
+            {"topology": ref("TpuTopology"), "chips": arr(ref("TpuChip")),
+             "freeCount": i()},
+            desc="GET /resources/tpus payload (schedulers/tpu.py "
+                 "get_status; reference GetGpuStatus)"),
+        "CpuStatus": obj(
+            {"totalCount": i(), "usedCount": i(),
+             "usedCores": arr(i())}),
+        "PortStatus": obj(
+            {"range": arr(i(), "[start, end]"), "availableCount": i(),
+             "usedPortSet": arr(i())}),
+        "Event": obj(
+            {"ts": {"type": "number", "description": "Unix seconds"},
+             "op": s("Operation, e.g. 'replicaSet.run'"),
+             "target": s(), "code": i("App code the op returned"),
+             "durationMs": {"type": "number"}, "requestId": s()},
+            desc="Operation event (events.py record)"),
+    }
+
+    v1 = "/api/v1"
+    paths = {
+        "/ping": {"get": op(
+            "ping", "Health check", envelope(None, None), tags=["meta"])},
+        f"{v1}/replicaSet": {"post": op(
+            "runReplicaSet",
+            "Create + start a container under a new replicaSet",
+            envelope(ref("RunResponse"), run_resp_example),
+            body=ref("ContainerRun"), tags=["replicaSet"],
+            desc="Grants tpuCount ICI-contiguous chips, cpuCount cores, "
+                 "and one host port per containerPort, then starts "
+                 "version 1 ({name}-1) on the substrate. App errors: "
+                 "1001 exists, 1013/1014/1015 not enough "
+                 "tpu/cpu/port.")},
+        f"{v1}/replicaSet/{{name}}": {
+            "get": op("getReplicaSet", "Current-version info",
+                      envelope(obj({"info": ref("ContainerInfo")})),
+                      params=[NAME_PARAM], tags=["replicaSet"]),
+            "patch": op(
+                "patchReplicaSet",
+                "Lift TPU/CPU/memory/volume config via rolling "
+                "replacement",
+                envelope(ref("RunResponse"), run_resp_example),
+                body=ref("PatchRequest"), params=[NAME_PARAM],
+                tags=["replicaSet"],
+                desc="Creates version {name}-{v+1}; the writable layer is "
+                     "copied; the old container stops BEFORE the new one "
+                     "starts (TPU chips are exclusive). A tpuPatch "
+                     "prefers sub-meshes containing the current grant."),
+            "delete": op("deleteReplicaSet",
+                         "Stop, release grants, delete all versions",
+                         envelope(None), params=[NAME_PARAM],
+                         tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/rollback": {"patch": op(
+            "rollbackReplicaSet", "Roll back to a stored version",
+            envelope(ref("RunResponse"), run_resp_example),
+            body=ref("RollbackRequest"), params=[NAME_PARAM],
+            tags=["replicaSet"],
+            desc="Re-runs the stored spec as a NEW version (the reference "
+                 "semantics: rollback is re-create, so history stays "
+                 "append-only)")},
+        f"{v1}/replicaSet/{{name}}/stop": {"patch": op(
+            "stopReplicaSet", "Stop + release chip/core/port grants",
+            envelope(None), params=[NAME_PARAM], tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/restart": {"patch": op(
+            "restartReplicaSet", "Restart (re-grants released resources)",
+            envelope(ref("RunResponse"), run_resp_example),
+            params=[NAME_PARAM], tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/pause": {"patch": op(
+            "pauseReplicaSet", "SIGSTOP the container processes",
+            envelope(None), params=[NAME_PARAM], tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/continue": {"patch": op(
+            "continueReplicaSet", "SIGCONT after pause",
+            envelope(None), params=[NAME_PARAM], tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/execute": {"post": op(
+            "executeReplicaSet", "Exec a command inside the container",
+            envelope(ref("ExecuteResponse"), {"output": "hello\n"}),
+            body=ref("ContainerExecute"), params=[NAME_PARAM],
+            tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/commit": {"post": op(
+            "commitReplicaSet", "Commit the container to a new image",
+            envelope(ref("CommitResponse")),
+            body=ref("ContainerCommit"), params=[NAME_PARAM],
+            tags=["replicaSet"])},
+        f"{v1}/replicaSet/{{name}}/history": {"get": op(
+            "replicaSetHistory", "All stored versions, newest first",
+            envelope(obj({"history": arr(ref("ContainerHistoryItem"))})),
+            params=[NAME_PARAM], tags=["replicaSet"])},
+        f"{v1}/volumes": {"post": op(
+            "createVolume", "Create a versioned volume",
+            envelope(ref("VolumeCreateResponse"),
+                     {"name": "data-1", "version": 1,
+                      "mountpoint": "/var/lib/tdapi/volumes/data-1",
+                      "size": "20GB"}),
+            body=ref("VolumeCreate"), tags=["volume"])},
+        f"{v1}/volumes/{{name}}": {
+            "get": op("getVolume", "Current-version info",
+                      envelope(obj({"info": ref("VolumeInfo")})),
+                      params=[NAME_PARAM], tags=["volume"]),
+            "delete": op(
+                "deleteVolume", "Delete the volume",
+                envelope(None),
+                params=[NAME_PARAM,
+                        {"name": "noall", "in": "query", "required": False,
+                         "schema": {"type": "boolean"},
+                         "description": "Keep history versions; delete "
+                                        "only the current one"}],
+                tags=["volume"])},
+        f"{v1}/volumes/{{name}}/size": {"patch": op(
+            "patchVolumeSize",
+            "Scale the volume (new version; data migrated; shrink "
+            "guarded by used bytes)",
+            envelope(ref("VolumeCreateResponse")),
+            body=ref("VolumeSize"), params=[NAME_PARAM], tags=["volume"])},
+        f"{v1}/volumes/{{name}}/history": {"get": op(
+            "volumeHistory", "All stored versions, newest first",
+            envelope(obj({"history": arr(ref("VolumeHistoryItem"))})),
+            params=[NAME_PARAM], tags=["volume"])},
+        f"{v1}/resources/tpus": {"get": op(
+            "resourceTpus", "Chip inventory + ICI topology",
+            envelope(obj({"tpus": ref("TpuStatus")})), tags=["resource"])},
+        f"{v1}/resources/gpus": {"get": op(
+            "resourceGpus", "Legacy alias of /resources/tpus",
+            envelope(obj({"tpus": ref("TpuStatus")})), tags=["resource"])},
+        f"{v1}/resources/cpus": {"get": op(
+            "resourceCpus", "Core inventory",
+            envelope(obj({"cpus": ref("CpuStatus")})), tags=["resource"])},
+        f"{v1}/resources/ports": {"get": op(
+            "resourcePorts", "Host-port pool",
+            envelope(obj({"ports": ref("PortStatus")})),
+            tags=["resource"])},
+        f"{v1}/events": {"get": op(
+            "events", "Recent operation events (bounded ring)",
+            envelope(obj({"events": arr(ref("Event"))})),
+            params=[{"name": "limit", "in": "query", "required": False,
+                     "schema": {"type": "integer", "minimum": 0}},
+                    {"name": "target", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description": "Filter by event target name"}],
+            tags=["meta"])},
+        "/metrics": {"get": op(
+            "metrics", "Prometheus text exposition",
+            {"200": {"description": "text/plain; version=0.0.4",
+                     "content": {"text/plain": {
+                         "schema": {"type": "string"}}}}},
+            tags=["meta"])},
+        "/openapi.json": {"get": op(
+            "openapi", "This document",
+            {"200": {"description": "OpenAPI 3.0 JSON",
+                     "content": {"application/json": {
+                         "schema": {"type": "object"}}}}},
+            tags=["meta"])},
+    }
+
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "tpu-docker-api",
+            "version": "0.4.0",
+            "description":
+                "TPU-native container-orchestration REST API. Same "
+                "surface as gpu-docker-api (reference "
+                "api/gpu-docker-api-en.openapi.json) with the NVIDIA "
+                "substrate replaced by an ICI-topology-aware TPU chip "
+                "allocator. Every response is HTTP 200 with an envelope "
+                "{code, msg, data}. Authentication: optional static "
+                "bearer token (APIKEY env) via the Authorization header; "
+                "403 envelope when it mismatches. Generated by "
+                "scripts/gen_openapi.py — do not edit by hand.",
+        },
+        "servers": [{"url": "http://localhost:2378"}],
+        "tags": [{"name": "replicaSet"}, {"name": "volume"},
+                 {"name": "resource"}, {"name": "meta"}],
+        "security": [{"bearer": []}],
+        "paths": paths,
+        "components": {
+            "securitySchemes": {
+                "bearer": {"type": "http", "scheme": "bearer",
+                           "description": "Static APIKEY; no-op when the "
+                                          "server runs without one"}},
+            "schemas": schemas,
+        },
+    }
+
+
+def main() -> None:
+    spec = build_spec()
+    # optional output override keeps CHECKS side-effect free (the
+    # regeneration test writes to a temp path and diffs)
+    out = (sys.argv[1] if len(sys.argv) > 1
+           else os.path.join(REPO, "api", "openapi.json"))
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(spec, f, indent=1, sort_keys=False)
+        f.write("\n")
+    n_paths = len(spec["paths"])
+    n_ops = sum(len(v) for v in spec["paths"].values())
+    n_schemas = len(spec["components"]["schemas"])
+    print(f"wrote {out}: {n_paths} paths, {n_ops} operations, "
+          f"{n_schemas} schemas")
+
+
+if __name__ == "__main__":
+    main()
