@@ -239,6 +239,53 @@ paged_decode_multi = make_decode_multi(_paged_decode_core)
 paged_decode_pick = make_decode_pick(_paged_decode_core)
 
 
+def _paged_verify_core(params, blocks, cache, active, config):
+    """Multi-token forward at each row's OWN frontier over the PAGED
+    pool — the paged twin of batching._slot_verify_core, and the kernel
+    that lets speculative decoding compose with the paged cache: blocks
+    [slots, T] append T tokens per row starting at that row's length,
+    with writes scattering through the page table at (block, offset)
+    pairs — a row's T positions may SPAN block boundaries; _paged_write's
+    per-position page lookup handles the split with no host logic.
+
+    The batcher guarantees the page table covers every written position
+    (admission reserves gamma extra positions of block budget per
+    request — the verify overshoot before rollback), so no active row's
+    write ever falls through to the scratch block; without that
+    guarantee two rows' overshoots would collide in scratch and corrupt
+    each other's verify logits. Inactive rows write junk to scratch and
+    do not advance. Returns (logits [slots, T, V] f32, cache)."""
+    c = _llama_view(config)
+    pos = cache["lengths"]                                  # [slots]
+    slots, t = blocks.shape
+    x = jnp.take(params["embed"], blocks, axis=0)           # [slots,T,D]
+    rows = pos[:, None] + jnp.arange(t)                     # [slots, T]
+    cos, sin = rope_frequencies(c, rows.reshape(-1))
+    cos = cos.reshape(slots, t, -1)
+    sin = sin.reshape(slots, t, -1)
+    bufs = _buf_keys(cache)
+
+    def body(x, scanned):
+        layer, *pools = scanned
+        x, *pools = _paged_layer_step(x, layer, *pools[:2],
+                                      cache["pages"], pos, config,
+                                      cos, sin, *pools[2:], active=active)
+        return x, tuple(pools)
+
+    x, pools_out = jax.lax.scan(
+        body, x, (params["layers"],) + tuple(cache[kk] for kk in bufs))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    out = dict(zip(bufs, pools_out))
+    out["pages"] = cache["pages"]
+    out["lengths"] = pos + t * active.astype(jnp.int32)
+    return logits, out
+
+
+paged_verify = jax.jit(_paged_verify_core,
+                       static_argnames=("config",), donate_argnums=(2,))
+
+
 class BlockAllocator:
     """Host-side REFCOUNTED free-list over the pool's blocks (block 0 =
     scratch, never handed out). The batcher's admission control: a

@@ -105,15 +105,26 @@ class _Batcher:
         # overshoot a row's budget before its rollback.
         self._draft = draft                  # (draft_config, draft_params)
         self.gamma = int(gamma)
-        if draft is not None and kv_block > 0:
-            raise ValueError(
-                "--draft-config composes with the DENSE slot cache; the "
-                "paged cache (--kv-block) needs a block-aware multi-token "
-                "verify — drop --kv-block or --draft-config")
         if draft is not None and draft[0].vocab_size != config.vocab_size:
             raise ValueError("draft and target must share a vocab")
         self._cache_len = max_len + (self.gamma + 1 if draft else 0)
+        # paged x speculative: the verify step writes gamma+1 tokens
+        # starting AT a row's frontier before its rollback, and a row's
+        # frontier tops out at prompt+max_new-2 (the arm token is never
+        # cache-resident when its round runs) — so written positions
+        # top out at prompt+max_new+gamma-2, inside a reservation of
+        # prompt+max_new+gamma positions (one spare, matching the dense
+        # path's gamma+1 convention). Admission reserves that budget
+        # (spec_pad extra tokens) UP FRONT: rollback stays
+        # pure length arithmetic (the over-written blocks are the row's
+        # own, reserved, and simply re-written by the next round), no
+        # mid-stream block alloc can deadlock, and no active row's
+        # verify write ever falls through the page table to the shared
+        # scratch block (where concurrent rows' overshoots would corrupt
+        # each other's verify logits).
+        self._spec_pad = self.gamma if draft else 0
         self.spec_rounds = 0                 # spec telemetry (healthz/bench)
+        self.spec_proposed = 0               # draft tokens proposed
         self.spec_accepted = 0               # draft tokens accepted
         self.spec_emitted = 0                # tokens emitted by spec rounds
         # > 1: when nothing is waiting to join, decode up to this many
@@ -139,7 +150,7 @@ class _Batcher:
         self._paged = kv_block > 0
         self.kv_block = kv_block
         if self._paged:
-            self._max_pages = -(-max_len // kv_block)
+            self._max_pages = -(-(max_len + self._spec_pad) // kv_block)
             self.kv_pool_blocks = (kv_pool_blocks
                                    or 1 + slots * self._max_pages)
         else:
@@ -222,6 +233,17 @@ class _Batcher:
         from ..batching import slot_decode_multi
         return slot_decode_multi
 
+    def _fn_verify(self):
+        """TARGET-side speculative verify (the draft always runs a dense
+        slot cache: it is the small model — paging the TARGET's KV is
+        the HBM win, and one allocator per batcher keeps admission
+        single-source-of-truth)."""
+        if self._paged:
+            from ..paging import paged_verify
+            return paged_verify
+        from ..batching import slot_verify
+        return slot_verify
+
     def _release_slot(self, i: int) -> None:
         """Free a slot AND (paged) return its blocks to the pool."""
         self.slots[i] = None
@@ -261,7 +283,8 @@ class _Batcher:
                 f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "
                 f"the batcher's max_len {self.max_len}")
         if self._paged:
-            needed = -(-(prompt_row.shape[0] + max_new) // self.kv_block)
+            needed = -(-(prompt_row.shape[0] + max_new + self._spec_pad)
+                       // self.kv_block)
             if needed > self.kv_pool_blocks - 1:    # block 0 is scratch
                 raise ValueError(
                     f"request needs {needed} KV blocks but the pool only "
@@ -388,7 +411,8 @@ class _Batcher:
                     # of the entry we share from) then can't return these
                     # blocks to the free list under us
                     self._alloc.share(shared)
-                total = -(-(prompt_len + item["max_new"]) // self.kv_block)
+                total = -(-(prompt_len + item["max_new"] + self._spec_pad)
+                          // self.kv_block)
                 blocks = self._alloc.alloc(total - len(shared))
                 # pool pressure: stored prefixes are a CACHE, not a
                 # reservation — evict LRU entries until the request fits
@@ -740,8 +764,8 @@ class _Batcher:
         import jax.numpy as jnp
 
         from ..batching import (rowwise_spec_accept, slot_decode,
-                                slot_spec_draft, slot_verify,
-                                spec_accept_greedy)
+                                slot_spec_draft, spec_accept_greedy)
+        slot_verify = self._fn_verify()        # dense or paged target
         dcfg, dparams = self._draft
         g = self.gamma
         act = jnp.array(active)
@@ -788,6 +812,7 @@ class _Batcher:
                        s["max_new"] - len(s["stream"]))
             s["stream"].extend(int(t) for t in emit_host[i, :take])
             s["last"] = s["stream"][-1]
+            self.spec_proposed += g
             self.spec_accepted += int(a_host[i])
             self.spec_emitted += take
             if len(s["stream"]) >= s["max_new"]:
@@ -994,11 +1019,16 @@ def _handler_for(srv: _Server, model_name: str):
                         data["batching"]["speculative"] = {
                             "gamma": b.gamma,
                             "rounds": b.spec_rounds,
+                            "proposed": b.spec_proposed,
                             "accepted": b.spec_accepted,
                             "emitted": b.spec_emitted,
+                            # fraction of PROPOSED draft tokens accepted
+                            # (a round proposes gamma per ACTIVE row, so
+                            # rounds*gamma under-counts the denominator
+                            # whenever >1 row is active)
                             "acceptRate": round(
                                 b.spec_accepted
-                                / max(b.spec_rounds * b.gamma, 1), 3),
+                                / max(b.spec_proposed, 1), 3),
                         }
                     if b._paged:
                         data["batching"]["paged"] = {
@@ -1255,7 +1285,8 @@ def main(argv=None) -> int:
                         "sampling). With --batch-slots: speculative "
                         "rounds run INSIDE the continuous batcher (per-"
                         "slot proposals, one shared verify forward, "
-                        "same exactness per row)")
+                        "same exactness per row); composes with "
+                        "--kv-block (block-aware verify)")
     p.add_argument("--draft-checkpoint", default="",
                    help="orbax checkpoint for the draft (fresh init when "
                         "empty — useful only for testing)")
@@ -1397,10 +1428,11 @@ def main(argv=None) -> int:
         # over the whole slot batch (per-slot proposals, one shared
         # verify forward; greedy rows bit-exact, sampling rows exact via
         # per-row rejection sampling). --kv-quant composes (int8 slot
-        # caches, both models). --kv-block does not (paged multi-token
-        # verify is future work; _Batcher refuses it with the same
-        # message). decode_chunk is superseded in speculative mode: a
-        # spec round already emits up to gamma+1 tokens per host sync.
+        # caches, both models). --kv-block composes (paged_verify writes
+        # each row's gamma+1 tokens through its page table; admission
+        # reserves the verify-overshoot headroom). decode_chunk is
+        # superseded in speculative mode: a spec round already emits up
+        # to gamma+1 tokens per host sync.
         try:
             srv.batcher = _Batcher(config, params, slots=args.batch_slots,
                                    max_len=args.batch_max_len
