@@ -1,0 +1,76 @@
+"""Standalone int8-vs-bf16 dot microbench probe (VERDICT r4 next #2).
+
+bench.py's dot_tfs row timed ONE call per dtype after warmup; through the
+axon tunnel that is one RTT-sized sample, and across rounds it produced
+contradictory records (bf16 28 TF/s vs int8 71 TF/s — with prose claiming
+the reverse ordering). This probe is the adjudicator: K timed calls per
+dtype, interleaved A/B/A/B to cancel drift, a long device-side scan per
+call (the tunnel-timing discipline: one dispatch, clock stopped on a host
+fetch of the result), run from N fresh processes by the shell wrapper.
+
+Usage:  python scripts/probe_dot.py            # one process, prints JSON
+        for i in 1 2 3; do python scripts/probe_dot.py; done
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+M = 4096
+SCAN = 64          # dots per timed dispatch — amortizes dispatch/RTT
+REPS = 5           # timed dispatches per dtype (best + spread reported)
+
+
+def make_chain(dtype, pref):
+    a = jax.random.normal(jax.random.key(7), (M, M),
+                          jnp.bfloat16).astype(dtype)
+    w = jax.random.normal(jax.random.key(8), (M, M),
+                          jnp.bfloat16).astype(dtype)
+
+    @jax.jit
+    def chain(x):
+        def body(c, _):
+            o = jax.lax.dot_general(
+                c, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=pref)
+            return o.astype(dtype), None
+        c, _ = jax.lax.scan(body, x, None, length=SCAN)
+        return jnp.sum(c.astype(jnp.float32))
+
+    float(chain(a))            # compile + first-run
+    return lambda: float(chain(a)), a
+
+
+def main():
+    bf16, _ = make_chain(jnp.bfloat16, jnp.float32)
+    i8, _ = make_chain(jnp.int8, jnp.int32)
+    times = {"bf16": [], "int8": []}
+    for _ in range(REPS):      # interleaved: drift hits both arms alike
+        for name, fn in (("bf16", bf16), ("int8", i8)):
+            t0 = time.perf_counter()
+            fn()
+            times[name].append((time.perf_counter() - t0) / SCAN)
+
+    def tfs(ts):
+        return round(2 * M ** 3 / min(ts) / 1e12, 1)
+
+    def spread(ts):
+        return round((max(ts) - min(ts)) / min(ts), 3)
+
+    out = {
+        "platform": jax.devices()[0].platform,
+        "device": jax.devices()[0].device_kind,
+        "m": M, "scan": SCAN, "reps": REPS,
+        "dot_tflops_bf16": tfs(times["bf16"]),
+        "dot_tflops_int8_i32": tfs(times["int8"]),
+        "int8_over_bf16": round(min(times["bf16"]) / min(times["int8"]), 2),
+        "spread_bf16": spread(times["bf16"]),
+        "spread_int8": spread(times["int8"]),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
