@@ -268,6 +268,16 @@ class _Batcher:
             # the plain path would crash the scheduler — reject up front
             raise ValueError("empty prompt")
         import math
+
+        import numpy as np
+        # validate the F32-ROUNDED values — the sampling vectors (and the
+        # lock-step broadcast wire) are float32, so a subnormal f64 that
+        # passes an f64 range check but rounds to 0.0f would empty the
+        # nucleus downstream: the silent degradation this validation
+        # exists to reject. (temperature rounding to 0.0f is safe — that
+        # IS the greedy gate value on every path.)
+        temperature = float(np.float32(temperature))
+        top_p = float(np.float32(top_p))
         if not (math.isfinite(temperature) and temperature >= 0):
             # NaN slips through a bare `< 0` check (json accepts the NaN
             # literal) and would silently stream garbage
@@ -278,6 +288,10 @@ class _Batcher:
             raise ValueError("top_p must be in (0, 1]")
         if top_k < 0:
             raise ValueError("top_k must be >= 0")
+        # top_k >= vocab means "no filter" (the kth-largest cutoff is the
+        # minimum) — clamp so the int32 sampling vectors / broadcast wire
+        # can't overflow on a huge-but-semantically-valid value
+        top_k = min(int(top_k), self.config.vocab_size)
         if prompt_row.shape[0] + max_new > self.max_len:
             raise ValueError(
                 f"prompt {prompt_row.shape[0]} + max_new {max_new} exceeds "

@@ -583,10 +583,21 @@ def test_batcher_submit_validates_sampling_params():
         with pytest.raises(ValueError, match="top_p"):
             b.submit(jnp.zeros((4,), jnp.int32), 4, temperature=1.0,
                      top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            # passes an f64 range check but rounds to 0.0f on the f32
+            # sampling vectors — must be rejected, not silently empty
+            # the nucleus
+            b.submit(jnp.zeros((4,), jnp.int32), 4, temperature=1.0,
+                     top_p=1e-46)
         with pytest.raises(ValueError, match="temperature"):
             b.submit(jnp.zeros((4,), jnp.int32), 4, temperature=-1.0)
         with pytest.raises(ValueError, match="top_k"):
             b.submit(jnp.zeros((4,), jnp.int32), 4, top_k=-3)
+        # a huge-but-valid top_k means "no filter"; it must clamp to
+        # vocab (int32 wire/vector safety), not crash the scheduler
+        out = b.submit(jnp.zeros((4,), jnp.int32), 2, temperature=0.5,
+                       top_k=2**31)
+        assert len(out) == 2
     finally:
         b.close()
 
