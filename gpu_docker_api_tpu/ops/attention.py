@@ -662,13 +662,14 @@ FLASH_CHUNK_SEQ = int(os.environ.get("TDAPI_FLASH_CHUNK_SEQ", "2048"))
 # The decomposition's (q-chunk, kv-chunk) pairs all share one shape, so
 # they STACK along the kernel's batch axis: every diagonal pair runs as ONE
 # causal launch and the off-diagonal pairs run in a few big non-causal
-# launches (pow2-capped groups keep the program variety bounded at any S)
-# — 2048-long per-pair grids underfeed the launch pipeline (the round-3
-# one-pair-per-call ladder measured ~19% MFU on the attention term; 36
-# launches at S=16k), while a stacked launch is one grid of
-# pairs x heads x blocks. VMEM per kernel instance is unchanged (batch is
-# the outer grid axis); the only cost is materializing the gathered
-# q/k/v stacks, which is small next to the step's HBM traffic.
+# launches (pow2-capped groups keep the program variety bounded at any S).
+# Measured on-chip A/B (round 5, scripts/probe_long.py, S=16k): stacking
+# does NOT change step time (890 vs 861 ms, noise-band) — the long-context
+# bound is the flash kernel's own ~37 TF/s throughput, not launch count.
+# Stacking's real benefit is BOUNDED PROGRAM VARIETY: a handful of
+# compiled programs at any S (compile 16.6 s vs 20.7 s at 16k, and the
+# gap grows with S), so it stays the default. VMEM per kernel instance is
+# unchanged (batch is the outer grid axis).
 FLASH_PAIR_STACK = int(os.environ.get("TDAPI_FLASH_PAIR_STACK", "32"))
 
 
@@ -696,10 +697,9 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     one causal kernel launch stacked along the batch axis, and the
     n(n-1)/2 unmasked past pairs run in ceil(P / FLASH_PAIR_STACK)
     non-causal launches (pow2-capped group sizes bound program variety)
-    — at S=16k that is 36 launches -> ~3, with each launch a full
-    pairs x heads x blocks grid instead of a 2048-row sliver (the
-    round-3 one-pair-per-call ladder measured ~19% MFU on the attention
-    term)."""
+    — at S=16k that is 36 launches -> ~3. Step-time effect is nil
+    (measured A/B, see FLASH_PAIR_STACK above); the stacking earns its
+    keep in bounded program count and compile time."""
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     b, s, h, d = q.shape

@@ -1,0 +1,39 @@
+"""Chip probe: long-context (16k/32k) train-step MFU, pair-stack A/B
+(VERDICT r4 weak #4 / next #4).
+
+TDAPI_FLASH_PAIR_STACK is read at module import, so each arm runs in its
+own process:
+
+    TDAPI_FLASH_PAIR_STACK=32 python scripts/probe_long.py 16384
+    TDAPI_FLASH_PAIR_STACK=1  python scripts/probe_long.py 16384
+    python scripts/probe_long.py 32768
+
+stack=1 reproduces round 3's one-pair-per-launch ladder (~19% MFU on the
+attention term at S=16k); stack=32 is the round-4 rewrite whose effect
+was never published.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import bench
+    from gpu_docker_api_tpu.models.llama import LlamaConfig
+    from gpu_docker_api_tpu.train import TrainConfig
+
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    cfg = dataclasses.replace(LlamaConfig.llama_250m(), max_seq_len=seq)
+    tc = TrainConfig(remat_policy="full") if seq > 16384 else None
+    rec = bench._mfu_one(f"llama_250m_s{seq // 1024}k", cfg, batch=1,
+                         seq=seq, K=2, tc=tc)
+    rec["pair_stack"] = int(os.environ.get("TDAPI_FLASH_PAIR_STACK", "32"))
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

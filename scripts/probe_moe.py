@@ -1,0 +1,37 @@
+"""Chip probe: moe_1b train-step MFU with the gather-dispatch path
+(VERDICT r4 weak #5 / next #5), plus a capacity-factor A/B.
+
+Round-4 record (einsum dispatch): 764 ms/step, 24% active-FLOPs MFU.
+The dispatch/combine one-hot einsums cost O(T·E·C·D) FLOPs/layer —
+arithmetic puts them at ~the expert matmuls themselves at T=4096 — so
+the gather path should roughly halve the MoE-side step time.
+
+Usage: python scripts/probe_moe.py [cf ...]   (default: 1.25 1.0)
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import dataclasses
+
+    import bench
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.train import TrainConfig
+
+    cfs = [float(x) for x in sys.argv[1:]] or [1.25, 1.0]
+    out = {}
+    for cf in cfs:
+        cfg = dataclasses.replace(MoEConfig.moe_1b(), capacity_factor=cf)
+        rec = bench._mfu_one(f"moe_1b_cf{cf}", cfg, batch=8, seq=2048,
+                             K=4, tc=TrainConfig(accum_steps=4))
+        out[f"cf{cf}"] = rec
+        print(json.dumps({f"cf{cf}": rec}), flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

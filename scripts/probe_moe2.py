@@ -1,0 +1,86 @@
+"""Chip probe 2: moe_1b time breakdown (VERDICT r4 next #5's "where does
+the time go") by ablation:
+
+- fwd:    forward-only loss (no backward) — fwd/bwd split
+- nrm:    remat off (backward without recompute) — remat tax
+- dense:  a dense twin with the SAME active FLOPs per token
+          (d_ff = top_k * expert d_ff) under identical accounting —
+          everything above its time is the MoE machinery tax
+          (routing, gathers, capacity padding, per-expert batching)
+
+All arms use capacity_factor 1.25 (the quality default) unless given.
+Usage: python scripts/probe_moe2.py
+"""
+
+import dataclasses
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from gpu_docker_api_tpu.models.llama import LlamaConfig
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+    from gpu_docker_api_tpu.models.moe import init_params as moe_init
+    from gpu_docker_api_tpu.models.moe import moe_forward
+    from gpu_docker_api_tpu.train import TrainConfig
+
+    out = {}
+    mcfg = MoEConfig.moe_1b()
+
+    # dense twin: same layers/d_model/heads, d_ff = top_k * 2560 = 5120
+    dcfg = LlamaConfig(
+        vocab_size=mcfg.vocab_size, d_model=mcfg.d_model,
+        n_layers=mcfg.n_layers, n_heads=mcfg.n_heads,
+        n_kv_heads=mcfg.n_kv_heads, d_ff=mcfg.top_k * mcfg.d_ff,
+        max_seq_len=mcfg.max_seq_len)
+    out["dense_twin"] = bench._mfu_one("dense_twin_d1024_ff5120", dcfg,
+                                       batch=8, seq=2048, K=4,
+                                       tc=TrainConfig(accum_steps=4))
+    print(json.dumps({"dense_twin": out["dense_twin"]}), flush=True)
+
+    # remat off (microbatch activations must fit without recompute)
+    try:
+        out["no_remat"] = bench._mfu_one(
+            "moe_1b_noremat", mcfg, batch=8, seq=2048, K=4,
+            tc=TrainConfig(accum_steps=4, remat=False))
+    except Exception as e:  # noqa: BLE001 — likely OOM
+        out["no_remat"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps({"no_remat": out["no_remat"]}), flush=True)
+
+    # forward-only: mean CE + router loss, jitted, K timed reps (same
+    # tunnel discipline: one scan, fetch at the end)
+    params = moe_init(mcfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 2048), 0,
+                                mcfg.vocab_size, jnp.int32)
+
+    def fwd_loss(p, toks):
+        logits, raux = moe_forward(p, toks[:, :-1], mcfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ce = -jnp.mean(jnp.take_along_axis(
+            logp, toks[:, 1:, None], axis=-1))
+        return ce + raux
+
+    @jax.jit
+    def k_fwd(p, toks):
+        def body(c, _):
+            return c + fwd_loss(p, toks), None
+        s, _ = jax.lax.scan(body, jnp.zeros(()), None, length=4)
+        return s
+
+    float(k_fwd(params, tokens))          # compile
+    t0 = time.perf_counter()
+    float(k_fwd(params, tokens))
+    fwd_ms = (time.perf_counter() - t0) / 4 * 1e3
+    out["fwd_only_ms"] = round(fwd_ms, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Chip probe 3: isolate the MoE machinery tax term by term.
+
+probe_moe2 put the dense same-active-FLOPs twin at 52% MFU / 353 ms and
+the gather-dispatch MoE at 28.2% / 652 ms — ~300 ms of tax. This probe
+times the candidate terms in isolation. Tunnel discipline: each timed
+dispatch is a jitted chain of `inner` iterations whose OUTPUT FEEDS THE
+NEXT INPUT (defeats loop-invariant hoisting; amortizes the ~50-60 ms
+tunnel RTT), clock stopped on a host fetch.
+
+  bmm / flat — per-expert batched einsum [E,C,D]x[E,D,F]x[E,F,D] vs the
+               flat matmul pair of identical FLOPs (grouped-matmul MXU
+               efficiency)
+  gath       — dispatch gather + combine gather-sum, fwd and grad (the
+               grad of a gather is a scatter-add)
+  route      — router matmul + top_k + capacity cumsum, fwd and grad
+
+Usage: python scripts/probe_moe3.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+INNER = 32
+REPS = 3
+
+
+def chain_timer(step, x0):
+    """step: x -> x (same shape/dtype). Returns best per-iteration s."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(x):
+        def body(c, _):
+            return step(c), None
+        c, _ = jax.lax.scan(body, x, None, length=INNER)
+        return jnp.sum(jax.tree.leaves(c)[0].astype(jnp.float32))
+
+    float(chain(x0))                       # compile + first-run
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        float(chain(x0))
+        best = min(best, time.perf_counter() - t0)
+    return best / INNER
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.models.moe import MoEConfig, capacity_positions
+
+    c = MoEConfig.moe_1b()
+    t, d, f, e = 4096, c.d_model, c.d_ff, c.n_experts   # microbatch T
+    cap = c.capacity(t)
+    key = jax.random.key(0)
+    ht = jax.random.normal(key, (t, d), jnp.bfloat16)
+    we1 = jax.random.normal(key, (e, d, f), jnp.bfloat16) * 0.02
+    we2 = jax.random.normal(key, (e, f, d), jnp.bfloat16) * 0.02
+    wf1 = jax.random.normal(key, (d, f), jnp.bfloat16) * 0.02
+    wf2 = jax.random.normal(key, (f, d), jnp.bfloat16) * 0.02
+    xe0 = jax.random.normal(key, (e, cap, d), jnp.bfloat16)
+
+    out = {"t": t, "cap": cap, "inner": INNER}
+    flops_pair = 2 * 2 * e * cap * d * f   # two matmuls per iteration
+
+    s = chain_timer(lambda x: jnp.einsum(
+        "ecf,efd->ecd", jnp.einsum("ecd,edf->ecf", x, we1),
+        we2).astype(jnp.bfloat16), xe0)
+    out["bmm_tflops"] = round(flops_pair / s / 1e12, 1)
+    s = chain_timer(lambda x: ((x @ wf1) @ wf2).astype(jnp.bfloat16),
+                    xe0.reshape(e * cap, d))
+    out["flat_tflops"] = round(flops_pair / s / 1e12, 1)
+
+    # gather dispatch + combine, fwd and grad, chained through [T, D]
+    gate_idx = jax.random.randint(key, (t, c.top_k), 0, e, jnp.int32)
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)
+    pos = capacity_positions(onehot)
+    keep = pos < cap
+    flat_slot = jnp.where(keep, gate_idx * cap + pos, e * cap)
+    gv = jax.random.uniform(key, (t, c.top_k), jnp.float32)
+
+    def gath(h):
+        tok = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[:, None],
+                               flat_slot.shape)
+        slot_tok = jnp.full((e * cap,), t, jnp.int32).at[
+            flat_slot.reshape(-1)].set(tok.reshape(-1), mode="drop")
+        hp = jnp.concatenate([h, jnp.zeros((1, d), h.dtype)], 0)
+        xe = jnp.take(hp, slot_tok, axis=0)              # dispatch
+        back = jnp.take(xe, jnp.where(keep, flat_slot, 0), axis=0)
+        w = (gv * keep.astype(jnp.float32))[..., None]
+        return jnp.sum(back.astype(jnp.float32) * w, 1).astype(h.dtype)
+
+    out["gather_fwd_ms"] = round(chain_timer(gath, ht) * 1e3, 3)
+    g_fn = jax.grad(lambda h: jnp.sum(gath(h).astype(jnp.float32)))
+    out["gather_fwdgrad_ms"] = round(chain_timer(g_fn, ht) * 1e3, 3)
+
+    # routing, fwd and grad, chained through [T, D]
+    router = jax.random.normal(key, (d, e), jnp.float32) * 0.02
+
+    def route(h):
+        logits = h.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, -1)
+        g, i = jax.lax.top_k(probs, c.top_k)
+        oh = jax.nn.one_hot(i, e, dtype=jnp.int32)
+        p = capacity_positions(oh)
+        # feed outputs back into h: full data dependency, tiny extra cost
+        return (h + ((probs + jnp.sum(g) + jnp.sum(p))
+                     @ router.T).astype(h.dtype) * 1e-3)
+
+    out["route_fwd_ms"] = round(chain_timer(route, ht) * 1e3, 3)
+    r_fn = jax.grad(lambda h: jnp.sum(route(h).astype(jnp.float32)))
+    out["route_fwdgrad_ms"] = round(chain_timer(r_fn, ht) * 1e3, 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,3 +145,42 @@ def test_auto_dispatch_respects_measured_crossover(monkeypatch):
     assert calls == ["flash"]
     with pytest.raises(ValueError, match="impl"):
         attn_mod.attention(q(128), q(128), q(128), impl="bogus")
+
+
+def test_moe_gather_einsum_dispatch_agree():
+    """The two expert-dispatch paths (one-hot einsums for ep-sharded
+    meshes, slot->token gathers for single-shard) must implement the
+    SAME routing semantics: identical capacity ranking, identical
+    drops, identical renormalized gate weighting. Forced-tight capacity
+    so real drops occur in the comparison."""
+    import numpy as np
+
+    from gpu_docker_api_tpu.models.moe import (
+        MoEConfig, _moe_experts_einsum, _moe_experts_gather,
+        capacity_positions, init_params)
+
+    cfg = MoEConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    layer = jax.tree.map(lambda p: p[0], params["layers"])
+    t = 96
+    ht = jax.random.normal(jax.random.key(1), (t, cfg.d_model),
+                           jnp.float32)
+    logits = ht @ layer["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(gate_idx, cfg.n_experts, dtype=jnp.int32)
+    pos = capacity_positions(onehot)
+    cap = max(2, cfg.capacity(t) // 2)      # tight: force real drops
+    keep = pos < cap
+    assert not bool(jnp.all(keep)), "capacity must actually drop tokens"
+
+    def pin(arr, spec):
+        return arr
+
+    a = _moe_experts_einsum(ht, layer, cfg, gate_idx, gate_vals, keep,
+                            pos, cap, pin)
+    b = _moe_experts_gather(ht, layer, cfg, gate_idx, gate_vals, keep,
+                            pos, cap, pin)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
