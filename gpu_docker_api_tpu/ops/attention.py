@@ -50,6 +50,22 @@ FLASH_MIN_SEQ_GRAD = int(os.environ.get("TDAPI_FLASH_MIN_SEQ_GRAD", "1024"))
 # be (8k, 128m)-aligned — a [B*H, S] residual with (1, blk_q) blocks does not
 # lower (the official jax TPU flash kernel stores l/m the same way).
 LANES = 128
+# bf16 MXU path: feed the MXU bf16 operands with f32 accumulation instead
+# of pre-casting to f32. Measured on v5e (round 5, interleaved A/B): NO
+# effect — s4096 fwd 36.5 vs 36.9 TF/s — i.e. these kernels are NOT
+# matmul-bound on this chip (the per-block VPU epilogue is the roofline;
+# see the split-loop mask-skip below). The path is kept OFF by default
+# (identical numerics to the f32 path) as a one-flag experiment for chips
+# where the f32 matmul penalty does bind; its numerics are pinned by
+# test_flash_bf16_mxu_path_matches_reference either way.
+FLASH_BF16_MXU = os.environ.get("TDAPI_FLASH_BF16_MXU", "0") == "1"
+
+
+def _fast_mxu(*dtypes) -> bool:
+    """Fast path only when EVERY dot operand is bf16 — with mixed inputs
+    (say a bf16 q over an f32-resident KV) the un-cast operands would be
+    a dot_general dtype mismatch; those keep the f32 path."""
+    return FLASH_BF16_MXU and all(d == jnp.bfloat16 for d in dtypes)
 
 
 # ---- reference (XLA) -------------------------------------------------------
@@ -98,7 +114,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         lse_ref = None
         acc_ref, m_ref, l_ref = rest
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
-    q = q_ref[0].astype(jnp.float32) * scale            # [blk_q, D]
+    fast = _fast_mxu(q_ref.dtype, k_ref.dtype, v_ref.dtype)
+    # fast path: q stays bf16 and `scale` folds in AFTER the dot (scaling
+    # a bf16 q would round; post-dot the scores are f32)
+    q = q_ref[0] if fast else q_ref[0].astype(jnp.float32) * scale
     m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
     l_ref[:] = jnp.zeros_like(l_ref)
     acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -116,37 +135,69 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     else:
         kv_lo = 0
 
-    def body(j, _):
-        import jax.experimental.pallas as pl
-        k = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [blk_q, blk_k]
-        if causal or window:
-            rows = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            cols = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            keep = cols <= rows if causal else (cols == cols)
-            if window:
-                keep &= cols > rows - window
-            s = jnp.where(keep, s, -jnp.inf)
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # guard the all-masked row case: exp(-inf - -inf) -> use finite m
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe)
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
-        return 0
+    def make_body(masked: bool):
+        # `masked` is a PYTHON constant: the unmasked body compiles with
+        # no iota/compare/where/isfinite chain at all — on v5e the per-
+        # block VPU epilogue, not the MXU dots, is the kernel's roofline
+        # (measured round 5), and for causal attention all but the <=2
+        # diagonal-straddling kv blocks per q block are fully visible.
+        def body(j, _):
+            import jax.experimental.pallas as pl
+            k = k_ref[0, pl.ds(j * blk_k, blk_k), :]
+            v = v_ref[0, pl.ds(j * blk_k, blk_k), :]
+            if not fast:
+                k = k.astype(jnp.float32)
+                v = v.astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [blk_q, blk_k]
+            if fast:
+                s = s * scale
+            if masked and (causal or window):
+                rows = i * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                cols = j * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                keep = cols <= rows if causal else (cols == cols)
+                if window:
+                    keep &= cols > rows - window
+                s = jnp.where(keep, s, -jnp.inf)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            if masked:
+                # guard the all-masked row: exp(-inf - -inf) -> finite m
+                m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                p = jnp.exp(s - m_safe)
+                p = jnp.where(jnp.isfinite(s), p, 0.0)
+            else:
+                # real scores are finite: m_new is finite, no guards
+                m_safe = m_new
+                p = jnp.exp(s - m_safe)
+            alpha = jnp.where(jnp.isfinite(m_prev),
+                              jnp.exp(m_prev - m_safe), 0.0)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype) if fast else p, v,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:] = m_new
+            return 0
+        return body
 
-    jax.lax.fori_loop(kv_lo, n_kv, body, 0)
+    if causal and not window:
+        # kv blocks whose every column is < the q block's first row are
+        # fully visible — only the diagonal-straddling tail needs masks
+        n_full = jnp.maximum((i * blk_q) // blk_k, kv_lo)
+        jax.lax.fori_loop(kv_lo, n_full, make_body(False), 0)
+        jax.lax.fori_loop(n_full, n_kv, make_body(True), 0)
+    else:
+        # windowed: interior band blocks COULD skip masks too (a three-
+        # segment split) — left on the shelf: the full-causal split only
+        # measured +2-3%, so the added bound arithmetic isn't yet paid
+        # for. Plain non-causal (blockwise past pairs — the dominant
+        # launches at long S): nothing is ever masked, guards off
+        jax.lax.fori_loop(kv_lo, n_kv,
+                          make_body(causal or bool(window)), 0)
     denom = jnp.maximum(l_ref[:], 1e-30)
     o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
     if want_lse:
@@ -247,8 +298,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dlse_ref = None
         (dq_ref,) = rest
     i = jax.lax.convert_element_type(_pid(1), jnp.int32)
-    q = q_ref[0].astype(jnp.float32) * scale             # [blk_q, D]
-    do = do_ref[0].astype(jnp.float32)                   # [blk_q, D]
+    fast = _fast_mxu(q_ref.dtype, k_ref.dtype, v_ref.dtype, do_ref.dtype)
+    # fast path: q/do stay bf16 for the MXU; scale folds in post-dot
+    q = q_ref[0] if fast else q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0] if fast else do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, 0:1]                             # [blk_q, 1]
     # D_i = rowsum(dO_i * O_i), computed in-VMEM from the o/do blocks (no
     # lane-replicated HBM delta array needed)
@@ -266,30 +319,50 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     kv_lo = (jnp.maximum((i * blk_q - window + 1) // blk_k, 0)
              if window else 0)
 
-    def body(j, acc):
-        k = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or window:
-            rows = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            cols = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            keep = cols <= rows if causal else (cols == cols)
-            if window:
-                keep &= cols > rows - window
-            s = jnp.where(keep, s, -jnp.inf)
-        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+    def make_body(masked: bool):
+        def body(j, acc):
+            k = k_ref[0, pl.ds(j * blk_k, blk_k), :]
+            v = v_ref[0, pl.ds(j * blk_k, blk_k), :]
+            if not fast:
+                k = k.astype(jnp.float32)
+                v = v.astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if fast:
+                s = s * scale
+            if masked and (causal or window):
+                rows = i * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                cols = j * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                keep = cols <= rows if causal else (cols == cols)
+                if window:
+                    keep &= cols > rows - window
+                s = jnp.where(keep, s, -jnp.inf)
+            if masked:
+                p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+            else:
+                p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            return acc + jax.lax.dot_general(
+                ds.astype(k.dtype) if fast else ds, k,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return body
 
     d = q_ref.shape[2]
-    acc = jax.lax.fori_loop(kv_lo, n_kv, body,
-                            jnp.zeros((blk_q, d), jnp.float32))
+    acc = jnp.zeros((blk_q, d), jnp.float32)
+    if causal and not window:
+        # same split as the forward: only diagonal-straddling kv blocks
+        # pay the mask/guard VPU chain
+        n_full = jnp.maximum((i * blk_q) // blk_k, kv_lo)
+        acc = jax.lax.fori_loop(kv_lo, n_full, make_body(False), acc)
+        acc = jax.lax.fori_loop(n_full, n_kv, make_body(True), acc)
+    else:
+        acc = jax.lax.fori_loop(kv_lo, n_kv,
+                                make_body(causal or bool(window)), acc)
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
@@ -306,8 +379,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_ref, dv_ref = rest
     j = jax.lax.convert_element_type(_pid(1), jnp.int32)
     g = jax.lax.convert_element_type(_pid(2), jnp.int32)
-    k = k_ref[0].astype(jnp.float32)                     # [blk_k, D]
-    v = v_ref[0].astype(jnp.float32)                     # [blk_k, D]
+    fast = _fast_mxu(q_ref.dtype, k_ref.dtype, v_ref.dtype, do_ref.dtype)
+    k = k_ref[0] if fast else k_ref[0].astype(jnp.float32)   # [blk_k, D]
+    v = v_ref[0] if fast else v_ref[0].astype(jnp.float32)   # [blk_k, D]
 
     n_q_total = seq_len // blk_q
     i_start = (j * blk_k) // blk_q if causal else 0
@@ -318,45 +392,73 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     else:
         i_end = n_q_total
 
-    def body(i, accs):
-        dk_acc, dv_acc = accs
-        q = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * blk_q, blk_q), :][:, 0:1]
-        delta = jnp.sum(
-            do * o_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32),
-            axis=-1, keepdims=True)                      # [blk_q, 1]
-        if with_dlse:
-            delta = delta - dlse_ref[0, pl.ds(i * blk_q, blk_q), :][:, 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or window:
-            rows = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            cols = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            keep = cols <= rows if causal else (cols == cols)
-            if window:
-                keep &= cols > rows - window
-            s = jnp.where(keep, s, -jnp.inf)
-        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [blk_k, D]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [blk_k, D]
-        return dk_acc, dv_acc
+    def make_body(masked: bool):
+        def body(i, accs):
+            dk_acc, dv_acc = accs
+            q = q_ref[0, pl.ds(i * blk_q, blk_q), :]
+            do = do_ref[0, pl.ds(i * blk_q, blk_q), :]
+            if not fast:
+                q = q.astype(jnp.float32) * scale
+                do = do.astype(jnp.float32)
+            lse = lse_ref[0, pl.ds(i * blk_q, blk_q), :][:, 0:1]
+            delta = jnp.sum(
+                do.astype(jnp.float32)
+                * o_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32),
+                axis=-1, keepdims=True)                  # [blk_q, 1]
+            if with_dlse:
+                delta = delta - dlse_ref[0, pl.ds(i * blk_q, blk_q),
+                                         :][:, 0:1]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if fast:
+                s = s * scale
+            if masked and (causal or window):
+                rows = i * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                cols = j * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                keep = cols <= rows if causal else (cols == cols)
+                if window:
+                    keep &= cols > rows - window
+                s = jnp.where(keep, s, -jnp.inf)
+            if masked:
+                p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+            else:
+                p = jnp.exp(s - lse)
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p.astype(do.dtype) if fast else p, do,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [blk_k, D]
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(q.dtype) if fast else ds, q,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [blk_k, D]
+            return dk_acc, dv_acc
+        return body
 
     d = k_ref.shape[2]
     zeros = jnp.zeros((blk_k, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(i_start, i_end, body,
-                                       (zeros, zeros))
-    # q was pre-scaled, so ds @ q already carries one factor of `scale`;
-    # dk needs exactly one — nothing more to multiply here
+    accs = (zeros, zeros)
+    if causal and not window:
+        # q blocks whose every row is >= this kv block's last column are
+        # fully visible: only the diagonal-straddling head needs masks
+        full_start = jnp.clip(
+            ((j + 1) * blk_k - 1 + blk_q - 1) // blk_q, i_start, i_end)
+        accs = jax.lax.fori_loop(i_start, full_start, make_body(True),
+                                 accs)
+        accs = jax.lax.fori_loop(full_start, i_end, make_body(False),
+                                 accs)
+    else:
+        accs = jax.lax.fori_loop(i_start, i_end,
+                                 make_body(causal or bool(window)), accs)
+    dk_acc, dv_acc = accs
+    if fast:
+        # the f32 path pre-scales q, so its ds @ q carries the one factor
+        # of `scale` dk needs; the fast path's q is raw — apply it here
+        dk_acc = dk_acc * scale
     first = g == 0
 
     @pl.when(first)

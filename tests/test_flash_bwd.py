@@ -196,3 +196,45 @@ def test_auto_long_seq_dispatch(monkeypatch):
         calls.clear()
         attn_mod.attention(qq(s), qq(s), qq(s), impl=impl)
         assert calls == [want], (impl, s, calls)
+
+
+@pytest.mark.parametrize("fast", [False, True], ids=["f32", "bf16mxu"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bf16_mxu_path_matches_reference(causal, fast, monkeypatch):
+    """bf16 inputs through BOTH kernel precisions: the default f32 path
+    and the TDAPI_FLASH_BF16_MXU fast path (operands stay bf16 into the
+    dots, f32 accumulation; default-off after the v5e A/B measured no
+    gain — kept for chips where the f32 matmul rate binds, so its
+    numerics must stay pinned). The flag is read at import, so the test
+    monkeypatches the module constant. QK^T products are exact (bf16
+    mantissa pairs fit f32); the p/ds second-dot operands round to bf16,
+    the same precision the bf16 output cast imposes anyway."""
+    import importlib
+    attn_mod = importlib.import_module("gpu_docker_api_tpu.ops.attention")
+    monkeypatch.setattr(attn_mod, "FLASH_BF16_MXU", fast)
+    b, s, h, hkv, d = 2, 128, 4, 2, 32
+    q = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(2), (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(3), (b, s, hkv, d), jnp.bfloat16)
+    cot = jax.random.normal(jax.random.key(4), (b, s, h, d), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=causal, blk_q=64, blk_k=64,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v).astype(jnp.float32) * cot)
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, blk_q=64, blk_k=64, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: reference_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            atol=6e-2, rtol=6e-2, err_msg=name)

@@ -200,6 +200,7 @@ import sys
 from gpu_docker_api_tpu.workloads.serve import main
 sys.exit(main(["--family", "llama", "--config", "tiny",
                "--tp", "2", "--batch-slots", "4", "--decode-chunk", "8",
+               "--batch-prefill-chunk", "4",
                "--host", "127.0.0.1", "--port", sys.argv[1]]))
 """
 

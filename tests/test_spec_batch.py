@@ -340,3 +340,26 @@ def test_vocab_mismatch_refused(setup):
     dcfg = dataclasses.replace(cfg, vocab_size=cfg.vocab_size + 1)
     with pytest.raises(ValueError, match="vocab"):
         _Batcher(cfg, target, slots=1, max_len=64, draft=(dcfg, draft))
+
+
+def test_paged_spec_kitchen_sink_composition(setup):
+    """EVERY serving feature at once: paged pool + speculative rounds +
+    int8 KV (both models) + prefix store + chunked prefill. Two rounds
+    of an identical prompt: the second admission reuses stored prefix
+    blocks zero-copy while spec rounds verify-write through page tables
+    in int8. Streams must equal the kv_quant solo reference bit-exactly."""
+    cfg, target, draft = setup
+    (p,) = prompts_for(cfg, [17], seed0=101)
+    want = solo(target, cfg, p, 9, kv_quant=True)
+    b = _Batcher(cfg, target, slots=2, max_len=64, kv_block=8,
+                 kv_quant=True, prefix_cache=2, prefill_chunk=4,
+                 draft=(cfg, draft), gamma=3)
+    try:
+        got1 = b.submit(p, 9)
+        got2 = b.submit(p, 9)
+    finally:
+        b.close()
+    np.testing.assert_array_equal(got1, want)
+    np.testing.assert_array_equal(got2, want)
+    assert b.prefix_hits >= 1          # the store path actually fired
+    assert b.spec_rounds >= 2
