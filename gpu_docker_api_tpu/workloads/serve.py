@@ -33,11 +33,11 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
-def _load_params(trainer, ckpt_dir: str | None):
+def _load_params(trainer, ckpt_dir: str | None, init_key: int = 0):
     import jax
 
     if not ckpt_dir:
-        return trainer.init(jax.random.key(0))["params"]
+        return trainer.init(jax.random.key(init_key))["params"]
     from ..train import restore_checkpoint
     # orbax needs an absolute path; scheduled workloads pass volume-bind
     # paths relative to $CONTAINER_ROOT (the process substrate's cwd)
@@ -181,26 +181,33 @@ class _Batcher:
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
 
+    def _build(self, init_fn):
+        """Materialize one freshly-initialized cache pytree. Hook: the
+        lock-step subclass jits init_fn with replicated out_shardings so
+        the arrays are GLOBAL over its mesh (the jitted slot-ops mix the
+        cache with mesh-sharded params)."""
+        return init_fn()
+
     def _make_cache(self) -> None:
         """(Re)build the device cache + host allocator state — init and
         the crash-restart path share it."""
         if self._paged:
             from ..paging import BlockAllocator, init_paged_cache
-            self.cache = init_paged_cache(
+            self.cache = self._build(lambda: init_paged_cache(
                 self.config, self.kv_pool_blocks, self.kv_block,
-                len(self.slots), self._max_pages, quantized=self.kv_quant)
+                len(self.slots), self._max_pages, quantized=self.kv_quant))
             self._alloc = BlockAllocator(self.kv_pool_blocks)
             self._slot_blocks: list = [None] * len(self.slots)
         else:
             from ..batching import init_slot_cache
-            self.cache = init_slot_cache(self.config, len(self.slots),
-                                         self._cache_len,
-                                         quantized=self.kv_quant)
+            self.cache = self._build(lambda: init_slot_cache(
+                self.config, len(self.slots), self._cache_len,
+                quantized=self.kv_quant))
         if self._draft is not None:
             from ..batching import init_slot_cache
-            self.d_cache = init_slot_cache(self._draft[0], len(self.slots),
-                                           self._cache_len,
-                                           quantized=self.kv_quant)
+            self.d_cache = self._build(lambda: init_slot_cache(
+                self._draft[0], len(self.slots), self._cache_len,
+                quantized=self.kv_quant))
 
     # the cache entry points, dispatched on dense vs paged mode (the
     # import + attribute lookup per call is trivia next to the jitted
@@ -971,8 +978,15 @@ class _LockstepBatcher(_Batcher):
     input in the base loop — is replaced by the synced pending list
     (_has_waiters override).
 
-    Scope: dense cache only (no draft/paged/prefix-cache — those stay
-    single-host for now; main() refuses the flags in multihost mode).
+    The single-host compositions ride along: the paged allocator,
+    prefix store, and in-flight sharing are host bookkeeping driven
+    ONLY by the synced pending list + SPMD device results, so their
+    decisions replicate across ranks tick-for-tick; the paged pool and
+    page tables are replicated global arrays (_build) that every rank
+    mutates in the same order. --kv-quant likewise (same programs,
+    int8 pools). Speculative rides too: the draft tree is built sharded
+    on the same mesh (_serve_multihost), its slot cache replicates via
+    _build, and accept/rollback reads SPMD-identical device results.
     restarts=0: a crash on one rank cannot be restarted in lock-step
     (the peers are parked in a collective nobody will complete) — fail
     every waiter and let the process-level supervisor restart the pod."""
@@ -982,39 +996,44 @@ class _LockstepBatcher(_Batcher):
     BCAST_K = 4
 
     def __init__(self, config, params, slots: int, max_len: int, mesh,
-                 rank: int, prefill_chunk: int = 0, decode_chunk: int = 1,
-                 seed: int = 0):
+                 rank: int, **kw):
+        """kw forwards the _Batcher composition knobs (prefill_chunk,
+        decode_chunk, seed, kv_quant, kv_block, kv_pool_blocks,
+        prefix_cache, draft, gamma) — the paged allocator, prefix store,
+        and spec scheduler are deterministic functions of the synced
+        pending list + SPMD device results, so they lock-step as-is;
+        only cache CONSTRUCTION needs the mesh (see _build)."""
         self._mesh = mesh
         self._rank = rank
         self._pending: list = []
-        super().__init__(config, params, slots, max_len,
-                         prefill_chunk=prefill_chunk,
-                         decode_chunk=decode_chunk, seed=seed,
-                         restarts=0)
+        super().__init__(config, params, slots, max_len, restarts=0, **kw)
 
-    def _make_cache(self) -> None:
-        """The slot cache must be a GLOBAL array (the jitted slot-ops
-        mix it with the mesh-sharded params): replicated over the mesh —
-        every rank holds the full cache, matmuls still run tp-sharded
-        (the KV attend is the replicated part; good enough for the
-        first lock-step milestone, sharded-KV is a dryrun plan first)."""
+    def _build(self, init_fn):
+        """Every cache (dense or paged pool, target or draft) must be a
+        GLOBAL array (the jitted slot-ops mix it with the mesh-sharded
+        params): replicated over the mesh — every rank holds the full
+        cache, matmuls still run tp-sharded (the KV attend is the
+        replicated part; sharded-KV is a dryrun plan first)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
-
-        from ..batching import init_slot_cache
-        self.cache = jax.jit(
-            lambda: init_slot_cache(self.config, len(self.slots),
-                                    self._cache_len),
-            out_shardings=NamedSharding(self._mesh, PartitionSpec()))()
+        return jax.jit(init_fn, out_shardings=NamedSharding(
+            self._mesh, PartitionSpec()))()
 
     def _has_waiters(self) -> bool:
-        return bool(self._pending)
+        return self._waiting is not None or bool(self._pending)
 
     @property
     def queued(self) -> int:
-        return self.queue.qsize() + len(self._pending)
+        return (self.queue.qsize() + len(self._pending)
+                + (self._waiting is not None))
 
     def _next_item(self):
+        """Parked head-of-line item (paged admission short on blocks)
+        first, exactly like the base — its parking decision was itself
+        lock-step, so every rank re-offers it in the same order."""
+        if self._waiting is not None:
+            item, self._waiting = self._waiting, None
+            return item
         return self._pending.pop(0) if self._pending else None
 
     def _fail_all(self, exc: Exception) -> None:
@@ -1373,8 +1392,29 @@ def _serve_multihost(args, config) -> int:
     b_max, t_max = 8, config.max_seq_len
 
     if args.batch_slots > 0:
+        draft = None
+        if args.draft_config:
+            from ..models import named_config
+            dcfg = named_config(args.family, args.draft_config)
+            if dcfg.vocab_size != config.vocab_size:
+                raise SystemExit("draft and target must share a vocab")
+            dtrainer = Trainer.create(dcfg, MeshPlan.auto(n_dev, tp=tp))
+            if args.draft_checkpoint:
+                abstract = dtrainer.abstract_state(jax.random.key(0))
+                dstate, dstep = restore_checkpoint(
+                    os.path.abspath(args.draft_checkpoint), abstract)
+                print(f"restored draft checkpoint step {dstep} (sharded)",
+                      flush=True)
+                dparams = dstate["params"]
+            else:
+                # key(1), not key(0): a fresh-init draft under the
+                # target's key would BE the fresh-init target whenever
+                # the two share a named config (every harness run) —
+                # real deployments pass --draft-checkpoint
+                dparams = dtrainer.init(jax.random.key(1))["params"]
+            draft = (dcfg, _maybe_ungroup(dparams, dcfg))
         return _serve_multihost_batched(args, config, trainer, params,
-                                        rank)
+                                        rank, draft)
 
     work_q: "_queue.Queue" = _queue.Queue()
     httpd = None
@@ -1463,7 +1503,8 @@ def _serve_multihost(args, config) -> int:
     return 0
 
 
-def _serve_multihost_batched(args, config, trainer, params, rank) -> int:
+def _serve_multihost_batched(args, config, trainer, params, rank,
+                             draft=None) -> int:
     """Lock-step CONTINUOUS BATCHING across the multi-process cluster:
     every rank constructs the same _LockstepBatcher (sharded params,
     replicated global slot cache, broadcast PRNG seed); rank 0 owns the
@@ -1479,12 +1520,19 @@ def _serve_multihost_batched(args, config, trainer, params, rank) -> int:
     # SPMD sampling programs)
     seed = int(multihost_utils.broadcast_one_to_all(
         np.array([int.from_bytes(os.urandom(4), "big")], np.uint32))[0])
-    batcher = _LockstepBatcher(
-        config, params, slots=args.batch_slots,
-        max_len=args.batch_max_len or config.max_seq_len,
-        mesh=trainer.mesh, rank=rank,
-        prefill_chunk=args.batch_prefill_chunk,
-        decode_chunk=args.decode_chunk, seed=seed)
+    try:
+        batcher = _LockstepBatcher(
+            config, params, slots=args.batch_slots,
+            max_len=args.batch_max_len or config.max_seq_len,
+            mesh=trainer.mesh, rank=rank,
+            prefill_chunk=args.batch_prefill_chunk,
+            decode_chunk=args.decode_chunk, seed=seed,
+            kv_quant=args.kv_quant, kv_block=args.kv_block,
+            kv_pool_blocks=args.kv_pool,
+            prefix_cache=args.prefix_cache,
+            draft=draft, gamma=args.gamma)
+    except ValueError as e:
+        raise SystemExit(str(e))
     if rank != 0:
         print(f"multihost batching engine rank {rank}/"
               f"{jax.process_count()} following", flush=True)
@@ -1496,11 +1544,15 @@ def _serve_multihost_batched(args, config, trainer, params, rank) -> int:
     httpd = ThreadingHTTPServer((args.host, args.port),
                                 _handler_for(srv, name))
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    mode = (f"paged ({batcher.kv_pool_blocks} x {args.kv_block} "
+            f"token blocks)" if args.kv_block else "dense")
+    spec = (f", speculative (draft {args.draft_config}, gamma "
+            f"{args.gamma})" if draft else "")
     print(f"multihost continuous batching {name} "
           f"({srv.n_params:,} params) on {args.host}:"
           f"{httpd.server_address[1]} — {args.batch_slots} slots x "
-          f"{batcher.max_len} tokens, rank 0 of {jax.process_count()}",
-          flush=True)
+          f"{batcher.max_len} tokens, {mode} KV{spec}, rank 0 of "
+          f"{jax.process_count()}", flush=True)
     # the main thread tracks the SCHEDULER, not the HTTP server: if the
     # lock-step loop dies, rank 0 must exit (not keep answering every
     # request with "batcher unavailable" while a supervisor sees a
@@ -1616,7 +1668,6 @@ def main(argv=None) -> int:
     cluster = maybe_initialize_from_env()
     if cluster is not None:
         for flag, msg in (
-                (args.draft_config, "--draft-config"),
                 (args.quantize, "--quantize"),
                 (args.host_load, "--host-load")):
             if flag:
@@ -1624,12 +1675,17 @@ def main(argv=None) -> int:
                     f"{msg} is single-host serving for now; the "
                     "multi-host engine runs plain sharded generate "
                     "(drop the flag, or serve per-host)")
-        if args.batch_slots and (args.kv_quant or args.prefix_cache
-                                 or args.kv_block):
+        if args.draft_config and not args.batch_slots:
             raise SystemExit(
-                "multihost --batch-slots runs the lock-step dense "
-                "batcher; --kv-quant/--prefix-cache/--kv-block are "
-                "single-host batching features for now")
+                "--draft-config in multihost mode runs inside the "
+                "lock-step batcher (per-slot proposals, shared sharded "
+                "verify) — add --batch-slots N")
+        if not args.batch_slots and (args.prefix_cache or args.kv_block
+                                     or args.kv_pool):
+            raise SystemExit(
+                "--prefix-cache/--kv-block/--kv-pool configure the "
+                "batching scheduler; they need --batch-slots N "
+                "(multihost or not)")
         return _serve_multihost(args, config)
 
     import jax
@@ -1680,8 +1736,11 @@ def main(argv=None) -> int:
     if args.draft_config:
         dcfg = named_config(args.family, args.draft_config)
         dtrainer = Trainer.create(dcfg, MeshPlan(), devices=jax.devices()[:1])
+        # fresh-init drafts use key(1), matching the multihost path: under
+        # the target's key(0) a same-named-config draft would BE the
+        # target (trivial 100% acceptance in every harness run)
         dparams = _maybe_ungroup(
-            _load_params(dtrainer, args.draft_checkpoint), dcfg)
+            _load_params(dtrainer, args.draft_checkpoint, init_key=1), dcfg)
         if dcfg.vocab_size != config.vocab_size:
             raise SystemExit("draft and target must share a vocab")
         draft = (dcfg, dparams)

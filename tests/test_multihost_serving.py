@@ -267,6 +267,182 @@ def test_multihost_batched_serving_concurrent_streams(app, tmp_path):
         _kill_all(procs)
 
 
+PAGED_SERVE_SCRIPT = r"""
+import sys
+from gpu_docker_api_tpu.workloads.serve import main
+sys.exit(main(["--family", "llama", "--config", "tiny",
+               "--tp", "2", "--batch-slots", "4", "--batch-max-len", "64",
+               "--decode-chunk", "8", "--batch-prefill-chunk", "4",
+               "--kv-block", "8", "--kv-pool", "14", "--kv-quant",
+               "--prefix-cache", "2",
+               "--host", "127.0.0.1", "--port", sys.argv[1]]))
+"""
+
+
+def _reference_paged_batcher_streams(prompts, max_new):
+    """Single-process batcher with the IDENTICAL composition flags — the
+    bit-equality oracle for the multihost paged test (sequential submits:
+    block placement differs, values must not)."""
+    import jax
+    import jax.numpy as jnp
+    from gpu_docker_api_tpu.models.llama import LlamaConfig
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan
+    from gpu_docker_api_tpu.train import Trainer
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    cfg = LlamaConfig.tiny()
+    trainer = Trainer.create(cfg, MeshPlan(), devices=jax.devices()[:1])
+    params = trainer.init(jax.random.key(0))["params"]
+    b = _Batcher(cfg, params, slots=4, max_len=64, prefill_chunk=4,
+                 prefix_cache=2, kv_quant=True, kv_block=8,
+                 kv_pool_blocks=14, decode_chunk=8)
+    try:
+        return [b.submit(jnp.asarray(p, jnp.int32), max_new)
+                for p in prompts]
+    finally:
+        b.close()
+
+
+def test_multihost_paged_prefix_kv8_lock_step(app, tmp_path):
+    """The single-host serving compositions ride the lock-step batcher
+    (round-5 closure of the 'dense only' scope note): paged KV with a
+    pool SMALL enough to force head-of-line parking, in-flight prefix
+    sharing + the prefix store, and int8 KV — across two real
+    processes. Every rank replays the same admission/parking/share
+    decisions from the broadcast pending list, so each stream must be
+    bit-equal to an identically-configured single-process batcher."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    multihost = _spanning_grant(app.server.port, "pagedpod", 8)
+    serve_port = _free_port()
+    procs = _launch_workers(multihost, tmp_path, PAGED_SERVE_SCRIPT,
+                            [str(serve_port)], devices_per_proc=4,
+                            coord_port=_free_port(), tag="pserve")
+    try:
+        health = _wait_healthz(serve_port, procs)
+        assert health["batching"]["paged"] == {
+            "blockSize": 8, "poolBlocks": 14, "freeBlocks": 13}
+
+        # 9-token common prefix (one full 8-token block usable) +
+        # distinct tails; 12 + 24 tokens = 5 blocks/request unshared, so
+        # a 13-free-block pool forces at least one concurrent request to
+        # park until an earlier stream frees its blocks
+        base = [5, 3, 8, 1, 9, 2, 7, 4, 6]
+        prompts = [base + t for t in
+                   ([11, 12, 13], [11, 14, 15], [16, 17, 18], [19, 20, 21])]
+        max_new = 24
+        want = _reference_paged_batcher_streams(prompts, max_new)
+
+        def ask(p):
+            return _call(serve_port, "POST", "/generate",
+                         {"tokens": [p], "max_new": max_new},
+                         timeout=240)["tokens"][0]
+
+        ex = ThreadPoolExecutor(4)
+        try:
+            futs = [ex.submit(ask, p) for p in prompts]
+            got = [f.result(timeout=240) for f in futs]
+        finally:
+            ex.shutdown(wait=True)
+        for g, w in zip(got, want):
+            assert g == w
+
+        # the composition actually engaged: blocks were shared (in-flight
+        # donors and/or the prefix store), and the pool drained back —
+        # only stored prefixes still hold references
+        health = _call(serve_port, "GET", "/healthz")
+        assert health["batching"]["prefixHits"] >= 1
+        paged = health["batching"]["paged"]
+        assert paged["freeBlocks"] >= 11    # <= 2 stored 1-block prefixes
+
+        # a second pass over one prompt must hit the prefix STORE (its
+        # full first block re-enters the new page table zero-copy) and
+        # stay bit-equal
+        assert ask(prompts[0]) == want[0]
+        health = _call(serve_port, "GET", "/healthz")
+        assert health["batching"]["prefixHits"] >= 2
+    finally:
+        _kill_all(procs)
+
+
+SPEC_SERVE_SCRIPT = r"""
+import sys
+from gpu_docker_api_tpu.workloads.serve import main
+sys.exit(main(["--family", "llama", "--config", "tiny",
+               "--tp", "2", "--batch-slots", "3", "--batch-max-len", "64",
+               "--batch-prefill-chunk", "4",
+               "--draft-config", "tiny", "--gamma", "3",
+               "--kv-block", "8", "--kv-quant",
+               "--host", "127.0.0.1", "--port", sys.argv[1]]))
+"""
+
+
+def test_multihost_speculative_paged_lock_step(app, tmp_path):
+    """Speculative decoding INSIDE the lock-step batcher, over the paged
+    int8 target cache, across two real processes: every rank runs the
+    same draft rounds + shared sharded verify, and the accept/rollback
+    decisions replay identically from SPMD device results. Greedy spec
+    is bit-exact by construction, so the oracle is the single-process
+    NON-speculative batcher with the same cache flags — equality proves
+    the whole multihost spec stack emits exactly the target-only
+    streams. The fresh-init draft uses a different key than the target
+    (worst-case proposals), so rejection/rollback paths really run."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+    from gpu_docker_api_tpu.models.llama import LlamaConfig
+    from gpu_docker_api_tpu.parallel.mesh import MeshPlan
+    from gpu_docker_api_tpu.train import Trainer
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    multihost = _spanning_grant(app.server.port, "specpod", 8)
+    serve_port = _free_port()
+    procs = _launch_workers(multihost, tmp_path, SPEC_SERVE_SCRIPT,
+                            [str(serve_port)], devices_per_proc=4,
+                            coord_port=_free_port(), tag="sserve")
+    try:
+        health = _wait_healthz(serve_port, procs)
+        assert health["batching"]["speculative"]["gamma"] == 3
+
+        prompts = [[3, 7, 1, 9, 4, 2], [5, 1, 8, 2, 6, 4, 9, 9],
+                   [2, 2, 6, 4, 1, 1, 3]]
+        max_new = 20
+
+        cfg = LlamaConfig.tiny()
+        trainer = Trainer.create(cfg, MeshPlan(), devices=jax.devices()[:1])
+        params = trainer.init(jax.random.key(0))["params"]
+        oracle = _Batcher(cfg, params, slots=3, max_len=64,
+                          prefill_chunk=4, kv_quant=True, kv_block=8)
+        try:
+            want = [oracle.submit(jnp.asarray(p, jnp.int32), max_new)
+                    for p in prompts]
+        finally:
+            oracle.close()
+
+        def ask(p):
+            return _call(serve_port, "POST", "/generate",
+                         {"tokens": [p], "max_new": max_new},
+                         timeout=240)["tokens"][0]
+
+        ex = ThreadPoolExecutor(3)
+        try:
+            futs = [ex.submit(ask, p) for p in prompts]
+            got = [f.result(timeout=240) for f in futs]
+        finally:
+            ex.shutdown(wait=True)
+        for g, w in zip(got, want):
+            assert g == w
+
+        spec = _call(serve_port, "GET", "/healthz")["batching"]["speculative"]
+        assert spec["rounds"] > 0 and spec["emitted"] > 0
+        # a key(1) draft against a key(0) target proposes near-noise:
+        # some proposals must have been rejected (rollback paths ran)
+        assert spec["accepted"] < spec["proposed"]
+    finally:
+        _kill_all(procs)
+
+
 def test_spanning_patch_and_rollback_cluster_reforms(app, tmp_path):
     """Patch 8 -> 16 chips (2 -> 4 workers), then roll back: after each
     worker-set change the relaunched cluster resumes training from the
