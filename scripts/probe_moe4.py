@@ -1,0 +1,171 @@
+"""Chip probe 4: can the MoE routing/dispatch machinery tax be cut?
+
+probe_moe3 put the per-layer-microbatch tax at routing ~2.1 ms +
+gathers ~2.2 ms fwd (+1.3 grad) against ~2 ms of expert matmul — the
+documented floor behind 28.2% active-FLOPs MFU (BASELINE.md). This
+probe times drop-in replacements for each term in isolation, same
+chain-timer discipline as probe_moe3 (output feeds next input; clock
+stopped on a host fetch):
+
+  route_topk / route_2max   — lax.top_k(probs, 2) vs two-pass masked max
+                              (k=2 needs no sort network)
+  cumsum / cumsum_blocked   — capacity ranking: jnp.cumsum over [K*T, E]
+                              vs two-level blocked scan (within-block
+                              tril matmul on the MXU + tiny cross-block
+                              cumsum — converts a length-8192 serial
+                              scan into G=16 block sums)
+  gath_take / gath_onehot   — slot->token row gather vs dispatch by
+                              [C_sub, T] one-hot matmul per expert
+
+Usage: python scripts/probe_moe4.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+INNER = 32
+REPS = 3
+
+
+def chain_timer(step, x0):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(x):
+        def body(c, _):
+            return step(c), None
+        c, _ = jax.lax.scan(body, x, None, length=INNER)
+        return jnp.sum(jax.tree.leaves(c)[0].astype(jnp.float32))
+
+    float(chain(x0))
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        float(chain(x0))
+        best = min(best, time.perf_counter() - t0)
+    return best / INNER
+
+
+def blocked_cumsum(flat, block: int = 512):
+    """Inclusive cumsum along axis 0 of [N, E] via two-level blocks:
+    within-block prefix sums ride a [B, B] tril MATMUL (MXU work, no
+    serial scan), block offsets come from one tiny cumsum over N/B
+    block totals."""
+    import jax.numpy as jnp
+
+    n, e = flat.shape
+    g = n // block
+    x = flat.reshape(g, block, e).astype(jnp.float32)
+    tril = jnp.tril(jnp.ones((block, block), jnp.float32))
+    within = jnp.einsum("ab,gbe->gae", tril, x)          # [G, B, E]
+    totals = within[:, -1, :]                            # [G, E]
+    offs = jnp.cumsum(totals, axis=0) - totals           # exclusive [G, E]
+    return (within + offs[:, None, :]).reshape(n, e)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.models.moe import MoEConfig
+
+    c = MoEConfig.moe_1b()
+    t, d, e = 4096, c.d_model, c.n_experts
+    k = c.top_k
+    cap = c.capacity(t)
+    key = jax.random.key(0)
+    ht = jax.random.normal(key, (t, d), jnp.bfloat16)
+    router = jax.random.normal(key, (d, e), jnp.float32) * 0.02
+
+    out = {"t": t, "cap": cap, "inner": INNER}
+
+    # -- routing: top_k vs two-pass max ------------------------------------
+    def route_topk(h):
+        logits = h.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, -1)
+        g, i = jax.lax.top_k(probs, k)
+        return h + ((probs + jnp.sum(g) + jnp.sum(i))
+                    @ router.T).astype(h.dtype) * 1e-3
+
+    def route_2max(h):
+        logits = h.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, -1)
+        i1 = jnp.argmax(probs, -1)
+        g1 = jnp.max(probs, -1)
+        masked = probs.at[jnp.arange(t), i1].set(-jnp.inf)
+        i2 = jnp.argmax(masked, -1)
+        g2 = jnp.max(masked, -1)
+        g = jnp.stack([g1, g2], -1)
+        i = jnp.stack([i1, i2], -1)
+        return h + ((probs + jnp.sum(g) + jnp.sum(i))
+                    @ router.T).astype(h.dtype) * 1e-3
+
+    out["route_topk_ms"] = round(chain_timer(route_topk, ht) * 1e3, 3)
+    out["route_2max_ms"] = round(chain_timer(route_2max, ht) * 1e3, 3)
+
+    # -- capacity ranking: cumsum vs blocked tril matmul -------------------
+    gate_idx = jax.random.randint(key, (t, k), 0, e, jnp.int32)
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)
+    flat0 = onehot.transpose(1, 0, 2).reshape(t * k, e)
+
+    def cs_base(f):
+        pos = jnp.cumsum(f, axis=0) * f - 1
+        return f + (jnp.sum(pos) % 2).astype(f.dtype)  # data dep, no drift
+
+    def cs_blocked(f):
+        pos = (blocked_cumsum(f).astype(jnp.int32)) * f - 1
+        return f + (jnp.sum(pos) % 2).astype(f.dtype)
+
+    out["cumsum_ms"] = round(chain_timer(cs_base, flat0) * 1e3, 3)
+    out["cumsum_blocked_ms"] = round(chain_timer(cs_blocked, flat0) * 1e3, 3)
+    # correctness cross-check
+    a = jnp.cumsum(flat0, axis=0)
+    b = blocked_cumsum(flat0).astype(jnp.int32)
+    assert bool(jnp.all(a == b)), "blocked cumsum mismatch"
+
+    # -- dispatch gather vs one-hot matmul dispatch ------------------------
+    from gpu_docker_api_tpu.models.moe import capacity_positions
+    pos = capacity_positions(onehot)
+    keep = pos < cap
+    flat_slot = jnp.where(keep, gate_idx * cap + pos, e * cap)
+    gv = jax.random.uniform(key, (t, k), jnp.float32)
+
+    def gath_take(h):
+        tok = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[:, None],
+                               flat_slot.shape)
+        slot_tok = jnp.full((e * cap,), t, jnp.int32).at[
+            flat_slot.reshape(-1)].set(tok.reshape(-1), mode="drop")
+        hp = jnp.concatenate([h, jnp.zeros((1, d), h.dtype)], 0)
+        xe = jnp.take(hp, slot_tok, axis=0)
+        back = jnp.take(xe, jnp.where(keep, flat_slot, 0), axis=0)
+        w = (gv * keep.astype(jnp.float32))[..., None]
+        return jnp.sum(back.astype(jnp.float32) * w, 1).astype(h.dtype)
+
+    out["gath_take_fwd_ms"] = round(chain_timer(gath_take, ht) * 1e3, 3)
+    g_fn = jax.grad(lambda h: jnp.sum(gath_take(h).astype(jnp.float32)))
+    out["gath_take_fwdgrad_ms"] = round(chain_timer(g_fn, ht) * 1e3, 3)
+
+    # one-hot dispatch as [E*C, T] x [T, D] matmul (the einsum path's
+    # dispatch HALF only, to see whether take or matmul wins per-term)
+    def gath_onehot(h):
+        slot_oh = (jax.nn.one_hot(flat_slot[:, 0], e * cap, dtype=h.dtype)
+                   + jax.nn.one_hot(flat_slot[:, 1], e * cap,
+                                    dtype=h.dtype))          # [T, E*C]
+        xe = jnp.einsum("ts,td->sd", slot_oh, h)
+        w = (gv * keep.astype(jnp.float32))
+        back = jnp.einsum("sd,ts->td", xe.astype(jnp.float32),
+                          slot_oh.astype(jnp.float32) * w[:, 0:1].T.T)
+        return back.astype(h.dtype)
+
+    out["gath_onehot_fwd_ms"] = round(
+        chain_timer(gath_onehot, ht) * 1e3, 3)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
