@@ -557,8 +557,8 @@ def _blocks(blk_q, blk_k, s, training):
     """Resolve user overrides (0 = auto) per execution path — jax traces
     the primal-only rule for inference and the vjp rules for training, so
     each gets its own measured tile (see _auto_block)."""
-    return (blk_q or _auto_block(s, training),
-            blk_k or _auto_block(s, training))
+    auto_q, auto_k = _auto_block(s, training)
+    return (blk_q or auto_q, blk_k or auto_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -593,20 +593,29 @@ def _fit_block(target: int, s: int) -> int:
     return max(target, 1)
 
 
-def _auto_block(s: int, training: bool) -> int:
-    """Measured on a real v5e chip (round 2, fetch-synced min-of-3 chains).
-    Small 128x128 score matmuls underfeed the MXU pipeline, but the best
-    tile differs per path — so the primal-only (inference) kernel and the
-    custom_vjp (training) kernels choose independently:
-    - fwd-only: 256 below S=4096 (S=2048: 2.65 ms vs 4.55 at 512), 512 at
-      S>=4096 (30.8 TF/s, 3.5x XLA);
-    - fwd+bwd: 512 from S>=1024 (S=2048: 10.4 ms vs 12.0 at 256; S=1024:
-      4.30 vs 4.41) — it lifted llama_250m training to 39.7% MFU."""
+def _auto_block(s: int, training: bool) -> tuple[int, int]:
+    """-> (blk_q, blk_k), measured on a real v5e chip. Round 2 probed
+    SQUARE tiles only (256 fwd below 4096, else 512); round 5 probed the
+    axes separately: the per-block epilogue's acc/l RESCALE work scales
+    1/blk_k while the O(S^2) exp work is blocking-invariant, so TALL-KV
+    tiles cut the VPU term that is this kernel's roofline. Interleaved
+    same-process A/B (the pallas arm's absolute TF/s swings ~2.6x
+    between tunnel epochs, so only interleaved ratios rank tiles —
+    scripts/probe_flash_tiles.py):
+    - fwd-only (512,1024) vs the old auto: S=1024 1.38x, S=2048 1.68x,
+      S=4096 1.25x (twice, spread <= 0.03);
+    - fwd+bwd (512,1024) vs (512,512): S=2048 1.06x, S=4096 1.13x;
+      S=1024 is a wash (0.99x) — kept square."""
     if training:
-        target = 512 if s >= 1024 else 256
+        if s >= 2048:
+            q_t, k_t = 512, 1024
+        elif s >= 1024:
+            q_t, k_t = 512, 512
+        else:
+            q_t, k_t = 256, 256
     else:
-        target = 512 if s >= 4096 else 256
-    return _fit_block(target, s)
+        q_t, k_t = 512, 1024
+    return _fit_block(q_t, s), _fit_block(k_t, s)
 
 
 @functools.partial(jax.jit,
@@ -688,8 +697,7 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     s = q.shape[1]
-    blk_q = blk_q or _auto_block(s, training=True)
-    blk_k = blk_k or _auto_block(s, training=True)
+    blk_q, blk_k = _blocks(blk_q, blk_k, s, training=True)
     return _flash_lse(q, k, v, causal, blk_q, blk_k, interpret, window)
 
 
@@ -816,6 +824,19 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def piece(x, i):
         return x[:, i * chunk:(i + 1) * chunk]
 
+    # n >= 16 (32k+ at the default chunk): under remat "full" the
+    # recompute-side lse kernels run on F32 operands, and at blk_q 512
+    # the stacked launch's scoped VMEM lands 448K past the 16M limit
+    # (measured compile-OOM at S=32k) — cap q rows there, keep the
+    # tall-kv tile. 16k and below keep the full (512,1024) win
+    # (774 ms vs 849 at blk_q 256, measured). The cap also binds
+    # fwd-only 32k calls that would fit at 512 (bf16 operands, no
+    # recompute): whether a trace will be differentiated is unknowable
+    # here, and a per-grad split would double the 32k program variety
+    # for a ~10% fwd-only win on a path trained far more than it is
+    # inferred — conservative single cap, revisit if 32k+ inference
+    # becomes hot.
+    stack_bq = 256 if n >= 16 else None
     if causal and not window:
         # stacked-batch plan: one causal launch for the n diagonals...
         qs = q.reshape(b, n, chunk, h, -1)
@@ -829,7 +850,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         diag_o, diag_l = flash_attention_lse(
             stack(qs, list(range(n))), stack(ks, list(range(n))),
-            stack(vs, list(range(n))), causal=True, interpret=interpret)
+            stack(vs, list(range(n))), causal=True, blk_q=stack_bq,
+            interpret=interpret)
         # ...and the past pairs in a few big non-causal launches
         pairs = [(i, j) for i in range(n) for j in range(i)]
         cap = max(FLASH_PAIR_STACK, 1)
@@ -846,7 +868,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 stack(qs, [i for i, _ in grp]),
                 stack(ks, [j for _, j in grp]),
                 stack(vs, [j for _, j in grp]),
-                causal=False, interpret=interpret)
+                causal=False, blk_q=stack_bq, interpret=interpret)
             for t, (i, j) in enumerate(grp):
                 past_o[(i, j)] = po[t * b:(t + 1) * b]
                 past_l[(i, j)] = plse[t * b:(t + 1) * b]

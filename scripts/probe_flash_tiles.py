@@ -1,0 +1,92 @@
+"""Chip probe: asymmetric flash tiles.
+
+_auto_block (round 2) picked SQUARE tiles (256/512). But the per-block
+VPU epilogue splits into terms with different tile scaling: the exp of
+every score is invariant (O(S^2) transcendentals no blocking removes),
+while the acc/l RESCALE work is O(S^2 * d / blk_k) — it shrinks as kv
+blocks grow, independent of blk_q. Square tiles never probed that axis:
+this sweeps (blk_q, blk_k) over the public flash_attention overrides,
+fwd (inference path) and fwd+bwd (training path), S=2048/4096, causal.
+Chain discipline: N calls per timing with the output feeding the next
+query (nothing CSE'd/overlapped), clock stopped on a host fetch.
+
+Usage: python scripts/probe_flash_tiles.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+REPS = 3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_docker_api_tpu.ops.attention import flash_attention
+
+    b, h, d = 4, 8, 128
+    key = jax.random.key(0)
+
+    for s, chain in ((1024, 64), (2048, 32), (4096, 16)):
+        q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        flops = 4 * b * h * s * s * d / 2          # causal fwd
+
+        tiles = [(128, 128), (256, 256), (512, 512),
+                 (256, 512), (256, 1024), (512, 1024),
+                 (128, 1024), (512, 2048), (256, 2048)]
+        for bq, bk in tiles:
+            if bq > s or bk > s:
+                continue
+
+            @jax.jit
+            def fwd_chain(q0):
+                def body(c, _):
+                    o = flash_attention(c, k, v, causal=True,
+                                        blk_q=bq, blk_k=bk)
+                    return o, None
+                c, _ = jax.lax.scan(body, q0, None, length=chain)
+                return jnp.sum(c.astype(jnp.float32))
+
+            @jax.jit
+            def bwd_chain(q0):
+                def body(c, _):
+                    g = jax.grad(lambda qq: jnp.sum(flash_attention(
+                        qq, k, v, causal=True, blk_q=bq,
+                        blk_k=bk).astype(jnp.float32)))(c)
+                    return g.astype(jnp.bfloat16), None
+                c, _ = jax.lax.scan(body, q0, None, length=chain)
+                return jnp.sum(c.astype(jnp.float32))
+
+            row = {"s": s, "bq": bq, "bk": bk}
+            try:
+                float(fwd_chain(q))
+                best = float("inf")
+                for _ in range(REPS):
+                    t0 = time.perf_counter()
+                    float(fwd_chain(q))
+                    best = min(best, time.perf_counter() - t0)
+                row["fwd_ms"] = round(best / chain * 1e3, 3)
+                row["fwd_tflops"] = round(flops / (best / chain) / 1e12, 1)
+            except Exception as e:
+                row["fwd_err"] = str(e)[:120]
+            try:
+                float(bwd_chain(q))
+                best = float("inf")
+                for _ in range(REPS):
+                    t0 = time.perf_counter()
+                    float(bwd_chain(q))
+                    best = min(best, time.perf_counter() - t0)
+                row["fwdbwd_ms"] = round(best / chain * 1e3, 3)
+            except Exception as e:
+                row["bwd_err"] = str(e)[:120]
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

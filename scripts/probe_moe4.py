@@ -158,7 +158,7 @@ def main():
         xe = jnp.einsum("ts,td->sd", slot_oh, h)
         w = (gv * keep.astype(jnp.float32))
         back = jnp.einsum("sd,ts->td", xe.astype(jnp.float32),
-                          slot_oh.astype(jnp.float32) * w[:, 0:1].T.T)
+                          slot_oh.astype(jnp.float32) * w[:, 0:1])
         return back.astype(h.dtype)
 
     out["gath_onehot_fwd_ms"] = round(
