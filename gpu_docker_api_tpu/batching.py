@@ -80,6 +80,26 @@ def slot_prefill(params, prompt, cache, slot, config, append: bool = False):
     return logits[:, -1], out
 
 
+def kv_shard_specs(mesh, shapes, axis: str = "tp") -> dict:
+    """NamedSharding tree for a cache pytree under serve --shard-kv:
+    K/V buffers and their kv8 scales shard over `axis` on the kv-head
+    dim — ALWAYS ndim-2 in every cache layout (dense
+    [L,slots,T,Hkv,D], paged pool [L,blocks,blk,Hkv,D], scales
+    [...,Hkv,1]) — while the bookkeeping (lengths, page tables) stays
+    replicated. The ONE definition of the sharded-KV layout:
+    serve._LockstepBatcher._build and the dryrun's S4/S5
+    communication-shape plans both call it, so the pinned plan and the
+    live server layout cannot drift."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    out = {}
+    for key, leaf in shapes.items():
+        spec = [None] * leaf.ndim
+        if key in ("k", "v", "ks", "vs"):
+            spec[leaf.ndim - 2] = axis
+        out[key] = NamedSharding(mesh, PartitionSpec(*spec))
+    return out
+
+
 def _buf_keys(cache) -> tuple:
     """The per-slot device buffers, in a fixed order ("k","v"[,"ks","vs"])."""
     return tuple(kk for kk in ("k", "v", "ks", "vs") if kk in cache)

@@ -181,11 +181,12 @@ class _Batcher:
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
 
-    def _build(self, init_fn):
+    def _build(self, init_fn, kv_sharded: bool = False):
         """Materialize one freshly-initialized cache pytree. Hook: the
-        lock-step subclass jits init_fn with replicated out_shardings so
-        the arrays are GLOBAL over its mesh (the jitted slot-ops mix the
-        cache with mesh-sharded params)."""
+        lock-step subclass jits init_fn with mesh out_shardings so the
+        arrays are GLOBAL over its mesh (the jitted slot-ops mix the
+        cache with mesh-sharded params); kv_sharded marks the TARGET
+        cache, whose K/V buffers it may additionally shard over tp."""
         return init_fn()
 
     def _make_cache(self) -> None:
@@ -195,14 +196,15 @@ class _Batcher:
             from ..paging import BlockAllocator, init_paged_cache
             self.cache = self._build(lambda: init_paged_cache(
                 self.config, self.kv_pool_blocks, self.kv_block,
-                len(self.slots), self._max_pages, quantized=self.kv_quant))
+                len(self.slots), self._max_pages, quantized=self.kv_quant),
+                kv_sharded=True)
             self._alloc = BlockAllocator(self.kv_pool_blocks)
             self._slot_blocks: list = [None] * len(self.slots)
         else:
             from ..batching import init_slot_cache
             self.cache = self._build(lambda: init_slot_cache(
                 self.config, len(self.slots), self._cache_len,
-                quantized=self.kv_quant))
+                quantized=self.kv_quant), kv_sharded=True)
         if self._draft is not None:
             from ..batching import init_slot_cache
             self.d_cache = self._build(lambda: init_slot_cache(
@@ -996,7 +998,7 @@ class _LockstepBatcher(_Batcher):
     BCAST_K = 4
 
     def __init__(self, config, params, slots: int, max_len: int, mesh,
-                 rank: int, **kw):
+                 rank: int, shard_kv: bool = False, **kw):
         """kw forwards the _Batcher composition knobs (prefill_chunk,
         decode_chunk, seed, kv_quant, kv_block, kv_pool_blocks,
         prefix_cache, draft, gamma) — the paged allocator, prefix store,
@@ -1005,19 +1007,34 @@ class _LockstepBatcher(_Batcher):
         only cache CONSTRUCTION needs the mesh (see _build)."""
         self._mesh = mesh
         self._rank = rank
+        self._shard_kv = shard_kv
         self._pending: list = []
         super().__init__(config, params, slots, max_len, restarts=0, **kw)
 
-    def _build(self, init_fn):
+    def _build(self, init_fn, kv_sharded: bool = False):
         """Every cache (dense or paged pool, target or draft) must be a
         GLOBAL array (the jitted slot-ops mix it with the mesh-sharded
-        params): replicated over the mesh — every rank holds the full
-        cache, matmuls still run tp-sharded (the KV attend is the
-        replicated part; sharded-KV is a dryrun plan first)."""
+        params). Default: replicated — every rank holds the full cache,
+        matmuls still run tp-sharded (the KV attend is the replicated
+        part). shard_kv: the TARGET cache's K/V buffers (and their kv8
+        scales) shard over tp on the kv-head axis (always axis ndim-2
+        in every layout — dense [L,slots,T,Hkv,D], paged pool
+        [L,blocks,blk,Hkv,D], scales [...,Hkv,1]), cutting per-rank
+        cache HBM by tp: the attend runs on each rank's own heads (q is
+        already head-sharded by the megatron wq), and the page tables /
+        lengths stay replicated so the host allocator logic is
+        untouched. The dryrun's S4 plan pins the HLO shape: no
+        cache-sized collectives appear."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
-        return jax.jit(init_fn, out_shardings=NamedSharding(
-            self._mesh, PartitionSpec()))()
+
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        if not (kv_sharded and self._shard_kv):
+            return jax.jit(init_fn, out_shardings=repl)()
+        from ..batching import kv_shard_specs
+        out_shardings = kv_shard_specs(self._mesh,
+                                       jax.eval_shape(init_fn))
+        return jax.jit(init_fn, out_shardings=out_shardings)()
 
     def _has_waiters(self) -> bool:
         return self._waiting is not None or bool(self._pending)
@@ -1392,6 +1409,12 @@ def _serve_multihost(args, config) -> int:
     b_max, t_max = 8, config.max_seq_len
 
     if args.batch_slots > 0:
+        if args.shard_kv:
+            n_kv = getattr(config, "n_kv_heads", 0) or config.n_heads
+            if n_kv % tp:
+                raise SystemExit(
+                    f"--shard-kv needs n_kv_heads ({n_kv}) divisible "
+                    f"by tp ({tp})")
         draft = None
         if args.draft_config:
             from ..models import named_config
@@ -1530,7 +1553,7 @@ def _serve_multihost_batched(args, config, trainer, params, rank,
             kv_quant=args.kv_quant, kv_block=args.kv_block,
             kv_pool_blocks=args.kv_pool,
             prefix_cache=args.prefix_cache,
-            draft=draft, gamma=args.gamma)
+            draft=draft, gamma=args.gamma, shard_kv=args.shard_kv)
     except ValueError as e:
         raise SystemExit(str(e))
     if rank != 0:
@@ -1546,6 +1569,8 @@ def _serve_multihost_batched(args, config, trainer, params, rank,
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     mode = (f"paged ({batcher.kv_pool_blocks} x {args.kv_block} "
             f"token blocks)" if args.kv_block else "dense")
+    if args.shard_kv:
+        mode += ", tp-sharded"
     spec = (f", speculative (draft {args.draft_config}, gamma "
             f"{args.gamma})" if draft else "")
     print(f"multihost continuous batching {name} "
@@ -1644,6 +1669,11 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel width for MULTI-HOST serving "
                         "(0 = auto); single-host serving ignores it")
+    p.add_argument("--shard-kv", action="store_true",
+                   help="multihost batching: shard the slot/paged KV "
+                        "cache over tp on the kv-head axis instead of "
+                        "replicating it — per-rank cache HBM drops by "
+                        "tp (requires n_kv_heads %% tp == 0)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -1680,6 +1710,10 @@ def main(argv=None) -> int:
                 "--draft-config in multihost mode runs inside the "
                 "lock-step batcher (per-slot proposals, shared sharded "
                 "verify) — add --batch-slots N")
+        if args.shard_kv and not args.batch_slots:
+            raise SystemExit(
+                "--shard-kv shards the batching scheduler's cache; it "
+                "needs --batch-slots N")
         if not args.batch_slots and (args.prefix_cache or args.kv_block
                                      or args.kv_pool):
             raise SystemExit(
@@ -1687,6 +1721,10 @@ def main(argv=None) -> int:
                 "batching scheduler; they need --batch-slots N "
                 "(multihost or not)")
         return _serve_multihost(args, config)
+    if args.shard_kv:
+        raise SystemExit(
+            "--shard-kv is multihost serving (the single-host cache "
+            "has no mesh to shard over)")
 
     import jax
     if args.host_load:

@@ -4,11 +4,20 @@ _auto_block (round 2) picked SQUARE tiles (256/512). But the per-block
 VPU epilogue splits into terms with different tile scaling: the exp of
 every score is invariant (O(S^2) transcendentals no blocking removes),
 while the acc/l RESCALE work is O(S^2 * d / blk_k) — it shrinks as kv
-blocks grow, independent of blk_q. Square tiles never probed that axis:
-this sweeps (blk_q, blk_k) over the public flash_attention overrides,
-fwd (inference path) and fwd+bwd (training path), S=2048/4096, causal.
-Chain discipline: N calls per timing with the output feeding the next
-query (nothing CSE'd/overlapped), clock stopped on a host fetch.
+blocks grow, independent of blk_q. Square tiles never probed that axis.
+
+TWO phases, because the pallas arm's ABSOLUTE rate is epoch-bimodal
+through the axon tunnel (22.7 vs 58.9 TF/s for the identical
+kernel+shape 40 min apart, XLA arm steady — BASELINE.md flash row):
+
+1. sweep — each tile timed on its own chained scan (output feeds the
+   next query; one host fetch stops the clock). Orients the search,
+   but rows from different minutes are not comparable across epochs.
+2. interleaved A/B — the ADJUDICATOR: candidate and baseline tiles
+   alternate A B A B within one process, best-of-5 per arm, ratio
+   reported. This is the phase the _auto_block/BASELINE.md numbers
+   come from (1.38x/1.68x/1.25x fwd at S=1024/2048/4096 for
+   (512,1024) over the old auto; 1.06-1.13x grad; s1024 grad wash).
 
 Usage: python scripts/probe_flash_tiles.py
 """
@@ -86,6 +95,54 @@ def main():
             except Exception as e:
                 row["bwd_err"] = str(e)[:120]
             print(json.dumps(row), flush=True)
+
+    # ---- phase 2: interleaved A/B (the adjudicator) -------------------
+    def make(s, bq, bk, chain, grad, k, v):
+        @jax.jit
+        def run(q0):
+            def body(c, _):
+                if grad:
+                    g = jax.grad(lambda qq: jnp.sum(flash_attention(
+                        qq, k, v, causal=True, blk_q=bq,
+                        blk_k=bk).astype(jnp.float32)))(c)
+                    return g.astype(jnp.bfloat16), None
+                return flash_attention(c, k, v, causal=True,
+                                       blk_q=bq, blk_k=bk), None
+            c, _ = jax.lax.scan(body, q0, None, length=chain)
+            return jnp.sum(c.astype(jnp.float32))
+        return run
+
+    # bases are round 2's auto tiles per path; candidate is the tall-kv
+    # (512,1024) that _auto_block now defaults to
+    cases = [
+        (1024, 256, False, (256, 256)), (1024, 256, True, (512, 512)),
+        (2048, 128, False, (256, 256)), (2048, 128, True, (512, 512)),
+        (4096, 64, False, (512, 512)), (4096, 64, True, (512, 512)),
+    ]
+    for s, chain, grad, (base_q, base_k) in cases:
+        q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        bq, bk = 512, min(1024, s)
+        base = make(s, base_q, base_k, chain, grad, k, v)
+        cand = make(s, bq, bk, chain, grad, k, v)
+        float(base(q))
+        float(cand(q))              # compiles outside the timing
+        ta, tb = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(base(q))
+            ta.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            float(cand(q))
+            tb.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "ab": True, "s": s, "grad": grad,
+            "base": [base_q, base_k], "cand": [bq, bk],
+            "base_ms": round(min(ta) / chain * 1e3, 3),
+            "cand_ms": round(min(tb) / chain * 1e3, 3),
+            "cand_over_base": round(min(ta) / min(tb), 3),
+        }), flush=True)
 
 
 if __name__ == "__main__":

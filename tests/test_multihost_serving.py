@@ -274,7 +274,7 @@ sys.exit(main(["--family", "llama", "--config", "tiny",
                "--tp", "2", "--batch-slots", "4", "--batch-max-len", "64",
                "--decode-chunk", "8", "--batch-prefill-chunk", "4",
                "--kv-block", "8", "--kv-pool", "14", "--kv-quant",
-               "--prefix-cache", "2",
+               "--prefix-cache", "2", "--shard-kv",
                "--host", "127.0.0.1", "--port", sys.argv[1]]))
 """
 
@@ -307,10 +307,13 @@ def test_multihost_paged_prefix_kv8_lock_step(app, tmp_path):
     """The single-host serving compositions ride the lock-step batcher
     (round-5 closure of the 'dense only' scope note): paged KV with a
     pool SMALL enough to force head-of-line parking, in-flight prefix
-    sharing + the prefix store, and int8 KV — across two real
-    processes. Every rank replays the same admission/parking/share
-    decisions from the broadcast pending list, so each stream must be
-    bit-equal to an identically-configured single-process batcher."""
+    sharing + the prefix store, int8 KV, and --shard-kv (the int8 pool
+    + scales sharded over tp on the kv-head axis; the oracle batcher
+    runs unsharded, so equality also pins that sharding never changes a
+    stream) — across two real processes. Every rank replays the same
+    admission/parking/share decisions from the broadcast pending list,
+    so each stream must be bit-equal to an identically-configured
+    single-process batcher."""
     from concurrent.futures import ThreadPoolExecutor
 
     multihost = _spanning_grant(app.server.port, "pagedpod", 8)
@@ -361,6 +364,56 @@ def test_multihost_paged_prefix_kv8_lock_step(app, tmp_path):
         assert ask(prompts[0]) == want[0]
         health = _call(serve_port, "GET", "/healthz")
         assert health["batching"]["prefixHits"] >= 2
+    finally:
+        _kill_all(procs)
+
+
+SHARDKV_SERVE_SCRIPT = r"""
+import sys
+from gpu_docker_api_tpu.workloads.serve import main
+sys.exit(main(["--family", "llama", "--config", "tiny",
+               "--tp", "2", "--batch-slots", "3", "--batch-max-len", "64",
+               "--decode-chunk", "4", "--shard-kv",
+               "--host", "127.0.0.1", "--port", sys.argv[1]]))
+"""
+
+
+def test_multihost_sharded_kv_lock_step(app, tmp_path):
+    """--shard-kv: the slot cache's K/V shard over tp on the kv-head
+    axis instead of replicating (per-rank cache HBM / tp). Attention
+    runs each rank's own heads (q is already head-sharded by the
+    megatron wq), so streams must stay bit-equal to the single-process
+    dense engine; the dryrun's S4 plan pins the HLO communication
+    shape, this test pins the live 2-process engine."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    multihost = _spanning_grant(app.server.port, "skvpod", 8)
+    serve_port = _free_port()
+    procs = _launch_workers(multihost, tmp_path, SHARDKV_SERVE_SCRIPT,
+                            [str(serve_port)], devices_per_proc=4,
+                            coord_port=_free_port(), tag="kserve")
+    try:
+        health = _wait_healthz(serve_port, procs)
+        assert health["batching"]["slots"] == 3
+
+        prompts = [[3, 7, 1, 9, 4, 2], [5, 1, 8, 2, 6, 4, 9, 9],
+                   [2, 2, 6, 4, 1, 1, 3]]
+        max_new = 16
+        want = _reference_streams(prompts, max_new)
+
+        def ask(p):
+            return _call(serve_port, "POST", "/generate",
+                         {"tokens": [p], "max_new": max_new},
+                         timeout=240)["tokens"][0]
+
+        ex = ThreadPoolExecutor(3)
+        try:
+            futs = [ex.submit(ask, p) for p in prompts]
+            got = [f.result(timeout=240) for f in futs]
+        finally:
+            ex.shutdown(wait=True)
+        for g, w in zip(got, want):
+            assert g == w
     finally:
         _kill_all(procs)
 
