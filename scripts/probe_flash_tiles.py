@@ -97,7 +97,7 @@ def main():
             print(json.dumps(row), flush=True)
 
     # ---- phase 2: interleaved A/B (the adjudicator) -------------------
-    def make(s, bq, bk, chain, grad, k, v):
+    def make(bq, bk, chain, grad, k, v):
         @jax.jit
         def run(q0):
             def body(c, _):
@@ -124,8 +124,8 @@ def main():
         k = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
         v = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
         bq, bk = 512, min(1024, s)
-        base = make(s, base_q, base_k, chain, grad, k, v)
-        cand = make(s, bq, bk, chain, grad, k, v)
+        base = make(base_q, base_k, chain, grad, k, v)
+        cand = make(bq, bk, chain, grad, k, v)
         float(base(q))
         float(cand(q))              # compiles outside the timing
         ta, tb = [], []

@@ -6,6 +6,23 @@ import time
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """One pytest process runs the whole suite, and jax's compiled-
+    executable caches grow monotonically across ~500 tests; at the
+    40-50 minute mark XLA:CPU was observed SEGFAULTING inside a fresh
+    compile (twice, different test_speculative tests, both green in
+    isolation and both green when their module runs alone) — classic
+    allocator pressure, not a test bug. Dropping the caches at module
+    boundaries keeps the process's RSS bounded; modules re-compile
+    their own programs anyway, so the only cost is re-tracing shared
+    tiny-model programs (~seconds per module)."""
+    yield
+    import sys
+    if "jax" in sys.modules:       # never force the import for pure tests
+        sys.modules["jax"].clear_caches()
+
+
 def wait_for(pred, timeout=10.0, msg="condition"):
     """Poll until pred() or timeout (shared by process-backend suites)."""
     deadline = time.time() + timeout
