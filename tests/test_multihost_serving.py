@@ -485,21 +485,23 @@ sys.exit(main(["--family", "llama", "--config", "tiny",
                "--tp", "2", "--batch-slots", "3", "--batch-max-len", "64",
                "--batch-prefill-chunk", "4",
                "--draft-config", "tiny", "--gamma", "3",
-               "--kv-block", "8", "--kv-quant",
+               "--kv-block", "8", "--kv-quant", "--shard-kv",
                "--host", "127.0.0.1", "--port", sys.argv[1]]))
 """
 
 
 def test_multihost_speculative_paged_lock_step(app, tmp_path):
     """Speculative decoding INSIDE the lock-step batcher, over the paged
-    int8 target cache, across two real processes: every rank runs the
-    same draft rounds + shared sharded verify, and the accept/rollback
-    decisions replay identically from SPMD device results. Greedy spec
-    is bit-exact by construction, so the oracle is the single-process
-    NON-speculative batcher with the same cache flags — equality proves
-    the whole multihost spec stack emits exactly the target-only
-    streams. The fresh-init draft uses a different key than the target
-    (worst-case proposals), so rejection/rollback paths really run."""
+    int8 TP-SHARDED target cache (--shard-kv: the full composition
+    stack), across two real processes: every rank runs the same draft
+    rounds + shared sharded verify, and the accept/rollback decisions
+    replay identically from SPMD device results. Greedy spec is
+    bit-exact by construction, so the oracle is the single-process
+    NON-speculative unsharded batcher with the same cache flags —
+    equality proves the whole multihost spec stack emits exactly the
+    target-only streams. The fresh-init draft uses a different key than
+    the target (worst-case proposals), so rejection/rollback paths
+    really run."""
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
