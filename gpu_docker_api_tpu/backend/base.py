@@ -47,6 +47,22 @@ class VolumeState:
     tier: str = ""                 # storage tier ("" = default/local)
 
 
+def copy_container_layer(backend: "Backend", old_name: str,
+                         new_name: str) -> bool:
+    """Carry one container's writable layer forward to another (reference
+    CopyOldMergedToNewContainerMerged, utils/copy.go:31-46). Shared by the
+    rolling-replace step and the crash reconciler's replay of it. Returns
+    True when a copy actually happened."""
+    from ..utils.file import copy_dir
+    old_state = backend.inspect(old_name)
+    new_state = backend.inspect(new_name)
+    if (old_state.exists and new_state.exists
+            and old_state.upper_dir and new_state.upper_dir):
+        copy_dir(old_state.upper_dir, new_state.upper_dir)
+        return True
+    return False
+
+
 def resolve_tier_root(default_root: str, tiers: dict, tier: str) -> str:
     """Map a volume tier name to its storage root. '' / 'local' is the
     default root; anything else must be configured (--volume-tier NAME=PATH
@@ -67,6 +83,13 @@ def resolve_tier_root(default_root: str, tiers: dict, tier: str) -> str:
 
 class Backend(abc.ABC):
     """Substrate operations (container + volume CRUD + exec)."""
+
+    #: True when every container/volume on the substrate belongs to this
+    #: control plane (mock/process own their state dir). False for shared
+    #: daemons (dockerd may run other stacks) — the crash reconciler's
+    #: orphan sweeps then require store acquaintance with the base name
+    #: before any destructive remove, not just a name-shape match.
+    exclusive_substrate = True
 
     # ---- containers ----
 
@@ -116,6 +139,12 @@ class Backend(abc.ABC):
 
     @abc.abstractmethod
     def volume_inspect(self, name: str) -> VolumeState: ...
+
+    def volume_list(self) -> list[str]:
+        """Names of every volume the substrate holds (reconciler cross-
+        check). Substrates that can't enumerate return [] — the reconciler
+        then skips orphan-volume detection rather than guessing."""
+        return []
 
     # ---- lifecycle ----
 

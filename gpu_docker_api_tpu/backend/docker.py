@@ -240,8 +240,17 @@ class DockerBackend(Backend):
                            mountpoint=out.get("Mountpoint", ""),
                            size_limit_bytes=size_bytes, driver_opts=opts)
 
+    # dockerd is a shared daemon: other stacks' containers/volumes live
+    # beside ours, so reconcile orphan sweeps must prove ownership first
+    exclusive_substrate = False
+
     def volume_remove(self, name: str) -> None:
         self._request("DELETE", f"/volumes/{name}")
+
+    def volume_list(self) -> list[str]:
+        out = self._request("GET", "/volumes")
+        return sorted(v.get("Name", "") for v in (out.get("Volumes") or [])
+                      if v.get("Name"))
 
     def volume_inspect(self, name: str) -> VolumeState:
         try:

@@ -143,6 +143,10 @@ class MockBackend(Backend):
             if v is not None:
                 shutil.rmtree(v.mountpoint, ignore_errors=True)
 
+    def volume_list(self) -> list[str]:
+        with self._lock:
+            return sorted(self._volumes)
+
     def volume_inspect(self, name: str) -> VolumeState:
         with self._lock:
             v = self._volumes.get(name)

@@ -85,7 +85,8 @@ class _Proc:
 class ProcessBackend(Backend):
     def __init__(self, state_dir: str, warm_pool: int = 0,
                  warm_preimport: str = "jax", supervise: bool = False,
-                 supervise_interval: float = 0.3):
+                 supervise_interval: float = 0.3,
+                 forgive_after: float = 10.0):
         self.state_dir = state_dir
         self._lock = threading.RLock()
         self._procs: dict[str, _Proc] = {}
@@ -108,6 +109,10 @@ class ProcessBackend(Backend):
         # itself — plus the rootfs storage-quota watchdog (the fallback
         # enforcement where no filesystem quota exists for a plain dir).
         self._interval = supervise_interval
+        # a container healthy for this long has its restart_count forgiven,
+        # so a much-later crash restarts promptly instead of inheriting a
+        # 30s backoff (tests shrink it to avoid real 10s waits)
+        self._forgive_after = forgive_after
         self._supervisor = None
         self._remount_quota_volumes()
         if supervise:
@@ -266,7 +271,7 @@ class ProcessBackend(Backend):
         rc = po.poll()
         if rc is None:
             # running healthily for a stretch: forgive the backoff history
-            if p.restart_count and now - p.started_at > 10.0:
+            if p.restart_count and now - p.started_at > self._forgive_after:
                 p.restart_count = 0
             return
         if p.user_stopped or p.quota_exceeded:
@@ -284,7 +289,14 @@ class ProcessBackend(Backend):
             return
         with self._lock:
             cur = self._procs.get(name)
-            if cur is not p or p.user_stopped or p.popen.poll() is None:
+            # re-check under the lock: remove() may have dropped the proc
+            # AND nulled p.popen since the unlocked poll above — the None
+            # guard is explicit because the old `p.popen.poll()` raised
+            # AttributeError here, silently eaten by _supervise's blanket
+            # except, leaving the restart permanently pending
+            po_now = p.popen
+            if (cur is not p or p.user_stopped or po_now is None
+                    or po_now.poll() is None):
                 return                             # raced a user action
             p.restart_at = 0.0
             p.restart_count += 1
@@ -354,6 +366,8 @@ class ProcessBackend(Backend):
             if os.path.exists(p.log_path):
                 os.unlink(p.log_path)
             self._procs.pop(name, None)
+            # a supervisor tick holding a stale _Proc must see the removal
+            p.popen = None
 
     def execute(self, name: str, cmd: list[str], workdir: str = "") -> tuple[int, str]:
         with self._lock:
@@ -558,6 +572,19 @@ class ProcessBackend(Backend):
             os.unlink(os.path.join(self._quota_dir, name))
         except OSError:
             pass
+
+    def volume_list(self) -> list[str]:
+        out = set()
+        root = os.path.join(self.state_dir, "volumes")
+        if os.path.isdir(root):
+            out.update(d for d in os.listdir(root)
+                       if os.path.isdir(os.path.join(root, d)))
+        for tier_root in getattr(self, "volume_tiers", {}).values():
+            managed = os.path.join(tier_root, "tpu-volumes")
+            if os.path.isdir(managed):
+                out.update(d for d in os.listdir(managed)
+                           if os.path.isdir(os.path.join(managed, d)))
+        return sorted(out)
 
     def volume_inspect(self, name: str) -> VolumeState:
         from ..utils.file import dir_size

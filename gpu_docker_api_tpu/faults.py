@@ -1,0 +1,120 @@
+"""Deterministic crashpoint fault-injection harness.
+
+Every multi-step control-plane mutation is instrumented with named
+crashpoints at its step boundaries (`crashpoint("replace.after_create")`).
+A crashpoint is inert until armed — via the TDAPI_CRASHPOINTS env var
+(comma-separated names, for manual chaos testing against a live daemon) or
+programmatically via arm() (test fixtures). An armed crashpoint raises
+InjectedCrash, which derives from BaseException ON PURPOSE: the services'
+blanket `except Exception` unwind paths must NOT catch it, because the
+whole point is to simulate the daemon dying mid-step with no unwind code
+running. The test then abandons the App and rebuilds it from the same
+state dir; the boot-time reconciler (reconcile.py) has to make the world
+consistent from the journal + stores alone.
+
+The registry is STATIC: every crashpoint name is declared here, and
+crashpoint() rejects undeclared names so an instrumentation typo fails the
+first test that crosses it instead of silently never firing. The sweep in
+tests/test_crash_recovery.py parametrizes over all_crashpoints(), so adding
+a name here without a sweep scenario fails CI — registry, instrumentation,
+and coverage stay in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_VAR = "TDAPI_CRASHPOINTS"
+
+
+class InjectedCrash(BaseException):
+    """Simulated control-plane death at a named crashpoint.
+
+    BaseException, not Exception: unwind/cleanup `except Exception`
+    handlers must not observe it (a crashed daemon runs no cleanup).
+    """
+
+    def __init__(self, name: str):
+        super().__init__(f"injected crash at crashpoint {name!r}")
+        self.name = name
+
+
+#: name -> where it sits in its mutation (documentation + the sweep table)
+CRASHPOINTS: dict[str, str] = {
+    # run = grant -> create -> start -> persist
+    "run.after_grant": "chips/cores granted, container not yet created",
+    "run.after_create": "container created, not yet started",
+    "run.after_start": "container started, latest pointer not yet persisted",
+    # rolling replace (patch / rollback / restart all funnel through it)
+    "replace.after_create": "new version created+persisted, old still running",
+    "replace.after_stop_old": "old stopped, layer not yet copied",
+    "replace.after_copy": "layer copied, new version not yet started",
+    "replace.after_start_new": "new running, old container not yet removed",
+    "replace.after_remove_old": "old removed, stale grants not yet freed",
+    # op-specific preambles before the shared replace machinery
+    "rollback.after_grant": "historical counts re-granted, replace not begun",
+    "restart.after_grant": "fresh grants applied, replace not begun",
+    # stop = backend stop -> free grants -> persist resourcesReleased
+    "stop.after_backend_stop": "container stopped, grants still held",
+    "stop.after_restore": "grants freed, release not yet persisted",
+    # delete = backend remove -> free grants -> drop store keys
+    "delete.after_remove": "container removed, grants still held",
+    "delete.after_restore": "grants freed, store keys not yet dropped",
+    # volumes
+    "volume.create.after_backend": "backend volume exists, record not persisted",
+    "volume.scale.after_create": "new volume version exists, data not migrated",
+    "volume.scale.after_migrate": "data migrated, old volume not yet handled",
+    "volume.delete.after_remove": "backend volume removed, store keys remain",
+    # write-behind persistence: the daemon dies before a queued write exists
+    "workqueue.before_submit": "mutation applied in memory, persist never queued",
+}
+
+_lock = threading.Lock()
+_armed: set[str] = set()
+
+
+def all_crashpoints() -> tuple[str, ...]:
+    """Every registered crashpoint name, sorted (the sweep table)."""
+    return tuple(sorted(CRASHPOINTS))
+
+
+def arm(name: str) -> None:
+    """Arm one crashpoint for this process (test fixture path)."""
+    if name not in CRASHPOINTS:
+        raise KeyError(f"unknown crashpoint {name!r}")
+    with _lock:
+        _armed.add(name)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def armed() -> frozenset[str]:
+    with _lock:
+        env = os.environ.get(ENV_VAR, "")
+        names = {n.strip() for n in env.split(",") if n.strip()}
+        return frozenset(_armed | names)
+
+
+def crashpoint(name: str) -> None:
+    """Step-boundary marker: raise InjectedCrash when `name` is armed.
+
+    Sits on production hot paths (every WorkQueue.submit), so the inert
+    case is a few dict/set lookups — no lock, no env parsing. The env var
+    is still consulted on every crossing when set, so exporting it against
+    a live daemon works."""
+    if name not in CRASHPOINTS:
+        raise RuntimeError(f"crashpoint {name!r} is not registered in "
+                           "faults.CRASHPOINTS")
+    if not _armed and not os.environ.get(ENV_VAR):
+        return
+    with _lock:
+        hot = name in _armed
+    if not hot:
+        env = os.environ.get(ENV_VAR, "")
+        hot = name in (n.strip() for n in env.split(","))
+    if hot:
+        raise InjectedCrash(name)

@@ -1,0 +1,138 @@
+"""Intent journal: durable record of in-flight multi-step mutations.
+
+Every multi-step control-plane mutation (run, patch/rolling-replace,
+rollback, restart, stop, delete, volume create/scale/delete) records a
+begin marker before its first side effect, a step marker after each
+completed step, and a done marker (key delete) after its last. The
+markers go through the MVCC store SYNCHRONOUSLY — not the write-behind
+queue — so the WAL always holds the intent before the step it describes
+can have happened. A control-plane crash therefore leaves behind exactly
+one open intent per mid-flight mutation, telling the boot-time reconciler
+(reconcile.py) which operation was interrupted, on which target, and how
+far it got.
+
+Key scheme: one key per (kind, target) under the `intents` resource —
+the per-name mutation mutex in the services guarantees at most one open
+mutation per target, so the key is stable and a completed mutation's
+delete leaves nothing to compact away (the `intents` prefix is
+deliberately NOT in KEEP_HISTORY_PREFIXES).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .store.client import StateClient
+
+INTENTS = "intents"
+
+KIND_CONTAINER = "container"
+KIND_VOLUME = "volume"
+
+
+@dataclass
+class IntentRecord:
+    """One open intent as persisted."""
+    op: str                     # run | replace | stop | delete | volume.create ...
+    target: str                 # replicaSet / volume base name
+    kind: str = KIND_CONTAINER
+    begun_at: float = 0.0
+    steps: list[dict] = field(default_factory=list)
+    meta: dict = field(default_factory=dict)
+
+    def step_names(self) -> list[str]:
+        return [s["name"] for s in self.steps]
+
+    def has_step(self, name: str) -> bool:
+        return any(s["name"] == name for s in self.steps)
+
+    def step_meta(self, name: str) -> dict:
+        for s in reversed(self.steps):
+            if s["name"] == name:
+                return {k: v for k, v in s.items() if k not in ("name", "at")}
+        return {}
+
+    def serialize(self) -> str:
+        return json.dumps({
+            "op": self.op, "target": self.target, "kind": self.kind,
+            "begunAt": self.begun_at, "steps": self.steps, "meta": self.meta,
+        }, sort_keys=True)
+
+    @classmethod
+    def deserialize(cls, s: str) -> "IntentRecord":
+        d = json.loads(s)
+        return cls(op=d.get("op", ""), target=d.get("target", ""),
+                   kind=d.get("kind", KIND_CONTAINER),
+                   begun_at=d.get("begunAt", 0.0),
+                   steps=list(d.get("steps", [])),
+                   meta=dict(d.get("meta", {})))
+
+
+class Intent:
+    """Handle for one in-flight mutation; records step boundaries."""
+
+    def __init__(self, journal: "IntentJournal", record: IntentRecord):
+        self._journal = journal
+        self.record = record
+        self.closed = False
+
+    def step(self, name: str, **meta) -> None:
+        """Persist "step `name` is complete" before the next one starts."""
+        if self.closed:
+            return
+        entry = {"name": name, "at": round(time.time(), 4)}
+        entry.update(meta)
+        self.record.steps.append(entry)
+        self._journal._write(self.record)
+
+    def done(self) -> None:
+        """The mutation finished (or fully unwound): clear the marker."""
+        if not self.closed:
+            self.closed = True
+            self._journal._clear(self.record)
+
+
+class IntentJournal:
+    def __init__(self, client: Optional[StateClient]):
+        self._client = client
+
+    @staticmethod
+    def _key(kind: str, target: str) -> str:
+        return f"{kind}:{target}"
+
+    def begin(self, op: str, target: str, kind: str = KIND_CONTAINER,
+              **meta) -> Intent:
+        rec = IntentRecord(op=op, target=target, kind=kind,
+                           begun_at=round(time.time(), 4), meta=meta)
+        self._write(rec)
+        return Intent(self, rec)
+
+    def _write(self, rec: IntentRecord) -> None:
+        if self._client is not None:
+            self._client.put(INTENTS, self._key(rec.kind, rec.target),
+                             rec.serialize())
+
+    def _clear(self, rec: IntentRecord) -> None:
+        if self._client is not None:
+            self._client.delete(INTENTS, self._key(rec.kind, rec.target))
+
+    def clear(self, kind: str, target: str) -> None:
+        """Reconciler path: drop a replayed intent by identity."""
+        if self._client is not None:
+            self._client.delete(INTENTS, self._key(kind, target))
+
+    def open_intents(self) -> list[IntentRecord]:
+        """All intents whose mutation never recorded done, oldest first."""
+        if self._client is None:
+            return []
+        out = []
+        for kv in self._client.range(INTENTS):
+            try:
+                out.append(IntentRecord.deserialize(kv.value))
+            except (json.JSONDecodeError, TypeError):
+                continue  # torn record: nothing actionable in it
+        out.sort(key=lambda r: r.begun_at)
+        return out

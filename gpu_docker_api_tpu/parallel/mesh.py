@@ -37,6 +37,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = False):
+    """jax.shard_map with a fallback for jax builds that only ship the
+    experimental API (pre-0.5: jax.experimental.shard_map, where
+    check_vma is spelled check_rep and partial-manual mode is the
+    complementary `auto` axis set instead of `axis_names`)."""
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {"check_rep": check_vma}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
 @dataclass(frozen=True)
 class MeshPlan:
     """How many devices each parallelism axis gets. Product must equal the

@@ -275,7 +275,8 @@ def pipeline_trunk(layers, x, layer_fn: Callable, mesh: Mesh,
         out_spec = P("pp")
         manual = {"pp"}
         aux_axes, aux_denom = ("pp",), m
-    out, aux = jax.shard_map(
+    from .mesh import shard_map
+    out, aux = shard_map(
         staged, mesh=mesh,
         in_specs=(P(None, "pp"), x_spec),
         out_specs=(out_spec, P()),  # [pp, M, b/M, S, D] + replicated scalar

@@ -75,7 +75,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     else:
         local = functools.partial(_ring_local, axis=axis, ring=n,
                                   causal=causal)
-    return jax.shard_map(
+    from .mesh import shard_map
+    return shard_map(
         local, mesh=mesh,
         in_specs=(spec_q, spec_q, spec_q),
         out_specs=spec_q,

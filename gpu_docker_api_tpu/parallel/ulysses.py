@@ -55,7 +55,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     spec = qkv_spec(mesh, q.shape[2], k.shape[2])
     local = functools.partial(_ulysses_local, axis=axis, sp=n, causal=causal,
                               impl=impl, window=window)
-    return jax.shard_map(
+    from .mesh import shard_map
+    return shard_map(
         local, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -96,6 +96,18 @@ class PortScheduler(Scheduler):
                     del self.used[p]
             self._persist()
 
+    def mark_used(self, grant: Optional[list[int]], owner: str = "") -> None:
+        """Re-mark ports as held by owner (unwind/reconcile path). Ports
+        currently granted to a DIFFERENT owner are left alone."""
+        if not grant:
+            return
+        with self._lock:
+            for p in grant:
+                p = int(p)
+                if self.used.get(p, owner) == owner:
+                    self.used[p] = owner
+            self._persist()
+
     def get_status(self) -> dict:
         """Reference GetPortStatus shape: availableCount already net of used
         (the reference subtracts in the handler, routers/resource.go:33-37 —

@@ -15,12 +15,16 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Optional
 
 from .. import xerrors
 from ..backend import make_backend
+from ..backend.base import Backend
 from ..dtos import ContainerRun, PatchRequest
 from ..events import EventLog
+from ..intents import IntentJournal
+from ..reconcile import Reconciler
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
 from ..services import ReplicaSetService, VolumeService
 from ..store import StateClient, open_store
@@ -59,15 +63,25 @@ class App:
         self._maint_stop = None
         # --- reference Init order: docker -> etcd -> workQueue -> schedulers
         #     -> version maps (main.go:53-97) ---
+        self.events = EventLog(state_dir)
         self.store = open_store(wal_path=os.path.join(state_dir, "state.wal"),
                                 engine=store_engine)
         self.client = StateClient(self.store)
-        self.wq = WorkQueue(self.client)
+        self.wq = WorkQueue(self.client, events=self.events)
         self.wq.start()
-        self.backend = make_backend(backend, os.path.join(state_dir, "backend"),
-                                    volume_tiers=volume_tiers,
-                                    warm_pool=warm_pool,
-                                    supervise=supervise)
+        # a Backend INSTANCE is accepted so a control-plane restart can be
+        # driven against a still-alive substrate (crash-recovery tests; an
+        # embedding daemon supervising its own backend)
+        if isinstance(backend, Backend):
+            self.backend = backend
+            if not getattr(backend, "volume_tiers", None):
+                backend.volume_tiers = dict(volume_tiers or {})
+        else:
+            self.backend = make_backend(backend,
+                                        os.path.join(state_dir, "backend"),
+                                        volume_tiers=volume_tiers,
+                                        warm_pool=warm_pool,
+                                        supervise=supervise)
         # an explicit topology overrides the store; otherwise boot from stored
         # state (crash-resume) and only probe the host on first run
         if topology is None and self.client.get("tpus", "tpuStatusMap") is None:
@@ -82,12 +96,24 @@ class App:
         self.merges = MergeMap(self.client, self.wq)
         xla_cache = os.path.abspath(os.path.join(state_dir, "xla-cache"))
         os.makedirs(xla_cache, exist_ok=True)
+        self.intents = IntentJournal(self.client)
         self.replicasets = ReplicaSetService(
             self.backend, self.client, self.wq, self.tpu, self.cpu, self.ports,
-            self.container_versions, self.merges, xla_cache_dir=xla_cache)
+            self.container_versions, self.merges, xla_cache_dir=xla_cache,
+            intents=self.intents)
         self.volumes = VolumeService(self.backend, self.client, self.wq,
-                                     self.volume_versions)
-        self.events = EventLog(state_dir)
+                                     self.volume_versions,
+                                     intents=self.intents)
+        # crash recovery: replay open intents, cross-check grants/backends,
+        # BEFORE the API starts serving (a request racing the repair could
+        # observe — or grab — a resource mid-reconcile)
+        self.reconciler = Reconciler(
+            self.backend, self.client, self.wq, self.tpu, self.cpu,
+            self.ports, self.container_versions, self.volume_versions,
+            self.merges, self.intents, events=self.events,
+            replicasets=self.replicasets, volumes=self.volumes)
+        self._reconcile_lock = threading.Lock()
+        self.last_reconcile = self.reconciler.run()
         self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
                                 events=self.events)
 
@@ -115,6 +141,7 @@ class App:
         r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
         r.add("GET", f"{v1}/events", self.h_events)
+        r.add("GET", f"{v1}/reconcile", self.h_reconcile)
         r.add("GET", "/metrics", self.h_metrics)
         r.add("GET", "/openapi.json", self.h_openapi)
         r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
@@ -364,6 +391,20 @@ class App:
         target = req.query.get("target", [""])[0]
         return ok({"events": self.events.recent(limit=limit, target=target)})
 
+    def h_reconcile(self, req: Request) -> Response:
+        """Admin view of crash recovery: the boot-time reconcile report;
+        ?run=1 performs a fresh pass. The reconciler assumes nothing is in
+        flight — an open intent at runtime IS an in-flight mutation (this
+        daemon is alive), so refuse rather than replay it out from under
+        the request thread that owns it."""
+        if req.query_flag("run"):
+            with self._reconcile_lock:
+                if self.intents.open_intents():
+                    return err(ResCode.ServerBusy,
+                               "mutations in flight — retry when idle")
+                self.last_reconcile = self.reconciler.run()
+        return ok({"reconcile": self.last_reconcile})
+
     def h_metrics(self, req: Request) -> Response:
         """Prometheus text exposition of the resource inventories and the
         write-behind queue — the pull-metrics surface the reference lacks
@@ -390,6 +431,10 @@ class App:
             f"tdapi_volumes {len(self.volume_versions.items())}",
             "# TYPE tdapi_workqueue_pending gauge",
             f"tdapi_workqueue_pending {self.wq.pending()}",
+            "# TYPE tdapi_workqueue_dropped gauge",
+            f"tdapi_workqueue_dropped {self.wq.dropped_count()}",
+            "# TYPE tdapi_reconcile_actions gauge",
+            f"tdapi_reconcile_actions {self.last_reconcile['actions']}",
             "# TYPE tdapi_store_wal_records gauge",
             f"tdapi_store_wal_records {self.store.wal_records}",
         ]
@@ -446,7 +491,6 @@ class App:
     def _start_store_maintenance(self) -> None:
         if self.store_maint_records <= 0:
             return
-        import threading
         self._maint_stop = threading.Event()
 
         def loop():

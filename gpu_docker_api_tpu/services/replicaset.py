@@ -49,14 +49,18 @@ import logging
 import threading
 import time
 
+from typing import Optional
+
 from .. import xerrors
 from ..backend.base import Backend
 from ..dtos import (
     ContainerRun, ContainerSpec, HistoryItem, PatchRequest, StoredContainerInfo,
 )
+from ..faults import crashpoint
+from ..intents import Intent, IntentJournal
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
 from ..store.client import StateClient
-from ..utils.file import copy_dir, to_bytes
+from ..utils.file import to_bytes
 from ..version import MergeMap, VersionMap
 from ..workqueue import Call, PutKeyValue, WorkQueue
 
@@ -73,7 +77,8 @@ class ReplicaSetService:
     def __init__(self, backend: Backend, client: StateClient, wq: WorkQueue,
                  tpu: TpuScheduler, cpu: CpuScheduler, ports: PortScheduler,
                  version_map: VersionMap, merge_map: MergeMap,
-                 xla_cache_dir: str = ""):
+                 xla_cache_dir: str = "",
+                 intents: Optional[IntentJournal] = None):
         # host-shared XLA persistent-compile-cache dir: injected into every
         # scheduled workload so the Nth launch of the same program skips the
         # 20-40s XLA compile — the single biggest lever on the north-star
@@ -88,6 +93,10 @@ class ReplicaSetService:
         self.ports = ports
         self.versions = version_map
         self.merges = merge_map
+        # intent journal: every multi-step mutation records begin/step/done
+        # markers synchronously, so a control-plane crash leaves a durable
+        # record of exactly what was in flight (reconcile.py replays them)
+        self.intents = intents if intents is not None else IntentJournal(client)
         # one mutation at a time per replicaSet; the reference relies on
         # goroutine luck here (SURVEY §5.2)
         self._name_locks: dict[str, threading.Lock] = {}
@@ -119,19 +128,28 @@ class ReplicaSetService:
             if req.memory:
                 spec.memory_bytes = to_bytes(req.memory)
 
+            intent = self.intents.begin("run", name)
             try:
                 if req.tpuCount > 0:
                     self._grant_tpus(spec, self.tpu.apply(req.tpuCount, name))
                 if req.cpuCount > 0:
                     spec.cpuset = self.cpu.apply(req.cpuCount, name)
                     spec.cpu_count = req.cpuCount
-                info = self._create_and_start(name, spec, req.containerPorts)
+                intent.step("granted", tpuChips=spec.tpu_chips,
+                            cpuset=spec.cpuset)
+                crashpoint("run.after_grant")
+                info = self._create_and_start(name, spec, req.containerPorts,
+                                              intent=intent, cp="run")
             except Exception:
                 # resource rollback on any failure (reference :103-124);
-                # owner-checked so over-release is impossible
+                # owner-checked so over-release is impossible. The unwind
+                # completes here, so the intent closes; an InjectedCrash
+                # (BaseException) skips both — exactly a daemon death.
                 self.tpu.restore(spec.tpu_chips, name)
                 self.cpu.restore(spec.cpuset, name)
+                intent.done()
                 raise
+            intent.done()
             return self._run_response(info)
 
     def _inject_xla_cache(self, spec: ContainerSpec) -> None:
@@ -157,24 +175,43 @@ class ReplicaSetService:
 
     def _create_and_start(self, name: str, spec: ContainerSpec,
                           container_ports: list[str],
-                          start: bool = True) -> StoredContainerInfo:
+                          start: bool = True,
+                          intent: Optional[Intent] = None,
+                          cp: str = "") -> StoredContainerInfo:
         """The runContainer core (reference replicaset_nomock.go:25-114):
-        version bump -> port grant -> create -> start -> persist."""
+        version bump -> port grant -> create -> start -> persist. `cp`
+        namespaces the step-boundary crashpoints (run path only; the
+        replace path places its own around this call)."""
         version = self.versions.bump(name)
         ctr_name = f"{name}-{version}"
         port_grant: list[int] = []
+        created = False
         try:
             if container_ports:
                 port_grant = self.ports.apply(len(container_ports), name)
                 spec.port_bindings = {
-                    cp: hp for cp, hp in zip(container_ports, port_grant)}
+                    cp_: hp for cp_, hp in zip(container_ports, port_grant)}
             spec.env = [e for e in spec.env if not e.startswith("CONTAINER_VERSION=")]
             spec.env.append(f"CONTAINER_VERSION={version}")
             self._inject_xla_cache(spec)
             self.backend.create(ctr_name, spec)
+            created = True
+            if intent is not None:
+                intent.step("created", container=ctr_name, version=version)
+            if cp:
+                crashpoint(f"{cp}.after_create")
             if start:
                 self.backend.start(ctr_name)
+                if cp:
+                    crashpoint(f"{cp}.after_start")
         except Exception:
+            if created:
+                # a created-but-failed container left behind would brick the
+                # name: the next run re-mints the same version and collides
+                try:
+                    self.backend.remove(ctr_name, force=True)
+                except Exception:  # noqa: BLE001
+                    log.exception("removing failed container %s", ctr_name)
             self.ports.restore(port_grant, name)
             self.versions.rollback_bump(name, version - 1)
             raise
@@ -182,6 +219,8 @@ class ReplicaSetService:
         info = StoredContainerInfo(
             version=version, createTime=_now(), containerName=ctr_name, spec=spec)
         self._persist_latest(name, info)
+        if intent is not None:
+            intent.step("persisted", container=ctr_name, version=version)
         return info
 
     def _persist_latest(self, name: str, info: StoredContainerInfo,
@@ -205,6 +244,10 @@ class ReplicaSetService:
             old = self._stored_info(name)
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             changed = False
+            intent = self.intents.begin(
+                "replace", name, via="patch", oldVersion=old.version,
+                oldContainer=old.containerName,
+                oldReleased=old.resourcesReleased)
             try:
                 if req.tpuPatch is not None:
                     changed |= self._patch_tpu(name, new_spec, old,
@@ -218,10 +261,12 @@ class ReplicaSetService:
                     changed |= self._patch_volume(new_spec, req.volumePatch)
                 if not changed:
                     raise xerrors.NoPatchRequiredError(name)
-                info = self._rolling_replace(name, old, new_spec)
+                info = self._rolling_replace(name, old, new_spec, intent)
             except Exception:
                 self._free_new_grants(name, new_spec, old.spec)
+                intent.done()
                 raise
+            intent.done()
             return self._run_response(info)
 
     def _patch_tpu(self, name: str, spec: ContainerSpec,
@@ -281,7 +326,8 @@ class ReplicaSetService:
     # ------------------------------------------------------- rolling replace
 
     def _rolling_replace(self, name: str, old: StoredContainerInfo,
-                         new_spec: ContainerSpec) -> StoredContainerInfo:
+                         new_spec: ContainerSpec,
+                         intent: Optional[Intent] = None) -> StoredContainerInfo:
         """create new version -> stop old (chip exclusivity) -> copy writable
         layer -> start new -> delete old (reference :318-353, reordered).
 
@@ -294,13 +340,24 @@ class ReplicaSetService:
         old_ports = list(old.spec.port_bindings.values())
         container_ports = list(new_spec.port_bindings.keys())
         new_spec.port_bindings = {}
-        info = self._create_and_start(name, new_spec, container_ports, start=False)
+        info = self._create_and_start(name, new_spec, container_ports,
+                                      start=False, intent=intent)
+        crashpoint("replace.after_create")
         old_state = self.backend.inspect(old.containerName)
         try:
             if old_state.exists and (old_state.running or old_state.paused):
                 self.backend.stop(old.containerName)
+            if intent is not None:
+                intent.step("stopped_old")
+            crashpoint("replace.after_stop_old")
             self._copy_layer(old.containerName, info.containerName)
+            if intent is not None:
+                intent.step("copied")
+            crashpoint("replace.after_copy")
             self.backend.start(info.containerName)
+            if intent is not None:
+                intent.step("started_new")
+            crashpoint("replace.after_start_new")
         except Exception:
             # failed mid-replace: remove the new container, revert latest
             # pointer + version counter + per-version key, restart the old
@@ -329,6 +386,9 @@ class ReplicaSetService:
             self.backend.remove(old.containerName, force=True)
         except Exception:  # noqa: BLE001
             log.exception("removing replaced container %s", old.containerName)
+        if intent is not None:
+            intent.step("removed_old")
+        crashpoint("replace.after_remove_old")
         if old_holds:
             stale_tpu = sorted(set(old.spec.tpu_chips) - set(new_spec.tpu_chips))
             self.tpu.restore(stale_tpu, name)
@@ -339,12 +399,10 @@ class ReplicaSetService:
         return info
 
     def _copy_layer(self, old_name: str, new_name: str) -> None:
-        """Carry the writable layer forward (reference
-        CopyOldMergedToNewContainerMerged, utils/copy.go:31-46)."""
-        old_state = self.backend.inspect(old_name)
-        new_state = self.backend.inspect(new_name)
-        if old_state.upper_dir and new_state.upper_dir:
-            copy_dir(old_state.upper_dir, new_state.upper_dir)
+        """Carry the writable layer forward (shared with the crash
+        reconciler's replay of this step — backend/base.py)."""
+        from ..backend.base import copy_container_layer
+        copy_container_layer(self.backend, old_name, new_name)
 
     def _record_merge(self, name: str, ctr_name: str) -> None:
         """Track the merged-layer path per version (reference setToMergeMap,
@@ -377,13 +435,22 @@ class ReplicaSetService:
             target_spec.devices = old.spec.devices
             target_spec.cpuset = old.spec.cpuset
             target_spec.cpu_count = old.spec.cpu_count
+            intent = self.intents.begin(
+                "replace", name, via="rollback", oldVersion=old.version,
+                oldContainer=old.containerName, targetVersion=version,
+                oldReleased=old.resourcesReleased)
             try:
                 self._patch_tpu(name, target_spec, old, len(hist.spec.tpu_chips))
                 self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
-                info = self._rolling_replace(name, old, target_spec)
+                intent.step("granted", tpuChips=target_spec.tpu_chips,
+                            cpuset=target_spec.cpuset)
+                crashpoint("rollback.after_grant")
+                info = self._rolling_replace(name, old, target_spec, intent)
             except Exception:
                 self._free_new_grants(name, target_spec, old.spec)
+                intent.done()
                 raise
+            intent.done()
             return self._run_response(info)
 
     # ---------------------------------------------------- stop / restart etc
@@ -395,15 +462,28 @@ class ReplicaSetService:
         replicaset.go:630-635 Restores again on its error path)."""
         with self._mutex(name):
             info = self._stored_info(name)
-            self.backend.stop(info.containerName)
-            if info.resourcesReleased:
-                return
-            spec = info.spec
-            self.tpu.restore(spec.tpu_chips, name)
-            self.cpu.restore(spec.cpuset, name)
-            self.ports.restore(list(spec.port_bindings.values()), name)
-            info.resourcesReleased = True
-            self._persist_latest(name, info, with_version_key=False)
+            intent = self.intents.begin("stop", name,
+                                        container=info.containerName,
+                                        released=info.resourcesReleased)
+            try:
+                self.backend.stop(info.containerName)
+                intent.step("stopped")
+                crashpoint("stop.after_backend_stop")
+                if info.resourcesReleased:
+                    intent.done()
+                    return
+                spec = info.spec
+                self.tpu.restore(spec.tpu_chips, name)
+                self.cpu.restore(spec.cpuset, name)
+                self.ports.restore(list(spec.port_bindings.values()), name)
+                intent.step("restored")
+                crashpoint("stop.after_restore")
+                info.resourcesReleased = True
+                self._persist_latest(name, info, with_version_key=False)
+            except Exception:
+                intent.done()
+                raise
+            intent.done()
 
     def restart_container(self, name: str) -> dict:
         """PATCH /replicaSet/{name}/restart (reference :736-864): a restart
@@ -413,6 +493,10 @@ class ReplicaSetService:
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             fresh_tpu: list[int] = []
             fresh_cpu = ""
+            intent = self.intents.begin(
+                "replace", name, via="restart", oldVersion=old.version,
+                oldContainer=old.containerName,
+                oldReleased=old.resourcesReleased)
             try:
                 if old.resourcesReleased:
                     # stopped: grants were returned at stop; re-apply counts
@@ -422,15 +506,20 @@ class ReplicaSetService:
                     if old.spec.cpu_count:
                         fresh_cpu = self.cpu.apply(old.spec.cpu_count, name)
                         new_spec.cpuset = fresh_cpu
+                intent.step("granted", tpuChips=new_spec.tpu_chips,
+                            cpuset=new_spec.cpuset)
+                crashpoint("restart.after_grant")
                 # running: keep the identical grant — same host, same ICI
                 # region, nothing to move (reference Restore-then-Apply
                 # churn, :783-808, buys nothing on a single host)
-                info = self._rolling_replace(name, old, new_spec)
+                info = self._rolling_replace(name, old, new_spec, intent)
             except Exception:
                 # free only what THIS restart freshly applied
                 self.tpu.restore(fresh_tpu, name)
                 self.cpu.restore(fresh_cpu, name)
+                intent.done()
                 raise
+            intent.done()
             return self._run_response(info)
 
     def pause_container(self, name: str) -> None:
@@ -505,21 +594,34 @@ class ReplicaSetService:
                 info = self._stored_info(name)
             except xerrors.NotExistInStoreError:
                 info = None
-            if info is not None:
-                state = self.backend.inspect(info.containerName)
-                if state.exists:
-                    self.backend.remove(info.containerName, force=True)
-                if not info.resourcesReleased:
-                    spec = info.spec
-                    self.tpu.restore(spec.tpu_chips, name)
-                    self.cpu.restore(spec.cpuset, name)
-                    self.ports.restore(list(spec.port_bindings.values()), name)
-            self._latest.pop(name, None)
-            self.versions.remove(name)
-            self.merges.remove_replicaset(name)
-            self.wq.join()  # drain queued writes before deleting the keys
-            self.client.delete(CONTAINERS, name)
-            self.client.delete_entity_versions(CONTAINERS, name)
+            intent = self.intents.begin(
+                "delete", name,
+                container=info.containerName if info else "",
+                released=info.resourcesReleased if info else True)
+            try:
+                if info is not None:
+                    state = self.backend.inspect(info.containerName)
+                    if state.exists:
+                        self.backend.remove(info.containerName, force=True)
+                    intent.step("removed")
+                    crashpoint("delete.after_remove")
+                    if not info.resourcesReleased:
+                        spec = info.spec
+                        self.tpu.restore(spec.tpu_chips, name)
+                        self.cpu.restore(spec.cpuset, name)
+                        self.ports.restore(list(spec.port_bindings.values()), name)
+                    intent.step("restored")
+                    crashpoint("delete.after_restore")
+                self._latest.pop(name, None)
+                self.versions.remove(name)
+                self.merges.remove_replicaset(name)
+                self.wq.join()  # drain queued writes before deleting the keys
+                self.client.delete(CONTAINERS, name)
+                self.client.delete_entity_versions(CONTAINERS, name)
+            except Exception:
+                intent.done()
+                raise
+            intent.done()
 
     # -------------------------------------------------------------- helpers
 
@@ -530,6 +632,11 @@ class ReplicaSetService:
         info = StoredContainerInfo.deserialize(self.client.get_value(CONTAINERS, name))
         self._latest[name] = info
         return info
+
+    def invalidate(self, name: str) -> None:
+        """Drop the latest-info cache entry — the reconciler rewrites
+        stored records out-of-band and must not leave a stale cache."""
+        self._latest.pop(name, None)
 
     @staticmethod
     def _run_response(info: StoredContainerInfo) -> dict:

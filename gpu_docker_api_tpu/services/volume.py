@@ -14,10 +14,13 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from typing import Optional
 
 from .. import xerrors
 from ..backend.base import Backend
 from ..dtos import HistoryItem, StoredVolumeInfo
+from ..faults import crashpoint
+from ..intents import KIND_VOLUME, Intent, IntentJournal
 from ..store.client import StateClient
 from ..utils.file import move_dir_contents, to_bytes
 from ..version import VersionMap
@@ -34,12 +37,14 @@ def _now() -> str:
 
 class VolumeService:
     def __init__(self, backend: Backend, client: StateClient, wq: WorkQueue,
-                 version_map: VersionMap, delete_old_on_patch: bool = False):
+                 version_map: VersionMap, delete_old_on_patch: bool = False,
+                 intents: Optional[IntentJournal] = None):
         self.backend = backend
         self.client = client
         self.wq = wq
         self.versions = version_map
         self.delete_old_on_patch = delete_old_on_patch
+        self.intents = intents if intents is not None else IntentJournal(client)
         self._name_locks: dict[str, threading.Lock] = {}
         self._guard = threading.Lock()
         # read-through cache over write-behind persistence (see ReplicaSetService)
@@ -57,9 +62,20 @@ class VolumeService:
         with self._mutex(name):
             if self.versions.exist(name):
                 raise xerrors.VolumeExistedError(name)
-            return self._create_version(name, size, tier)
+            intent = self.intents.begin("volume.create", name,
+                                        kind=KIND_VOLUME)
+            try:
+                out = self._create_version(name, size, tier,
+                                           intent=intent, cp="volume.create")
+            except Exception:
+                intent.done()
+                raise
+            intent.done()
+            return out
 
-    def _create_version(self, name: str, size: str, tier: str = "") -> dict:
+    def _create_version(self, name: str, size: str, tier: str = "",
+                        intent: Optional[Intent] = None,
+                        cp: str = "") -> dict:
         version = self.versions.bump(name)
         vol_name = f"{name}-{version}"
         size_bytes = to_bytes(size) if size else 0
@@ -69,6 +85,10 @@ class VolumeService:
         except Exception:
             self.versions.rollback_bump(name, version - 1)
             raise
+        if intent is not None:
+            intent.step("created", volume=vol_name, version=version)
+        if cp:
+            crashpoint(f"{cp}.after_backend")
         info = StoredVolumeInfo(version=version, createTime=_now(),
                                 volumeName=vol_name, size=size, tier=tier)
         payload = info.serialize()
@@ -77,6 +97,8 @@ class VolumeService:
         self.wq.submit(Call(
             lambda: self.client.put_entity_version(VOLUMES, name, version, payload),
             describe=f"persist {VOLUMES}/{name}@{version}"))
+        if intent is not None:
+            intent.step("persisted", volume=vol_name, version=version)
         return {"name": vol_name, "version": version,
                 "mountpoint": state.mountpoint, "size": size}
 
@@ -100,11 +122,23 @@ class VolumeService:
                 raise xerrors.VolumeSizeUsedGreaterThanReducedError(
                     f"used {old_state.used_bytes}B > target {new_bytes}B")
 
-            # a scaled version stays on its tier (data migrates in-tier)
-            out = self._create_version(name, size, tier=info.tier)
+            intent = self.intents.begin(
+                "volume.scale", name, kind=KIND_VOLUME,
+                oldVersion=info.version, oldVolume=info.volumeName,
+                newSize=size)
+            try:
+                # a scaled version stays on its tier (data migrates in-tier)
+                out = self._create_version(name, size, tier=info.tier,
+                                           intent=intent)
+                crashpoint("volume.scale.after_create")
+            except Exception:
+                intent.done()
+                raise
             new_state = self.backend.volume_inspect(out["name"])
             try:
                 move_dir_contents(old_state.mountpoint, new_state.mountpoint)
+                intent.step("migrated")
+                crashpoint("volume.scale.after_migrate")
             except Exception:
                 # migration failed: drop the new version, keep the old live,
                 # revert the latest cache/pointer and the per-version key
@@ -123,6 +157,7 @@ class VolumeService:
                         lambda v=failed_version: self.client.delete_entity_version(
                             VOLUMES, name, v),
                         describe=f"drop {VOLUMES}/{name}@{failed_version}"))
+                intent.done()
                 raise
             if self.delete_old_on_patch:
                 try:
@@ -131,6 +166,7 @@ class VolumeService:
                     log.exception("removing old volume %s", info.volumeName)
             # else: reference behavior — old volume intentionally kept
             # (volume.go:155-159); GC is the operator's call
+            intent.done()
             return out
 
     # ---- delete / info / history ----
@@ -143,17 +179,28 @@ class VolumeService:
                 info = self._stored_info(name)
             except xerrors.NotExistInStoreError:
                 info = None
-            if info is not None:
-                try:
-                    self.backend.volume_remove(info.volumeName)
-                except Exception:  # noqa: BLE001
-                    log.exception("removing volume %s", info.volumeName)
-            self._latest.pop(name, None)
-            if not keep_history:
-                self.versions.remove(name)
-                self.wq.join()  # drain queued writes before deleting the keys
-                self.client.delete(VOLUMES, name)
-                self.client.delete_entity_versions(VOLUMES, name)
+            intent = self.intents.begin(
+                "volume.delete", name, kind=KIND_VOLUME,
+                volume=info.volumeName if info else "",
+                keepHistory=keep_history)
+            try:
+                if info is not None:
+                    try:
+                        self.backend.volume_remove(info.volumeName)
+                    except Exception:  # noqa: BLE001
+                        log.exception("removing volume %s", info.volumeName)
+                    intent.step("removed")
+                    crashpoint("volume.delete.after_remove")
+                self._latest.pop(name, None)
+                if not keep_history:
+                    self.versions.remove(name)
+                    self.wq.join()  # drain queued writes before deleting the keys
+                    self.client.delete(VOLUMES, name)
+                    self.client.delete_entity_versions(VOLUMES, name)
+            except Exception:
+                intent.done()
+                raise
+            intent.done()
 
     def get_volume_info(self, name: str) -> dict:
         info = self._stored_info(name)
@@ -186,3 +233,7 @@ class VolumeService:
         info = StoredVolumeInfo.deserialize(self.client.get_value(VOLUMES, name))
         self._latest[name] = info
         return info
+
+    def invalidate(self, name: str) -> None:
+        """Drop the latest-info cache entry (reconciler rewrites records)."""
+        self._latest.pop(name, None)

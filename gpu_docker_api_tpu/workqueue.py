@@ -8,7 +8,11 @@ Differences by design:
 - bounded retries with exponential backoff instead of an unbounded hot loop;
 - a single drainer thread applying ops in order (the reference's
   goroutine-per-message loses write ordering — SURVEY §2 bug 8);
-- join() for deterministic tests and graceful shutdown.
+- join() for deterministic tests and graceful shutdown;
+- dead-letter visibility: messages that exhaust retries land in `dropped`
+  (counted in /metrics, one event each) instead of vanishing, and
+  replay_dropped() re-queues them — the boot-time reconciler calls it so a
+  transient store outage can't become permanent state loss.
 """
 
 from __future__ import annotations
@@ -19,6 +23,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from .faults import crashpoint
 
 log = logging.getLogger(__name__)
 
@@ -45,6 +51,17 @@ class Call:
     describe: str = "call"
 
 
+def describe(msg) -> str:
+    """Stable human-readable identity of a queue message (drop events)."""
+    if isinstance(msg, PutKeyValue):
+        return f"put {msg.resource}/{msg.name}"
+    if isinstance(msg, DelKey):
+        return f"del {msg.resource}/{msg.name}"
+    if isinstance(msg, Call):
+        return msg.describe
+    return repr(msg)
+
+
 @dataclass
 class _Envelope:
     msg: object
@@ -54,18 +71,22 @@ class _Envelope:
 
 class WorkQueue:
     def __init__(self, client, capacity: int = DEFAULT_CAPACITY,
-                 max_retries: int = 8, base_backoff: float = 0.05):
+                 max_retries: int = 8, base_backoff: float = 0.05,
+                 events=None):
         self._client = client
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
         self._max_retries = max_retries
         self._base_backoff = base_backoff
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._events = events      # EventLog: one record per dropped message
+        self._dropped_lock = threading.Lock()
         self.dropped: list[object] = []  # messages that exhausted retries
 
     # ---- producer side ----
 
     def submit(self, msg) -> None:
+        crashpoint("workqueue.before_submit")
         if self._closed.is_set():
             raise RuntimeError("work queue closed")
         self._q.put(_Envelope(msg))
@@ -104,7 +125,7 @@ class WorkQueue:
                         if env.attempts > self._max_retries:
                             log.error("workqueue: dropping %r after %d attempts: %s",
                                       env.msg, env.attempts, e)
-                            self.dropped.append(env.msg)
+                            self._record_drop(env.msg, env.attempts, e)
                             break
                         delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
                         log.warning("workqueue: retry %d for %r in %.2fs: %s",
@@ -112,6 +133,34 @@ class WorkQueue:
                         time.sleep(delay)
             finally:
                 self._q.task_done()
+
+    def _record_drop(self, msg, attempts: int, exc: Exception) -> None:
+        """Dead-letter a message visibly: keep it for replay_dropped(),
+        emit one event (the silent-loss fix — a dropped write used to be
+        observable only in the process log)."""
+        with self._dropped_lock:
+            self.dropped.append(msg)
+        if self._events is not None:
+            try:
+                self._events.record("workqueue.drop", target=describe(msg),
+                                    code=500, attempts=attempts,
+                                    error=str(exc))
+            except Exception:  # noqa: BLE001 — never kill the drainer
+                log.exception("recording workqueue drop event")
+
+    def replay_dropped(self) -> int:
+        """Re-queue every dead-lettered message with a fresh retry budget.
+        Called by the boot-time reconciler; safe to call any time. Returns
+        the number of messages re-queued."""
+        with self._dropped_lock:
+            msgs, self.dropped = self.dropped, []
+        for m in msgs:
+            self._q.put(_Envelope(m))
+        return len(msgs)
+
+    def dropped_count(self) -> int:
+        with self._dropped_lock:
+            return len(self.dropped)
 
     def _dispatch(self, msg) -> None:
         if isinstance(msg, PutKeyValue):

@@ -299,6 +299,22 @@ def build_spec() -> dict:
              "target": s(), "code": i("App code the op returned"),
              "durationMs": {"type": "number"}, "requestId": s()},
             desc="Operation event (events.py record)"),
+        "ReconcileReport": obj(
+            {"intentsReplayed": arr(s("kind:target:op")),
+             "opsCompleted": arr(s()),
+             "orphanContainersRemoved": arr(s()),
+             "containersRecreated": arr(s()),
+             "containersStarted": arr(s()),
+             "containersAdopted": arr(s()),
+             "layersCopied": i(),
+             "grantsFreed": obj({"tpu": i(), "cpu": i(), "ports": i()}),
+             "grantsRemarked": obj({"tpu": i(), "cpu": i(), "ports": i()}),
+             "versionFixes": i(),
+             "orphanVolumesRemoved": arr(s()),
+             "volumesMigrated": i(),
+             "droppedReplayed": i(),
+             "actions": i("Total corrective actions; 0 = clean boot")},
+            desc="Boot-time crash-recovery report (reconcile.py)"),
     }
 
     v1 = "/api/v1"
@@ -420,6 +436,15 @@ def build_spec() -> dict:
                     {"name": "target", "in": "query", "required": False,
                      "schema": {"type": "string"},
                      "description": "Filter by event target name"}],
+            tags=["meta"])},
+        f"{v1}/reconcile": {"get": op(
+            "reconcile", "Crash-recovery report from the boot-time "
+            "reconciler; ?run=1 performs a fresh pass (admin; quiesce "
+            "mutations first)",
+            envelope(obj({"reconcile": ref("ReconcileReport")})),
+            params=[{"name": "run", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description": "Set to 1 to run a fresh pass"}],
             tags=["meta"])},
         "/metrics": {"get": op(
             "metrics", "Prometheus text exposition",

@@ -1,0 +1,535 @@
+"""Crash-safety sweep: kill the control plane at EVERY registered
+crashpoint, rebuild the whole App from the same state dir, and assert the
+boot-time reconciler restores the invariants.
+
+Crash model: an armed crashpoint raises InjectedCrash (a BaseException, so
+no service unwind handler runs — the daemon "died" at that step boundary).
+The test then abandons the App exactly as a crash would: the write-behind
+queue's already-submitted work reaches the WAL (the crash sits at a step
+boundary, making the persisted prefix deterministic), nothing is flushed,
+no graceful stop runs. The backend OBJECT survives across the rebuild —
+containers are real processes/dockerd state in production and do not die
+with the control plane.
+
+Invariants checked after every crash + rebuild (ISSUE acceptance):
+- zero leaked or double-freed scheduler grants (bitmaps == stored specs),
+- zero orphan backend containers (backend names == stored currents),
+- version maps consistent (counter >= stored version >= every history key),
+- no open intents, and a second reconcile pass is a no-op.
+"""
+
+import os
+
+import pytest
+
+from gpu_docker_api_tpu import faults
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import (
+    ContainerRun, PatchRequest, StoredContainerInfo, StoredVolumeInfo,
+    TpuPatch,
+)
+from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.topology import make_topology
+
+pytestmark = pytest.mark.crash
+
+N_CHIPS = 16      # v4-32 single host
+N_CORES = 16
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def make_app(tmp_path, backend=None):
+    return App(state_dir=str(tmp_path / "state"),
+               backend=backend if backend is not None else "mock",
+               addr="127.0.0.1:0", port_range=(44000, 44100),
+               topology=make_topology("v4-32"), api_key="", cpu_cores=N_CORES,
+               store_maint_records=0)
+
+
+def crash(app):
+    """Abandon the App as a daemon death would: drain what was already
+    submitted (step-boundary determinism), release the WAL handle, run NO
+    graceful flush. Returns the surviving backend."""
+    faults.disarm_all()
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    return app.backend
+
+
+def crash_and_rebuild(app, tmp_path):
+    return make_app(tmp_path, backend=crash(app))
+
+
+# ------------------------------------------------------------ invariants
+
+def stored_containers(app):
+    return {kv.key.rsplit("/", 1)[1]: StoredContainerInfo.deserialize(kv.value)
+            for kv in app.client.range("containers")}
+
+
+def stored_volumes(app):
+    return {kv.key.rsplit("/", 1)[1]: StoredVolumeInfo.deserialize(kv.value)
+            for kv in app.client.range("volumes")}
+
+
+def assert_invariants(app):
+    app.wq.join()
+    stored = stored_containers(app)
+    # scheduler bitmaps hold exactly the grants of non-released records
+    exp_tpu, exp_cpu, exp_ports = {}, {}, {}
+    for name, info in stored.items():
+        if info.resourcesReleased:
+            continue
+        for c in info.spec.tpu_chips:
+            exp_tpu[c] = name
+        for c in app.cpu._cores(info.spec.cpuset):
+            exp_cpu[c] = name
+        for p in info.spec.port_bindings.values():
+            exp_ports[int(p)] = name
+    assert {i: o for i, o in app.tpu.status.items()
+            if o not in (None, "")} == exp_tpu
+    assert {i: o for i, o in app.cpu.status.items()
+            if o not in (None, "")} == exp_cpu
+    assert dict(app.ports.used) == exp_ports
+    # backend holds exactly the stored current containers
+    assert set(app.backend.list_names()) == {
+        i.containerName for i in stored.values()}
+    # version maps consistent with records and history keys
+    for name, info in stored.items():
+        vm = app.container_versions.get(name)
+        assert vm is not None and vm >= info.version
+        for v, _ in app.client.entity_versions("containers", name):
+            assert v <= vm
+    for name in app.container_versions.items():
+        assert name in stored
+    # stored current volumes are backed by real backend volumes
+    backend_vols = set(app.backend.volume_list())
+    for name, info in stored_volumes(app).items():
+        assert info.volumeName in backend_vols
+        vv = app.volume_versions.get(name)
+        assert vv is not None and vv >= info.version
+    # every intent was settled, and reconcile has reached a fixpoint
+    assert app.intents.open_intents() == []
+    rerun = app.reconciler.run()
+    assert rerun["actions"] == 0, f"re-reconcile not a no-op: {rerun}"
+    return stored
+
+
+# ------------------------------------------------------- sweep scenarios
+
+def run_demo(app, name="demo", tpus=2):
+    return app.replicasets.run_container(ContainerRun(
+        imageName="img", replicaSetName=name, tpuCount=tpus, cpuCount=2,
+        containerPorts=["8888"]))
+
+
+def _mark(app, ctr):
+    """Drop a marker file in the container's writable layer — replace
+    crashes must never lose it."""
+    upper = app.backend.inspect(ctr).upper_dir
+    with open(os.path.join(upper, "marker.txt"), "w") as f:
+        f.write("precious")
+
+
+def _has_mark(app, ctr):
+    upper = app.backend.inspect(ctr).upper_dir
+    return os.path.exists(os.path.join(upper, "marker.txt"))
+
+
+def _patch_tpus(app, name="demo", count=4):
+    app.replicasets.patch_container(
+        name, PatchRequest(tpuPatch=TpuPatch(tpuCount=count)))
+
+
+def scenario_run(app):
+    run_demo(app)
+
+
+def post_run(app, stored):
+    # the run never reached its persist step: it must be fully unwound
+    assert stored == {}
+    assert app.backend.list_names() == []
+    assert app.container_versions.items() == {}
+
+
+def setup_replace(app):
+    run_demo(app)
+    _mark(app, "demo-1")
+
+
+def scenario_replace(app):
+    _patch_tpus(app)
+
+
+def post_replace(app, stored):
+    # the new version persisted before every replace.* crashpoint: the
+    # reconciler rolls FORWARD — new version alive, layer data carried
+    info = stored["demo"]
+    assert info.version == 2
+    assert len(info.spec.tpu_chips) == 4
+    assert app.backend.inspect("demo-2").running
+    assert _has_mark(app, "demo-2")
+
+
+def setup_rollback(app):
+    run_demo(app)
+    _mark(app, "demo-1")
+    _patch_tpus(app)            # v2 with 4 chips; history has v1 (2 chips)
+
+
+def scenario_rollback(app):
+    app.replicasets.rollback_container("demo", 1)
+
+
+def setup_restart(app):
+    run_demo(app)
+    app.replicasets.stop_container("demo")   # exercises the re-grant path
+
+
+def scenario_restart(app):
+    app.replicasets.restart_container("demo")
+
+
+def setup_stop(app):
+    run_demo(app)
+
+
+def scenario_stop(app):
+    app.replicasets.stop_container("demo")
+
+
+def post_stop(app, stored):
+    # the user asked for a stop: the reconciler completes it
+    assert stored["demo"].resourcesReleased
+    assert not app.backend.inspect("demo-1").running
+    assert sum(1 for o in app.tpu.status.values() if o is None) == N_CHIPS
+
+
+def setup_delete(app):
+    run_demo(app)
+
+
+def scenario_delete(app):
+    app.replicasets.delete_container("demo")
+
+
+def post_delete(app, stored):
+    assert stored == {}
+    assert app.backend.list_names() == []
+
+
+def scenario_vol_create(app):
+    app.volumes.create_volume("vol", "16MB")
+
+
+def post_vol_create(app, stored):
+    # never persisted: fully unwound, backend volume gone
+    assert stored_volumes(app) == {}
+    assert app.backend.volume_list() == []
+    assert app.volume_versions.items() == {}
+
+
+def setup_vol_scale(app):
+    out = app.volumes.create_volume("vol", "16MB")
+    with open(os.path.join(out["mountpoint"], "data.bin"), "w") as f:
+        f.write("payload")
+
+
+def scenario_vol_scale(app):
+    app.volumes.patch_volume_size("vol", "32MB")
+
+
+def post_vol_scale(app, stored):
+    vols = stored_volumes(app)
+    assert vols["vol"].version == 2
+    # the data migrated (by the service before the crash, or by the
+    # reconciler after it)
+    mp = app.backend.volume_inspect("vol-2").mountpoint
+    assert open(os.path.join(mp, "data.bin")).read() == "payload"
+
+
+def setup_vol_delete(app):
+    app.volumes.create_volume("vol", "16MB")
+
+
+def scenario_vol_delete(app):
+    app.volumes.delete_volume("vol")
+
+
+def post_vol_delete(app, stored):
+    assert stored_volumes(app) == {}
+    assert app.backend.volume_list() == []
+
+
+# crashpoint-name prefix -> (setup, mutate, extra post-assertions)
+SCENARIOS = [
+    ("run.", (None, scenario_run, post_run)),
+    ("replace.", (setup_replace, scenario_replace, post_replace)),
+    ("rollback.", (setup_rollback, scenario_rollback, None)),
+    ("restart.", (setup_restart, scenario_restart, None)),
+    ("stop.", (setup_stop, scenario_stop, post_stop)),
+    ("delete.", (setup_delete, scenario_delete, post_delete)),
+    ("volume.create.", (None, scenario_vol_create, post_vol_create)),
+    ("volume.scale.", (setup_vol_scale, scenario_vol_scale, post_vol_scale)),
+    ("volume.delete.", (setup_vol_delete, scenario_vol_delete,
+                        post_vol_delete)),
+    ("workqueue.", (None, scenario_run, post_run)),
+]
+
+
+@pytest.mark.parametrize("cp", faults.all_crashpoints())
+def test_crashpoint_sweep(cp, tmp_path):
+    for prefix, triple in SCENARIOS:
+        if cp.startswith(prefix):
+            setup, mutate, post = triple
+            break
+    else:
+        pytest.fail(f"crashpoint {cp} has no sweep scenario — every "
+                    f"registered crashpoint must be swept")
+    app = make_app(tmp_path)
+    if setup is not None:
+        setup(app)
+    faults.arm(cp)
+    with pytest.raises(InjectedCrash):
+        mutate(app)
+    app2 = crash_and_rebuild(app, tmp_path)
+    stored = assert_invariants(app2)
+    if post is not None:
+        post(app2, stored)
+
+
+# ----------------------------------------------- targeted recovery tests
+
+def test_clean_reboot_is_noop(tmp_path):
+    app = make_app(tmp_path)
+    run_demo(app)
+    app2 = crash_and_rebuild(app, tmp_path)
+    assert app2.last_reconcile["actions"] == 0, app2.last_reconcile
+    assert_invariants(app2)
+
+
+def test_substrate_wipe_recreates_containers(tmp_path):
+    """Host reboot: the backend loses everything, the store remembers.
+    The reconciler rebuilds and restarts the recorded containers."""
+    app = make_app(tmp_path)
+    run_demo(app)
+    crash(app)
+    fresh = MockBackend(os.path.join(str(tmp_path / "state"), "backend2"))
+    app2 = make_app(tmp_path, backend=fresh)
+    assert "demo-1" in app2.last_reconcile["containersRecreated"]
+    assert app2.backend.inspect("demo-1").running
+    assert_invariants(app2)
+
+
+def test_orphan_backend_container_removed(tmp_path):
+    app = make_app(tmp_path)
+    run_demo(app)
+    app.wq.join()
+    app.backend.create("ghost-1", stored_containers(app)["demo"].spec)
+    rep = app.reconciler.run()
+    assert "ghost-1" in rep["orphanContainersRemoved"]
+    assert_invariants(app)
+
+
+def test_orphan_grant_freed_and_lost_grant_remarked(tmp_path):
+    app = make_app(tmp_path)
+    run_demo(app)
+    app.wq.join()
+    app.tpu.apply(2, "ghost")                       # leaked grant
+    chips = stored_containers(app)["demo"].spec.tpu_chips
+    app.tpu.restore(chips, "demo")                  # lost grant
+    rep = app.reconciler.run()
+    assert rep["grantsFreed"]["tpu"] == 2
+    assert rep["grantsRemarked"]["tpu"] == len(chips)
+    assert_invariants(app)
+
+
+def test_replace_unwound_when_new_version_never_persisted(tmp_path):
+    """The hardest write-behind loss: the replace's new container exists in
+    the backend and the intent records it, but the latest pointer still
+    names the old version (its persist write died with the daemon). The
+    reconciler must unwind to the old version — remove the new container
+    and its history key — because the store is the authority."""
+    app = make_app(tmp_path)
+    run_demo(app)
+    app.wq.join()
+    old = stored_containers(app)["demo"]
+    # forge the mid-crash world: intent open at the created step, backend
+    # already holding the never-persisted demo-2
+    intent = app.intents.begin("replace", "demo", via="patch",
+                               oldVersion=old.version,
+                               oldContainer=old.containerName,
+                               oldReleased=False)
+    intent.step("created", container="demo-2", version=2)
+    app.backend.create("demo-2", old.spec)
+    app2 = crash_and_rebuild(app, tmp_path)
+    rep = app2.last_reconcile
+    assert "demo-2" in rep["orphanContainersRemoved"]
+    stored = assert_invariants(app2)
+    assert stored["demo"].version == 1
+    assert app2.backend.inspect("demo-1").running
+
+
+def test_orphan_sweep_spares_foreign_names(tmp_path):
+    """A shared substrate (a dockerd also running other stacks) holds
+    containers and volumes that are not this control plane's: the orphan
+    sweeps must only ever touch `{dashless}-{digits}` names."""
+    app = make_app(tmp_path)
+    run_demo(app)
+    app.wq.join()
+    spec = stored_containers(app)["demo"].spec
+    app.backend.create("proj_db-data", spec)        # suffix not numeric
+    app.backend.create("web-api-1", spec)           # dashed base name
+    app.backend.volume_create("proj_db-data")
+    rep = app.reconciler.run()
+    assert rep["orphanContainersRemoved"] == []
+    assert rep["orphanVolumesRemoved"] == []
+    assert app.backend.inspect("proj_db-data").exists
+    assert app.backend.inspect("web-api-1").exists
+    # clean the foreign state up so the shared invariants hold again
+    app.backend.remove("proj_db-data", force=True)
+    app.backend.remove("web-api-1", force=True)
+    app.backend.volume_remove("proj_db-data")
+    assert_invariants(app)
+
+
+def test_purge_spares_prefix_sharing_sibling(tmp_path):
+    """Unwinding a crashed mutation of replicaSet "web" must not remove
+    containers of a sibling whose name shares the prefix ("web-api" is not
+    a version of "web")."""
+    app = make_app(tmp_path)
+    run_demo(app, name="webapi")
+    app.wq.join()
+    spec = stored_containers(app)["webapi"].spec
+    # forge: "web" crashed mid-run (open intent, no stored record) while a
+    # prefix-sharing container exists on the backend
+    app.backend.create("web-api-1", spec)
+    app.intents.begin("run", "web")
+    app2 = crash_and_rebuild(app, tmp_path)
+    assert app2.backend.inspect("web-api-1").exists
+    app2.backend.remove("web-api-1", force=True)
+    assert_invariants(app2)
+
+
+def test_volume_scale_crash_before_create_never_self_migrates(tmp_path):
+    """Review finding: a scale intent with no 'created' step (crash before
+    the new version existed) must not migrate the live volume onto itself."""
+    app = make_app(tmp_path)
+    out = app.volumes.create_volume("vol", "16MB")
+    sub = os.path.join(out["mountpoint"], "nested")
+    os.makedirs(sub)
+    with open(os.path.join(sub, "f.txt"), "w") as f:
+        f.write("data")
+    app.intents.begin("volume.scale", "vol", kind="volume",
+                      oldVersion=1, oldVolume="vol-1", newSize="32MB")
+    app2 = crash_and_rebuild(app, tmp_path)
+    assert app2.last_reconcile["volumesMigrated"] == 0
+    mp = app2.backend.volume_inspect("vol-1").mountpoint
+    assert open(os.path.join(mp, "nested", "f.txt")).read() == "data"
+    assert_invariants(app2)
+
+
+def test_runtime_reconcile_refused_while_mutation_in_flight(tmp_path):
+    """?run=1 must not replay an intent a live request thread still owns."""
+    import http.client
+    import json
+
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        app.intents.begin("run", "live")      # an in-flight mutation
+        conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                          timeout=10)
+        conn.request("GET", "/api/v1/reconcile?run=1")
+        body = json.loads(conn.getresponse().read())
+        assert body["code"] != 200            # refused, not replayed
+        assert app.intents.open_intents()     # intent untouched
+        conn.close()
+    finally:
+        app.intents.clear("container", "live")
+        app.stop()
+
+
+def test_reconcile_endpoint_and_metrics(tmp_path):
+    import http.client
+    import json
+
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                          timeout=10)
+        conn.request("GET", "/api/v1/reconcile")
+        body = json.loads(conn.getresponse().read())
+        assert body["code"] == 200
+        assert body["data"]["reconcile"]["actions"] == 0
+        conn.request("GET", "/api/v1/reconcile?run=1")
+        body = json.loads(conn.getresponse().read())
+        assert body["data"]["reconcile"]["actions"] == 0
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        assert "tdapi_workqueue_dropped 0" in text
+        assert "tdapi_reconcile_actions 0" in text
+        conn.close()
+    finally:
+        app.stop()
+
+
+def test_intent_journal_lifecycle(tmp_path):
+    app = make_app(tmp_path)
+    intent = app.intents.begin("run", "thing", tpus=2)
+    intent.step("granted", tpuChips=[0, 1])
+    open_ = app.intents.open_intents()
+    assert len(open_) == 1
+    rec = open_[0]
+    assert rec.op == "run" and rec.target == "thing"
+    assert rec.has_step("granted")
+    assert rec.step_meta("granted")["tpuChips"] == [0, 1]
+    intent.done()
+    assert app.intents.open_intents() == []
+
+
+def test_workqueue_drop_event_and_replay(tmp_path):
+    from gpu_docker_api_tpu.events import EventLog
+    from gpu_docker_api_tpu.store import MVCCStore, StateClient
+    from gpu_docker_api_tpu.workqueue import PutKeyValue, WorkQueue
+
+    class FlakyClient:
+        def __init__(self, inner):
+            self.inner = inner
+            self.failing = True
+
+        def put(self, resource, name, value):
+            if self.failing:
+                raise RuntimeError("store outage")
+            self.inner.put(resource, name, value)
+
+        def delete(self, resource, name):
+            self.inner.delete(resource, name)
+
+    store = MVCCStore()
+    events = EventLog(str(tmp_path))
+    flaky = FlakyClient(StateClient(store))
+    wq = WorkQueue(flaky, max_retries=1, base_backoff=0.001, events=events)
+    wq.start()
+    wq.submit(PutKeyValue("containers", "x", "v1"))
+    wq.join()
+    assert wq.dropped_count() == 1
+    drops = [e for e in events.recent() if e["op"] == "workqueue.drop"]
+    assert drops and drops[0]["target"] == "put containers/x"
+    # outage over: the reconciler's replay path recovers the write
+    flaky.failing = False
+    assert wq.replay_dropped() == 1
+    wq.join()
+    assert flaky.inner.get("containers", "x").value == "v1"
+    assert wq.dropped_count() == 0
+    wq.close()
+    events.close()

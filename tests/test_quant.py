@@ -207,7 +207,7 @@ def test_qeinsum_rejects_unsupported_scale_layouts():
     np.testing.assert_allclose(
         np.asarray(out),
         np.asarray(jnp.einsum("ecd,edf->ecf", a, dequantize(bank, a.dtype))),
-        rtol=1e-5)
+        rtol=3e-5)  # both sides are f32 einsums; contraction-order noise
     # layer-stacked bank that scan didn't unstack
     bank4 = quantize(
         jax.random.normal(jax.random.key(2), (3, 2, 8, 4)), "w8")

@@ -80,6 +80,22 @@ def test_failed_replace_reverts_world(world):
     assert [h["version"] for h in hist] == [2, 1]
 
 
+def test_failed_first_start_does_not_brick_the_name(world):
+    """Review finding: create succeeded, start failed — the created
+    container must be removed, or every retry collides with the leftover
+    and the name is unusable until a reboot's reconcile."""
+    rs, _, backend, tpu, cpu, ports, wq, client = world
+    backend.fail_next_start = True
+    with pytest.raises(RuntimeError):
+        _run(rs, "a", tpus=1)
+    assert not backend.inspect("a-1").exists
+    assert tpu.get_status()["freeCount"] == 16
+    # the name is immediately reusable
+    resp = _run(rs, "a", tpus=1)
+    assert resp["name"] == "a-1"
+    assert backend.inspect("a-1").running
+
+
 # finding 2: double-stop must not free chips now owned by another replicaSet
 
 def test_double_stop_cannot_free_others_chips(world):

@@ -89,6 +89,84 @@ def test_policy_no_never_restarts(sup):
     assert _runs(sup, "c6") == 1
 
 
+def test_backoff_forgiveness_resets_restart_count(tmp_path):
+    """process.py forgiveness window: a container healthy past
+    forgive_after has its restart_count reset, so a much-later crash
+    restarts promptly instead of inheriting an escalated backoff."""
+    b = ProcessBackend(str(tmp_path / "b"), supervise=True,
+                       supervise_interval=0.05, forgive_after=0.3)
+    try:
+        st = _start(b, "c1", "echo run >> runs.txt; sleep 60")
+        wait_for(lambda: _runs(b, "c1") >= 1, msg="first run")
+        os.kill(st.pid, signal.SIGKILL)
+        wait_for(lambda: _runs(b, "c1") >= 2, msg="first restart")
+        p = b._get("c1")
+        assert p.restart_count >= 1
+        # healthy past the window: the history is forgiven
+        wait_for(lambda: p.restart_count == 0, timeout=5,
+                 msg="backoff forgiveness")
+        # the next crash starts from the minimum backoff, not 2^n
+        st2 = b.inspect("c1")
+        os.kill(st2.pid, signal.SIGKILL)
+        t0 = time.time()
+        wait_for(lambda: _runs(b, "c1") >= 3, timeout=5,
+                 msg="prompt restart after forgiveness")
+        assert time.time() - t0 < 3.0      # base delay is 0.25s, not 30s
+        assert b._get("c1").restart_count == 1
+    finally:
+        b.close()
+
+
+class _RacingProcs(dict):
+    """Simulates remove() winning the race inside _supervise_one's locked
+    re-check: the lookup succeeds but the proc's popen is already None."""
+
+    def get(self, key, default=None):
+        p = super().get(key, default)
+        if p is not None:
+            p.popen = None
+        return p
+
+
+def test_supervise_remove_race_guarded(tmp_path):
+    """Regression (ISSUE satellite): inside the locked re-check, p.popen
+    can be nulled by a concurrent remove(); the old code raised
+    AttributeError there — eaten by the supervisor's blanket except, so
+    the restart stayed silently pending forever."""
+    b = ProcessBackend(str(tmp_path / "b"))      # no supervisor thread
+    try:
+        spec = ContainerSpec(cmd=["sh", "-c", "exit 1"],
+                             restart_policy="always")
+        b.create("c1", spec)
+        b.start("c1")
+        p = b._get("c1")
+        p.popen.wait(timeout=10)
+        b._supervise_one("c1", p)                # observes death
+        assert p.restart_at > 0
+        p.restart_at = time.time() - 1           # restart is due NOW
+        b._procs = _RacingProcs(b._procs)        # remove() races the lock
+        b._supervise_one("c1", p)                # must not raise or restart
+        assert p.popen is None
+        assert p.restart_count == 0
+    finally:
+        b.close()
+
+
+def test_remove_nulls_popen_for_stale_handles(tmp_path):
+    """remove() marks the proc dead for any supervisor tick still holding
+    the old _Proc — the other half of the race fix."""
+    b = ProcessBackend(str(tmp_path / "b"))
+    try:
+        b.create("c1", ContainerSpec(cmd=["sleep", "30"]))
+        b.start("c1")
+        p = b._get("c1")
+        b.remove("c1", force=True)
+        assert p.popen is None
+        b._supervise_one("c1", p)                # stale tick: clean no-op
+    finally:
+        b.close()
+
+
 def test_rootfs_quota_watchdog_kills_writer(sup):
     st = _start(sup, "c7",
                 "dd if=/dev/zero of=big bs=1M count=5 2>/dev/null; sleep 60",
