@@ -5,8 +5,8 @@ PY ?= python3
 ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
-.PHONY: all native test test-fast verify-crash bench serve serve-mock \
-    dryrun apidoc lint clean
+.PHONY: all native test test-fast verify-crash verify-faults bench serve \
+    serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -15,9 +15,15 @@ native:                 ## build the C++ cores (MVCC store, topology search)
 
 test: native            ## full suite on the virtual 8-device CPU mesh
 	$(PY) -m pytest tests/ -q
+	@echo "robustness tiers included above — rerun in isolation with:"
+	@echo "  make verify-crash   (crashpoint sweep: -m crash)"
+	@echo "  make verify-faults  (transient-fault sweep: -m faults)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
 	$(PY) -m pytest tests/ -q -m crash
+
+verify-faults:          ## transient-fault sweep: error/latency/hang on every backend op
+	$(PY) -m pytest tests/ -q -m faults
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

@@ -1,4 +1,5 @@
 from .base import Backend, ContainerState, VolumeState  # noqa: F401
+from .guard import CircuitBreaker, GuardedBackend  # noqa: F401
 from .mock import MockBackend  # noqa: F401
 from .process import ProcessBackend  # noqa: F401
 

@@ -146,7 +146,37 @@ class Backend(abc.ABC):
         then skips orphan-volume detection rather than guessing."""
         return []
 
+    # ---- health hooks (health.py probes these; defaults = healthy) ----
+
+    def ping(self) -> bool:
+        """Substrate reachability. Docker pings dockerd; process/mock own
+        their substrate in-process and are reachable by construction."""
+        return True
+
+    def chip_available(self, device_path: str) -> bool:
+        """Is the chip behind device_path present and usable? Device-backed
+        substrates (process/docker) check path existence; MockBackend makes
+        it injectable. Base default: healthy (no device knowledge)."""
+        return True
+
+    def flap_counts(self) -> dict[str, int]:
+        """container name -> consecutive crash/restart count, for flap
+        detection. Substrates without supervision return {}."""
+        return {}
+
     # ---- lifecycle ----
 
     def close(self) -> None:  # noqa: B027 — optional hook
         pass
+
+
+def device_path_available(device_path: str) -> bool:
+    """Shared chip-presence probe for device-backed substrates: the chip is
+    unhealthy when ITS device node is gone while the host does expose accel
+    devices. A host with no /dev/accel* at all is running a virtual
+    topology (CPU dev box, CI) — there is nothing to check, so every chip
+    reports healthy rather than the monitor cordoning the whole mesh."""
+    import glob
+    if not glob.glob("/dev/accel*") and not glob.glob("/dev/vfio/*"):
+        return True
+    return os.path.exists(device_path)

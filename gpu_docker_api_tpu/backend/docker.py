@@ -70,6 +70,22 @@ class DockerBackend(Backend):
         # fail fast like the reference's 2s blocking dial (etcd/client.go:17)
         self._request("GET", "/_ping", raw=True)
 
+    # ---- health hooks ----
+
+    def ping(self) -> bool:
+        """dockerd reachability over the Unix socket, with a short timeout
+        so the health monitor's probe loop can't wedge behind a stalled
+        daemon."""
+        try:
+            self._request("GET", "/_ping", raw=True, timeout=2.0)
+            return True
+        except (DockerError, OSError):
+            return False
+
+    def chip_available(self, device_path: str) -> bool:
+        from .base import device_path_available
+        return device_path_available(device_path)
+
     # ---- HTTP plumbing ----
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,

@@ -40,8 +40,32 @@ class MockBackend(Backend):
         self._containers: dict[str, _MockContainer] = {}
         self._volumes: dict[str, VolumeState] = {}
         self._images: dict[str, str] = {}
+        # injectable health state (health.py probes; tests flip these)
+        self._ping_ok = True
+        self._chip_health: dict[str, bool] = {}
+        self._flaps: dict[str, int] = {}
         os.makedirs(os.path.join(state_dir, "upper"), exist_ok=True)
         os.makedirs(os.path.join(state_dir, "volumes"), exist_ok=True)
+
+    # ---- injectable health (no real substrate to probe) ----
+
+    def set_ping(self, ok: bool) -> None:
+        self._ping_ok = ok
+
+    def ping(self) -> bool:
+        return self._ping_ok
+
+    def set_chip_health(self, device_path: str, ok: bool) -> None:
+        self._chip_health[device_path] = ok
+
+    def chip_available(self, device_path: str) -> bool:
+        return self._chip_health.get(device_path, True)
+
+    def set_flap_count(self, name: str, count: int) -> None:
+        self._flaps[name] = count
+
+    def flap_counts(self) -> dict[str, int]:
+        return {n: c for n, c in self._flaps.items() if c > 0}
 
     # ---- containers ----
 

@@ -22,6 +22,7 @@ docker pause (which freezes the cgroup).
 
 from __future__ import annotations
 
+import logging
 import os
 import resource
 import shutil
@@ -34,16 +35,37 @@ import uuid
 from typing import Optional
 
 from ..dtos import ContainerSpec
-from .base import Backend, ContainerState, VolumeState
+from .base import Backend, ContainerState, VolumeState, device_path_available
+
+log = logging.getLogger(__name__)
 
 
-def _run_quiet(cmd: list[str], timeout: float = 30.0) -> bool:
-    """Run a host tool, True on rc 0; missing binary / failure = False."""
+def _run_quiet(cmd: list[str], timeout: float = 30.0, events=None,
+               label: str = "") -> bool:
+    """Run a host tool, True on rc 0; missing binary / failure = False.
+
+    A TIMEOUT is not silent like the other failures: a mount/umount that
+    stalls for 30s is a substrate symptom (dying disk, wedged loop device)
+    the operator must be able to see — it is logged and, when the caller
+    wires an EventLog, emitted as a backend.tool_timeout event on
+    /api/v1/events."""
     try:
         return subprocess.run(
             cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             timeout=timeout).returncode == 0
-    except (OSError, subprocess.TimeoutExpired):
+    except subprocess.TimeoutExpired:
+        log.warning("host tool timed out after %.0fs: %s",
+                    timeout, " ".join(cmd))
+        if events is not None:
+            try:
+                events.record("backend.tool_timeout",
+                              target=label or os.path.basename(cmd[0]),
+                              code=500, tool=" ".join(cmd),
+                              timeoutSec=timeout)
+            except Exception:  # noqa: BLE001 — observability must not kill
+                log.exception("recording tool-timeout event")
+        return False
+    except OSError:
         return False
 
 
@@ -90,6 +112,9 @@ class ProcessBackend(Backend):
         self.state_dir = state_dir
         self._lock = threading.RLock()
         self._procs: dict[str, _Proc] = {}
+        # optional EventLog; the App wires it so quota mount/umount stalls
+        # surface on /api/v1/events (see _run_quiet)
+        self.events = None
         for sub in ("rootfs", "volumes", "images", "logs"):
             os.makedirs(os.path.join(state_dir, sub), exist_ok=True)
         # warm worker pool (warmpool.py): python workloads start in a
@@ -419,6 +444,21 @@ class ProcessBackend(Backend):
         with self._lock:
             return sorted(n for n in self._procs if n.startswith(prefix))
 
+    # ---- health hooks ----
+
+    def chip_available(self, device_path: str) -> bool:
+        """A chip whose /dev/accel* node vanished (PCIe drop, driver
+        reset) is unusable; a host with no accel devices at all runs a
+        virtual topology and reports healthy (base.py)."""
+        return device_path_available(device_path)
+
+    def flap_counts(self) -> dict[str, int]:
+        """Supervisor restart counters: a container crash-looping under
+        restart policy shows up here until forgive_after clears it."""
+        with self._lock:
+            return {n: p.restart_count for n, p in self._procs.items()
+                    if p.restart_count > 0}
+
     # ---- volumes ----
 
     def volume_create(self, name: str, size_bytes: int = 0,
@@ -514,9 +554,11 @@ class ProcessBackend(Backend):
                 # sparse image: disk is consumed as the volume fills, the
                 # fs SIZE (the quota) is fixed
                 f.truncate(size_bytes)
-            if not _run_quiet(["mkfs.ext4", "-q", "-F", img]):
+            if not _run_quiet(["mkfs.ext4", "-q", "-F", img],
+                              events=self.events, label=name):
                 raise OSError("mkfs.ext4 failed")
-            if not _run_quiet(["mount", "-o", "loop", img, mp]):
+            if not _run_quiet(["mount", "-o", "loop", img, mp],
+                              events=self.events, label=name):
                 raise OSError("loop mount failed")
             # the workload writes as the container's uid; lost+found stays
             os.chmod(mp, 0o777)
@@ -540,12 +582,15 @@ class ProcessBackend(Backend):
             found = self._find_volume(f[:-4])
             if found and not os.path.ismount(found[0]):
                 img = os.path.join(self._volimg_dir, f)
-                _run_quiet(["mount", "-o", "loop", img, found[0]])
+                _run_quiet(["mount", "-o", "loop", img, found[0]],
+                           events=self.events, label=f[:-4])
 
     def _unmount_quota_fs(self, mp: str, name: str) -> None:
         if os.path.ismount(mp):
-            if not _run_quiet(["umount", mp]):
-                _run_quiet(["umount", "-l", mp])   # lazy: busy writer
+            if not _run_quiet(["umount", mp], events=self.events, label=name):
+                # lazy: busy writer
+                _run_quiet(["umount", "-l", mp],
+                           events=self.events, label=name)
         try:
             os.unlink(os.path.join(self._volimg_dir, f"{name}.img"))
         except OSError:

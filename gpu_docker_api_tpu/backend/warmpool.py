@@ -31,11 +31,15 @@ image/runtime startup every time); this is a TPU-native addition.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import subprocess
 import sys
 import threading
+import time
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 # The worker loop. Runs under `python -u -c`; heavy imports happen BEFORE
 # the stdin read, so an idle worker is a fully warmed interpreter.
@@ -106,9 +110,22 @@ class WarmPool:
     """N idle pre-imported interpreters; take() pops one, a replacement
     spawns in the background."""
 
-    def __init__(self, size: int = 1, preimport: str = "jax"):
+    def __init__(self, size: int = 1, preimport: str = "jax",
+                 give_up_after: int = 5, backoff_base: float = 0.05,
+                 backoff_cap: float = 2.0):
         self.size = max(int(size), 0)
         self.preimport = preimport
+        # refill damping: a worker that can't spawn (or dies before it is
+        # ever taken — e.g. a preimport that crashes the interpreter) must
+        # not turn the take->refill cycle into a hot respawn loop. Each
+        # consecutive failure backs the next refill off exponentially, and
+        # give_up_after consecutive failures disables the pool entirely
+        # (every start falls back to the cold path — correct, just slower).
+        self.give_up_after = max(1, int(give_up_after))
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._consec_failures = 0
+        self._gave_up = False
         self._lock = threading.Lock()
         self._idle: list[subprocess.Popen] = []
         self._closed = False
@@ -129,16 +146,54 @@ class WarmPool:
             return None
 
     def _add_worker(self) -> None:
+        with self._lock:
+            if self._closed or self._gave_up:
+                return
         w = self._spawn()
-        if w is not None:
-            with self._lock:
-                if self._closed:
-                    _reap(w)
-                    return
-                self._idle.append(w)
+        if w is None:
+            self._note_failure("spawn failed")
+            return
+        with self._lock:
+            if self._closed:
+                _reap(w)
+                return
+            self._idle.append(w)
 
     def _refill_async(self) -> None:
-        threading.Thread(target=self._add_worker, daemon=True).start()
+        with self._lock:
+            if self._closed or self._gave_up:
+                return
+            delay = (min(self.backoff_cap,
+                         self.backoff_base * (2 ** (self._consec_failures - 1)))
+                     if self._consec_failures else 0.0)
+
+        def refill():
+            if delay:
+                time.sleep(delay)
+            self._add_worker()
+
+        threading.Thread(target=refill, daemon=True).start()
+
+    def _note_failure(self, why: str) -> None:
+        with self._lock:
+            self._consec_failures += 1
+            if (self._consec_failures >= self.give_up_after
+                    and not self._gave_up):
+                self._gave_up = True
+                log.warning(
+                    "warm pool giving up after %d consecutive worker "
+                    "failures (last: %s) — workloads fall back to cold "
+                    "spawn", self._consec_failures, why)
+
+    def _note_success(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"idle": len(self._idle),
+                    "consecFailures": self._consec_failures,
+                    "gaveUp": self._gave_up}
 
     # ---- dispatch ----
 
@@ -180,7 +235,7 @@ class WarmPool:
         Every popped worker — taken OR found dead — schedules a
         replacement, so a crashed worker can never shrink the pool
         permanently."""
-        refills, taken = 0, None
+        refills, taken, dead = 0, None, 0
         with self._lock:
             if self._closed:
                 return None
@@ -190,6 +245,13 @@ class WarmPool:
                 if w.poll() is None:
                     taken = w
                     break
+                dead += 1
+        # dead idle workers are consecutive-failure evidence (a broken
+        # preimport kills them between spawn and take); a live take resets
+        for _ in range(dead):
+            self._note_failure("worker died while idle")
+        if taken is not None:
+            self._note_success()
         for _ in range(refills):
             self._refill_async()
         return taken

@@ -55,6 +55,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the process-backend supervisor (restart "
                         "policy enforcement + rootfs storage-quota "
                         "watchdog; on by default for the daemon)")
+    p.add_argument("--no-guard", action="store_true",
+                   help="disable the guarded backend (per-op deadlines, "
+                        "transient-error retries, circuit breaker; on by "
+                        "default for the daemon)")
+    p.add_argument("--health-interval", type=float, default=5.0,
+                   metavar="SEC",
+                   help="substrate health probe period (chip presence, "
+                        "reachability, flap detection; 0 disables the "
+                        "background prober — /healthz still probes on "
+                        "demand; default 5)")
+    p.add_argument("--no-auto-cordon", action="store_true",
+                   help="report unhealthy chips on /healthz but never "
+                        "cordon them automatically")
     return p
 
 
@@ -80,7 +93,10 @@ def main(argv=None) -> int:
     app = App(state_dir=args.state_dir, backend=args.backend, addr=args.addr,
               port_range=parse_port_range(args.portRange), topology=topology,
               volume_tiers=tiers, warm_pool=args.warm_pool,
-              supervise=not args.no_supervise)
+              supervise=not args.no_supervise,
+              guard_backend=not args.no_guard,
+              health_interval=args.health_interval,
+              auto_cordon=not args.no_auto_cordon)
     app.start()
 
     status = app.tpu.get_status()

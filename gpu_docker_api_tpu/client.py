@@ -21,6 +21,7 @@ from __future__ import annotations
 import http.client
 import json
 import re
+import time
 from typing import Any, Optional
 
 
@@ -102,10 +103,18 @@ class ApiClient:
 
     def __init__(self, host: str, port: int,
                  spec: Optional[dict] = None, api_key: str = "",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, get_retries: int = 2,
+                 retry_backoff: float = 0.1, retry_backoff_cap: float = 1.0):
         self.host, self.port = host, port
         self.api_key = api_key
         self.timeout = timeout
+        # idempotent-GET retry budget: a briefly-degraded daemon (restart,
+        # breaker cooldown, connection reset) should not fail a read —
+        # mutations are NEVER retried here (not idempotent; the server's
+        # 503 + Retry-After is the client's signal for those)
+        self.get_retries = max(0, int(get_retries))
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_cap = retry_backoff_cap
         if spec is None:
             spec = json.loads(self._raw("GET", "/openapi.json"))
         self.spec = spec
@@ -135,16 +144,26 @@ class ApiClient:
 
     def _raw(self, method: str, path: str, payload: bytes | None = None,
              content_type: str = "application/json") -> bytes:
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
-        try:
-            headers = {"Content-Type": content_type}
-            if self.api_key:
-                headers["Authorization"] = f"Bearer {self.api_key}"
-            conn.request(method, path, payload, headers)
-            return conn.getresponse().read()
-        finally:
-            conn.close()
+        # connection-level retries for GET only (idempotent by HTTP
+        # semantics and by this API's design); capped exponential backoff
+        attempts = 1 + (self.get_retries if method == "GET" else 0)
+        for attempt in range(attempts):
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            try:
+                headers = {"Content-Type": content_type}
+                if self.api_key:
+                    headers["Authorization"] = f"Bearer {self.api_key}"
+                conn.request(method, path, payload, headers)
+                return conn.getresponse().read()
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException):
+                if attempt + 1 >= attempts:
+                    raise
+                time.sleep(min(self.retry_backoff_cap,
+                               self.retry_backoff * (2 ** attempt)))
+            finally:
+                conn.close()
 
     def _invoke(self, op_id: str, entry: dict, body: Any,
                 params: dict) -> Any:

@@ -1,16 +1,17 @@
-"""Deterministic crashpoint fault-injection harness.
+"""Deterministic fault-injection harness: crashpoints + transient faults.
 
-Every multi-step control-plane mutation is instrumented with named
-crashpoints at its step boundaries (`crashpoint("replace.after_create")`).
-A crashpoint is inert until armed — via the TDAPI_CRASHPOINTS env var
-(comma-separated names, for manual chaos testing against a live daemon) or
-programmatically via arm() (test fixtures). An armed crashpoint raises
-InjectedCrash, which derives from BaseException ON PURPOSE: the services'
-blanket `except Exception` unwind paths must NOT catch it, because the
-whole point is to simulate the daemon dying mid-step with no unwind code
-running. The test then abandons the App and rebuilds it from the same
-state dir; the boot-time reconciler (reconcile.py) has to make the world
-consistent from the journal + stores alone.
+**Crashpoints** — every multi-step control-plane mutation is instrumented
+with named crashpoints at its step boundaries
+(`crashpoint("replace.after_create")`). A crashpoint is inert until armed —
+via the TDAPI_CRASHPOINTS env var (comma-separated names, for manual chaos
+testing against a live daemon) or programmatically via arm() (test
+fixtures). An armed crashpoint raises InjectedCrash, which derives from
+BaseException ON PURPOSE: the services' blanket `except Exception` unwind
+paths must NOT catch it, because the whole point is to simulate the daemon
+dying mid-step with no unwind code running. The test then abandons the App
+and rebuilds it from the same state dir; the boot-time reconciler
+(reconcile.py) has to make the world consistent from the journal + stores
+alone.
 
 The registry is STATIC: every crashpoint name is declared here, and
 crashpoint() rejects undeclared names so an instrumentation typo fails the
@@ -18,14 +19,30 @@ first test that crosses it instead of silently never firing. The sweep in
 tests/test_crash_recovery.py parametrizes over all_crashpoints(), so adding
 a name here without a sweep scenario fails CI — registry, instrumentation,
 and coverage stay in lockstep.
+
+**Transient faults** — where a crashpoint kills the control plane, a
+transient fault makes the SUBSTRATE misbehave while the control plane stays
+up: a backend op errors once (`error_once`), errors N times (`error_n:N`),
+answers slowly (`latency:S`), or hangs past its deadline (`hang:S`). Faults
+are armed per backend op name via the TDAPI_FAULTS env var
+(`op:mode[:arg]` comma-separated, e.g. `create:error_once,start:latency:0.2`)
+or programmatically via arm_fault(). GuardedBackend (backend/guard.py)
+crosses fault_gate(op) inside its per-op deadline before delegating, so an
+injected hang is cut by the same deadline machinery a real dockerd stall
+would be. InjectedFault derives from ConnectionError — a TRANSIENT error by
+the guard's classification — so retries/breaker react exactly as they would
+to a flaky socket. tests/test_substrate_faults.py sweeps every mutating
+endpoint under each mode.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 
 ENV_VAR = "TDAPI_CRASHPOINTS"
+FAULTS_ENV_VAR = "TDAPI_FAULTS"
 
 
 class InjectedCrash(BaseException):
@@ -118,3 +135,120 @@ def crashpoint(name: str) -> None:
         hot = name in (n.strip() for n in env.split(","))
     if hot:
         raise InjectedCrash(name)
+
+
+# --------------------------------------------------- transient faults
+
+class InjectedFault(ConnectionError):
+    """Simulated transient substrate failure at a backend op.
+
+    ConnectionError (⊂ OSError) on purpose: the guard's transient-error
+    classification — and any real error handling — must treat it exactly
+    like a flaky dockerd socket or a vanished /dev/accel*.
+    """
+
+    def __init__(self, op: str, mode: str):
+        super().__init__(f"injected {mode} fault on backend op {op!r}")
+        self.op = op
+        self.mode = mode
+
+
+#: mode -> meaning of its optional arg (documentation + validation)
+FAULT_MODES: dict[str, str] = {
+    "error_once": "raise InjectedFault on the first crossing only",
+    "error_n": "raise InjectedFault on the first N crossings (arg = N)",
+    "latency": "sleep arg seconds (default 0.05) on every crossing, "
+               "then proceed",
+    "hang": "sleep arg seconds (default 2.0) on the first crossing, then "
+            "raise — models a stalled call the deadline must cut",
+}
+
+_DEFAULT_ARG = {"error_once": 1.0, "error_n": 1.0, "latency": 0.05,
+                "hang": 2.0}
+
+
+class _Fault:
+    __slots__ = ("op", "mode", "arg", "remaining")
+
+    def __init__(self, op: str, mode: str, arg: float):
+        self.op = op
+        self.mode = mode
+        self.arg = arg
+        # error_once/error_n/hang fire a bounded number of times so a
+        # retried op can converge; latency is persistent (a slow substrate
+        # stays slow — every attempt pays it)
+        self.remaining = (int(arg) if mode == "error_n"
+                          else 1 if mode in ("error_once", "hang")
+                          else -1)
+
+
+_faults: dict[str, _Fault] = {}
+_faults_env_parsed = ""
+
+
+def arm_fault(spec: str) -> None:
+    """Arm one transient fault from an `op:mode[:arg]` spec (test path)."""
+    op, _, rest = spec.partition(":")
+    mode, _, arg_s = rest.partition(":")
+    if not op or mode not in FAULT_MODES:
+        raise ValueError(f"bad fault spec {spec!r} — want op:mode[:arg] "
+                         f"with mode in {sorted(FAULT_MODES)}")
+    arg = float(arg_s) if arg_s else _DEFAULT_ARG[mode]
+    with _lock:
+        _faults[op] = _Fault(op, mode, arg)
+
+
+def disarm_faults() -> None:
+    global _faults_env_parsed
+    with _lock:
+        _faults.clear()
+        _faults_env_parsed = ""
+
+
+def _ingest_env() -> None:
+    """Materialize TDAPI_FAULTS into the live table (lock held). Parsed
+    once per distinct env value so error_n countdowns survive crossings."""
+    global _faults_env_parsed
+    env = os.environ.get(FAULTS_ENV_VAR, "")
+    if env == _faults_env_parsed:
+        return
+    _faults_env_parsed = env
+    for spec in env.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        op, _, rest = spec.partition(":")
+        mode, _, arg_s = rest.partition(":")
+        if not op or mode not in FAULT_MODES or op in _faults:
+            continue  # malformed entries are inert, not fatal, on a daemon
+        try:
+            arg = float(arg_s) if arg_s else _DEFAULT_ARG[mode]
+        except ValueError:
+            continue
+        _faults[op] = _Fault(op, mode, arg)
+
+
+def fault_gate(op: str) -> None:
+    """Crossed by GuardedBackend before delegating op to the substrate.
+
+    Inert case is one dict check under the module lock — cheap enough for
+    every backend call. Sleeps happen OUTSIDE the lock so a hang on one op
+    never blocks another op's gate."""
+    if not _faults and not os.environ.get(FAULTS_ENV_VAR):
+        return
+    with _lock:
+        _ingest_env()
+        f = _faults.get(op)
+        if f is None:
+            return
+        if f.remaining == 0:
+            return
+        if f.remaining > 0:
+            f.remaining -= 1
+        mode, arg = f.mode, f.arg
+    if mode == "latency":
+        time.sleep(arg)
+        return
+    if mode == "hang":
+        time.sleep(arg)
+    raise InjectedFault(op, mode)

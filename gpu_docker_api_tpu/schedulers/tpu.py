@@ -66,6 +66,14 @@ class TpuScheduler(Scheduler):
             self.status = merge_stored_status(
                 state["status"] if state is not None else None,
                 {c.index: FREE for c in self.topology.chips})
+        # cordoned set: chips excluded from every placement (health monitor
+        # or operator marked them bad). Persisted with the status map so a
+        # restart cannot resurrect a dead chip as allocatable; indices that
+        # no longer exist under an overriding topology are dropped.
+        self.cordoned: set[int] = {
+            int(i) for i in (state.get("cordoned", [])
+                             if state is not None else [])
+            if int(i) in self.status}
         with self._lock:
             self._persist()
 
@@ -86,12 +94,18 @@ class TpuScheduler(Scheduler):
         if n <= 0:
             return []
         with self._lock:
+            # cordoned chips are invisible to placement — not free, and not
+            # reusable either: the whole point of a drain's re-grant is to
+            # move the workload OFF them
             reusable = {i for i in (reuse or [])
-                        if self.status.get(i) == owner}
-            free = {i for i, s in self.status.items() if s is FREE} | reusable
+                        if self.status.get(i) == owner
+                        and i not in self.cordoned}
+            free = ({i for i, s in self.status.items()
+                     if s is FREE and i not in self.cordoned} | reusable)
             if len(free) < n:
                 raise xerrors.TpuNotEnoughError(
-                    f"want {n}, only {len(free)} of {len(self.status)} free")
+                    f"want {n}, only {len(free)} of {len(self.status)} "
+                    f"allocatable ({len(self.cordoned)} cordoned)")
             grant = self._find_box(n, free, prefer=reusable)
             if grant is None:
                 grant = self._find_connected(n, free, prefer=reusable)
@@ -129,6 +143,30 @@ class TpuScheduler(Scheduler):
                 if i in self.status and self.status[i] in (FREE, owner):
                     self.status[i] = owner
             self._persist()
+
+    # ---- cordon / uncordon ----
+
+    def cordon(self, chips: list[int]) -> list[int]:
+        """Exclude chips from all future placements. A cordoned chip that
+        is currently GRANTED keeps its owner — cordon never yanks a live
+        workload; drain (services/replicaset.py drain_cordoned) migrates
+        it through the rolling-replace path. Returns the full cordoned
+        set. Unknown indices raise ValueError (an operator typo must not
+        silently no-op)."""
+        with self._lock:
+            bad = [i for i in chips if i not in self.status]
+            if bad:
+                raise ValueError(f"unknown chip index(es) {bad} "
+                                 f"(topology has {len(self.status)} chips)")
+            self.cordoned.update(chips)
+            self._persist()
+            return sorted(self.cordoned)
+
+    def uncordon(self, chips: list[int]) -> list[int]:
+        with self._lock:
+            self.cordoned.difference_update(chips)
+            self._persist()
+            return sorted(self.cordoned)
 
     # ---- placement search ----
 
@@ -262,11 +300,16 @@ class TpuScheduler(Scheduler):
                 "coord": list(c.coord),
                 "used": self.status[c.index] is not FREE,
                 "owner": self.status[c.index] or "",
+                "cordoned": c.index in self.cordoned,
             } for c in self.topology.chips]
             return {
                 "topology": self.topology.serialize(),
                 "chips": chips,
-                "freeCount": sum(1 for s in self.status.values() if s is FREE),
+                # freeCount = ALLOCATABLE capacity: a cordoned-but-unowned
+                # chip is not capacity anyone can be granted
+                "freeCount": sum(1 for i, s in self.status.items()
+                                 if s is FREE and i not in self.cordoned),
+                "cordoned": sorted(self.cordoned),
             }
 
     def env_for(self, grant: list[int]) -> dict[str, str]:
@@ -280,6 +323,7 @@ class TpuScheduler(Scheduler):
         return {
             "topology": self.topology.serialize(),
             "status": {str(k): v for k, v in self.status.items()},
+            "cordoned": sorted(self.cordoned),
         }
 
 

@@ -21,8 +21,10 @@ from typing import Optional
 from .. import xerrors
 from ..backend import make_backend
 from ..backend.base import Backend
+from ..backend.guard import GuardedBackend, breaker_gauge
 from ..dtos import ContainerRun, PatchRequest
 from ..events import EventLog
+from ..health import HealthMonitor
 from ..intents import IntentJournal
 from ..reconcile import Reconciler
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
@@ -35,7 +37,9 @@ from ..version import (
 )
 from ..workqueue import WorkQueue
 from .codes import ResCode
-from .http import ApiServer, RawResponse, Request, Response, Router, err, ok
+from .http import (
+    ApiServer, RawResponse, Request, Response, Router, err, ok, unavailable,
+)
 
 log = logging.getLogger(__name__)
 
@@ -52,7 +56,10 @@ class App:
                  store_maint_records: int = 5000,
                  volume_tiers: Optional[dict] = None,
                  warm_pool: int = 0,
-                 supervise: bool = False):
+                 supervise: bool = False,
+                 guard_backend: bool = False,
+                 health_interval: float = 0.0,
+                 auto_cordon: bool = True):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         # WAL maintenance trigger: when the record count crosses this,
@@ -82,6 +89,22 @@ class App:
                                         volume_tiers=volume_tiers,
                                         warm_pool=warm_pool,
                                         supervise=supervise)
+        # substrate fault tolerance: deadlines + retries + circuit breaker
+        # (backend/guard.py). The daemon (cli.py) turns this on; embedded
+        # test Apps opt in explicitly so unit substrates stay transparent.
+        if guard_backend and not isinstance(self.backend, GuardedBackend):
+            self.backend = GuardedBackend(self.backend, events=self.events)
+        # a pre-guarded backend instance (tests; embedding daemons) gets its
+        # breaker transitions onto THIS App's event log
+        if (isinstance(self.backend, GuardedBackend)
+                and self.backend.breaker.events is None):
+            self.backend.breaker.events = self.events
+        # the inner (unguarded) backend: health probes must keep seeing the
+        # substrate while the breaker refuses workload ops, and the event
+        # log rides on it so quota-tool stalls surface on /api/v1/events
+        inner = getattr(self.backend, "inner", self.backend)
+        if getattr(inner, "events", None) is None and hasattr(inner, "events"):
+            inner.events = self.events
         # an explicit topology overrides the store; otherwise boot from stored
         # state (crash-resume) and only probe the host on first run
         if topology is None and self.client.get("tpus", "tpuStatusMap") is None:
@@ -89,6 +112,11 @@ class App:
         self.tpu = TpuScheduler(self.client, self.wq, topology=topology)
         self.cpu = CpuScheduler(self.client, self.wq, core_count=cpu_cores)
         self.ports = PortScheduler(self.client, self.wq, port_range=port_range)
+        # health monitor probes the UNGUARDED substrate (see above); it
+        # feeds the scheduler's cordon set, which drain acts on
+        self.health = HealthMonitor(inner, self.tpu, events=self.events,
+                                    interval=health_interval,
+                                    auto_cordon=auto_cordon)
         self.container_versions = VersionMap(CONTAINER_VERSION_MAP_KEY,
                                              self.client, self.wq)
         self.volume_versions = VersionMap(VOLUME_VERSION_MAP_KEY,
@@ -142,6 +170,10 @@ class App:
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
         r.add("GET", f"{v1}/events", self.h_events)
         r.add("GET", f"{v1}/reconcile", self.h_reconcile)
+        r.add("GET", f"{v1}/healthz", self.h_healthz)
+        r.add("POST", f"{v1}/tpus/:id/cordon", self.h_cordon)
+        r.add("POST", f"{v1}/tpus/:id/uncordon", self.h_uncordon)
+        r.add("POST", f"{v1}/tpus/drain", self.h_drain)
         r.add("GET", "/metrics", self.h_metrics)
         r.add("GET", "/openapi.json", self.h_openapi)
         r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
@@ -176,6 +208,8 @@ class App:
             return err(ResCode.ContainerCpuNotEnough)
         except xerrors.PortNotEnoughError:
             return err(ResCode.ContainerPortNotEnough)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("run failed [%s]", req.request_id)
             return err(ResCode.ContainerRunFailed)
@@ -205,6 +239,8 @@ class App:
             return err(ResCode.ContainerPortNotEnough)
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("patch failed [%s]", req.request_id)
             return err(ResCode.ContainerPatchFailed)
@@ -222,6 +258,8 @@ class App:
             return err(ResCode.ContainerRollbackFailed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("rollback failed [%s]", req.request_id)
             return err(ResCode.ContainerRollbackFailed)
@@ -232,6 +270,8 @@ class App:
             return ok()
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("stop failed [%s]", req.request_id)
             return err(ResCode.ContainerStopFailed)
@@ -243,6 +283,8 @@ class App:
             return err(ResCode.ContainerGetInfoFailed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("restart failed [%s]", req.request_id)
             return err(ResCode.ContainerRestartFailed)
@@ -253,6 +295,8 @@ class App:
             return ok()
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("pause failed [%s]", req.request_id)
             return err(ResCode.ContainerShutDownFailed)
@@ -263,6 +307,8 @@ class App:
             return ok()
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("continue failed [%s]", req.request_id)
             return err(ResCode.ContainerStartUpFailed)
@@ -276,6 +322,8 @@ class App:
             return ok({"output": out})
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("execute failed [%s]", req.request_id)
             return err(ResCode.ContainerExecuteFailed)
@@ -289,6 +337,8 @@ class App:
             return ok({"imageId": image_id, "imageName": new_image})
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("commit failed [%s]", req.request_id)
             return err(ResCode.ContainerCommitFailed)
@@ -309,6 +359,8 @@ class App:
         try:
             self.replicasets.delete_container(req.params["name"])
             return ok()
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("delete failed [%s]", req.request_id)
             return err(ResCode.ContainerDeleteFailed)
@@ -336,6 +388,8 @@ class App:
             # client input error (e.g. unknown tier) — return the
             # actionable message, don't bury it in a server stack trace
             return err(ResCode.VolumeCreateFailed, str(e))
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("volume create failed [%s]", req.request_id)
             return err(ResCode.VolumeCreateFailed)
@@ -353,6 +407,8 @@ class App:
             return err(ResCode.VolumeSizeUsedGreaterThanReduce)
         except xerrors.NotExistInStoreError:
             return err(ResCode.VolumeGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("volume patch failed [%s]", req.request_id)
             return err(ResCode.VolumePatchFailed)
@@ -363,6 +419,8 @@ class App:
             self.volumes.delete_volume(req.params["name"],
                                        keep_history=req.query_flag("noall"))
             return ok()
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
         except Exception:  # noqa: BLE001
             log.exception("volume delete failed [%s]", req.request_id)
             return err(ResCode.VolumeDeleteFailed)
@@ -405,6 +463,65 @@ class App:
                 self.last_reconcile = self.reconciler.run()
         return ok({"reconcile": self.last_reconcile})
 
+    # ------------------------------------------- health / cordon / drain
+
+    def h_healthz(self, req: Request) -> Response:
+        """Component health report. When the background prober is off (or
+        ?probe is given), a probe cycle runs inline so the answer is
+        fresh, not a stale snapshot."""
+        if req.query_flag("probe") or not self.health.report()["running"]:
+            rep = self.health.probe_once()
+        else:
+            rep = self.health.report()
+        breaker = None
+        if isinstance(self.backend, GuardedBackend):
+            breaker = self.backend.breaker.describe()
+            if breaker["state"] != "closed":
+                rep["status"] = "degraded"
+        return ok({
+            "status": rep["status"],
+            "health": rep,
+            "breaker": breaker,
+            "workqueue": {"pending": self.wq.pending(),
+                          "dropped": self.wq.dropped_count()},
+            "reconcileActions": self.last_reconcile["actions"],
+        })
+
+    def _chip_index(self, req: Request) -> int:
+        idx = int(req.params["id"])
+        if idx not in self.tpu.status:
+            raise ValueError(f"unknown chip index {idx}")
+        return idx
+
+    def h_cordon(self, req: Request) -> Response:
+        try:
+            idx = self._chip_index(req)
+        except ValueError as e:
+            return err(ResCode.InvalidParams, str(e))
+        cordoned = self.tpu.cordon([idx])
+        self.events.record("tpu.cordon", target=str(idx), code=200,
+                           request_id=req.request_id)
+        return ok({"cordoned": cordoned})
+
+    def h_uncordon(self, req: Request) -> Response:
+        try:
+            idx = self._chip_index(req)
+        except ValueError as e:
+            return err(ResCode.InvalidParams, str(e))
+        cordoned = self.tpu.uncordon([idx])
+        self.events.record("tpu.uncordon", target=str(idx), code=200,
+                           request_id=req.request_id)
+        return ok({"cordoned": cordoned})
+
+    def h_drain(self, req: Request) -> Response:
+        try:
+            return ok({"drain": self.replicasets.drain_cordoned()})
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
+        except Exception:  # noqa: BLE001
+            log.exception("drain failed [%s]", req.request_id)
+            return err(ResCode.ServerBusy)
+
     def h_metrics(self, req: Request) -> Response:
         """Prometheus text exposition of the resource inventories and the
         write-behind queue — the pull-metrics surface the reference lacks
@@ -412,12 +529,13 @@ class App:
         tpu = self.tpu.get_status()
         cpu = self.cpu.get_status()
         ports = self.ports.get_status()
-        n_chips = len(tpu["chips"])
         free_chips = tpu["freeCount"]
         lines = [
             "# TYPE tdapi_tpu_chips gauge",
             f'tdapi_tpu_chips{{state="free"}} {free_chips}',
-            f'tdapi_tpu_chips{{state="used"}} {n_chips - free_chips}',
+            f'tdapi_tpu_chips{{state="used"}} '
+            f'{sum(1 for c in tpu["chips"] if c["used"])}',
+            f'tdapi_tpu_chips{{state="cordoned"}} {len(tpu["cordoned"])}',
             "# TYPE tdapi_cpu_cores gauge",
             f'tdapi_cpu_cores{{state="used"}} {cpu["usedCount"]}',
             f'tdapi_cpu_cores{{state="free"}} '
@@ -437,7 +555,20 @@ class App:
             f"tdapi_reconcile_actions {self.last_reconcile['actions']}",
             "# TYPE tdapi_store_wal_records gauge",
             f"tdapi_store_wal_records {self.store.wal_records}",
+            "# TYPE tdapi_chip_health_failures gauge",
+            f"tdapi_chip_health_failures "
+            f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",
         ]
+        if isinstance(self.backend, GuardedBackend):
+            brk = self.backend.breaker.describe()
+            lines += [
+                "# TYPE tdapi_breaker_state gauge",
+                "# 0 = closed, 1 = half-open, 2 = open",
+                f"tdapi_breaker_state {breaker_gauge(brk['state'])}",
+                "# TYPE tdapi_breaker_consecutive_failures gauge",
+                f"tdapi_breaker_consecutive_failures "
+                f"{brk['consecutiveFailures']}",
+            ]
         return RawResponse(("\n".join(lines) + "\n").encode(),
                            "text/plain; version=0.0.4")
 
@@ -472,6 +603,7 @@ class App:
     def start(self) -> None:
         self.server.start()
         self._start_store_maintenance()
+        self.health.start()   # no-op when health_interval <= 0
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
                  self.server.host, self.server.port, self.tpu.topology.num_chips)
 
@@ -509,6 +641,7 @@ class App:
         """Graceful shutdown: drain queue, flush all state (reference Stop,
         main.go:139-154)."""
         self.server.stop()
+        self.health.stop()
         if self._maint_stop is not None:
             # join, don't just signal: an in-flight maintain() racing past
             # store.close() would os.replace() its snapshot over a WAL a

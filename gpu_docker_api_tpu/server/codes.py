@@ -16,6 +16,9 @@ class ResCode(enum.IntEnum):
     Success = 200
     ServerBusy = 500
     Forbidden = 403
+    # substrate circuit breaker open: mutations refused fast (the ONLY
+    # envelope code that also changes the HTTP status — 503 + Retry-After)
+    BackendUnavailable = 503
 
     InvalidParams = 1000
     ImageNameCannotBeEmpty = 1001
@@ -67,6 +70,9 @@ _MESSAGES: dict[ResCode, str] = {
     ResCode.Success: "Success",
     ResCode.ServerBusy: "Server busy",
     ResCode.Forbidden: "Forbidden",
+    ResCode.BackendUnavailable:
+        "Substrate unavailable (circuit open) — mutations refused; "
+        "retry after the interval in the Retry-After header",
 
     ResCode.InvalidParams: "Failed to parse body",
     ResCode.ImageNameCannotBeEmpty: "Image name cannot be empty",

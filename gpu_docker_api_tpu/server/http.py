@@ -50,10 +50,19 @@ class Request:
 
 class Response:
     def __init__(self, code: ResCode, data: Optional[dict] = None,
-                 msg: Optional[str] = None):
+                 msg: Optional[str] = None,
+                 http_status: int = 200,
+                 headers: Optional[dict[str, str]] = None):
         self.code = code
         self.data = data
         self.msg = msg if msg is not None else code.msg
+        # the envelope convention is HTTP-200-always (reference
+        # response.go); http_status exists for the ONE deliberate
+        # exception — 503 + Retry-After when the backend breaker is open,
+        # so load balancers and generic clients back off without parsing
+        # the envelope
+        self.http_status = http_status
+        self.headers = dict(headers or {})
 
     def payload(self) -> bytes:
         return json.dumps(
@@ -80,6 +89,14 @@ def ok(data: Optional[dict] = None) -> Response:
 
 def err(code: ResCode, msg: "str | None" = None) -> Response:
     return Response(code, None, msg=msg)
+
+
+def unavailable(e: BaseException) -> Response:
+    """503 + Retry-After for an open backend circuit (degraded mode):
+    mutating routes answer with this; reads keep serving from the store."""
+    retry = max(1, int(round(float(getattr(e, "retry_after", 5.0)))))
+    return Response(ResCode.BackendUnavailable, None, http_status=503,
+                    headers={"Retry-After": str(retry)})
 
 
 class Router:
@@ -171,7 +188,9 @@ class ApiServer:
                 request_id=req.request_id)
         if isinstance(resp, RawResponse):
             cors["Content-Type"] = resp.content_type
-        return 200, cors, resp.payload()
+        if resp.headers:
+            cors.update(resp.headers)
+        return resp.http_status, cors, resp.payload()
 
     # ---- lifecycle ----
 

@@ -453,6 +453,72 @@ class ReplicaSetService:
             intent.done()
             return self._run_response(info)
 
+    # ---------------------------------------------------------------- drain
+
+    def drain_cordoned(self) -> dict:
+        """POST /tpus/drain: migrate every stored replicaSet holding a
+        cordoned chip onto healthy chips through the rolling-replace path.
+
+        Each migration is an ordinary replace (via="drain") — journaled
+        through the intent journal, so a crash mid-drain reconciles like
+        any other interrupted replace. The re-grant offers the old chips
+        for in-place reuse; apply() itself filters cordoned chips out of
+        both the free pool and the reuse set, so the new placement keeps
+        healthy chips where it can and never re-grants a cordoned one.
+        Failures (e.g. not enough healthy capacity) are reported per
+        replicaSet and do not abort the rest of the drain."""
+        cordoned = set(self.tpu.cordoned)
+        result: dict = {"cordoned": sorted(cordoned), "drained": [],
+                        "skipped": [], "failed": {}}
+        if not cordoned:
+            return result
+        self.wq.join()      # the stored-record scan must see queued writes
+        names = sorted({kv.key.rsplit("/", 1)[1]
+                        for kv in self.client.range(CONTAINERS)})
+        for name in names:
+            with self._mutex(name):
+                try:
+                    old = self._stored_info(name)
+                except xerrors.NotExistInStoreError:
+                    continue
+                if not set(old.spec.tpu_chips) & cordoned:
+                    continue
+                if old.resourcesReleased:
+                    # stopped: holds no grant; its next restart re-applies
+                    # fresh counts, which already exclude cordoned chips
+                    result["skipped"].append(name)
+                    continue
+                new_spec = ContainerSpec.from_json(old.spec.to_json())
+                intent = self.intents.begin(
+                    "replace", name, via="drain", oldVersion=old.version,
+                    oldContainer=old.containerName,
+                    oldReleased=old.resourcesReleased)
+                try:
+                    self._grant_tpus(new_spec, self.tpu.apply(
+                        len(old.spec.tpu_chips), name,
+                        reuse=list(old.spec.tpu_chips)))
+                    intent.step("granted", tpuChips=new_spec.tpu_chips)
+                    info = self._rolling_replace(name, old, new_spec, intent)
+                except xerrors.BackendUnavailableError:
+                    # breaker open: the WHOLE substrate is refusing — abort
+                    # the drain (503 to the caller) instead of logging one
+                    # doomed migration per replicaSet
+                    self._free_new_grants(name, new_spec, old.spec)
+                    intent.done()
+                    raise
+                except Exception as e:  # noqa: BLE001 — drain the rest
+                    self._free_new_grants(name, new_spec, old.spec)
+                    intent.done()
+                    log.exception("drain: migrating %s failed", name)
+                    result["failed"][name] = str(e)
+                    continue
+                intent.done()
+                result["drained"].append({
+                    "name": name, "version": info.version,
+                    "fromChips": sorted(old.spec.tpu_chips),
+                    "toChips": sorted(info.spec.tpu_chips)})
+        return result
+
     # ---------------------------------------------------- stop / restart etc
 
     def stop_container(self, name: str) -> None:
@@ -549,16 +615,26 @@ class ReplicaSetService:
 
     def get_container_info(self, name: str) -> dict:
         info = self._stored_info(name)
-        state = self.backend.inspect(info.containerName)
+        try:
+            state = self.backend.inspect(info.containerName)
+            running, paused, degraded = state.running, state.paused, False
+        except xerrors.BackendUnavailableError:
+            # degraded read-only mode: the breaker is refusing substrate
+            # calls, but the MVCC store still knows everything except live
+            # run-state — answer from it rather than 503 a read
+            running = paused = None
+            degraded = True
         out = {
             "version": info.version,
             "createTime": info.createTime,
             "containerName": info.containerName,
-            "running": state.running,
-            "paused": state.paused,
+            "running": running,
+            "paused": paused,
             "resourcesReleased": info.resourcesReleased,
             "spec": info.spec.to_json(),
         }
+        if degraded:
+            out["degraded"] = True
         # per-worker launch plan when the grant spans TPU VM hosts: the env
         # each worker's container needs so the libtpu processes form ONE
         # slice (SURVEY §5.8 — multi-host over the same REST surface)

@@ -187,6 +187,11 @@ class VolumeService:
                 if info is not None:
                     try:
                         self.backend.volume_remove(info.volumeName)
+                    except xerrors.BackendUnavailableError:
+                        # breaker open: the remove never reached the
+                        # substrate — deleting the record anyway would
+                        # orphan the real volume behind a refused call
+                        raise
                     except Exception:  # noqa: BLE001
                         log.exception("removing volume %s", info.volumeName)
                     intent.step("removed")
@@ -204,16 +209,23 @@ class VolumeService:
 
     def get_volume_info(self, name: str) -> dict:
         info = self._stored_info(name)
-        state = self.backend.volume_inspect(info.volumeName)
-        return {
+        out = {
             "version": info.version,
             "createTime": info.createTime,
             "volumeName": info.volumeName,
             "size": info.size,
             "tier": info.tier,
-            "mountpoint": state.mountpoint,
-            "usedBytes": state.used_bytes,
         }
+        try:
+            state = self.backend.volume_inspect(info.volumeName)
+            out["mountpoint"] = state.mountpoint
+            out["usedBytes"] = state.used_bytes
+        except xerrors.BackendUnavailableError:
+            # breaker open: serve what the store knows (degraded read)
+            out["mountpoint"] = ""
+            out["usedBytes"] = None
+            out["degraded"] = True
+        return out
 
     def get_volume_history(self, name: str) -> list[dict]:
         self.wq.join()  # history reads the store; drain write-behind first

@@ -57,6 +57,29 @@ class VolumeSizeUsedGreaterThanReducedError(XError):
     sentinel = "volume used size greater than reduced size"
 
 
+# --- substrate errors (no reference counterpart: the reference lets a
+# --- dockerd stall propagate to a raw 500) ---
+
+class BackendUnavailableError(XError):
+    """The guarded backend's circuit breaker is open: the substrate has
+    failed repeatedly and calls are refused fast instead of piling up.
+    Carries the breaker's retry hint; routes map it to HTTP 503 +
+    Retry-After while reads degrade to the MVCC store."""
+
+    sentinel = "backend unavailable (circuit open)"
+
+    def __init__(self, detail: str = "", retry_after: float = 5.0):
+        super().__init__(detail)
+        self.retry_after = retry_after
+
+
+class BackendTimeoutError(XError):
+    """A backend call overran its per-op deadline (GuardedBackend). Treated
+    as transient: retried with backoff, counted by the circuit breaker."""
+
+    sentinel = "backend op deadline exceeded"
+
+
 # --- state-store errors (reference internal/xerrors/etcd.go) ---
 
 class NotExistInStoreError(XError):

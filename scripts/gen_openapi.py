@@ -110,6 +110,10 @@ NAME_PARAM = {"name": "name", "in": "path", "required": True,
               "description": "replicaSet / volume base name (unversioned; "
                              "must not contain '-')"}
 
+CHIP_PARAM = {"name": "id", "in": "path", "required": True,
+              "schema": {"type": "integer", "minimum": 0},
+              "description": "Global chip index (see /resources/tpus)"}
+
 
 def build_codes_desc() -> str:
     from gpu_docker_api_tpu.server.codes import ResCode
@@ -247,7 +251,14 @@ def build_spec() -> dict:
         "CommitResponse": obj({"imageId": s(), "imageName": s()}),
         "ContainerInfo": obj(
             {"version": i(), "createTime": s(), "containerName": s(),
-             "running": b(), "paused": b(), "resourcesReleased": b(),
+             "running": {"type": "boolean", "nullable": True,
+                         "description": "null in degraded read-only mode "
+                                        "(breaker open: live state "
+                                        "unknown)"},
+             "paused": {"type": "boolean", "nullable": True},
+             "resourcesReleased": b(),
+             "degraded": b("Present/true when the answer came from the "
+                           "store alone (substrate circuit open)"),
              "spec": ref("ContainerSpec"),
              "multihost": obj(
                  {}, additional=obj({}, additional=s()),
@@ -266,7 +277,11 @@ def build_spec() -> dict:
         "VolumeInfo": obj(
             {"version": i(), "createTime": s(), "volumeName": s(),
              "size": s(), "tier": s(), "mountpoint": s(),
-             "usedBytes": i()},
+             "usedBytes": {"type": "integer", "nullable": True,
+                           "description": "null in degraded read-only "
+                                          "mode (breaker open)"},
+             "degraded": b("Present/true when served from the store "
+                           "alone (substrate circuit open)")},
             desc="GET volume info payload (services/volume.py)"),
         "VolumeHistoryItem": obj(
             {"version": i(), "createTime": s(),
@@ -275,7 +290,9 @@ def build_spec() -> dict:
             {"index": i("Global chip index"), "id": s(),
              "device": s("/dev/accel* path"),
              "coord": arr(i(), "ICI mesh coordinate"),
-             "used": b(), "owner": s("Granting replicaSet ('' = free)")}),
+             "used": b(), "owner": s("Granting replicaSet ('' = free)"),
+             "cordoned": b("Excluded from placement (health monitor or "
+                           "operator cordon)")}),
         "TpuTopology": obj(
             {"acceleratorType": s("e.g. 'v5p-8'"), "generation": s(),
              "shape": arr(i(), "ICI mesh shape"), "wraparound": b(),
@@ -284,7 +301,8 @@ def build_spec() -> dict:
             desc="topology.Topology.serialize()"),
         "TpuStatus": obj(
             {"topology": ref("TpuTopology"), "chips": arr(ref("TpuChip")),
-             "freeCount": i()},
+             "freeCount": i("ALLOCATABLE chips: free and not cordoned"),
+             "cordoned": arr(i(), "Cordoned chip indices")},
             desc="GET /resources/tpus payload (schedulers/tpu.py "
                  "get_status; reference GetGpuStatus)"),
         "CpuStatus": obj(
@@ -299,6 +317,57 @@ def build_spec() -> dict:
              "target": s(), "code": i("App code the op returned"),
              "durationMs": {"type": "number"}, "requestId": s()},
             desc="Operation event (events.py record)"),
+        "ChipHealth": obj(
+            {"index": i("Global chip index"), "device": s(),
+             "failureScore": i("Consecutive failed probes (presence or "
+                               "flap evidence); resets on success"),
+             "healthy": b(), "cordoned": b()},
+            desc="Per-chip probe state (health.py)"),
+        "HealthReport": obj(
+            {"status": s("'ok' or 'degraded'",
+                         enum=["ok", "degraded"]),
+             "substrate": obj({"reachable": b("backend.ping()")}),
+             "chips": arr(ref("ChipHealth")),
+             "cordoned": arr(i()),
+             "flapping": obj(
+                 {}, additional=i(),
+                 desc="container -> restart count >= flap threshold"),
+             "probes": i("Probe cycles run so far"),
+             "lastProbeAt": {"type": "number",
+                             "description": "Unix seconds"},
+             "running": b("Background prober active")},
+            desc="Substrate health probe report (health.py report)"),
+        "BreakerState": obj(
+            {"state": s(enum=["closed", "half_open", "open"]),
+             "consecutiveFailures": i(), "threshold": i(),
+             "cooldownSec": {"type": "number"}},
+            desc="Backend circuit-breaker state (backend/guard.py); null "
+                 "when the daemon runs unguarded"),
+        "Healthz": obj(
+            {"status": s(enum=["ok", "degraded"]),
+             "health": ref("HealthReport"),
+             "breaker": {"allOf": [ref("BreakerState")],
+                         "nullable": True},
+             "workqueue": obj({"pending": i(), "dropped": i()}),
+             "reconcileActions": i("Boot reconcile total; non-zero = the "
+                                   "previous daemon died dirty")},
+            desc="GET /api/v1/healthz payload (server/app.py h_healthz)"),
+        "CordonResponse": obj(
+            {"cordoned": arr(i(), "Full cordoned set after the change")}),
+        "DrainItem": obj(
+            {"name": s("replicaSet base name"), "version": i("New version"),
+             "fromChips": arr(i()), "toChips": arr(i())}),
+        "DrainResult": obj(
+            {"cordoned": arr(i()),
+             "drained": arr(ref("DrainItem")),
+             "skipped": arr(s(), "Stopped replicaSets (hold no grant; "
+                                 "restart re-grants healthy chips)"),
+             "failed": obj({}, additional=s(),
+                           desc="replicaSet -> error (e.g. not enough "
+                                "healthy capacity); the rest of the "
+                                "drain proceeds")},
+            desc="POST /tpus/drain payload (services/replicaset.py "
+                 "drain_cordoned)"),
         "ReconcileReport": obj(
             {"intentsReplayed": arr(s("kind:target:op")),
              "opsCompleted": arr(s()),
@@ -437,6 +506,41 @@ def build_spec() -> dict:
                      "schema": {"type": "string"},
                      "description": "Filter by event target name"}],
             tags=["meta"])},
+        f"{v1}/healthz": {"get": op(
+            "healthz", "Substrate health: chip presence, reachability, "
+            "flap detection, breaker state",
+            envelope(ref("Healthz")),
+            params=[{"name": "probe", "in": "query", "required": False,
+                     "schema": {"type": "boolean"},
+                     "description": "Run a fresh probe cycle inline "
+                                    "instead of answering from the last "
+                                    "background cycle"}],
+            tags=["meta"],
+            desc="status='degraded' when the substrate is unreachable, "
+                 "any chip is failing or cordoned, a container is "
+                 "flapping, or the breaker is not closed.")},
+        f"{v1}/tpus/{{id}}/cordon": {"post": op(
+            "cordonTpu", "Exclude a chip from all future placements",
+            envelope(ref("CordonResponse"), {"cordoned": [3]}),
+            params=[CHIP_PARAM], tags=["resource"],
+            desc="A cordoned chip that is currently granted keeps its "
+                 "owner — cordon never kills a workload; POST "
+                 "/tpus/drain migrates them off. Persisted: a restart "
+                 "cannot resurrect the chip as allocatable.")},
+        f"{v1}/tpus/{{id}}/uncordon": {"post": op(
+            "uncordonTpu", "Return a cordoned chip to the allocatable "
+            "pool",
+            envelope(ref("CordonResponse"), {"cordoned": []}),
+            params=[CHIP_PARAM], tags=["resource"])},
+        f"{v1}/tpus/drain": {"post": op(
+            "drainTpus", "Migrate every replicaSet holding a cordoned "
+            "chip onto healthy chips",
+            envelope(ref("DrainResult")), tags=["resource"],
+            desc="Each migration is an intent-journaled rolling "
+                 "replacement (crash mid-drain reconciles at boot). "
+                 "Per-replicaSet failures are reported in `failed` and "
+                 "do not abort the rest. App error 503 when the backend "
+                 "circuit is open.")},
         f"{v1}/reconcile": {"get": op(
             "reconcile", "Crash-recovery report from the boot-time "
             "reconciler; ?run=1 performs a fresh pass (admin; quiesce "
@@ -464,17 +568,22 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.4.0",
+            "version": "0.5.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "
                 "api/gpu-docker-api-en.openapi.json) with the NVIDIA "
                 "substrate replaced by an ICI-topology-aware TPU chip "
                 "allocator. Every response is HTTP 200 with an envelope "
-                "{code, msg, data}. Authentication: optional static "
-                "bearer token (APIKEY env) via the Authorization header; "
-                "403 envelope when it mismatches. Generated by "
-                "scripts/gen_openapi.py — do not edit by hand.",
+                "{code, msg, data} — with ONE exception: when the "
+                "substrate circuit breaker is open, mutating endpoints "
+                "answer HTTP 503 with a Retry-After header (envelope "
+                "code 503) while reads keep serving from the state "
+                "store (degraded read-only mode). Authentication: "
+                "optional static bearer token (APIKEY env) via the "
+                "Authorization header; 403 envelope when it mismatches. "
+                "Generated by scripts/gen_openapi.py — do not edit by "
+                "hand.",
         },
         "servers": [{"url": "http://localhost:2378"}],
         "tags": [{"name": "replicaSet"}, {"name": "volume"},

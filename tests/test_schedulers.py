@@ -81,6 +81,68 @@ def test_tpu_state_persists_and_reboots(client):
     assert s2.get_status()["freeCount"] == 4
 
 
+def test_tpu_cordon_excluded_from_apply(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))  # 4 chips
+    s.cordon([0, 1])
+    assert s.get_status()["freeCount"] == 2
+    g = s.apply(2)
+    assert not set(g) & {0, 1}
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        s.apply(1)          # 2 free chips exist but both are cordoned
+    s.uncordon([0])
+    assert len(s.apply(1)) == 1
+
+
+def test_tpu_cordon_unknown_index_rejected(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    with pytest.raises(ValueError):
+        s.cordon([99])
+
+
+def test_tpu_cordoned_chip_not_reusable(client):
+    """A drain-style re-grant offers the old chips for reuse; cordoned
+    ones must be excluded even though the owner still holds them."""
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    g = s.apply(2, "rs")
+    s.cordon([g[0]])
+    g2 = s.apply(2, "rs", reuse=g)
+    assert g[0] not in g2
+    assert g[1] in g2        # the healthy old chip IS kept in place
+
+
+def test_tpu_serialize_roundtrips_cordoned(client):
+    """Satellite: serialize()/boot-restore round-trips the cordoned set,
+    and restore() of a grant holding a now-cordoned chip frees it WITHOUT
+    resurrecting it as allocatable."""
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    g = s.apply(2, "rs")
+    s.cordon([g[0], 3])
+    assert s.serialize()["cordoned"] == sorted([g[0], 3])
+    s.flush()
+    s2 = TpuScheduler(client)      # boots from store, no topology given
+    assert s2.cordoned == {g[0], 3}
+    # the grant releases, but the cordoned chip stays out of the pool
+    s2.restore(g, "rs")
+    assert s2.status[g[0]] is None          # freed (not owned)
+    assert s2.get_status()["freeCount"] == 2  # 4 - 2 cordoned
+    granted = set(s2.apply(2))
+    assert not granted & {g[0], 3}
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        s2.apply(1)
+    # legacy state without the key boots to an empty cordon set
+    s3 = TpuScheduler(None, topology=make_topology("v5p-8"))
+    assert s3.cordoned == set()
+
+
+def test_tpu_status_reports_cordoned_flags(client):
+    s = TpuScheduler(client, topology=make_topology("v5p-8"))
+    s.cordon([2])
+    st = s.get_status()
+    assert st["cordoned"] == [2]
+    assert [c["index"] for c in st["chips"] if c["cordoned"]] == [2]
+    assert st["freeCount"] == 3
+
+
 def test_tpu_env_and_devices(client):
     s = TpuScheduler(client, topology=make_topology("v5p-8"))
     g = s.apply(4)

@@ -1,0 +1,546 @@
+"""Substrate fault tolerance sweep: transient faults, breaker, cordon/drain.
+
+Where tests/test_crash_recovery.py kills the CONTROL PLANE at every step
+boundary, this suite makes the SUBSTRATE misbehave under a live control
+plane: every mutating endpoint is driven with each transient fault mode
+(error_once / error_n / latency / hang — faults.py) armed on each backend
+op it crosses, through a GuardedBackend with test-scale deadlines and
+retries. Invariants after every case:
+
+- the op either succeeded after retry or failed clean,
+- zero leaked TPU/CPU/port grants (bitmaps == non-released stored specs),
+- a fresh reconcile pass is a no-op.
+
+Plus: breaker open => mutating routes answer HTTP 503 + Retry-After while
+reads serve from the store; breaker open -> half-open -> closed recovery;
+health monitor auto-cordon; cordon + drain leaves no spec on a cordoned
+chip with the rolling replacement in history.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import faults, xerrors
+from gpu_docker_api_tpu.backend import GuardedBackend, MockBackend
+from gpu_docker_api_tpu.backend.guard import CLOSED, OPEN
+from gpu_docker_api_tpu.dtos import (
+    ContainerRun, PatchRequest, StoredContainerInfo, TpuPatch,
+)
+from gpu_docker_api_tpu.health import HealthMonitor
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.topology import make_topology
+
+pytestmark = pytest.mark.faults
+
+N_CHIPS = 16      # v4-32 single host
+N_CORES = 16
+
+# test-scale guard: deadline far under the hang fault's sleep, fast retries
+DEADLINE = 0.4
+RETRIES = 2
+HANG = 1.2        # > DEADLINE: first attempt must be cut by the deadline
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_faults()
+    yield
+    faults.disarm_faults()
+
+
+def make_app(tmp_path, breaker_threshold=50, breaker_cooldown=30.0):
+    backend = GuardedBackend(
+        MockBackend(str(tmp_path / "backend")),
+        deadline=DEADLINE, retries=RETRIES, backoff_base=0.01,
+        backoff_cap=0.05, breaker_threshold=breaker_threshold,
+        breaker_cooldown=breaker_cooldown)
+    return App(state_dir=str(tmp_path / "state"), backend=backend,
+               addr="127.0.0.1:0", port_range=(46000, 46100),
+               topology=make_topology("v4-32"), api_key="",
+               cpu_cores=N_CORES, store_maint_records=0)
+
+
+def run_demo(app, name="demo", tpus=2):
+    return app.replicasets.run_container(ContainerRun(
+        imageName="img", replicaSetName=name, tpuCount=tpus, cpuCount=2,
+        containerPorts=["8888"]))
+
+
+# ------------------------------------------------------------ invariants
+
+def stored_containers(app):
+    app.wq.join()
+    return {kv.key.rsplit("/", 1)[1]: StoredContainerInfo.deserialize(kv.value)
+            for kv in app.client.range("containers")}
+
+
+def assert_no_leaks(app):
+    """Scheduler bitmaps hold exactly the grants of non-released stored
+    records, no intent is left open, and reconcile reaches a fixpoint.
+
+    The first reconcile pass may legitimately clean BACKEND-side debris a
+    services layer deliberately tolerated (a failed post-commit remove
+    leaves an orphan container/volume for exactly this pass) — but it must
+    never need to fix a grant: resource accounting has to be exact the
+    moment the op returns, not one reconcile later."""
+    stored = stored_containers(app)
+    exp_tpu, exp_cpu, exp_ports = {}, {}, {}
+    for name, info in stored.items():
+        if info.resourcesReleased:
+            continue
+        for c in info.spec.tpu_chips:
+            exp_tpu[c] = name
+        for c in app.cpu._cores(info.spec.cpuset):
+            exp_cpu[c] = name
+        for p in info.spec.port_bindings.values():
+            exp_ports[int(p)] = name
+    assert {i: o for i, o in app.tpu.status.items()
+            if o not in (None, "")} == exp_tpu
+    assert {i: o for i, o in app.cpu.status.items()
+            if o not in (None, "")} == exp_cpu
+    assert dict(app.ports.used) == exp_ports
+    assert app.intents.open_intents() == []
+    settle = app.reconciler.run()
+    assert sum(settle["grantsFreed"].values()) == 0, settle
+    assert sum(settle["grantsRemarked"].values()) == 0, settle
+    rerun = app.reconciler.run()
+    assert rerun["actions"] == 0, f"re-reconcile not a no-op: {rerun}"
+    return stored
+
+
+# ------------------------------------------------------- sweep scenarios
+
+def mut_run(app):
+    run_demo(app, name="fresh")
+
+
+def mut_patch(app):
+    app.replicasets.patch_container(
+        "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+
+
+def mut_rollback(app):
+    app.replicasets.patch_container(
+        "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+    app.replicasets.rollback_container("demo", 1)
+
+
+def mut_stop(app):
+    app.replicasets.stop_container("demo")
+
+
+def mut_restart(app):
+    app.replicasets.restart_container("demo")
+
+
+def mut_pause(app):
+    app.replicasets.pause_container("demo")
+
+
+def mut_continue(app):
+    app.replicasets.startup_container("demo")
+
+
+def mut_delete(app):
+    app.replicasets.delete_container("demo")
+
+
+def mut_vol_create(app):
+    app.volumes.create_volume("vol", "16MB")
+
+
+def mut_vol_patch(app):
+    app.volumes.create_volume("vol", "16MB")
+    app.volumes.patch_volume_size("vol", "32MB")
+
+
+def mut_vol_delete(app):
+    app.volumes.create_volume("vol", "16MB")
+    app.volumes.delete_volume("vol")
+
+
+# every mutating endpoint x the backend ops it crosses. `swallowed` marks
+# ops whose failure the services layer deliberately tolerates (post-commit
+# cleanup — the endpoint still succeeds; the reconciler's orphan sweep is
+# the designed janitor). Every pair below is actually crossed by its
+# endpoint, so an armed fault that never fires would mean the table rotted.
+SWEEP = [
+    ("run", mut_run, "create", False),
+    ("run", mut_run, "start", False),
+    ("patch", mut_patch, "create", False),
+    ("patch", mut_patch, "start", False),
+    ("patch", mut_patch, "stop", False),
+    ("patch", mut_patch, "remove", True),     # old-version removal is logged
+    ("rollback", mut_rollback, "stop", False),
+    ("stop", mut_stop, "stop", False),
+    ("restart", mut_restart, "create", False),
+    ("restart", mut_restart, "start", False),
+    ("pause", mut_pause, "pause", False),
+    ("continue", mut_continue, "restart_inplace", False),
+    ("delete", mut_delete, "remove", False),
+    ("vol.create", mut_vol_create, "volume_create", False),
+    ("vol.patch", mut_vol_patch, "volume_create", False),
+    ("vol.delete", mut_vol_delete, "volume_remove", True),  # logged, swept
+]
+
+MODES = ["error_once", f"error_n:{RETRIES + 2}", "latency:0.02",
+         f"hang:{HANG}"]
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("endpoint,mutate,op,swallowed",
+                         [(e, m, o, s) for e, m, o, s in SWEEP],
+                         ids=[f"{e}-{o}" for e, _, o, _ in SWEEP])
+def test_transient_fault_sweep(endpoint, mutate, op, swallowed, mode,
+                               tmp_path):
+    """Under every fault mode, every mutating endpoint either converges
+    (bounded-retry win) or fails clean with zero leaked grants and a
+    fixpoint reconcile."""
+    app = make_app(tmp_path)
+    if endpoint not in ("run", "vol.create", "vol.patch", "vol.delete"):
+        run_demo(app)
+    faults.arm_fault(f"{op}:{mode}")
+    mode_name = mode.partition(":")[0]
+    try:
+        mutate(app)
+        outcome = "ok"
+    except (OSError, xerrors.XError, RuntimeError) as e:
+        outcome = f"failed: {e}"
+    finally:
+        faults.disarm_faults()
+    from gpu_docker_api_tpu.backend.guard import NON_IDEMPOTENT
+    if mode_name == "hang" and op in NON_IDEMPOTENT:
+        # a timed-out create/commit may have half-applied: NOT retried —
+        # must fail clean instead of risking a double-apply
+        assert outcome != "ok", f"{endpoint}/{op}/{mode} unexpectedly passed"
+    elif mode_name in ("error_once", "latency", "hang"):
+        # retries must absorb a once-off error, a slow call, and one hang
+        assert outcome == "ok", f"{endpoint}/{op}/{mode}: {outcome}"
+    elif not swallowed:
+        # more consecutive errors than the retry budget: must fail clean
+        assert outcome != "ok", f"{endpoint}/{op}/{mode} unexpectedly passed"
+    assert_no_leaks(app)
+
+
+def test_error_n_exhausts_then_recovers(tmp_path):
+    """After a clean failure, the same mutation succeeds once the fault
+    clears — nothing about the failed attempt poisoned the name."""
+    app = make_app(tmp_path)
+    faults.arm_fault(f"create:error_n:{RETRIES + 2}")
+    with pytest.raises(OSError):
+        run_demo(app)
+    faults.disarm_faults()
+    assert_no_leaks(app)
+    out = run_demo(app)
+    assert out["name"] == "demo-1"
+    assert_no_leaks(app)
+
+
+# --------------------------------------------------------- breaker + HTTP
+
+def call(app, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=10)
+    payload = json.dumps(body) if body is not None else None
+    conn.request(method, path, payload, {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers, json.loads(raw) if raw else None
+
+
+MUTATING_ROUTES = [
+    ("POST", "/api/v1/replicaSet",
+     {"imageName": "i", "replicaSetName": "x"}),
+    ("PATCH", "/api/v1/replicaSet/demo", {"tpuPatch": {"tpuCount": 2}}),
+    ("PATCH", "/api/v1/replicaSet/demo/rollback", {"version": 1}),
+    ("PATCH", "/api/v1/replicaSet/demo/stop", None),
+    ("PATCH", "/api/v1/replicaSet/demo/restart", None),
+    ("PATCH", "/api/v1/replicaSet/demo/pause", None),
+    ("PATCH", "/api/v1/replicaSet/demo/continue", None),
+    ("POST", "/api/v1/replicaSet/demo/execute", {"cmd": ["ls"]}),
+    ("POST", "/api/v1/replicaSet/demo/commit", {"newImageName": "img2"}),
+    ("DELETE", "/api/v1/replicaSet/demo", None),
+    ("POST", "/api/v1/volumes", {"name": "v", "size": "16MB"}),
+    ("PATCH", "/api/v1/volumes/vol/size", {"size": "32MB"}),
+    ("DELETE", "/api/v1/volumes/vol", None),
+    ("POST", "/api/v1/tpus/drain", None),
+]
+
+
+def test_breaker_open_503_and_degraded_reads(tmp_path):
+    """Breaker forced open: every mutating route answers HTTP 503 with
+    Retry-After (envelope code 503) while info/history/resource reads keep
+    serving from the MVCC store."""
+    app = make_app(tmp_path)
+    app.volumes.create_volume("vol", "16MB")
+    run_demo(app)
+    # v2 with 4 chips, so the rollback body (version 1) is a real rollback
+    # and the patch body (tpuCount 2) is a real change under the breaker
+    out = app.replicasets.patch_container(
+        "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+    # a cordoned chip inside demo's grant makes /tpus/drain attempt a real
+    # migration — which must 503, not log a per-replicaSet failure
+    app.tpu.cordon([out["tpuChips"][0]])
+    app.start()
+    try:
+        app.backend.breaker.force_open(cooldown=60)
+        for method, path, body in MUTATING_ROUTES:
+            status, headers, out = call(app, method, path, body)
+            assert status == 503, (method, path, status, out)
+            assert int(headers["Retry-After"]) >= 1, (method, path)
+            assert out["code"] == 503, (method, path, out)
+        # reads: answered from the store, degraded where live state is gone
+        status, _, out = call(app, "GET", "/api/v1/replicaSet/demo")
+        assert status == 200 and out["code"] == 200
+        assert out["data"]["info"]["degraded"] is True
+        assert out["data"]["info"]["running"] is None
+        assert out["data"]["info"]["spec"]["tpu_chips"]
+        status, _, out = call(app, "GET", "/api/v1/replicaSet/demo/history")
+        assert status == 200 and out["code"] == 200 and out["data"]["history"]
+        status, _, out = call(app, "GET", "/api/v1/volumes/vol")
+        assert status == 200 and out["code"] == 200
+        assert out["data"]["info"]["degraded"] is True
+        status, _, out = call(app, "GET", "/api/v1/resources/tpus")
+        assert status == 200 and out["data"]["tpus"]["freeCount"] == N_CHIPS - 4
+        status, _, out = call(app, "GET", "/api/v1/healthz")
+        assert status == 200 and out["data"]["status"] == "degraded"
+        assert out["data"]["breaker"]["state"] == "open"
+        status, _, _ = call(app, "GET", "/api/v1/events")
+        assert status == 200
+        app.backend.breaker.force_close()
+        assert_no_leaks(app)
+    finally:
+        app.backend.breaker.force_close()
+        app.stop()
+
+
+def test_breaker_opens_on_failures_and_recovers_via_probe(tmp_path):
+    """Consecutive transient failures open the breaker; after the cooldown
+    a half-open trial succeeds and closes it — and the transitions are
+    emitted as events."""
+    app = make_app(tmp_path, breaker_threshold=2, breaker_cooldown=0.15)
+    backend = app.backend
+    # two post-retry failures: error_n outlasting the retry budget, twice
+    for _ in range(2):
+        faults.arm_fault(f"inspect:error_n:{RETRIES + 1}")
+        with pytest.raises(OSError):
+            backend.inspect("ghost")
+        faults.disarm_faults()
+    assert backend.breaker.describe()["state"] == OPEN
+    # while open: refused fast with a retry hint
+    with pytest.raises(xerrors.BackendUnavailableError) as ei:
+        backend.inspect("ghost")
+    assert ei.value.retry_after > 0
+    # cooldown elapses -> one probe call is admitted and closes the breaker
+    time.sleep(0.2)
+    state = backend.inspect("ghost")
+    assert not state.exists
+    assert backend.breaker.describe()["state"] == CLOSED
+    ops = [e["op"] for e in app.events.recent()]
+    assert "breaker.open" in ops and "breaker.closed" in ops
+    assert_no_leaks(app)
+
+
+def test_breaker_halfopen_failure_reopens(tmp_path):
+    app = make_app(tmp_path, breaker_threshold=1, breaker_cooldown=0.1)
+    backend = app.backend
+    faults.arm_fault(f"inspect:error_n:{2 * (RETRIES + 1)}")
+    with pytest.raises(OSError):
+        backend.inspect("ghost")
+    assert backend.breaker.describe()["state"] == OPEN
+    time.sleep(0.15)
+    with pytest.raises(OSError):        # the half-open trial fails too
+        backend.inspect("ghost")
+    assert backend.breaker.describe()["state"] == OPEN
+    faults.disarm_faults()
+    time.sleep(0.15)
+    backend.inspect("ghost")
+    assert backend.breaker.describe()["state"] == CLOSED
+
+
+# ------------------------------------------------------------- health
+
+def test_health_monitor_auto_cordons_missing_chip(tmp_path):
+    app = make_app(tmp_path)
+    inner = app.backend.inner
+    dead = app.tpu.topology.chips[3]
+    inner.set_chip_health(dead.device_path, False)
+    mon = HealthMonitor(inner, app.tpu, events=app.events, interval=0,
+                        fail_threshold=2)
+    rep = mon.probe_once()
+    assert rep["status"] == "degraded"
+    assert dead.index not in app.tpu.cordoned      # below threshold
+    rep = mon.probe_once()
+    assert dead.index in app.tpu.cordoned          # score hit threshold
+    assert rep["chips"][dead.index]["cordoned"]
+    assert "health.cordon" in [e["op"] for e in app.events.recent()]
+    # recovery clears the score but NOT the cordon (explicit uncordon only)
+    inner.set_chip_health(dead.device_path, True)
+    rep = mon.probe_once()
+    assert rep["chips"][dead.index]["failureScore"] == 0
+    assert dead.index in app.tpu.cordoned
+
+
+def test_health_monitor_flap_scores_chips(tmp_path):
+    app = make_app(tmp_path)
+    run_demo(app)
+    inner = app.backend.inner
+    info = stored_containers(app)["demo"]
+    inner.set_flap_count(info.containerName, 5)
+    mon = HealthMonitor(inner, app.tpu, interval=0, fail_threshold=3,
+                        flap_threshold=3, auto_cordon=False)
+    rep = mon.probe_once()
+    assert rep["flapping"] == {info.containerName: 5}
+    for c in info.spec.tpu_chips:
+        assert rep["chips"][c]["failureScore"] == 1
+    assert rep["status"] == "degraded"
+
+
+def test_healthz_probes_fresh_when_prober_off(tmp_path):
+    """With the background prober off, EVERY /healthz (not just the
+    first) must run a fresh probe cycle — a chip dying between two
+    requests shows up in the second."""
+    app = make_app(tmp_path)        # health_interval 0: prober not running
+    app.start()
+    try:
+        _, _, out = call(app, "GET", "/api/v1/healthz")
+        assert out["data"]["status"] == "ok"
+        dead = app.tpu.topology.chips[1]
+        app.backend.inner.set_chip_health(dead.device_path, False)
+        _, _, out = call(app, "GET", "/api/v1/healthz")   # no ?probe
+        assert out["data"]["status"] == "degraded"
+        assert out["data"]["health"]["chips"][1]["failureScore"] >= 1
+    finally:
+        app.stop()
+
+
+def test_substrate_unreachable_reported(tmp_path):
+    app = make_app(tmp_path)
+    app.backend.inner.set_ping(False)
+    app.start()
+    try:
+        status, _, out = call(app, "GET", "/api/v1/healthz?probe")
+        assert out["data"]["status"] == "degraded"
+        assert out["data"]["health"]["substrate"]["reachable"] is False
+    finally:
+        app.stop()
+
+
+# -------------------------------------------------------- cordon / drain
+
+def test_cordon_drain_end_to_end(tmp_path):
+    """Acceptance: after cordon + drain of a chip held by a running
+    replicaSet, /resources/tpus shows it cordoned, no stored spec
+    references it, and the version history shows the rolling
+    replacement."""
+    app = make_app(tmp_path)
+    out = run_demo(app, tpus=4)
+    victim = out["tpuChips"][0]
+    app.start()
+    try:
+        status, _, body = call(app, "POST", f"/api/v1/tpus/{victim}/cordon")
+        assert body["code"] == 200 and victim in body["data"]["cordoned"]
+        status, _, body = call(app, "POST", "/api/v1/tpus/drain")
+        assert body["code"] == 200, body
+        drained = body["data"]["drain"]["drained"]
+        assert [d["name"] for d in drained] == ["demo"]
+        assert victim in drained[0]["fromChips"]
+        assert victim not in drained[0]["toChips"]
+        # chip shows cordoned on the resource read; capacity excludes it
+        status, _, body = call(app, "GET", "/api/v1/resources/tpus")
+        chips = body["data"]["tpus"]["chips"]
+        assert chips[victim]["cordoned"] and not chips[victim]["used"]
+        assert body["data"]["tpus"]["freeCount"] == N_CHIPS - 4 - 1
+        # no stored spec references the cordoned chip
+        for info in stored_containers(app).values():
+            assert victim not in info.spec.tpu_chips
+        # history shows the replacement (v2 off, v1 on the cordoned chip)
+        status, _, body = call(app, "GET", "/api/v1/replicaSet/demo/history")
+        hist = body["data"]["history"]
+        assert hist[0]["version"] == 2
+        assert victim not in hist[0]["status"]["spec"]["tpu_chips"]
+        assert victim in hist[1]["status"]["spec"]["tpu_chips"]
+        # uncordon returns the chip to the pool
+        status, _, body = call(app, "POST",
+                               f"/api/v1/tpus/{victim}/uncordon")
+        assert body["data"]["cordoned"] == []
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+def test_drain_insufficient_capacity_fails_clean(tmp_path):
+    """Draining more chips than the healthy pool can absorb reports the
+    failure per replicaSet and leaves the workload running on its old
+    grant — degraded but alive beats dead."""
+    app = make_app(tmp_path)
+    run_demo(app, tpus=N_CHIPS)         # the whole mesh: no spare chip
+    victim = 0
+    app.tpu.cordon([victim])
+    result = app.replicasets.drain_cordoned()
+    assert "demo" in result["failed"]
+    assert result["drained"] == []
+    info = stored_containers(app)["demo"]
+    assert victim in info.spec.tpu_chips       # still on the old grant
+    assert_no_leaks(app)
+
+
+def test_drain_skips_stopped_replicasets(tmp_path):
+    app = make_app(tmp_path)
+    out = run_demo(app)
+    app.replicasets.stop_container("demo")
+    app.tpu.cordon([out["tpuChips"][0]])
+    result = app.replicasets.drain_cordoned()
+    assert result["skipped"] == ["demo"]
+    assert result["drained"] == [] and result["failed"] == {}
+    assert_no_leaks(app)
+
+
+def test_crash_mid_drain_reconciles(tmp_path):
+    """A drain is an intent-journaled replace: a daemon death mid-drain
+    must reconcile at boot exactly like any interrupted replace."""
+    from gpu_docker_api_tpu.faults import InjectedCrash
+
+    app = make_app(tmp_path)
+    out = run_demo(app)
+    victim = out["tpuChips"][0]
+    app.tpu.cordon([victim])
+    faults.arm("replace.after_stop_old")
+    try:
+        with pytest.raises(InjectedCrash):
+            app.replicasets.drain_cordoned()
+    finally:
+        faults.disarm_all()
+    # abandon like a crash (same protocol as test_crash_recovery.crash)
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = App(state_dir=str(tmp_path / "state"), backend=app.backend,
+               addr="127.0.0.1:0", port_range=(46000, 46100),
+               topology=make_topology("v4-32"), api_key="",
+               cpu_cores=N_CORES, store_maint_records=0)
+    stored = assert_no_leaks(app2)
+    # rolled forward: the new version is live and off the cordoned chip
+    info = stored["demo"]
+    assert info.version == 2
+    assert victim not in info.spec.tpu_chips
+    assert victim in app2.tpu.cordoned          # cordon survived the crash
+    assert app2.backend.inspect(info.containerName).running
+
+
+def test_fault_gate_env_var(tmp_path, monkeypatch):
+    """TDAPI_FAULTS arms faults against a live daemon, mirroring
+    TDAPI_CRASHPOINTS for crashpoints."""
+    monkeypatch.setenv(faults.FAULTS_ENV_VAR,
+                       f"create:error_n:{RETRIES + 2}")
+    app = make_app(tmp_path)
+    with pytest.raises(OSError):
+        run_demo(app)
+    monkeypatch.delenv(faults.FAULTS_ENV_VAR)
+    faults.disarm_faults()
+    assert_no_leaks(app)

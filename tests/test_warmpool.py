@@ -184,6 +184,48 @@ def test_non_python_cmd_bypasses_pool(warm_backend):
     b.stop("c6", timeout=5)
 
 
+def test_pool_gives_up_after_consecutive_spawn_failures(monkeypatch):
+    """Satellite: a broken spawn path (e.g. a preimport that can't even
+    exec) must back off and eventually disable the pool instead of
+    spinning a hot respawn loop."""
+    pool = WarmPool(size=0, preimport="json", give_up_after=3,
+                    backoff_base=0.001, backoff_cap=0.01)
+    spawns = []
+    monkeypatch.setattr(pool, "_spawn",
+                        lambda: spawns.append(1) or None)
+    for _ in range(10):
+        pool._add_worker()
+    st = pool.stats()
+    assert st["gaveUp"] is True
+    assert st["consecFailures"] >= 3
+    # once given up, no further spawn attempts happen at all
+    assert len(spawns) == 3
+    pool._refill_async()               # must not resurrect the loop
+    time.sleep(0.05)
+    assert len(spawns) == 3
+    assert pool.take() is None
+    pool.close()
+
+
+def test_pool_dead_idle_workers_count_toward_give_up(tmp_path):
+    """Workers that die between spawn and take (broken preimport) are
+    consecutive-failure evidence; a LIVE take resets the streak."""
+    pool = WarmPool(size=2, preimport="json", give_up_after=50)
+    wait_for(lambda: pool.stats()["idle"] == 2, msg="two workers")
+    for w in list(pool._idle):
+        w.kill()
+        w.wait(timeout=5)
+    assert pool.take() is None                  # both popped dead
+    assert pool.stats()["consecFailures"] == 2
+    wait_for(lambda: pool.stats()["idle"] >= 1, msg="refill")
+    w = pool.take()
+    assert w is not None                        # live take...
+    assert pool.stats()["consecFailures"] == 0  # ...resets the streak
+    from gpu_docker_api_tpu.backend.warmpool import _reap
+    _reap(w)
+    pool.close()
+
+
 def test_pool_close_reaps_workers(tmp_path):
     b = ProcessBackend(str(tmp_path / "b2"), warm_pool=2,
                        warm_preimport="json")
