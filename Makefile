@@ -5,8 +5,8 @@ PY ?= python3
 ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
-.PHONY: all native test test-fast verify-crash verify-faults bench serve \
-    serve-mock dryrun apidoc lint clean
+.PHONY: all native test test-fast verify-crash verify-faults verify-perf \
+    bench serve serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -15,15 +15,19 @@ native:                 ## build the C++ cores (MVCC store, topology search)
 
 test: native            ## full suite on the virtual 8-device CPU mesh
 	$(PY) -m pytest tests/ -q
-	@echo "robustness tiers included above — rerun in isolation with:"
+	@echo "robustness + perf tiers included above — rerun in isolation with:"
 	@echo "  make verify-crash   (crashpoint sweep: -m crash)"
 	@echo "  make verify-faults  (transient-fault sweep: -m faults)"
+	@echo "  make verify-perf    (throughput-floor smoke: -m perf)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
 	$(PY) -m pytest tests/ -q -m crash
 
 verify-faults:          ## transient-fault sweep: error/latency/hang on every backend op
 	$(PY) -m pytest tests/ -q -m faults
+
+verify-perf:            ## control-plane throughput smoke (generous floors, tier-1-safe)
+	$(PY) -m pytest tests/ -q -m perf
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

@@ -21,6 +21,7 @@ from __future__ import annotations
 import http.client
 import json
 import re
+import threading
 import time
 from typing import Any, Optional
 
@@ -104,7 +105,8 @@ class ApiClient:
     def __init__(self, host: str, port: int,
                  spec: Optional[dict] = None, api_key: str = "",
                  timeout: float = 60.0, get_retries: int = 2,
-                 retry_backoff: float = 0.1, retry_backoff_cap: float = 1.0):
+                 retry_backoff: float = 0.1, retry_backoff_cap: float = 1.0,
+                 keep_alive: bool = True):
         self.host, self.port = host, port
         self.api_key = api_key
         self.timeout = timeout
@@ -115,6 +117,12 @@ class ApiClient:
         self.get_retries = max(0, int(get_retries))
         self.retry_backoff = retry_backoff
         self.retry_backoff_cap = retry_backoff_cap
+        # keep-alive pool: ONE persistent HTTPConnection per calling thread
+        # (http.client connections are not thread-safe), reused across
+        # requests — no TCP setup on the hot path. keep_alive=False restores
+        # the connection-per-request behavior for debugging.
+        self.keep_alive = keep_alive
+        self._pool = threading.local()
         if spec is None:
             spec = json.loads(self._raw("GET", "/openapi.json"))
         self.spec = spec
@@ -142,28 +150,72 @@ class ApiClient:
 
     # ---- wire ----
 
+    def _connection(self) -> http.client.HTTPConnection:
+        """This thread's pooled connection (created on first use)."""
+        conn = getattr(self._pool, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            self._pool.conn = conn
+            self._pool.reused = False  # no request completed on it yet
+        return conn
+
+    def _discard_connection(self) -> None:
+        """Close-on-error: a connection that saw any failure is never
+        reused — the next request opens fresh."""
+        conn = getattr(self._pool, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._pool.conn = None
+
+    def close(self) -> None:
+        """Release the calling thread's pooled connection."""
+        self._discard_connection()
+
     def _raw(self, method: str, path: str, payload: bytes | None = None,
              content_type: str = "application/json") -> bytes:
         # connection-level retries for GET only (idempotent by HTTP
-        # semantics and by this API's design); capped exponential backoff
+        # semantics and by this API's design); capped exponential backoff.
+        # Independently of that budget, GETs take ONE free immediate retry
+        # on a fresh socket when a REUSED keep-alive connection is cleanly
+        # closed before a byte of response arrives (RemoteDisconnected) —
+        # the server reaping an idle socket. Mutations NEVER take it: a
+        # clean close can also be the daemon dying AFTER processing the
+        # request but before responding, and resending would double-apply
+        # (urllib3 restricts this retry the same way).
         attempts = 1 + (self.get_retries if method == "GET" else 0)
-        for attempt in range(attempts):
-            conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=self.timeout)
+        attempt = 0
+        stale_retry_left = True
+        headers = {"Content-Type": content_type}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        while True:
+            conn = self._connection()
+            reused = self._pool.reused
             try:
-                headers = {"Content-Type": content_type}
-                if self.api_key:
-                    headers["Authorization"] = f"Bearer {self.api_key}"
                 conn.request(method, path, payload, headers)
-                return conn.getresponse().read()
+                resp = conn.getresponse()
+                body = resp.read()
+                if self.keep_alive and not resp.will_close:
+                    self._pool.reused = True
+                else:
+                    self._discard_connection()
+                return body
             except (ConnectionError, TimeoutError, OSError,
-                    http.client.HTTPException):
-                if attempt + 1 >= attempts:
+                    http.client.HTTPException) as e:
+                self._discard_connection()
+                if (reused and stale_retry_left and method == "GET"
+                        and isinstance(e, http.client.RemoteDisconnected)):
+                    stale_retry_left = False
+                    continue
+                attempt += 1
+                if attempt >= attempts:
                     raise
                 time.sleep(min(self.retry_backoff_cap,
-                               self.retry_backoff * (2 ** attempt)))
-            finally:
-                conn.close()
+                               self.retry_backoff * (2 ** (attempt - 1))))
 
     def _invoke(self, op_id: str, entry: dict, body: Any,
                 params: dict) -> Any:

@@ -19,10 +19,20 @@ from typing import Optional
 
 
 class EventLog:
+    # jsonl flush cadence: events are observability, not state — a
+    # per-record flush put a locked disk write on EVERY api request (hot
+    # path). Under steady traffic records flush at most once a second;
+    # recent() and close() also flush, so tailing /api/v1/events or a
+    # graceful stop drains the buffer. The in-memory ring is always
+    # current; worst case a CRASH on an idle daemon loses the OFFLINE
+    # copy's buffered tail (whatever arrived since the last flush/read).
+    FLUSH_INTERVAL_S = 1.0
+
     def __init__(self, state_dir: Optional[str] = None, capacity: int = 2048):
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._f = None
+        self._last_flush = 0.0
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
             self._f = open(os.path.join(state_dir, "events.jsonl"), "a",
@@ -45,11 +55,17 @@ class EventLog:
             self._ring.append(evt)
             if self._f is not None:
                 self._f.write(json.dumps(evt) + "\n")
-                self._f.flush()
+                now = time.monotonic()
+                if now - self._last_flush >= self.FLUSH_INTERVAL_S:
+                    self._f.flush()
+                    self._last_flush = now
 
     def recent(self, limit: int = 200, target: str = "") -> list[dict]:
         with self._lock:
             evts = list(self._ring)
+            if self._f is not None:     # reads drain the offline buffer
+                self._f.flush()
+                self._last_flush = time.monotonic()
         if target:
             evts = [e for e in evts if e.get("target") == target]
         return evts[-limit:]

@@ -79,14 +79,22 @@ class Scheduler(abc.ABC):
 
     def _persist(self) -> None:
         """Queue a write of the current serialized state. Called with the
-        scheduler lock held so snapshot order == persist order."""
+        scheduler lock held so snapshot order == persist order. The snapshot
+        (serialize() — fresh dicts of immutable values) is taken under the
+        lock, but the json.dumps runs on the workqueue DRAINER via a
+        deferred payload: the grant path never pays serialization, and a
+        burst of grants coalesces to one store write of the newest
+        snapshot."""
         if self._client is None:
             return
-        payload = json.dumps(self.serialize(), sort_keys=True)
+        snap = self.serialize()
         if self._wq is not None:
-            self._wq.submit(PutKeyValue(self.resource, self.state_key, payload))
+            self._wq.submit(PutKeyValue(
+                self.resource, self.state_key,
+                lambda: json.dumps(snap, sort_keys=True)))
         else:
-            self._client.put(self.resource, self.state_key, payload)
+            self._client.put(self.resource, self.state_key,
+                             json.dumps(snap, sort_keys=True))
 
     def flush(self) -> None:
         """Synchronous persist for graceful shutdown (reference Stop flush,

@@ -551,10 +551,22 @@ class App:
             f"tdapi_workqueue_pending {self.wq.pending()}",
             "# TYPE tdapi_workqueue_dropped gauge",
             f"tdapi_workqueue_dropped {self.wq.dropped_count()}",
+            "# TYPE tdapi_workqueue_coalesced counter",
+            "# puts superseded by a newer same-key put before hitting the store",
+            f"tdapi_workqueue_coalesced {self.wq.coalesced_count()}",
             "# TYPE tdapi_reconcile_actions gauge",
             f"tdapi_reconcile_actions {self.last_reconcile['actions']}",
             "# TYPE tdapi_store_wal_records gauge",
             f"tdapi_store_wal_records {self.store.wal_records}",
+            "# TYPE tdapi_store_wal_flushes counter",
+            "# flushed_records / flushes = avg group-commit batch size",
+            f"tdapi_store_wal_flushes {getattr(self.store, 'wal_flushes', 0)}",
+            "# TYPE tdapi_store_wal_flushed_records counter",
+            f"tdapi_store_wal_flushed_records "
+            f"{getattr(self.store, 'wal_flushed_records', 0)}",
+            "# TYPE tdapi_store_wal_flush_batch_max gauge",
+            f"tdapi_store_wal_flush_batch_max "
+            f"{getattr(self.store, 'wal_flush_batch_max', 0)}",
             "# TYPE tdapi_chip_health_failures gauge",
             f"tdapi_chip_health_failures "
             f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",

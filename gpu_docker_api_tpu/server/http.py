@@ -129,6 +129,14 @@ class Router:
         return (None, {"_405": "1"}) if path_matched else (None, {})
 
 
+class _KeepAliveHTTPServer(ThreadingHTTPServer):
+    # listen backlog (consumed by server_activate at construction): the
+    # default 5 SYN-drops any >5-client connect burst into multi-second
+    # kernel retries. Keep-alive makes connects rare, but the first wave
+    # of a fleet must not stall.
+    request_queue_size = 128
+
+
 class ApiServer:
     def __init__(self, router: Router, addr: str = "127.0.0.1:2378",
                  api_key: Optional[str] = None, events=None):
@@ -198,7 +206,19 @@ class ApiServer:
         server = self
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 + the Content-Length we always send = persistent
+            # connections: a client keeps one TCP socket (and one server
+            # thread) across requests instead of paying handshake + slow
+            # start per call — the keep-alive half of the hot-path work
+            # (client.py pools the other half)
             protocol_version = "HTTP/1.1"
+            # small request/response envelopes: Nagle would hold the last
+            # segment hostage waiting for an ACK that keep-alive defers
+            disable_nagle_algorithm = True
+            # idle keep-alive sockets are dropped after this (the base
+            # handler catches the timeout and closes), so dead clients
+            # can't pin a ThreadingHTTPServer thread forever
+            timeout = 120
 
             def log_message(self, fmt, *args):  # route through our logger
                 log.debug("http: " + fmt, *args)
@@ -221,8 +241,8 @@ class ApiServer:
         return _Handler
 
     def _bind(self) -> None:
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          self._make_handler())
+        self._httpd = _KeepAliveHTTPServer((self.host, self.port),
+                                           self._make_handler())
         self.port = self._httpd.server_address[1]
 
     def serve_forever(self) -> None:

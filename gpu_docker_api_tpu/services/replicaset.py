@@ -45,6 +45,7 @@ TPU-specific deltas (SURVEY §7 hard parts):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -106,9 +107,33 @@ class ReplicaSetService:
         # having drained (the reference reads etcd here and wins by luck)
         self._latest: dict[str, StoredContainerInfo] = {}
 
-    def _mutex(self, name: str) -> threading.Lock:
+    @contextlib.contextmanager
+    def _mutex(self, name: str):
+        """Hold the per-name mutation mutex. delete_container drops the
+        table entry when a replicaSet is gone (the table used to grow one
+        lock per name FOREVER); a waiter that acquires a lock which was
+        dropped while it was blocked retries on the fresh entry, so two
+        holders can never coexist. Only a holder may drop the entry, which
+        is what makes the acquire-then-recheck race-free."""
+        while True:
+            with self._name_locks_guard:
+                lock = self._name_locks.setdefault(name, threading.Lock())
+            lock.acquire()
+            with self._name_locks_guard:
+                current = self._name_locks.get(name)
+            if current is lock:
+                break
+            lock.release()   # entry dropped while we waited: retry fresh
+        try:
+            yield
+        finally:
+            lock.release()
+
+    def _drop_mutex(self, name: str) -> None:
+        """Forget a deleted replicaSet's lock entry. MUST be called while
+        holding the name's mutex (see _mutex)."""
         with self._name_locks_guard:
-            return self._name_locks.setdefault(name, threading.Lock())
+            self._name_locks.pop(name, None)
 
     # ------------------------------------------------------------------ run
 
@@ -135,7 +160,7 @@ class ReplicaSetService:
                 if req.cpuCount > 0:
                     spec.cpuset = self.cpu.apply(req.cpuCount, name)
                     spec.cpu_count = req.cpuCount
-                intent.step("granted", tpuChips=spec.tpu_chips,
+                intent.step("granted", sync=False, tpuChips=spec.tpu_chips,
                             cpuset=spec.cpuset)
                 crashpoint("run.after_grant")
                 info = self._create_and_start(name, spec, req.containerPorts,
@@ -220,7 +245,8 @@ class ReplicaSetService:
             version=version, createTime=_now(), containerName=ctr_name, spec=spec)
         self._persist_latest(name, info)
         if intent is not None:
-            intent.step("persisted", container=ctr_name, version=version)
+            intent.step("persisted", sync=False, container=ctr_name,
+                        version=version)
         return info
 
     def _persist_latest(self, name: str, info: StoredContainerInfo,
@@ -348,7 +374,7 @@ class ReplicaSetService:
             if old_state.exists and (old_state.running or old_state.paused):
                 self.backend.stop(old.containerName)
             if intent is not None:
-                intent.step("stopped_old")
+                intent.step("stopped_old", sync=False)
             crashpoint("replace.after_stop_old")
             self._copy_layer(old.containerName, info.containerName)
             if intent is not None:
@@ -356,7 +382,7 @@ class ReplicaSetService:
             crashpoint("replace.after_copy")
             self.backend.start(info.containerName)
             if intent is not None:
-                intent.step("started_new")
+                intent.step("started_new", sync=False)
             crashpoint("replace.after_start_new")
         except Exception:
             # failed mid-replace: remove the new container, revert latest
@@ -387,7 +413,7 @@ class ReplicaSetService:
         except Exception:  # noqa: BLE001
             log.exception("removing replaced container %s", old.containerName)
         if intent is not None:
-            intent.step("removed_old")
+            intent.step("removed_old", sync=False)
         crashpoint("replace.after_remove_old")
         if old_holds:
             stale_tpu = sorted(set(old.spec.tpu_chips) - set(new_spec.tpu_chips))
@@ -442,7 +468,7 @@ class ReplicaSetService:
             try:
                 self._patch_tpu(name, target_spec, old, len(hist.spec.tpu_chips))
                 self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
-                intent.step("granted", tpuChips=target_spec.tpu_chips,
+                intent.step("granted", sync=False, tpuChips=target_spec.tpu_chips,
                             cpuset=target_spec.cpuset)
                 crashpoint("rollback.after_grant")
                 info = self._rolling_replace(name, old, target_spec, intent)
@@ -497,7 +523,7 @@ class ReplicaSetService:
                     self._grant_tpus(new_spec, self.tpu.apply(
                         len(old.spec.tpu_chips), name,
                         reuse=list(old.spec.tpu_chips)))
-                    intent.step("granted", tpuChips=new_spec.tpu_chips)
+                    intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
                     info = self._rolling_replace(name, old, new_spec, intent)
                 except xerrors.BackendUnavailableError:
                     # breaker open: the WHOLE substrate is refusing — abort
@@ -533,7 +559,7 @@ class ReplicaSetService:
                                         released=info.resourcesReleased)
             try:
                 self.backend.stop(info.containerName)
-                intent.step("stopped")
+                intent.step("stopped", sync=False)
                 crashpoint("stop.after_backend_stop")
                 if info.resourcesReleased:
                     intent.done()
@@ -542,7 +568,7 @@ class ReplicaSetService:
                 self.tpu.restore(spec.tpu_chips, name)
                 self.cpu.restore(spec.cpuset, name)
                 self.ports.restore(list(spec.port_bindings.values()), name)
-                intent.step("restored")
+                intent.step("restored", sync=False)
                 crashpoint("stop.after_restore")
                 info.resourcesReleased = True
                 self._persist_latest(name, info, with_version_key=False)
@@ -572,7 +598,7 @@ class ReplicaSetService:
                     if old.spec.cpu_count:
                         fresh_cpu = self.cpu.apply(old.spec.cpu_count, name)
                         new_spec.cpuset = fresh_cpu
-                intent.step("granted", tpuChips=new_spec.tpu_chips,
+                intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips,
                             cpuset=new_spec.cpuset)
                 crashpoint("restart.after_grant")
                 # running: keep the identical grant — same host, same ICI
@@ -679,14 +705,14 @@ class ReplicaSetService:
                     state = self.backend.inspect(info.containerName)
                     if state.exists:
                         self.backend.remove(info.containerName, force=True)
-                    intent.step("removed")
+                    intent.step("removed", sync=False)
                     crashpoint("delete.after_remove")
                     if not info.resourcesReleased:
                         spec = info.spec
                         self.tpu.restore(spec.tpu_chips, name)
                         self.cpu.restore(spec.cpuset, name)
                         self.ports.restore(list(spec.port_bindings.values()), name)
-                    intent.step("restored")
+                    intent.step("restored", sync=False)
                     crashpoint("delete.after_restore")
                 self._latest.pop(name, None)
                 self.versions.remove(name)
@@ -698,6 +724,9 @@ class ReplicaSetService:
                 intent.done()
                 raise
             intent.done()
+            # the name is gone: drop its mutex entry (unbounded-growth fix;
+            # safe here because we still hold the lock — see _mutex)
+            self._drop_mutex(name)
 
     # -------------------------------------------------------------- helpers
 

@@ -98,7 +98,8 @@ class VolumeService:
             lambda: self.client.put_entity_version(VOLUMES, name, version, payload),
             describe=f"persist {VOLUMES}/{name}@{version}"))
         if intent is not None:
-            intent.step("persisted", volume=vol_name, version=version)
+            intent.step("persisted", sync=False, volume=vol_name,
+                        version=version)
         return {"name": vol_name, "version": version,
                 "mountpoint": state.mountpoint, "size": size}
 
@@ -194,7 +195,7 @@ class VolumeService:
                         raise
                     except Exception:  # noqa: BLE001
                         log.exception("removing volume %s", info.volumeName)
-                    intent.step("removed")
+                    intent.step("removed", sync=False)
                     crashpoint("volume.delete.after_remove")
                 self._latest.pop(name, None)
                 if not keep_history:

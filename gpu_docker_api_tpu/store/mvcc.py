@@ -8,9 +8,12 @@ MVCC revisions one gRPC Get(WithRev) at a time (internal/etcd/revision.go:18-44)
 This store keeps etcd's data model (global revision counter; per-key
 create_revision / mod_revision / version; tombstoned deletes reset version) but
 is embedded, lock-protected, WAL-persisted, and exposes history as a single
-O(1)-roundtrip call. A C++ core (native/mvcc_store.cc) provides the same API
-via ctypes for the hot path; this file is the always-available reference
-implementation and fallback.
+O(1)-roundtrip call. WAL durability uses leader/follower group commit (etcd's
+batched-fsync idea): writers append under the lock, then block until a flush
+leader has made their record durable — N concurrent mutations cost one
+flush/fsync instead of N (see _commit; docs/performance.md). A C++ core
+(native/mvcc_store.cc) provides the same API via ctypes for the hot path;
+this file is the always-available reference implementation and fallback.
 """
 
 from __future__ import annotations
@@ -18,8 +21,15 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
+
+# Group-commit batch window in milliseconds: when > 0, the flush leader
+# sleeps this long before flushing so more concurrent writers join the
+# batch. 0 (default) flushes as soon as a leader picks the batch up —
+# latency-optimal, and still amortizes whenever writers actually race.
+WAL_BATCH_MS_ENV = "TDAPI_WAL_BATCH_MS"
 
 
 @dataclass(frozen=True)
@@ -52,21 +62,44 @@ class MVCCStore:
         self._fsync = fsync
         self._wal = None
         self._wal_records = 0
+        # ---- group commit state (guarded by _commit_cond, NOT _lock) ----
+        # Writers append WAL records under _lock (buffered, no flush) and
+        # receive a sequence number; _commit() then blocks until a flush
+        # leader has made that sequence durable. N writers racing through
+        # the window share ONE flush/fsync instead of paying N — durability
+        # semantics are unchanged (put() still returns only after its
+        # record is on disk), only the flush cost is amortized.
+        self._commit_cond = threading.Condition()
+        self._seq = 0            # records appended (under _lock)
+        self._durable_seq = 0    # records flushed (under _commit_cond)
+        self._flushing = False   # a leader is mid-flush
+        self._flushes = 0
+        self._flushed_records = 0
+        self._flush_batch_max = 0
+        try:
+            self._batch_window = max(
+                0.0, float(os.environ.get(WAL_BATCH_MS_ENV, "0") or 0)) / 1e3
+        except ValueError:
+            self._batch_window = 0.0
         if wal_path:
             if os.path.exists(wal_path):
                 self._replay(wal_path)
             os.makedirs(os.path.dirname(os.path.abspath(wal_path)), exist_ok=True)
-            self._wal = open(wal_path, "a", encoding="utf-8")
+            # binary append: BufferedWriter is internally locked, so the
+            # flush leader can run without _lock while writers append
+            self._wal = open(wal_path, "ab")
 
     # ---- write path ----
 
     def put(self, key: str, value: str) -> int:
-        """Write value; returns the new global revision."""
+        """Write value; returns the new global revision once durable."""
         with self._lock:
             self._rev += 1
-            self._apply_put(key, value, self._rev)
-            self._wal_append({"op": "put", "k": key, "v": value, "r": self._rev})
-            return self._rev
+            rev = self._rev
+            self._apply_put(key, value, rev)
+            seq = self._wal_append({"op": "put", "k": key, "v": value, "r": rev})
+        self._commit(seq)
+        return rev
 
     def delete(self, key: str) -> bool:
         """Tombstone the key. Re-creating it later restarts version at 1
@@ -76,9 +109,10 @@ class MVCCStore:
             if not revs or revs[-1].tombstone:
                 return False
             self._rev += 1
+            seq = self._wal_append({"op": "del", "k": key, "r": self._rev})
             self._apply_delete(key, self._rev)
-            self._wal_append({"op": "del", "k": key, "r": self._rev})
-            return True
+        self._commit(seq)
+        return True
 
     def _apply_put(self, key: str, value: str, rev: int) -> None:
         revs = self._log.setdefault(key, [])
@@ -177,8 +211,9 @@ class MVCCStore:
             dropped = self._compact_locked(revision, keep_history_prefixes)
             # durable: replay must re-apply the compaction, or a restart
             # would resurrect compacted revisions and reset _compacted
-            self._wal_append({"op": "compact", "r": revision,
-                              "keep": list(keep_history_prefixes)})
+            seq = self._wal_append({"op": "compact", "r": revision,
+                                    "keep": list(keep_history_prefixes)})
+        self._commit(seq)
         return dropped
 
     def _compact_locked(self, revision: int,
@@ -237,14 +272,14 @@ class MVCCStore:
             dropped = self._compact_locked(self._rev, keep_history_prefixes)
             self.snapshot(self._wal_path + ".snap")
             if self._wal is not None:
-                self._wal.close()
+                self._wal.close()   # flushes — everything appended so far
             try:
                 os.replace(self._wal_path + ".snap", self._wal_path)
-                self._wal = open(self._wal_path, "a", encoding="utf-8")
+                self._wal = open(self._wal_path, "ab")
             except OSError:
                 # never leave _wal as a closed handle — subsequent puts
                 # would half-apply (memory mutated, WAL append raising)
-                self._wal = open(self._wal_path, "a", encoding="utf-8")
+                self._wal = open(self._wal_path, "ab")
                 raise
             # re-count: the snapshot holds one "rev" record + the live kvs
             with open(self._wal_path, "r", encoding="utf-8") as f:
@@ -253,17 +288,124 @@ class MVCCStore:
             # itself carries only puts) — a no-op prune that sets _compacted
             self._wal_append({"op": "compact", "r": self._compacted,
                               "keep": list(keep_history_prefixes)})
+            self._wal.flush()
+            # appends can't race this (they need _lock): everything up to
+            # _seq is durable — wake any commit waiters parked on the old
+            # handle (its close() flushed their records)
+            self._mark_durable(self._seq)
             return {"dropped": dropped, "wal_records": self._wal_records}
 
     # ---- persistence ----
 
-    def _wal_append(self, rec: dict) -> None:
-        if self._wal is not None:
-            self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+    def _wal_append(self, rec: dict) -> int:
+        """Append under _lock; returns the record's commit sequence number
+        (0 = no WAL, nothing to wait for). fsync mode appends BUFFERED and
+        leaves the flush to the group-commit leader; non-fsync mode flushes
+        inline — a page-cache flush costs microseconds, less than parking
+        the writer on the commit condition variable would."""
+        if self._wal is None:
+            return 0
+        self._wal.write(
+            (json.dumps(rec, separators=(",", ":")) + "\n").encode("utf-8"))
+        if not self._fsync:
             self._wal.flush()
-            if self._fsync:
-                os.fsync(self._wal.fileno())
-            self._wal_records += 1
+        self._wal_records += 1
+        self._seq += 1
+        return self._seq
+
+    # ---- group commit ----
+
+    def _mark_durable(self, target: int) -> None:
+        with self._commit_cond:
+            if target > self._durable_seq:
+                self._flushes += 1
+                batch = target - self._durable_seq
+                self._flushed_records += batch
+                self._flush_batch_max = max(self._flush_batch_max, batch)
+                self._durable_seq = target
+            self._commit_cond.notify_all()
+
+    def _commit(self, seq: int) -> None:
+        """Block until record `seq` is durable.
+
+        fsync mode is leader/follower group commit: the first waiter to
+        find no flush in progress becomes the leader and flushes + fsyncs
+        EVERYTHING appended so far; the rest wait on the condition variable
+        and are woken durable — N concurrent writers share one fsync. The
+        leader never holds _lock, so writers keep appending (and batching
+        up for the next flush) while an fsync is on the wire. Non-fsync
+        mode flushed inline in _wal_append and only updates the counters
+        here.
+
+        Visibility note (fsync mode): the record is applied to memory
+        under _lock BEFORE this wait, so a concurrent get() can observe a
+        revision whose fsync is still in flight — the WRITER's ack is the
+        durability boundary, not other readers' visibility. That matches
+        the system's semantics everywhere else: most control-plane state
+        persists write-BEHIND (workqueue.py), and the boot reconciler
+        heals any power-loss gap between observed and durable state.
+        """
+        if seq == 0:
+            return
+        if not self._fsync:
+            # already flushed inline by _wal_append (under _lock): just
+            # account for it — group commit only pays off when a commit
+            # costs an fsync (see docs/performance.md)
+            self._mark_durable(seq)
+            return
+        with self._commit_cond:
+            while self._durable_seq < seq:
+                if self._flushing:
+                    self._commit_cond.wait()
+                    continue
+                self._flushing = True
+                self._commit_cond.release()
+                err: Optional[BaseException] = None
+                target = 0
+                try:
+                    if self._batch_window > 0:
+                        time.sleep(self._batch_window)
+                    target = self._seq  # everything appended so far
+                    wal = self._wal
+                    if wal is not None:
+                        wal.flush()
+                        if self._fsync:
+                            os.fsync(wal.fileno())
+                except ValueError:
+                    # handle swapped/closed mid-flush (maintain()/close()):
+                    # both flush before closing, so target IS durable
+                    pass
+                except BaseException as e:  # noqa: BLE001 — must not wedge waiters
+                    err = e
+                finally:
+                    self._commit_cond.acquire()
+                    self._flushing = False
+                    if err is None and target > self._durable_seq:
+                        self._flushes += 1
+                        batch = target - self._durable_seq
+                        self._flushed_records += batch
+                        self._flush_batch_max = max(self._flush_batch_max, batch)
+                        self._durable_seq = target
+                    self._commit_cond.notify_all()
+                if err is not None:
+                    raise err
+
+    @property
+    def wal_flushes(self) -> int:
+        """Physical flush()+fsync batches issued — wal_flushed_records /
+        wal_flushes is the average group-commit batch size."""
+        with self._commit_cond:
+            return self._flushes
+
+    @property
+    def wal_flushed_records(self) -> int:
+        with self._commit_cond:
+            return self._flushed_records
+
+    @property
+    def wal_flush_batch_max(self) -> int:
+        with self._commit_cond:
+            return self._flush_batch_max
 
     def _replay(self, path: str) -> None:
         with open(path, "r", encoding="utf-8") as f:
@@ -310,6 +452,8 @@ class MVCCStore:
                 os.fsync(self._wal.fileno())
                 self._wal.close()
                 self._wal = None
+            # wake any commit waiters: the final flush covered them
+            self._mark_durable(self._seq)
 
     def __enter__(self) -> "MVCCStore":
         return self

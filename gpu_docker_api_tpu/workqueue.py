@@ -8,6 +8,15 @@ Differences by design:
 - bounded retries with exponential backoff instead of an unbounded hot loop;
 - a single drainer thread applying ops in order (the reference's
   goroutine-per-message loses write ordering — SURVEY §2 bug 8);
+- write-behind coalescing: the drainer pops every immediately-available
+  message and collapses consecutive PutKeyValue for the same
+  (resource, name) to the latest snapshot — a burst of status-map updates
+  costs one store write. DelKey and Call act as BARRIERS (no coalescing
+  across them), so apply order is preserved exactly;
+- deferred payloads: PutKeyValue.value may be a zero-arg callable — the
+  producer snapshots cheap state under its lock and the DRAINER pays the
+  JSON serialization (schedulers/base.py uses this to get json.dumps off
+  the grant path);
 - join() for deterministic tests and graceful shutdown;
 - dead-letter visibility: messages that exhaust retries land in `dropped`
   (counted in /metrics, one event each) instead of vanishing, and
@@ -18,11 +27,12 @@ Differences by design:
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 from .faults import crashpoint
 
@@ -30,12 +40,22 @@ log = logging.getLogger(__name__)
 
 DEFAULT_CAPACITY = 1024  # reference: 110 (workQueue.go:12)
 
+# max messages the drainer coalesces per sweep (env-tunable; a sweep never
+# blocks — it only takes what is already queued)
+BATCH_MAX_ENV = "TDAPI_WQ_BATCH_MAX"
+DEFAULT_BATCH_MAX = 128
+
 
 @dataclass
 class PutKeyValue:
     resource: str
     name: str
-    value: str
+    # str, or a zero-arg callable resolved on the drainer (deferred
+    # serialization); coalescing keeps only the LATEST value per key
+    value: Union[str, Callable[[], str]]
+
+    def resolve(self) -> str:
+        return self.value() if callable(self.value) else self.value
 
 
 @dataclass
@@ -72,7 +92,7 @@ class _Envelope:
 class WorkQueue:
     def __init__(self, client, capacity: int = DEFAULT_CAPACITY,
                  max_retries: int = 8, base_backoff: float = 0.05,
-                 events=None):
+                 events=None, batch_max: Optional[int] = None):
         self._client = client
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
         self._max_retries = max_retries
@@ -82,6 +102,14 @@ class WorkQueue:
         self._events = events      # EventLog: one record per dropped message
         self._dropped_lock = threading.Lock()
         self.dropped: list[object] = []  # messages that exhausted retries
+        if batch_max is None:
+            try:
+                batch_max = int(os.environ.get(BATCH_MAX_ENV,
+                                               str(DEFAULT_BATCH_MAX)))
+            except ValueError:
+                batch_max = DEFAULT_BATCH_MAX
+        self._batch_max = max(1, batch_max)
+        self.coalesced = 0  # puts superseded by a later one (drainer-only)
 
     # ---- producer side ----
 
@@ -112,27 +140,72 @@ class WorkQueue:
                 if self._closed.is_set():
                     return
                 continue
-            # Retry inline, blocking the drainer: later writes to the same key
-            # must not overtake a failed earlier one, and join()/close() must
-            # see in-flight retries as unfinished work.
-            try:
-                while True:
-                    try:
-                        self._dispatch(env.msg)
-                        break
-                    except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
-                        env.attempts += 1
-                        if env.attempts > self._max_retries:
-                            log.error("workqueue: dropping %r after %d attempts: %s",
-                                      env.msg, env.attempts, e)
-                            self._record_drop(env.msg, env.attempts, e)
+            # sweep everything already queued (never blocks) and coalesce
+            batch = [env]
+            while len(batch) < self._batch_max:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            for env, superseded in self._coalesce(batch):
+                # Retry inline, blocking the drainer: later writes to the same
+                # key must not overtake a failed earlier one, and join()/
+                # close() must see in-flight retries as unfinished work.
+                try:
+                    while True:
+                        try:
+                            self._dispatch(env.msg)
                             break
-                        delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
-                        log.warning("workqueue: retry %d for %r in %.2fs: %s",
-                                    env.attempts, env.msg, delay, e)
-                        time.sleep(delay)
-            finally:
-                self._q.task_done()
+                        except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
+                            env.attempts += 1
+                            if env.attempts > self._max_retries:
+                                log.error("workqueue: dropping %r after %d attempts: %s",
+                                          env.msg, env.attempts, e)
+                                self._record_drop(env.msg, env.attempts, e)
+                                break
+                            delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
+                            log.warning("workqueue: retry %d for %r in %.2fs: %s",
+                                        env.attempts, env.msg, delay, e)
+                            time.sleep(delay)
+                finally:
+                    # superseded envelopes complete WITH their survivor:
+                    # join() must not report done while the key's latest
+                    # value is still un-persisted
+                    self._q.task_done()
+                    for _ in superseded:
+                        self._q.task_done()
+
+    def _coalesce(self, batch: list) -> list[tuple]:
+        """[(survivor_envelope, [superseded_envelopes])], order-preserving.
+
+        Consecutive PutKeyValue for the same (resource, name) collapse to
+        the LATEST envelope at the FIRST one's position — between two
+        barriers only the newest snapshot of a key can matter. DelKey and
+        Call are barriers: coalescing never crosses them, so put→del→put
+        still applies as three ops in order (collapsing around the del
+        would resurrect or lose the key)."""
+        out: list[tuple] = []
+        index: dict[tuple[str, str], int] = {}  # key -> slot in current segment
+        for env in batch:
+            msg = env.msg
+            if isinstance(msg, PutKeyValue):
+                slot = index.get((msg.resource, msg.name))
+                if slot is not None:
+                    keep, superseded = out[slot]
+                    superseded.append(keep)
+                    out[slot] = (env, superseded)
+                    self.coalesced += 1
+                else:
+                    index[(msg.resource, msg.name)] = len(out)
+                    out.append((env, []))
+            else:
+                index.clear()   # barrier: a new segment starts after it
+                out.append((env, []))
+        return out
+
+    def coalesced_count(self) -> int:
+        """Puts superseded by a newer same-key put (for /metrics)."""
+        return self.coalesced
 
     def _record_drop(self, msg, attempts: int, exc: Exception) -> None:
         """Dead-letter a message visibly: keep it for replay_dropped(),
@@ -164,7 +237,7 @@ class WorkQueue:
 
     def _dispatch(self, msg) -> None:
         if isinstance(msg, PutKeyValue):
-            self._client.put(msg.resource, msg.name, msg.value)
+            self._client.put(msg.resource, msg.name, msg.resolve())
         elif isinstance(msg, DelKey):
             self._client.delete(msg.resource, msg.name)
         elif isinstance(msg, Call):
@@ -175,13 +248,19 @@ class WorkQueue:
     # ---- lifecycle ----
 
     def join(self, timeout: float = 5.0) -> bool:
-        """Block until all currently-queued work is applied."""
+        """Block until all currently-queued work is applied. Event-driven on
+        the queue's all_tasks_done condition — the old 5ms poll put a hard
+        latency floor under every mutation that drains before a read
+        (delete, history, rollback)."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._q.unfinished_tasks == 0:
-                return True
-            time.sleep(0.005)
-        return False
+        q = self._q
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                q.all_tasks_done.wait(remaining)
+        return True
 
     def close(self, timeout: float = 5.0) -> None:
         self.join(timeout)

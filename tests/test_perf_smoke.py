@@ -1,0 +1,121 @@
+"""Tier-1-safe control-plane throughput smoke (`make verify-perf`).
+
+Floors are DELIBERATELY generous — an order of magnitude under the numbers
+a loaded dev machine produces (bench.py's scheduling extra records 250+
+chips/sec at concurrency 16; the floors here are 25) — so this can run in
+the default tier on any CI box without flaking, while still catching the
+failure mode that matters: a regression that re-serializes the hot path
+(per-record WAL flushes, per-request TCP setup, O(op) scheduler dumps)
+costs 3-10x, which no amount of machine noise hides behind a 10x margin.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.perf
+
+CHIPS_PER_RS = 4
+FLOOR_CHIPS_PER_SEC = 25        # bench records ~10x this; see module doc
+FLOOR_STORE_OPS_PER_SEC = 2000  # store_bench records ~10x this
+
+
+@pytest.fixture()
+def app(tmp_path):
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", topology=make_topology("v4-128"),
+            api_key="", cpu_cores=16)
+    a.start()
+    yield a
+    a.stop()
+
+
+def _cycle(conn, name: str) -> None:
+    for method, path, body in (
+            ("POST", "/api/v1/replicaSet",
+             {"imageName": "x", "replicaSetName": name,
+              "tpuCount": CHIPS_PER_RS}),
+            ("DELETE", f"/api/v1/replicaSet/{name}", None)):
+        conn.request(method, path,
+                     json.dumps(body) if body is not None else None,
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert out.get("code") == 200, out
+
+
+def test_scheduling_throughput_floor(app):
+    """Full REST stack on the mock substrate, 4 keep-alive clients: the
+    control plane must schedule comfortably more than FLOOR chips/sec."""
+    conc, per_client = 4, 6
+    warm = http.client.HTTPConnection("127.0.0.1", app.server.port, timeout=30)
+    _cycle(warm, "warm")
+    warm.close()
+    errs: list = []
+
+    def client(cid: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                          timeout=30)
+        try:
+            for j in range(per_client):
+                _cycle(conn, f"perf{cid}x{j}")
+        except Exception as e:  # noqa: BLE001
+            errs.append(f"client {cid}: {e!r}")
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(conc)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert not errs, errs
+    chips_per_sec = conc * per_client * CHIPS_PER_RS / dt
+    assert chips_per_sec >= FLOOR_CHIPS_PER_SEC, (
+        f"control-plane throughput collapsed: {chips_per_sec:.1f} chips/sec "
+        f"< floor {FLOOR_CHIPS_PER_SEC} (was the hot path re-serialized?)")
+
+
+def test_store_put_throughput_floor(tmp_path):
+    """WAL-backed store writes (group-commit path, 4 concurrent writers)
+    must stay comfortably above FLOOR ops/sec on both engines."""
+    from gpu_docker_api_tpu.store import native_available, open_store
+
+    engines = ["python"] + (["native"] if native_available() else [])
+    for engine in engines:
+        s = open_store(wal_path=str(tmp_path / f"perf-{engine}.wal"),
+                       engine=engine)
+        n, conc = 500, 4
+        errs: list = []
+
+        def writer(wid: int, store=s) -> None:
+            try:
+                for j in range(n):
+                    store.put(f"/perf/{wid}/k{j % 50}", f"v{j}")
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        s.close()
+        assert not errs, errs
+        ops = conc * n / dt
+        assert ops >= FLOOR_STORE_OPS_PER_SEC, (
+            f"{engine} store puts collapsed: {ops:.0f} ops/sec < "
+            f"floor {FLOOR_STORE_OPS_PER_SEC}")

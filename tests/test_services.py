@@ -398,3 +398,63 @@ def test_volume_tiers_end_to_end(tmp_path):
             app.volumes.create_volume("bad", "1GB", tier="warpfs")
     finally:
         app.stop()
+
+
+# ------------------------------------------------- name-lock lifecycle
+
+def test_delete_drops_name_lock_entry(world):
+    """Satellite regression: _name_locks used to grow one entry per
+    replicaSet name FOREVER (never removed on delete) — a create/delete
+    churn leaked a lock object per name."""
+    rs = world[0]
+    for i in range(5):
+        _run(rs, name=f"churn{i}", tpus=1, cpus=0, ports=0)
+    assert len(rs._name_locks) == 5
+    for i in range(5):
+        rs.delete_container(f"churn{i}")
+    assert rs._name_locks == {}
+    # recreating a deleted name works and re-registers exactly one lock
+    _run(rs, name="churn0", tpus=1, cpus=0, ports=0)
+    assert set(rs._name_locks) == {"churn0"}
+    rs.delete_container("churn0")
+    assert rs._name_locks == {}
+
+
+def test_name_lock_waiter_survives_delete(world):
+    """A thread blocked on a name's mutex while that name is deleted must
+    proceed safely on the FRESH lock entry (mutual exclusion preserved,
+    no deadlock, no KeyError)."""
+    import threading
+
+    rs = world[0]
+    _run(rs, name="victim", tpus=1, cpus=0, ports=0)
+    in_delete = threading.Event()
+    release_delete = threading.Event()
+    real_join = rs.wq.join
+
+    def slow_join(*a, **kw):
+        in_delete.set()
+        release_delete.wait(5)
+        return real_join(*a, **kw)
+
+    rs.wq.join = slow_join          # widen the window while delete holds the lock
+    results = []
+
+    def create_again():
+        in_delete.wait(5)
+        rs.wq.join = real_join      # only the first (delete) call is slowed
+        release_delete.set()
+        try:
+            results.append(_run(rs, name="victim", tpus=1, cpus=0, ports=0))
+        except Exception as e:  # noqa: BLE001
+            results.append(e)
+
+    t = threading.Thread(target=create_again)
+    t.start()
+    rs.delete_container("victim")
+    t.join(10)
+    assert not t.is_alive()
+    assert results and not isinstance(results[0], Exception), results
+    assert results[0]["name"] == "victim-1"   # fresh lifecycle, version 1
+    rs.delete_container("victim")
+    assert "victim" not in rs._name_locks

@@ -150,6 +150,131 @@ def test_workqueue_retry_preserves_key_order(client):
     wq.close()
 
 
+class _RecordingClient:
+    """StateClient wrapper counting the ops that actually hit the store."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.ops: list[tuple] = []
+
+    def put(self, resource, name, value):
+        self.ops.append(("put", resource, name, value))
+        return self.inner.put(resource, name, value)
+
+    def delete(self, resource, name):
+        self.ops.append(("del", resource, name))
+        return self.inner.delete(resource, name)
+
+
+def test_coalesce_consecutive_puts_same_key(client):
+    """Tentpole: a queued burst of same-key puts hits the store ONCE, with
+    the latest value; distinct keys keep their relative order."""
+    rec = _RecordingClient(client)
+    wq = WorkQueue(rec)
+    for i in range(20):
+        wq.submit(PutKeyValue("containers", "hot", f"v{i}"))
+    wq.submit(PutKeyValue("containers", "other", "x"))
+    wq.start()          # drainer sees the whole burst at once
+    assert wq.join()
+    puts = [op for op in rec.ops if op[0] == "put"]
+    assert puts == [("put", "containers", "hot", "v19"),
+                    ("put", "containers", "other", "x")]
+    assert client.get_value("containers", "hot") == "v19"
+    assert wq.coalesced_count() == 19
+    wq.close()
+
+
+def test_coalesce_del_is_a_barrier(client):
+    """put -> del -> put must apply as THREE ops in order: collapsing the
+    puts around the barrier would end the run with the key deleted (or
+    resurrect a deleted value)."""
+    rec = _RecordingClient(client)
+    wq = WorkQueue(rec)
+    wq.submit(PutKeyValue("containers", "k", "v1"))
+    wq.submit(DelKey("containers", "k"))
+    wq.submit(PutKeyValue("containers", "k", "v2"))
+    wq.start()
+    assert wq.join()
+    assert rec.ops == [("put", "containers", "k", "v1"),
+                       ("del", "containers", "k"),
+                       ("put", "containers", "k", "v2")]
+    assert client.get_value("containers", "k") == "v2"
+    assert wq.coalesced_count() == 0
+    wq.close()
+
+
+def test_coalesce_call_is_a_barrier(client):
+    """Call closures fence coalescing the same way DelKey does — a
+    persistence closure may read keys written before it."""
+    rec = _RecordingClient(client)
+    wq = WorkQueue(rec)
+    seen = {}
+    wq.submit(PutKeyValue("containers", "k", "v1"))
+    wq.submit(Call(lambda: seen.update(
+        at_call=client.get_value("containers", "k")), "probe"))
+    wq.submit(PutKeyValue("containers", "k", "v2"))
+    wq.start()
+    assert wq.join()
+    assert seen["at_call"] == "v1"      # the barrier saw the FIRST write
+    assert client.get_value("containers", "k") == "v2"
+    wq.close()
+
+
+def test_coalesce_deferred_value_resolved_on_drainer(client):
+    """PutKeyValue.value may be a callable (deferred serialization): the
+    drainer resolves it, and coalescing keeps only the newest snapshot."""
+    wq = WorkQueue(client)
+    resolved = []
+
+    def snap(i):
+        def go():
+            resolved.append(i)
+            return f"snapshot-{i}"
+        return go
+
+    for i in range(5):
+        wq.submit(PutKeyValue("tpus", "statusMap", snap(i)))
+    wq.start()
+    assert wq.join()
+    assert client.get_value("tpus", "statusMap") == "snapshot-4"
+    assert resolved == [4]              # superseded snapshots never serialized
+    wq.close()
+
+
+def test_coalesced_drop_dead_letters_survivor(client):
+    """Dead-letter interaction: when the coalesced survivor exhausts its
+    retries, the LATEST message lands in dropped (the superseded ones are
+    moot), join() still completes, and replay_dropped() re-queues it."""
+    class Failing:
+        def __init__(self, inner):
+            self.inner = inner
+            self.healthy = False
+
+        def put(self, resource, name, value):
+            if not self.healthy:
+                raise OSError("store down")
+            return self.inner.put(resource, name, value)
+
+        def delete(self, resource, name):
+            return self.inner.delete(resource, name)
+
+    failing = Failing(client)
+    wq = WorkQueue(failing, max_retries=1, base_backoff=0.001)
+    for i in range(8):
+        wq.submit(PutKeyValue("containers", "dl", f"v{i}"))
+    wq.start()
+    assert wq.join(10)                  # drop still completes the batch
+    assert wq.coalesced_count() == 7
+    assert len(wq.dropped) == 1
+    assert wq.dropped[0].value == "v7"  # the survivor IS the newest value
+    failing.healthy = True
+    assert wq.replay_dropped() == 1
+    assert wq.join(10)
+    assert client.get_value("containers", "dl") == "v7"
+    assert wq.dropped_count() == 0
+    wq.close()
+
+
 def test_merge_map_prefix_no_cross_replicaset(client):
     mm = MergeMap(client)
     mm.set("app-1", "/m/app/app-1")
