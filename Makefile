@@ -6,7 +6,7 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    bench serve serve-mock dryrun apidoc lint clean
+    verify-retry bench serve serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -18,6 +18,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "robustness + perf tiers included above — rerun in isolation with:"
 	@echo "  make verify-crash   (crashpoint sweep: -m crash)"
 	@echo "  make verify-faults  (transient-fault sweep: -m faults)"
+	@echo "  make verify-retry   (exactly-once sweep: -m retry)"
 	@echo "  make verify-perf    (throughput-floor smoke: -m perf)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -25,6 +26,9 @@ verify-crash:           ## crashpoint sweep: kill + rebuild at every step bounda
 
 verify-faults:          ## transient-fault sweep: error/latency/hang on every backend op
 	$(PY) -m pytest tests/ -q -m faults
+
+verify-retry:           ## exactly-once sweep: duplicate keys, dropped responses, overload
+	$(PY) -m pytest tests/ -q -m retry
 
 verify-perf:            ## control-plane throughput smoke (generous floors, tier-1-safe)
 	$(PY) -m pytest tests/ -q -m perf

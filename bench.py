@@ -857,7 +857,10 @@ def scheduling_bench() -> dict:
     connections): the headline chips_per_sec is the BEST level — the
     control plane's capacity — and the per-level numbers record how WAL
     group commit + write-behind coalescing scale it (serial traffic can't
-    batch; 16 racing clients share flushes)."""
+    batch; 16 racing clients share flushes). Each level also records its
+    p99 request latency and the admission gate's shed count: overload
+    protection must show up in the trajectory (a 429 that was retried),
+    not silently cap throughput."""
     import threading
 
     from gpu_docker_api_tpu.server.app import App
@@ -871,35 +874,53 @@ def scheduling_bench() -> dict:
     port = app.server.port
     chips_per_rs = 4
 
-    def cycle(conn, name):
-        """One create+delete over a persistent connection."""
+    def cycle(conn, name, lats, shed):
+        """One create+delete over a persistent connection; per-request
+        latencies into `lats`, 429-retries counted in `shed[0]`. Each
+        mutation carries an Idempotency-Key — the shipped client stamps
+        one by default, so THIS is the hot path the numbers must price
+        (claim + executed-marker + response writes included)."""
         for method, path, body in (
                 ("POST", "/api/v1/replicaSet",
                  {"imageName": "x", "replicaSetName": name,
                   "tpuCount": chips_per_rs}),
                 ("DELETE", f"/api/v1/replicaSet/{name}", None)):
-            conn.request(method, path,
-                         json.dumps(body) if body is not None else None,
-                         {"Content-Type": "application/json"})
-            out = json.loads(conn.getresponse().read())
-            if out.get("code") != 200:
-                raise RuntimeError(f"{method} {path} -> {out}")
+            key = f"bench-{name}-{method}"
+            while True:
+                t0 = time.perf_counter()
+                conn.request(method, path,
+                             json.dumps(body) if body is not None else None,
+                             {"Content-Type": "application/json",
+                              "Idempotency-Key": key})
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+                lats.append(time.perf_counter() - t0)
+                if out.get("code") == 429:
+                    shed[0] += 1
+                    time.sleep(float(resp.getheader("Retry-After") or 1))
+                    continue
+                if out.get("code") != 200:
+                    raise RuntimeError(f"{method} {path} -> {out}")
+                break
 
     try:
         warm = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-        cycle(warm, "warm")        # first request pays route/store setup
+        cycle(warm, "warm", [], [0])   # first request pays route/store setup
         warm.close()
         sweep = {}
         for conc in (1, 4, 16):
             per_client = max(4, 48 // conc)
             errs: list = []
+            lat_lists: list = [[] for _ in range(conc)]
+            shed_boxes: list = [[0] for _ in range(conc)]
 
             def client(cid, conc=conc, per_client=per_client):
                 conn = http.client.HTTPConnection("127.0.0.1", port,
                                                   timeout=60)
                 try:
                     for j in range(per_client):
-                        cycle(conn, f"s{conc}x{cid}x{j}")
+                        cycle(conn, f"s{conc}x{cid}x{j}",
+                              lat_lists[cid], shed_boxes[cid])
                 except Exception as e:  # noqa: BLE001 — fail the level loudly
                     errs.append(f"c{conc} client {cid}: {e}")
                 finally:
@@ -916,16 +937,27 @@ def scheduling_bench() -> dict:
             if errs:
                 raise RuntimeError("; ".join(errs[:3]))
             cycles = conc * per_client
+            lats = sorted(x for lst in lat_lists for x in lst)
+            shed = sum(b[0] for b in shed_boxes)
             sweep[f"c{conc}"] = {
                 "chips_per_sec": round(cycles * chips_per_rs / dt, 1),
                 "replicasets_per_sec": round(cycles / dt, 1),
                 "cycles": cycles,
+                "p99_ms": round(
+                    lats[int(0.99 * (len(lats) - 1))] * 1e3, 2),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "shed": shed,
+                "shed_rate": round(shed / (len(lats) or 1), 4),
             }
         best = max(sweep.values(), key=lambda r: r["chips_per_sec"])
         return {
             "chips_per_sec": best["chips_per_sec"],
             "replicasets_per_sec": best["replicasets_per_sec"],
             "chips_per_rs": chips_per_rs,
+            # the 16-client level is the overload-relevant one: its tail
+            # latency + shed rate are first-class trajectory numbers
+            "c16_p99_ms": sweep["c16"]["p99_ms"],
+            "c16_shed_rate": sweep["c16"]["shed_rate"],
             "concurrency_sweep": sweep,
         }
     finally:

@@ -23,6 +23,7 @@ import json
 import re
 import threading
 import time
+import uuid
 from typing import Any, Optional
 
 
@@ -106,26 +107,45 @@ class ApiClient:
                  spec: Optional[dict] = None, api_key: str = "",
                  timeout: float = 60.0, get_retries: int = 2,
                  retry_backoff: float = 0.1, retry_backoff_cap: float = 1.0,
-                 keep_alive: bool = True):
+                 keep_alive: bool = True, idempotency: bool = True):
         self.host, self.port = host, port
         self.api_key = api_key
         self.timeout = timeout
-        # idempotent-GET retry budget: a briefly-degraded daemon (restart,
-        # breaker cooldown, connection reset) should not fail a read —
-        # mutations are NEVER retried here (not idempotent; the server's
-        # 503 + Retry-After is the client's signal for those)
+        # connection-error retry budget. GETs always get it (idempotent by
+        # HTTP semantics and by this API's design). Mutations get the SAME
+        # budget when `idempotency` is on: every mutating call is stamped
+        # with a fresh Idempotency-Key, so a resend of a request the
+        # server already executed replays the stored response instead of
+        # double-applying (server-side result cache, idempotency.py).
+        # With idempotency=False mutations are never retried — a
+        # connection error may mean the daemon died AFTER applying.
         self.get_retries = max(0, int(get_retries))
         self.retry_backoff = retry_backoff
         self.retry_backoff_cap = retry_backoff_cap
+        self.idempotency = idempotency
         # keep-alive pool: ONE persistent HTTPConnection per calling thread
         # (http.client connections are not thread-safe), reused across
         # requests — no TCP setup on the hot path. keep_alive=False restores
         # the connection-per-request behavior for debugging.
         self.keep_alive = keep_alive
         self._pool = threading.local()
+        # every pooled connection ever handed out, so close() can release
+        # ALL threads' sockets; _gen invalidates other threads' pool slots
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._gen = 0
+        self._stats_lock = threading.Lock()
+        self._stats = {"getRetries": 0, "mutationRetries": 0,
+                       "staleRetries": 0, "replays": 0}
         if spec is None:
             spec = json.loads(self._raw("GET", "/openapi.json"))
         self.spec = spec
+        # retrying a mutation is only safe when the SERVER deduplicates:
+        # against an older daemon whose spec doesn't advertise the
+        # Idempotency-Key header, a resend would double-apply — fall
+        # back to the never-retry-mutations behavior automatically
+        if self.idempotency and not self._spec_supports_idempotency():
+            self.idempotency = False
         self.operations: dict[str, dict] = {}
         for path, methods in spec["paths"].items():
             for method, op in methods.items():
@@ -133,6 +153,18 @@ class ApiClient:
                     continue
                 self.operations[op["operationId"]] = {
                     "method": method.upper(), "path": path, "op": op}
+
+    def _spec_supports_idempotency(self) -> bool:
+        """True when any operation documents the Idempotency-Key header
+        (servers >= 0.6.0 — the ones that replay duplicates)."""
+        for methods in self.spec.get("paths", {}).values():
+            for op in methods.values():
+                if not isinstance(op, dict):
+                    continue
+                for p in op.get("parameters", []):
+                    if p.get("name") == "Idempotency-Key":
+                        return True
+        return False
 
     def __getattr__(self, name: str):
         ops = self.__dict__.get("operations") or {}
@@ -151,13 +183,23 @@ class ApiClient:
     # ---- wire ----
 
     def _connection(self) -> http.client.HTTPConnection:
-        """This thread's pooled connection (created on first use)."""
+        """This thread's pooled connection (created on first use). A slot
+        minted before the last close() is stale — discard and re-open."""
         conn = getattr(self._pool, "conn", None)
+        if conn is not None and getattr(self._pool, "gen", -1) != self._gen:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            conn = None
         if conn is None:
             conn = http.client.HTTPConnection(self.host, self.port,
                                               timeout=self.timeout)
             self._pool.conn = conn
+            self._pool.gen = self._gen
             self._pool.reused = False  # no request completed on it yet
+            with self._conns_lock:
+                self._conns.add(conn)
         return conn
 
     def _discard_connection(self) -> None:
@@ -170,28 +212,66 @@ class ApiClient:
             except OSError:
                 pass
             self._pool.conn = None
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def close(self) -> None:
-        """Release the calling thread's pooled connection."""
-        self._discard_connection()
+        """Release EVERY pooled connection — all threads', not just the
+        caller's (a client shared across worker threads used to leak one
+        socket per thread). Other threads notice the generation bump and
+        re-open lazily on their next call."""
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+            self._gen += 1
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._pool.conn = None
+
+    def stats(self) -> dict:
+        """Connection-retry / replay counters: getRetries and
+        mutationRetries (budgeted resends after a connection error),
+        staleRetries (free fresh-socket retry after a reaped keep-alive
+        connection), replays (responses the server answered from its
+        idempotency cache rather than executing)."""
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def _bump(self, stat: str) -> None:
+        with self._stats_lock:
+            self._stats[stat] += 1
 
     def _raw(self, method: str, path: str, payload: bytes | None = None,
-             content_type: str = "application/json") -> bytes:
-        # connection-level retries for GET only (idempotent by HTTP
-        # semantics and by this API's design); capped exponential backoff.
-        # Independently of that budget, GETs take ONE free immediate retry
-        # on a fresh socket when a REUSED keep-alive connection is cleanly
-        # closed before a byte of response arrives (RemoteDisconnected) —
-        # the server reaping an idle socket. Mutations NEVER take it: a
-        # clean close can also be the daemon dying AFTER processing the
-        # request but before responding, and resending would double-apply
-        # (urllib3 restricts this retry the same way).
-        attempts = 1 + (self.get_retries if method == "GET" else 0)
+             content_type: str = "application/json",
+             extra_headers: Optional[dict] = None,
+             idempotent: bool = False) -> bytes:
+        # connection-level retries for requests that are safe to resend:
+        # GETs (idempotent by HTTP semantics and by this API's design) and
+        # mutations stamped with an Idempotency-Key (the server replays
+        # the stored response instead of re-executing) — capped
+        # exponential backoff. Independently of that budget, retryable
+        # requests take ONE free immediate retry on a fresh socket when a
+        # REUSED keep-alive connection is cleanly closed before a byte of
+        # response arrives (RemoteDisconnected) — the server reaping an
+        # idle socket. Un-keyed mutations NEVER retry at all: a clean
+        # close can also be the daemon dying AFTER processing the request
+        # but before responding, and resending would double-apply.
+        retryable = method == "GET" or idempotent
+        attempts = 1 + (self.get_retries if retryable else 0)
         attempt = 0
         stale_retry_left = True
+        # HTTP 409 = our keyed retry raced the still-executing original
+        # (e.g. the first attempt timed out client-side but kept running
+        # server-side): poll for the stored result per Retry-After
+        # instead of surfacing a bogus terminal error
+        conflict_polls_left = max(1, self.get_retries) if idempotent else 0
         headers = {"Content-Type": content_type}
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
+        if extra_headers:
+            headers.update(extra_headers)
         while True:
             conn = self._connection()
             reused = self._pool.reused
@@ -199,21 +279,35 @@ class ApiClient:
                 conn.request(method, path, payload, headers)
                 resp = conn.getresponse()
                 body = resp.read()
+                if resp.getheader("Idempotency-Replayed"):
+                    self._bump("replays")
                 if self.keep_alive and not resp.will_close:
                     self._pool.reused = True
                 else:
                     self._discard_connection()
+                if resp.status == 409 and conflict_polls_left > 0:
+                    conflict_polls_left -= 1
+                    self._bump("mutationRetries")
+                    try:
+                        wait = float(resp.getheader("Retry-After") or 1)
+                    except ValueError:
+                        wait = 1.0
+                    time.sleep(min(2.0, max(0.05, wait)))
+                    continue
                 return body
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException) as e:
                 self._discard_connection()
-                if (reused and stale_retry_left and method == "GET"
+                if (reused and stale_retry_left and retryable
                         and isinstance(e, http.client.RemoteDisconnected)):
                     stale_retry_left = False
+                    self._bump("staleRetries")
                     continue
                 attempt += 1
                 if attempt >= attempts:
                     raise
+                self._bump("getRetries" if method == "GET"
+                           else "mutationRetries")
                 time.sleep(min(self.retry_backoff_cap,
                                self.retry_backoff * (2 ** (attempt - 1))))
 
@@ -221,8 +315,21 @@ class ApiClient:
                 params: dict) -> Any:
         op = entry["op"]
         path = entry["path"]
+        method = entry["method"]
+        # reserved kwargs (header-borne; dashes can't be kwarg names):
+        # if_match=N sends If-Match; idempotency_key overrides the
+        # auto-generated per-call key
+        extra: dict[str, str] = {}
+        if_match = params.pop("if_match", None)
+        if if_match is not None:
+            extra["If-Match"] = str(if_match)
+        idem_key = params.pop("idempotency_key", None)
+        if method != "GET" and (idem_key or self.idempotency):
+            extra["Idempotency-Key"] = str(idem_key or uuid.uuid4().hex)
         query = []
         for p in op.get("parameters", []):
+            if p.get("in") == "header":
+                continue        # documentation-only; sent via `extra`
             val = params.pop(p["name"], None)
             if p.get("required") and val is None:
                 raise SchemaError(f"{op_id}: missing path parameter "
@@ -258,7 +365,12 @@ class ApiClient:
                 payload = json.dumps(body).encode()
         elif body is not None:
             raise SchemaError(f"{op_id} takes no request body")
-        raw = self._raw(entry["method"], path, payload)
+        # auto-retry requires SERVER-side dedup: an explicit key is still
+        # sent (caller's choice), but against a daemon whose spec doesn't
+        # advertise the header a resend would double-apply — never retry
+        raw = self._raw(method, path, payload, extra_headers=extra,
+                        idempotent=(self.idempotency
+                                    and bool(extra.get("Idempotency-Key"))))
         ok = op["responses"].get("200", {})
         if "application/json" not in ok.get("content", {}):
             return raw                       # /metrics, /openapi.json

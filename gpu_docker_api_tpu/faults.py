@@ -161,10 +161,20 @@ FAULT_MODES: dict[str, str] = {
                "then proceed",
     "hang": "sleep arg seconds (default 2.0) on the first crossing, then "
             "raise — models a stalled call the deadline must cut",
+    # duplicate-delivery injection: unlike the modes above (gated on
+    # backend ops), this one is gated on HTTP endpoints — arm it on
+    # 'METHOD /concrete/path' (e.g. 'POST /api/v1/replicaSet'). The
+    # server EXECUTES the mutation, then severs the connection before a
+    # response byte is written: the client sees a connection error and
+    # cannot tell a dropped response from a dead daemon — exactly the
+    # ambiguity Idempotency-Key replay resolves.
+    "drop_response": "execute, then sever the connection before the "
+                     "response is written, on the first N crossings "
+                     "(arg = N, default 1)",
 }
 
 _DEFAULT_ARG = {"error_once": 1.0, "error_n": 1.0, "latency": 0.05,
-                "hang": 2.0}
+                "hang": 2.0, "drop_response": 1.0}
 
 
 class _Fault:
@@ -174,10 +184,10 @@ class _Fault:
         self.op = op
         self.mode = mode
         self.arg = arg
-        # error_once/error_n/hang fire a bounded number of times so a
-        # retried op can converge; latency is persistent (a slow substrate
-        # stays slow — every attempt pays it)
-        self.remaining = (int(arg) if mode == "error_n"
+        # error_once/error_n/hang/drop_response fire a bounded number of
+        # times so a retried op can converge; latency is persistent (a
+        # slow substrate stays slow — every attempt pays it)
+        self.remaining = (int(arg) if mode in ("error_n", "drop_response")
                           else 1 if mode in ("error_once", "hang")
                           else -1)
 
@@ -239,8 +249,8 @@ def fault_gate(op: str) -> None:
     with _lock:
         _ingest_env()
         f = _faults.get(op)
-        if f is None:
-            return
+        if f is None or f.mode == "drop_response":
+            return          # drop_response is the HTTP layer's gate
         if f.remaining == 0:
             return
         if f.remaining > 0:
@@ -252,3 +262,19 @@ def fault_gate(op: str) -> None:
     if mode == "hang":
         time.sleep(arg)
     raise InjectedFault(op, mode)
+
+
+def should_drop_response(op: str) -> bool:
+    """Crossed by the HTTP server after a handler has EXECUTED, before its
+    response is written. True => sever the connection (see FAULT_MODES
+    drop_response). `op` is 'METHOD /concrete/path'."""
+    if not _faults and not os.environ.get(FAULTS_ENV_VAR):
+        return False
+    with _lock:
+        _ingest_env()
+        f = _faults.get(op)
+        if f is None or f.mode != "drop_response" or f.remaining == 0:
+            return False
+        if f.remaining > 0:
+            f.remaining -= 1
+        return True

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .idempotency import active_key
 from .store.client import StateClient
 
 INTENTS = "intents"
@@ -107,16 +108,31 @@ class Intent:
         if sync:
             self._journal._write(self.record)
 
-    def done(self) -> None:
-        """The mutation finished (or fully unwound): clear the marker."""
-        if not self.closed:
-            self.closed = True
-            self._journal._clear(self.record)
+    def done(self, committed: bool = False) -> None:
+        """The mutation finished: clear the marker. committed=True (the
+        services' success paths) additionally stamps the request's
+        idempotency record as executed BEFORE the intent key is cleared,
+        so a crash between here and the middleware's response store
+        still resolves to "replay", never to a double-apply. Unwind
+        paths use the default — an unwound mutation has no effect to
+        protect."""
+        if self.closed:
+            return
+        self.closed = True
+        if committed and not self.record.meta.get("idemPartial"):
+            key = self.record.meta.get("idemKey", "")
+            cache = self._journal.idempotency
+            if key and cache is not None:
+                cache.mark_executed(key)
+        self._journal._clear(self.record)
 
 
 class IntentJournal:
     def __init__(self, client: Optional[StateClient]):
         self._client = client
+        # set by App: lets intent.done(committed=True) stamp the active
+        # idempotency record as executed before the intent key clears
+        self.idempotency = None
 
     @staticmethod
     def _key(kind: str, target: str) -> str:
@@ -124,6 +140,12 @@ class IntentJournal:
 
     def begin(self, op: str, target: str, kind: str = KIND_CONTAINER,
               **meta) -> Intent:
+        # fold the request's Idempotency-Key (if any) into the journal:
+        # the boot reconciler settles the key's result cache entry to the
+        # SAME outcome it settles this intent to (idempotency.py)
+        key = active_key()
+        if key:
+            meta.setdefault("idemKey", key)
         rec = IntentRecord(op=op, target=target, kind=kind,
                            begun_at=round(time.time(), 4), meta=meta)
         self._write(rec)

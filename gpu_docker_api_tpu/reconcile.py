@@ -71,7 +71,8 @@ _MANAGED_NAME = re.compile(r"[^-]+-\d+$")
 class Reconciler:
     def __init__(self, backend, client, wq, tpu, cpu, ports,
                  container_versions, volume_versions, merges, intents,
-                 events=None, replicasets=None, volumes=None):
+                 events=None, replicasets=None, volumes=None,
+                 idempotency=None):
         self.backend = backend
         self.client = client
         self.wq = wq
@@ -85,6 +86,7 @@ class Reconciler:
         self.events = events
         self.replicasets = replicasets   # for cache invalidation only
         self.volumes = volumes
+        self.idempotency = idempotency   # keyed-mutation result cache
 
     # ------------------------------------------------------------- entry
 
@@ -103,17 +105,41 @@ class Reconciler:
             "orphanVolumesRemoved": [],
             "volumesMigrated": 0,
             "droppedReplayed": 0,
+            "idempotency": {"finalized": 0, "dropped": 0, "expired": 0},
         }
         # make store reads current before cross-checking anything
         self.wq.join()
+        # idemKey -> how the intent replay settled that mutation; the
+        # idempotency sweep below settles the key's cache entry the SAME
+        # way, so a post-crash client retry sees exactly one state change
+        idem_outcomes: dict[str, str] = {}
         for rec in self.intents.open_intents():
+            ops_before = len(report["opsCompleted"])
+            replay_ok = True
             try:
                 self._replay_intent(rec, report)
             except Exception:  # noqa: BLE001 — one bad intent must not
                 log.exception("replaying intent %s:%s", rec.kind, rec.target)
+                replay_ok = False
             self.intents.clear(rec.kind, rec.target)
             report["intentsReplayed"].append(
                 f"{rec.kind}:{rec.target}:{rec.op}")
+            key = rec.meta.get("idemKey", "")
+            if key:
+                # a failed replay must NOT finalize the key as done — drop
+                # it instead, so the client's retry re-executes and the
+                # services' own guards arbitrate. Same for a PARTIAL
+                # intent (one of several journaled by a single request,
+                # e.g. drain): completing one migration says nothing
+                # about the request as a whole — re-execute.
+                newly = report["opsCompleted"][ops_before:]
+                completed = (replay_ok
+                             and not rec.meta.get("idemPartial")
+                             and not any("-unwound:" in s for s in newly))
+                idem_outcomes[key] = "completed" if completed else "unwound"
+        if self.idempotency is not None:
+            report["idempotency"] = self.idempotency.reconcile_boot(
+                idem_outcomes)
         self._reconcile_grants(report)
         self._reconcile_containers(report)
         self._reconcile_versions(report)
@@ -132,7 +158,11 @@ class Reconciler:
             + report["versionFixes"]
             + len(report["orphanVolumesRemoved"])
             + report["volumesMigrated"]
-            + report["droppedReplayed"])
+            + report["droppedReplayed"]
+            # TTL-expired records are routine hygiene, not evidence of a
+            # dirty shutdown — only settled crash leftovers count
+            + report["idempotency"]["finalized"]
+            + report["idempotency"]["dropped"])
         if self.events is not None:
             self.events.record("reconcile", code=200,
                                actions=report["actions"],
@@ -246,6 +276,13 @@ class Reconciler:
             return
         old_ctr = rec.meta.get("oldContainer", "")
         new_ctr = stored.containerName
+        if not rec.has_step("created"):
+            # died before anything was created: the only side effects are
+            # grants, which the grant cross-check pass frees — the replace
+            # did NOT commit (an idempotent retry must re-execute, so this
+            # must never read as "-completed")
+            report["opsCompleted"].append(f"replace-unwound:{rec.target}")
+            return
         new_version = rec.step_meta("created").get("version")
         if new_version is not None and stored.version != new_version:
             # latest pointer still names the OLD version: the new one was
@@ -280,8 +317,13 @@ class Reconciler:
         backend stop, free the grants, and persist the release flag (the
         grant cross-check trusts that flag, so it must be settled first)."""
         stored = self._stored(rec.target)
-        if stored is None or stored.resourcesReleased:
+        if stored is None:
+            # no record to stop: nothing committed — must not read as a
+            # completed stop for the idempotency-outcome inference
+            report["opsCompleted"].append(f"stop-unwound:{rec.target}")
             return
+        if stored.resourcesReleased:
+            return      # already settled: the stop IS complete
         state = self.backend.inspect(stored.containerName)
         if state.exists and (state.running or state.paused):
             try:
@@ -326,10 +368,20 @@ class Reconciler:
     def _replay_volume_scale(self, rec: IntentRecord, report: dict) -> None:
         kv = self.client.get(VOLUMES, rec.target)
         if kv is None:
+            # base record lost to write-behind: the scale cannot have
+            # committed — never read as completed (see _replay_replace)
+            report["opsCompleted"].append(
+                f"volume.scale-unwound:{rec.target}")
             return
         stored = StoredVolumeInfo.deserialize(kv.value)
         old_vol = rec.meta.get("oldVolume", "")
         created = rec.step_meta("created")
+        if not rec.has_step("created"):
+            # died before the new version existed: nothing scaled — must
+            # not read as completed (see _replay_replace)
+            report["opsCompleted"].append(
+                f"volume.scale-unwound:{rec.target}")
+            return
         if created and stored.volumeName != created.get("volume"):
             # new version never persisted: drop its backend volume + key
             vol = created.get("volume", "")

@@ -16,8 +16,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
+from collections import deque
 from typing import Optional
 
+from .. import idempotency as idem
 from .. import xerrors
 from ..backend import make_backend
 from ..backend.base import Backend
@@ -25,6 +28,7 @@ from ..backend.guard import GuardedBackend, breaker_gauge
 from ..dtos import ContainerRun, PatchRequest
 from ..events import EventLog
 from ..health import HealthMonitor
+from ..idempotency import IdempotencyCache
 from ..intents import IntentJournal
 from ..reconcile import Reconciler
 from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
@@ -38,10 +42,152 @@ from ..version import (
 from ..workqueue import WorkQueue
 from .codes import ResCode
 from .http import (
-    ApiServer, RawResponse, Request, Response, Router, err, ok, unavailable,
+    ApiServer, RawResponse, Request, Response, Router, err, ok,
+    precondition_failed, too_many, unavailable,
 )
 
 log = logging.getLogger(__name__)
+
+
+def _if_match(req: Request):
+    """Parse the optional If-Match version precondition header. Accepts a
+    bare or quoted integer; anything else is a client error."""
+    raw = req.headers.get("If-Match", "").strip().strip('"')
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"If-Match must be an integer version, got {raw!r}")
+
+
+class MutationGate:
+    """Bounded-concurrency admission control for mutating requests.
+
+    Overload on the PR 3 keep-alive stack used to be absorbed by letting
+    every request in: threads pile up behind the name locks and the WAL,
+    latency grows unboundedly, and the eventual failures strike mid-
+    mutation. This gate sheds EARLY instead — before any grant, version
+    bump, or journal write exists:
+
+    - at most `max_inflight` mutations execute concurrently (semaphore);
+    - at most `max_waiting` more may queue for a slot (watermark); the
+      queue wait is bounded by `wait_timeout`;
+    - per-client fairness: one remote address may hold at most
+      `per_client` slots (executing + queued), so a single runaway
+      client saturating the gate cannot starve the rest.
+
+    A shed answers HTTP 429 + Retry-After. Counters feed /metrics
+    (tdapi_mutations_*)."""
+
+    def __init__(self, max_inflight: int = 32, max_waiting: int = 64,
+                 per_client: Optional[int] = None,
+                 wait_timeout: float = 10.0):
+        self.max_inflight = max(1, max_inflight)
+        self.max_waiting = max(0, max_waiting)
+        self.per_client = (per_client if per_client and per_client > 0
+                           else self.max_inflight)
+        self.wait_timeout = wait_timeout
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._waiting = 0
+        # FIFO ticket queue: newcomers may not barge past parked waiters
+        # (a sustained arrival stream would otherwise starve the queue
+        # into spurious queue_timeout sheds)
+        self._fifo: deque = deque()
+        self._per_client: dict[str, int] = {}
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.shed_by_reason = {"per_client": 0, "queue_full": 0,
+                               "queue_timeout": 0}
+
+    def _drop_client(self, client: str) -> None:
+        n = self._per_client.get(client, 0) - 1
+        if n <= 0:
+            self._per_client.pop(client, None)
+        else:
+            self._per_client[client] = n
+
+    def _shed(self, reason: str) -> str:
+        self.shed_total += 1
+        self.shed_by_reason[reason] += 1
+        return reason
+
+    def acquire(self, client: str) -> Optional[str]:
+        """Admit (returns None; caller MUST release()) or shed (returns
+        the reason)."""
+        with self._cond:
+            if self._per_client.get(client, 0) >= self.per_client:
+                return self._shed("per_client")
+            self._per_client[client] = self._per_client.get(client, 0) + 1
+            if self._inflight < self.max_inflight and not self._fifo:
+                self._inflight += 1
+                self.admitted_total += 1
+                return None
+            if self._waiting >= self.max_waiting:
+                self._drop_client(client)
+                return self._shed("queue_full")
+            ticket = object()
+            self._fifo.append(ticket)
+            self._waiting += 1
+            deadline = time.monotonic() + self.wait_timeout
+            try:
+                while (self._inflight >= self.max_inflight
+                       or self._fifo[0] is not ticket):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        self._drop_client(client)
+                        return self._shed("queue_timeout")
+                    self._cond.wait(left)
+                self._inflight += 1
+                self.admitted_total += 1
+                return None
+            finally:
+                self._waiting -= 1
+                try:
+                    self._fifo.remove(ticket)
+                except ValueError:
+                    pass
+                # whether admitted or timed out, the head may have moved:
+                # wake everyone so the new head rechecks (bounded by
+                # max_waiting, so notify_all stays cheap)
+                self._cond.notify_all()
+
+    def release(self, client: str) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._drop_client(client)
+            self._cond.notify_all()
+
+    def describe(self) -> dict:
+        with self._cond:
+            return {
+                "inflight": self._inflight,
+                "waiting": self._waiting,
+                "maxInflight": self.max_inflight,
+                "maxWaiting": self.max_waiting,
+                "perClient": self.per_client,
+                "admittedTotal": self.admitted_total,
+                "shedTotal": self.shed_total,
+                "shedByReason": dict(self.shed_by_reason),
+            }
+
+
+class _WrappingRouter:
+    """Registration facade used by App._router(): every mutating method
+    (POST/PATCH/DELETE) is wrapped with the admission gate + idempotency
+    middleware at add() time, so no mutating route can forget it."""
+
+    MUTATING = ("POST", "PATCH", "DELETE")
+
+    def __init__(self, router: Router, app: "App"):
+        self._router = router
+        self._app = app
+
+    def add(self, method: str, pattern: str, handler) -> None:
+        if method.upper() in self.MUTATING:
+            handler = self._app._mutating(handler)
+        self._router.add(method, pattern, handler)
 
 
 class App:
@@ -59,9 +205,40 @@ class App:
                  supervise: bool = False,
                  guard_backend: bool = False,
                  health_interval: float = 0.0,
-                 auto_cordon: bool = True):
+                 auto_cordon: bool = True,
+                 max_inflight_mutations: Optional[int] = None,
+                 mutation_queue_depth: Optional[int] = None,
+                 per_client_mutations: Optional[int] = None,
+                 mutation_wait_timeout: float = 10.0,
+                 idem_ttl: Optional[float] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
+
+        def _env_int(name: str, given: Optional[int], default: int) -> int:
+            if given is not None:
+                return given
+            try:
+                return int(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        # admission control for mutating routes: shed with 429 before any
+        # grant is taken instead of queueing unboundedly (MutationGate)
+        self.gate = MutationGate(
+            max_inflight=_env_int("TDAPI_MAX_INFLIGHT_MUTATIONS",
+                                  max_inflight_mutations, 32),
+            max_waiting=_env_int("TDAPI_MUTATION_QUEUE_DEPTH",
+                                 mutation_queue_depth, 64),
+            per_client=_env_int("TDAPI_PER_CLIENT_MUTATIONS",
+                                per_client_mutations, 0) or None,
+            wait_timeout=mutation_wait_timeout)
+        if idem_ttl is None:
+            try:
+                idem_ttl = float(os.environ.get("TDAPI_IDEM_TTL", "") or
+                                 idem.DEFAULT_TTL)
+            except ValueError:
+                idem_ttl = idem.DEFAULT_TTL
+        self._idem_ttl = idem_ttl
         # WAL maintenance trigger: when the record count crosses this,
         # compact + rewrite (0 disables). The reference leans on an external
         # etcd's auto-compaction — which its revision walker then breaks
@@ -125,6 +302,10 @@ class App:
         xla_cache = os.path.abspath(os.path.join(state_dir, "xla-cache"))
         os.makedirs(xla_cache, exist_ok=True)
         self.intents = IntentJournal(self.client)
+        # exactly-once mutation replay: keyed requests persist their
+        # result here; duplicates get the stored response (idempotency.py)
+        self.idempotency = IdempotencyCache(self.client, ttl=self._idem_ttl)
+        self.intents.idempotency = self.idempotency
         self.replicasets = ReplicaSetService(
             self.backend, self.client, self.wq, self.tpu, self.cpu, self.ports,
             self.container_versions, self.merges, xla_cache_dir=xla_cache,
@@ -139,7 +320,8 @@ class App:
             self.backend, self.client, self.wq, self.tpu, self.cpu,
             self.ports, self.container_versions, self.volume_versions,
             self.merges, self.intents, events=self.events,
-            replicasets=self.replicasets, volumes=self.volumes)
+            replicasets=self.replicasets, volumes=self.volumes,
+            idempotency=self.idempotency)
         self._reconcile_lock = threading.Lock()
         self.last_reconcile = self.reconciler.run()
         self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
@@ -148,7 +330,8 @@ class App:
     # ------------------------------------------------------------- routes
 
     def _router(self) -> Router:
-        r = Router()
+        base = Router()
+        r = _WrappingRouter(base, self)
         v1 = "/api/v1"
         r.add("GET", "/ping", lambda req: ok({"status": "pong"}))
         r.add("POST", f"{v1}/replicaSet", self.h_run)
@@ -180,7 +363,84 @@ class App:
         r.add("GET", f"{v1}/resources/gpus", self.h_res_tpus)  # legacy alias
         r.add("GET", f"{v1}/resources/cpus", self.h_res_cpus)
         r.add("GET", f"{v1}/resources/ports", self.h_res_ports)
-        return r
+        return base
+
+    # -------------------------------------- mutation middleware (tentpole)
+
+    def _mutating(self, handler):
+        """Wrap a mutating handler: admission gate first (shed with 429
+        BEFORE any grant/journal write exists), then Idempotency-Key
+        replay, then the handler."""
+        def wrapped(req: Request) -> Response:
+            # If-Match parsed ONCE here for every mutating route (the
+            # handlers read req.if_match); malformed is a client error
+            # and must not consume a gate slot
+            try:
+                req.if_match = _if_match(req)
+            except ValueError as e:
+                return err(ResCode.InvalidParams, str(e))
+            reason = self.gate.acquire(req.client_addr or "?")
+            if reason is not None:
+                self.events.record("admission.shed", target=req.path,
+                                   code=int(ResCode.TooManyRequests),
+                                   reason=reason, request_id=req.request_id)
+                return too_many(reason)
+            try:
+                return self._with_idempotency(req, handler)
+            finally:
+                self.gate.release(req.client_addr or "?")
+        return wrapped
+
+    def _with_idempotency(self, req: Request, handler) -> Response:
+        key = req.headers.get("Idempotency-Key", "").strip()
+        if not key:
+            return handler(req)
+        fp = idem.fingerprint(req.method, req.path, req.body, req.query)
+        state, rec = self.idempotency.begin(key, fp)
+        if state == idem.MISMATCH:
+            return err(ResCode.InvalidParams,
+                       "Idempotency-Key reused with a different request")
+        if state == idem.IN_FLIGHT:
+            # a live request holds this key right now: the duplicate must
+            # neither execute nor pretend an outcome — 409, retry shortly
+            return Response(ResCode.Conflict, None, http_status=409,
+                            headers={"Retry-After": "1"})
+        if state == idem.REPLAY:
+            self.events.record("idempotency.replay", target=req.path,
+                               code=rec.get("code", 200),
+                               request_id=req.request_id)
+            resp = RawResponse(rec.get("payload", "").encode(),
+                               "application/json")
+            resp.http_status = rec.get("httpStatus", 200)
+            resp.headers = dict(rec.get("headers", {}))
+            resp.headers["Idempotency-Replayed"] = "true"
+            try:
+                resp.code = ResCode(rec.get("code", 200))
+            except ValueError:
+                pass    # event log shows 200; the payload carries the code
+            return resp
+        # state == NEW: execute with the key active so intents.begin()
+        # journals it (crash recovery settles cache + state together)
+        try:
+            with idem.context(key):
+                resp = handler(req)
+        except Exception:
+            # clean unwind: the mutation did not happen — drop the claim
+            # so a retry re-executes (an InjectedCrash/BaseException skips
+            # this, exactly like a daemon death would)
+            self.idempotency.abandon(key)
+            raise
+        if int(resp.code) != 200:
+            # errors never changed state (the services unwind before
+            # returning), so a retry is always safe to re-execute — and
+            # caching one would pin a transient failure (breaker open,
+            # substrate timeout mapped to a *Failed envelope) past its
+            # recovery. Only success is replay-worthy.
+            self.idempotency.abandon(key)
+            return resp
+        self.idempotency.finish(key, int(resp.code), resp.http_status,
+                                resp.payload(), resp.headers)
+        return resp
 
     # ------------------------------------------------- replicaSet handlers
 
@@ -228,7 +488,10 @@ class App:
         if mp is not None and not valid_size_unit(mp.memory):
             return err(ResCode.ContainerMemorySizeNotSupported)
         try:
-            return ok(self.replicasets.patch_container(name, patch))
+            return ok(self.replicasets.patch_container(
+                name, patch, if_match=req.if_match))
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.NoPatchRequiredError:
             return err(ResCode.ContainerNoNeedPatch)
         except xerrors.TpuNotEnoughError:
@@ -251,7 +514,10 @@ class App:
         if version < 0:
             return err(ResCode.ContainerVersionMustBeGreaterThanOrEqualZero)
         try:
-            return ok(self.replicasets.rollback_container(name, version))
+            return ok(self.replicasets.rollback_container(
+                name, version, if_match=req.if_match))
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.NoRollbackRequiredError:
             return err(ResCode.ContainerNoNeedRollback)
         except (xerrors.NotExistInStoreError, xerrors.VersionNotFoundError):
@@ -266,8 +532,11 @@ class App:
 
     def h_stop(self, req: Request) -> Response:
         try:
-            self.replicasets.stop_container(req.params["name"])
+            self.replicasets.stop_container(req.params["name"],
+                                            if_match=req.if_match)
             return ok()
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
         except xerrors.BackendUnavailableError as e:
@@ -278,7 +547,10 @@ class App:
 
     def h_restart(self, req: Request) -> Response:
         try:
-            return ok(self.replicasets.restart_container(req.params["name"]))
+            return ok(self.replicasets.restart_container(
+                req.params["name"], if_match=req.if_match))
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
         except xerrors.TpuNotEnoughError:
@@ -357,8 +629,11 @@ class App:
 
     def h_delete(self, req: Request) -> Response:
         try:
-            self.replicasets.delete_container(req.params["name"])
+            self.replicasets.delete_container(req.params["name"],
+                                              if_match=req.if_match)
             return ok()
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.BackendUnavailableError as e:
             return unavailable(e)
         except Exception:  # noqa: BLE001
@@ -400,7 +675,10 @@ class App:
         if not valid_size_unit(size):
             return err(ResCode.VolumeSizeNotSupported)
         try:
-            return ok(self.volumes.patch_volume_size(name, size))
+            return ok(self.volumes.patch_volume_size(name, size,
+                                                     if_match=req.if_match))
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.NoPatchRequiredError:
             return err(ResCode.VolumeSizeNoNeedPatch)
         except xerrors.VolumeSizeUsedGreaterThanReducedError:
@@ -417,8 +695,11 @@ class App:
         # ?noall keeps history (reference routers/volume.go:121-127)
         try:
             self.volumes.delete_volume(req.params["name"],
-                                       keep_history=req.query_flag("noall"))
+                                       keep_history=req.query_flag("noall"),
+                                       if_match=req.if_match)
             return ok()
+        except xerrors.PreconditionFailedError as e:
+            return precondition_failed(e)
         except xerrors.BackendUnavailableError as e:
             return unavailable(e)
         except Exception:  # noqa: BLE001
@@ -571,6 +852,23 @@ class App:
             f"tdapi_chip_health_failures "
             f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",
         ]
+        gate = self.gate.describe()
+        lines += [
+            "# TYPE tdapi_mutations_inflight gauge",
+            f"tdapi_mutations_inflight {gate['inflight']}",
+            "# TYPE tdapi_mutations_waiting gauge",
+            f"tdapi_mutations_waiting {gate['waiting']}",
+            "# TYPE tdapi_mutations_admitted_total counter",
+            f"tdapi_mutations_admitted_total {gate['admittedTotal']}",
+            "# TYPE tdapi_mutations_shed_total counter",
+            "# requests answered 429 before taking any grant",
+            f"tdapi_mutations_shed_total {gate['shedTotal']}",
+            "# TYPE tdapi_idempotency_records gauge",
+            f"tdapi_idempotency_records {self.idempotency.record_count()}",
+            "# TYPE tdapi_idempotency_replays_total counter",
+            "# duplicate keyed mutations answered from the result cache",
+            f"tdapi_idempotency_replays_total {self.idempotency.replays}",
+        ]
         if isinstance(self.backend, GuardedBackend):
             brk = self.backend.breaker.describe()
             lines += [
@@ -628,6 +926,7 @@ class App:
         crosses store_maint_records."""
         from ..store.client import KEEP_HISTORY_PREFIXES
         stats = self.store.maintain(KEEP_HISTORY_PREFIXES)
+        stats["idempotencySwept"] = self.idempotency.sweep()
         log.info("store maintenance: dropped %d revisions, WAL now %d records",
                  stats["dropped"], stats["wal_records"])
         return stats

@@ -16,9 +16,15 @@ class ResCode(enum.IntEnum):
     Success = 200
     ServerBusy = 500
     Forbidden = 403
-    # substrate circuit breaker open: mutations refused fast (the ONLY
-    # envelope code that also changes the HTTP status — 503 + Retry-After)
+    # envelope codes that ALSO change the HTTP status (the deliberate
+    # exceptions to the reference's HTTP-200-always convention, so load
+    # balancers and generic clients react without parsing the envelope):
+    # 503 breaker open, 412 version precondition, 429 overload shed,
+    # 409 duplicate Idempotency-Key racing its still-executing original
     BackendUnavailable = 503
+    PreconditionFailed = 412
+    TooManyRequests = 429
+    Conflict = 409
 
     InvalidParams = 1000
     ImageNameCannotBeEmpty = 1001
@@ -73,6 +79,15 @@ _MESSAGES: dict[ResCode, str] = {
     ResCode.BackendUnavailable:
         "Substrate unavailable (circuit open) — mutations refused; "
         "retry after the interval in the Retry-After header",
+    ResCode.PreconditionFailed:
+        "Version precondition failed — the If-Match version is not the "
+        "current version (see X-Current-Version)",
+    ResCode.TooManyRequests:
+        "Too many in-flight mutations — request shed; retry after the "
+        "interval in the Retry-After header",
+    ResCode.Conflict:
+        "A request with this Idempotency-Key is still executing — retry "
+        "shortly for its stored result",
 
     ResCode.InvalidParams: "Failed to parse body",
     ResCode.ImageNameCannotBeEmpty: "Image name cannot be empty",

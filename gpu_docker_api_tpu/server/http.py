@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import re
+import socket
 import threading
 import time
 import uuid
@@ -21,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from .. import faults
 from .codes import ResCode
 
 log = logging.getLogger(__name__)
@@ -30,13 +32,19 @@ Handler = Callable[["Request"], "Response"]
 
 class Request:
     def __init__(self, method: str, path: str, query: dict[str, list[str]],
-                 body: bytes, headers: dict[str, str], params: dict[str, str]):
+                 body: bytes, headers: dict[str, str], params: dict[str, str],
+                 client_addr: str = ""):
         self.method = method
         self.path = path
         self.query = query
         self.body = body
         self.headers = headers
         self.params = params
+        # remote address — the admission gate's per-client fairness key
+        self.client_addr = client_addr
+        # version precondition, parsed once by the mutation middleware
+        # (server/app.py) from the If-Match header
+        self.if_match: Optional[int] = None
         self.request_id = uuid.uuid4().hex[:16]
 
     def json(self) -> dict:
@@ -99,6 +107,30 @@ def unavailable(e: BaseException) -> Response:
                     headers={"Retry-After": str(retry)})
 
 
+def precondition_failed(e: BaseException) -> Response:
+    """412 for a failed If-Match version check: the current version rides
+    both the payload and X-Current-Version so the client can rebase."""
+    current = int(getattr(e, "current", 0))
+    return Response(ResCode.PreconditionFailed,
+                    {"currentVersion": current}, http_status=412,
+                    headers={"X-Current-Version": str(current)})
+
+
+def too_many(reason: str = "", retry_after: float = 1.0) -> Response:
+    """429 + Retry-After: the mutation admission gate shed this request
+    before it touched any state (server/app.py MutationGate)."""
+    retry = max(1, int(round(retry_after)))
+    return Response(ResCode.TooManyRequests, None,
+                    msg=(f"{ResCode.TooManyRequests.msg} ({reason})"
+                         if reason else None),
+                    http_status=429, headers={"Retry-After": str(retry)})
+
+
+class DroppedResponse(Exception):
+    """Injected drop_response fault (faults.py): the handler executed;
+    sever the connection without writing a response byte."""
+
+
 class Router:
     """(method, /path/with/:params) -> handler."""
 
@@ -149,11 +181,20 @@ class ApiServer:
         self.api_key = api_key if api_key is not None else os.environ.get("APIKEY", "")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # graceful-drain state: stop() waits for in-flight requests to
+        # complete (instead of closing sockets under them) and then severs
+        # the remaining IDLE keep-alive connections so their handler
+        # threads unblock
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._inflight = 0
+        self._draining = False
 
     # ---- request pipeline ----
 
     def _handle(self, method: str, raw_path: str, body: bytes,
-                headers: dict[str, str]) -> tuple[int, dict[str, str], bytes]:
+                headers: dict[str, str],
+                client_addr: str = "") -> tuple[int, dict[str, str], bytes]:
         cors = {
             # reflected-origin permissive CORS (reference cors.go:12-20)
             "Access-Control-Allow-Origin": headers.get("Origin", "*"),
@@ -177,7 +218,7 @@ class ApiServer:
             return 404, cors, body_out
 
         req = Request(method, parsed.path, parse_qs(parsed.query, keep_blank_values=True),
-                      body, headers, params)
+                      body, headers, params, client_addr=client_addr)
         t0 = time.perf_counter()
         try:
             resp = handler(req)
@@ -194,6 +235,10 @@ class ApiServer:
                 code=int(resp.code),
                 duration_ms=(time.perf_counter() - t0) * 1000,
                 request_id=req.request_id)
+        # duplicate-delivery injection: the handler EXECUTED; make the
+        # client see a dead connection instead of the response
+        if faults.should_drop_response(f"{method} {parsed.path}"):
+            raise DroppedResponse()
         if isinstance(resp, RawResponse):
             cors["Content-Type"] = resp.content_type
         if resp.headers:
@@ -223,18 +268,52 @@ class ApiServer:
             def log_message(self, fmt, *args):  # route through our logger
                 log.debug("http: " + fmt, *args)
 
+            def setup(self):
+                super().setup()
+                with server._conns_lock:
+                    server._conns.add(self.connection)
+
+            def finish(self):
+                with server._conns_lock:
+                    server._conns.discard(self.connection)
+                super().finish()
+
             def _dispatch(self):
                 length = int(self.headers.get("Content-Length", 0) or 0)
                 body = self.rfile.read(length) if length else b""
-                status, hdrs, payload = server._handle(
-                    self.command, self.path, body, dict(self.headers))
-                self.send_response(status)
-                for k, v in hdrs.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                if payload:
-                    self.wfile.write(payload)
+                # in-flight accounting spans handler AND response write:
+                # stop() drains until this hits zero, so a mutation's
+                # response is never cut off mid-socket
+                with server._conns_lock:
+                    server._inflight += 1
+                try:
+                    try:
+                        status, hdrs, payload = server._handle(
+                            self.command, self.path, body, dict(self.headers),
+                            self.client_address[0])
+                    except DroppedResponse:
+                        # injected duplicate delivery: the mutation ran;
+                        # sever without writing a byte
+                        self.close_connection = True
+                        try:
+                            self.connection.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        return
+                    if server._draining:
+                        hdrs = dict(hdrs)
+                        hdrs["Connection"] = "close"
+                        self.close_connection = True
+                    self.send_response(status)
+                    for k, v in hdrs.items():
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    if payload:
+                        self.wfile.write(payload)
+                finally:
+                    with server._conns_lock:
+                        server._inflight -= 1
 
             do_GET = do_POST = do_PATCH = do_DELETE = do_OPTIONS = _dispatch
 
@@ -257,11 +336,38 @@ class ApiServer:
             name="api-server", daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Graceful shutdown: stop accepting, DRAIN in-flight requests to
+        completion (a client mid-mutation gets its response, not a reset),
+        then sever the remaining idle keep-alive sockets so their handler
+        threads unblock instead of sitting out the 120s idle timeout."""
         if self._httpd is not None:
-            self._httpd.shutdown()
+            self._draining = True
+            self._httpd.shutdown()      # accept loop stops; workers keep going
+            deadline = time.monotonic() + max(0.0, drain_timeout)
+            clear_streak = 0
+            while time.monotonic() < deadline:
+                with self._conns_lock:
+                    busy = self._inflight
+                if busy == 0:
+                    # two consecutive clear reads: a request accepted just
+                    # before shutdown() may not have entered _dispatch yet
+                    clear_streak += 1
+                    if clear_streak >= 2:
+                        break
+                else:
+                    clear_streak = 0
+                time.sleep(0.02)
+            with self._conns_lock:
+                idle = list(self._conns)
+            for conn in idle:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
             self._httpd.server_close()
             self._httpd = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._draining = False

@@ -174,7 +174,7 @@ class ReplicaSetService:
                 self.cpu.restore(spec.cpuset, name)
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             return self._run_response(info)
 
     def _inject_xla_cache(self, spec: ContainerSpec) -> None:
@@ -262,12 +262,19 @@ class ReplicaSetService:
 
     # ---------------------------------------------------------------- patch
 
-    def patch_container(self, name: str, req: PatchRequest) -> dict:
-        """PATCH /replicaSet/{name} (reference PatchContainer :267-363)."""
+    def patch_container(self, name: str, req: PatchRequest,
+                        if_match: Optional[int] = None) -> dict:
+        """PATCH /replicaSet/{name} (reference PatchContainer :267-363).
+
+        if_match: optional version precondition, checked under the name
+        lock BEFORE any grant — a concurrent mutation that bumped the
+        version makes this request lose with PreconditionFailedError
+        (HTTP 412) instead of silently last-write-winning."""
         if req.empty:
             raise xerrors.NoPatchRequiredError(name)
         with self._mutex(name):
             old = self._stored_info(name)
+            xerrors.PreconditionFailedError.check(name, old.version, if_match)
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             changed = False
             intent = self.intents.begin(
@@ -292,7 +299,7 @@ class ReplicaSetService:
                 self._free_new_grants(name, new_spec, old.spec)
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             return self._run_response(info)
 
     def _patch_tpu(self, name: str, spec: ContainerSpec,
@@ -439,13 +446,16 @@ class ReplicaSetService:
 
     # ------------------------------------------------------------- rollback
 
-    def rollback_container(self, name: str, version: int) -> dict:
+    def rollback_container(self, name: str, version: int,
+                           if_match: Optional[int] = None) -> dict:
         """PATCH /replicaSet/{name}/rollback (reference :365-446): forward-
-        write a new version with the historical config."""
+        write a new version with the historical config. if_match guards
+        the CURRENT version (the one being rolled away from)."""
         with self._mutex(name):
             current = self.versions.get(name)
             if current is None:
                 raise xerrors.NotExistInStoreError(name)
+            xerrors.PreconditionFailedError.check(name, current, if_match)
             if current == version:
                 raise xerrors.NoRollbackRequiredError(name)
             self.wq.join()  # per-version keys are write-behind; drain first
@@ -476,7 +486,7 @@ class ReplicaSetService:
                 self._free_new_grants(name, target_spec, old.spec)
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             return self._run_response(info)
 
     # ---------------------------------------------------------------- drain
@@ -515,10 +525,15 @@ class ReplicaSetService:
                     result["skipped"].append(name)
                     continue
                 new_spec = ContainerSpec.from_json(old.spec.to_json())
+                # idemPartial: one drain request journals one intent PER
+                # replicaSet, so no single intent's completion means the
+                # REQUEST completed — a crash mid-drain must re-execute
+                # the keyed retry (re-drain skips already-migrated sets),
+                # never finalize the key as a fabricated full success
                 intent = self.intents.begin(
                     "replace", name, via="drain", oldVersion=old.version,
                     oldContainer=old.containerName,
-                    oldReleased=old.resourcesReleased)
+                    oldReleased=old.resourcesReleased, idemPartial=True)
                 try:
                     self._grant_tpus(new_spec, self.tpu.apply(
                         len(old.spec.tpu_chips), name,
@@ -547,13 +562,15 @@ class ReplicaSetService:
 
     # ---------------------------------------------------- stop / restart etc
 
-    def stop_container(self, name: str) -> None:
+    def stop_container(self, name: str,
+                       if_match: Optional[int] = None) -> None:
         """PATCH /replicaSet/{name}/stop (reference :582-639): resources are
         released; container stays stopped. Idempotent: the release is
         recorded, so a second stop cannot double-free (reference bug —
         replicaset.go:630-635 Restores again on its error path)."""
         with self._mutex(name):
             info = self._stored_info(name)
+            xerrors.PreconditionFailedError.check(name, info.version, if_match)
             intent = self.intents.begin("stop", name,
                                         container=info.containerName,
                                         released=info.resourcesReleased)
@@ -562,7 +579,7 @@ class ReplicaSetService:
                 intent.step("stopped", sync=False)
                 crashpoint("stop.after_backend_stop")
                 if info.resourcesReleased:
-                    intent.done()
+                    intent.done(committed=True)
                     return
                 spec = info.spec
                 self.tpu.restore(spec.tpu_chips, name)
@@ -575,13 +592,15 @@ class ReplicaSetService:
             except Exception:
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
 
-    def restart_container(self, name: str) -> dict:
+    def restart_container(self, name: str,
+                          if_match: Optional[int] = None) -> dict:
         """PATCH /replicaSet/{name}/restart (reference :736-864): a restart
         is a NEW VERSION with freshly applied resources, not docker restart."""
         with self._mutex(name):
             old = self._stored_info(name)
+            xerrors.PreconditionFailedError.check(name, old.version, if_match)
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             fresh_tpu: list[int] = []
             fresh_cpu = ""
@@ -611,7 +630,7 @@ class ReplicaSetService:
                 self.cpu.restore(fresh_cpu, name)
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             return self._run_response(info)
 
     def pause_container(self, name: str) -> None:
@@ -685,7 +704,8 @@ class ReplicaSetService:
 
     # --------------------------------------------------------------- delete
 
-    def delete_container(self, name: str) -> None:
+    def delete_container(self, name: str,
+                         if_match: Optional[int] = None) -> None:
         """DELETE /replicaSet/{name} (reference :157-223): remove container,
         release resources, drop ALL state + history. Resources are released
         whenever this replicaSet still holds them — including containers
@@ -696,6 +716,8 @@ class ReplicaSetService:
                 info = self._stored_info(name)
             except xerrors.NotExistInStoreError:
                 info = None
+            xerrors.PreconditionFailedError.check(
+                name, info.version if info else 0, if_match)
             intent = self.intents.begin(
                 "delete", name,
                 container=info.containerName if info else "",
@@ -723,7 +745,7 @@ class ReplicaSetService:
             except Exception:
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             # the name is gone: drop its mutex entry (unbounded-growth fix;
             # safe here because we still hold the lock — see _mutex)
             self._drop_mutex(name)

@@ -70,7 +70,7 @@ class VolumeService:
             except Exception:
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
             return out
 
     def _create_version(self, name: str, size: str, tier: str = "",
@@ -105,11 +105,14 @@ class VolumeService:
 
     # ---- patch (scale) ----
 
-    def patch_volume_size(self, name: str, size: str) -> dict:
+    def patch_volume_size(self, name: str, size: str,
+                          if_match: Optional[int] = None) -> dict:
         """PATCH /volumes/{name}/size (reference PatchVolumeSize :98-170):
-        create `{name}-{v+1}` at the new size, migrate data, repoint."""
+        create `{name}-{v+1}` at the new size, migrate data, repoint.
+        if_match: version precondition under the name lock (HTTP 412)."""
         with self._mutex(name):
             info = self._stored_info(name)
+            xerrors.PreconditionFailedError.check(name, info.version, if_match)
             new_bytes = to_bytes(size)
             old_bytes = to_bytes(info.size) if info.size else 0
             if new_bytes == old_bytes:
@@ -167,12 +170,13 @@ class VolumeService:
                     log.exception("removing old volume %s", info.volumeName)
             # else: reference behavior — old volume intentionally kept
             # (volume.go:155-159); GC is the operator's call
-            intent.done()
+            intent.done(committed=True)
             return out
 
     # ---- delete / info / history ----
 
-    def delete_volume(self, name: str, keep_history: bool = False) -> None:
+    def delete_volume(self, name: str, keep_history: bool = False,
+                      if_match: Optional[int] = None) -> None:
         """DELETE /volumes/{name} (reference :174-199). keep_history mirrors
         the `?noall` toggle (routers/volume.go:121-127)."""
         with self._mutex(name):
@@ -180,6 +184,8 @@ class VolumeService:
                 info = self._stored_info(name)
             except xerrors.NotExistInStoreError:
                 info = None
+            xerrors.PreconditionFailedError.check(
+                name, info.version if info else 0, if_match)
             intent = self.intents.begin(
                 "volume.delete", name, kind=KIND_VOLUME,
                 volume=info.volumeName if info else "",
@@ -206,7 +212,7 @@ class VolumeService:
             except Exception:
                 intent.done()
                 raise
-            intent.done()
+            intent.done(committed=True)
 
     def get_volume_info(self, name: str) -> dict:
         info = self._stored_info(name)

@@ -73,6 +73,28 @@ class BackendUnavailableError(XError):
         self.retry_after = retry_after
 
 
+class PreconditionFailedError(XError):
+    """An `If-Match: <version>` precondition did not hold: the target's
+    current version differs from the one the client based its mutation on
+    (a concurrent mutation won the race). Checked under the per-name
+    mutation mutex, so the losing request never takes a grant; routes map
+    it to HTTP 412 with the current version in `X-Current-Version`."""
+
+    sentinel = "version precondition failed"
+
+    def __init__(self, detail: str = "", current: int = 0):
+        super().__init__(detail)
+        self.current = current
+
+    @classmethod
+    def check(cls, name: str, current: "int | None",
+              if_match: "int | None") -> None:
+        """Raise unless `if_match` is unset or equals the current version."""
+        if if_match is not None and if_match != (current or 0):
+            raise cls(f"{name}: If-Match {if_match} != current "
+                      f"{current or 0}", current=current or 0)
+
+
 class BackendTimeoutError(XError):
     """A backend call overran its per-op deadline (GuardedBackend). Treated
     as transient: retried with backoff, counted by the circuit breaker."""

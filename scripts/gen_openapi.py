@@ -110,6 +110,47 @@ NAME_PARAM = {"name": "name", "in": "path", "required": True,
               "description": "replicaSet / volume base name (unversioned; "
                              "must not contain '-')"}
 
+# Attached to EVERY mutating operation (post-processing in build_spec):
+# exactly-once retry semantics (server/app.py middleware + idempotency.py)
+IDEM_PARAM = {
+    "name": "Idempotency-Key", "in": "header", "required": False,
+    "schema": {"type": "string"},
+    "description": "Client-chosen key making this mutation safe to "
+                   "retry: the server persists the response and replays "
+                   "it on duplicates (Idempotency-Replayed: true) "
+                   "instead of re-executing — across daemon crashes too "
+                   "(the boot reconciler settles the cache together with "
+                   "the interrupted mutation). Reusing a key with a "
+                   "different request is rejected (envelope code 1000); "
+                   "a duplicate racing the original answers HTTP 409 + "
+                   "Retry-After."}
+
+# Attached to the version-guarded mutations (IF_MATCH_OPS below)
+IF_MATCH_PARAM = {
+    "name": "If-Match", "in": "header", "required": False,
+    "schema": {"type": "integer", "minimum": 0},
+    "description": "Optimistic-concurrency precondition: the mutation "
+                   "only proceeds if the target's current version equals "
+                   "this value (checked under the per-name mutation "
+                   "lock). On mismatch: HTTP 412, envelope code 412, "
+                   "current version in X-Current-Version and "
+                   "data.currentVersion."}
+
+IF_MATCH_OPS = {"patchReplicaSet", "rollbackReplicaSet", "stopReplicaSet",
+                "restartReplicaSet", "deleteReplicaSet", "patchVolumeSize",
+                "deleteVolume"}
+
+RESP_429 = {"description":
+            "Shed by the mutation admission gate before any state was "
+            "touched (envelope code 429) — too many in-flight mutations; "
+            "retry after the Retry-After header."}
+RESP_412 = {"description":
+            "If-Match version precondition failed (envelope code 412); "
+            "X-Current-Version carries the current version."}
+RESP_409 = {"description":
+            "A request with the same Idempotency-Key is currently "
+            "executing; retry shortly for its stored result."}
+
 CHIP_PARAM = {"name": "id", "in": "path", "required": True,
               "schema": {"type": "integer", "minimum": 0},
               "description": "Global chip index (see /resources/tpus)"}
@@ -382,6 +423,14 @@ def build_spec() -> dict:
              "orphanVolumesRemoved": arr(s()),
              "volumesMigrated": i(),
              "droppedReplayed": i(),
+             "idempotency": obj(
+                 {"finalized": i("in_progress records whose intent "
+                                 "rolled forward (retries replay)"),
+                  "dropped": i("records of unwound/never-started "
+                               "mutations (retries re-execute)"),
+                  "expired": i("TTL-expired records swept")},
+                 desc="Idempotency-cache settlement (idempotency.py "
+                      "reconcile_boot)"),
              "actions": i("Total corrective actions; 0 = clean boot")},
             desc="Boot-time crash-recovery report (reconcile.py)"),
     }
@@ -564,26 +613,46 @@ def build_spec() -> dict:
             tags=["meta"])},
     }
 
+    # every mutating operation gets the exactly-once surface: the
+    # Idempotency-Key header, the 429 shed response, and (for mutations of
+    # a named, versioned resource) the If-Match precondition + 412
+    for path_item in paths.values():
+        for method, o in path_item.items():
+            if method not in ("post", "patch", "delete"):
+                continue
+            o.setdefault("parameters", []).append(dict(IDEM_PARAM))
+            o["responses"]["429"] = dict(RESP_429)
+            o["responses"]["409"] = dict(RESP_409)
+            if o["operationId"] in IF_MATCH_OPS:
+                o["parameters"].append(dict(IF_MATCH_PARAM))
+                o["responses"]["412"] = dict(RESP_412)
+
     return {
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.5.0",
+            "version": "0.6.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "
                 "api/gpu-docker-api-en.openapi.json) with the NVIDIA "
                 "substrate replaced by an ICI-topology-aware TPU chip "
                 "allocator. Every response is HTTP 200 with an envelope "
-                "{code, msg, data} — with ONE exception: when the "
-                "substrate circuit breaker is open, mutating endpoints "
-                "answer HTTP 503 with a Retry-After header (envelope "
-                "code 503) while reads keep serving from the state "
-                "store (degraded read-only mode). Authentication: "
-                "optional static bearer token (APIKEY env) via the "
-                "Authorization header; 403 envelope when it mismatches. "
-                "Generated by scripts/gen_openapi.py — do not edit by "
-                "hand.",
+                "{code, msg, data} — with these exceptions (chosen so "
+                "load balancers and generic clients react without "
+                "parsing the envelope): 503 + Retry-After when the "
+                "substrate circuit breaker is open (reads keep serving "
+                "from the state store in degraded read-only mode), 412 "
+                "when an If-Match version precondition fails, 429 + "
+                "Retry-After when the mutation admission gate sheds "
+                "under overload, and 409 when a duplicate "
+                "Idempotency-Key races its original. Mutations are "
+                "exactly-once under retry when stamped with an "
+                "Idempotency-Key header (see that parameter). "
+                "Authentication: optional static bearer token (APIKEY "
+                "env) via the Authorization header; 403 envelope when "
+                "it mismatches. Generated by scripts/gen_openapi.py — "
+                "do not edit by hand.",
         },
         "servers": [{"url": "http://localhost:2378"}],
         "tags": [{"name": "replicaSet"}, {"name": "volume"},

@@ -1,0 +1,890 @@
+"""Exactly-once mutation sweep (`make verify-retry`).
+
+Every mutating endpoint is driven under the three delivery hazards the
+tentpole defends against:
+
+1. **duplicate key** — the same request sent twice under one
+   `Idempotency-Key` must produce exactly one state change (store
+   revision and version maps unchanged by the duplicate; the duplicate
+   gets the stored response with `Idempotency-Replayed: true`);
+2. **dropped response** — the server executes but the client sees a
+   connection error (faults.py `drop_response`); the keyed retry must
+   replay, not re-execute;
+3. **overload** — a gate forced full must shed the request with HTTP 429
+   + Retry-After and exactly ZERO state change.
+
+Plus: crash-between-attempts through the crashpoint harness (the boot
+reconciler settles the result cache together with the interrupted
+mutation), If-Match races (exactly one winner, the loser gets 412 and no
+grant), graceful drain, TTL sweeping, and the client-side satellites
+(close() across threads, retry/replay stats).
+
+Invariants after every case mirror the crash/fault sweeps: scheduler
+bitmaps == non-released stored specs, no open intents, reconcile
+fixpoint.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import faults, xerrors
+from gpu_docker_api_tpu.client import ApiClient, ApiError
+from gpu_docker_api_tpu.dtos import StoredContainerInfo
+from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.server.app import App, MutationGate
+from gpu_docker_api_tpu.server.http import Request
+from gpu_docker_api_tpu.topology import make_topology
+
+pytestmark = pytest.mark.retry
+
+N_CHIPS = 16      # v4-32 single host
+N_CORES = 16
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_all()
+    faults.disarm_faults()
+    yield
+    faults.disarm_all()
+    faults.disarm_faults()
+
+
+def make_app(tmp_path, **kw):
+    kw.setdefault("backend", "mock")
+    kw.setdefault("topology", make_topology("v4-32"))
+    return App(state_dir=str(tmp_path / "state"), addr="127.0.0.1:0",
+               port_range=(47000, 47100), api_key="", cpu_cores=N_CORES,
+               store_maint_records=0, **kw)
+
+
+def call(app, method, path, body=None, headers=None, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=timeout)
+    payload = json.dumps(body) if body is not None else None
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request(method, path, payload, hdrs)
+    resp = conn.getresponse()
+    raw = resp.read()
+    out_headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, out_headers, json.loads(raw) if raw else None
+
+
+def direct(app, method, path, body=None, headers=None):
+    """Drive the full middleware chain (gate -> idempotency -> handler)
+    without HTTP — for crash cases, where the handler thread 'dies' with
+    InjectedCrash and a socket would just add teardown noise."""
+    handler, params = app.server.router.resolve(method, path)
+    assert handler is not None, (method, path)
+    req = Request(method, path, {},
+                  json.dumps(body).encode() if body is not None else b"",
+                  dict(headers or {}), params, client_addr="test")
+    return handler(req)
+
+
+# ------------------------------------------------------------ invariants
+
+def stored_containers(app):
+    app.wq.join()
+    return {kv.key.rsplit("/", 1)[1]: StoredContainerInfo.deserialize(kv.value)
+            for kv in app.client.range("containers")}
+
+
+def assert_no_leaks(app):
+    stored = stored_containers(app)
+    exp_tpu, exp_cpu, exp_ports = {}, {}, {}
+    for name, info in stored.items():
+        if info.resourcesReleased:
+            continue
+        for c in info.spec.tpu_chips:
+            exp_tpu[c] = name
+        for c in app.cpu._cores(info.spec.cpuset):
+            exp_cpu[c] = name
+        for p in info.spec.port_bindings.values():
+            exp_ports[int(p)] = name
+    assert {i: o for i, o in app.tpu.status.items()
+            if o not in (None, "")} == exp_tpu
+    assert {i: o for i, o in app.cpu.status.items()
+            if o not in (None, "")} == exp_cpu
+    assert dict(app.ports.used) == exp_ports
+    assert app.intents.open_intents() == []
+    settle = app.reconciler.run()
+    assert sum(settle["grantsFreed"].values()) == 0, settle
+    assert sum(settle["grantsRemarked"].values()) == 0, settle
+    rerun = app.reconciler.run()
+    assert rerun["actions"] == 0, f"re-reconcile not a no-op: {rerun}"
+    return stored
+
+
+# ------------------------------------------------------- endpoint table
+
+def setup_demo(app):
+    app.replicasets.run_container(__import__(
+        "gpu_docker_api_tpu.dtos", fromlist=["ContainerRun"]).ContainerRun(
+        imageName="img", replicaSetName="demo", tpuCount=2, cpuCount=2,
+        containerPorts=["8888"]))
+
+
+def setup_demo_v2(app):
+    from gpu_docker_api_tpu.dtos import PatchRequest, TpuPatch
+    setup_demo(app)
+    app.replicasets.patch_container(
+        "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+
+
+def setup_vol(app):
+    app.volumes.create_volume("vol", "16MB")
+
+
+def setup_cordoned(app):
+    setup_demo(app)
+    chips = stored_containers(app)["demo"].spec.tpu_chips
+    app.tpu.cordon([chips[0]])
+
+
+# every mutating endpoint: (id, setup, method, path, body)
+ENDPOINTS = [
+    ("run", None, "POST", "/api/v1/replicaSet",
+     {"imageName": "i", "replicaSetName": "fresh", "tpuCount": 1,
+      "cpuCount": 1, "containerPorts": ["80"]}),
+    ("patch", setup_demo, "PATCH", "/api/v1/replicaSet/demo",
+     {"tpuPatch": {"tpuCount": 4}}),
+    ("rollback", setup_demo_v2, "PATCH",
+     "/api/v1/replicaSet/demo/rollback", {"version": 1}),
+    ("stop", setup_demo, "PATCH", "/api/v1/replicaSet/demo/stop", None),
+    ("restart", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo/restart", None),
+    ("pause", setup_demo, "PATCH", "/api/v1/replicaSet/demo/pause", None),
+    ("continue", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo/continue", None),
+    ("execute", setup_demo, "POST", "/api/v1/replicaSet/demo/execute",
+     {"cmd": ["echo", "hi"]}),
+    ("commit", setup_demo, "POST", "/api/v1/replicaSet/demo/commit",
+     {"newImageName": "snap:v1"}),
+    ("delete", setup_demo, "DELETE", "/api/v1/replicaSet/demo", None),
+    ("volCreate", None, "POST", "/api/v1/volumes",
+     {"name": "vol", "size": "16MB"}),
+    ("volPatch", setup_vol, "PATCH", "/api/v1/volumes/vol/size",
+     {"size": "32MB"}),
+    ("volDelete", setup_vol, "DELETE", "/api/v1/volumes/vol", None),
+    ("cordon", None, "POST", "/api/v1/tpus/0/cordon", None),
+    ("uncordon", None, "POST", "/api/v1/tpus/0/uncordon", None),
+    ("drain", setup_cordoned, "POST", "/api/v1/tpus/drain", None),
+]
+
+IDS = [e[0] for e in ENDPOINTS]
+
+
+def _state_fingerprint(app):
+    """Everything a duplicate must not change: store revision, version
+    maps, scheduler ownership."""
+    app.wq.join()
+    return (app.store.revision,
+            app.container_versions.items(), app.volume_versions.items(),
+            dict(app.tpu.status), dict(app.cpu.status),
+            dict(app.ports.used))
+
+
+@pytest.mark.parametrize("ep,setup,method,path,body", ENDPOINTS, ids=IDS)
+def test_duplicate_key_sweep(ep, setup, method, path, body, tmp_path):
+    """Acceptance: the same mutation delivered twice under one key
+    produces exactly one state change and one version bump; the duplicate
+    replays the stored response byte-for-byte."""
+    app = make_app(tmp_path)
+    if setup is not None:
+        setup(app)
+    app.start()
+    try:
+        key = f"dup-{ep}"
+        status1, hdrs1, out1 = call(app, method, path, body,
+                                    headers={"Idempotency-Key": key})
+        assert out1["code"] == 200, (ep, out1)
+        assert "Idempotency-Replayed" not in hdrs1
+        fp = _state_fingerprint(app)
+        status2, hdrs2, out2 = call(app, method, path, body,
+                                    headers={"Idempotency-Key": key})
+        assert hdrs2.get("Idempotency-Replayed") == "true", (ep, hdrs2)
+        assert status2 == status1 and out2 == out1, ep
+        assert _state_fingerprint(app) == fp, \
+            f"{ep}: duplicate changed state"
+        # key reused with a DIFFERENT request: rejected, still no change
+        _, _, out3 = call(app, "POST", "/api/v1/replicaSet",
+                          {"imageName": "i", "replicaSetName": "other"},
+                          headers={"Idempotency-Key": key})
+        assert out3["code"] == 1000, out3
+        assert _state_fingerprint(app) == fp
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+@pytest.mark.parametrize("ep,setup,method,path,body", ENDPOINTS, ids=IDS)
+def test_dropped_response_sweep(ep, setup, method, path, body, tmp_path):
+    """Acceptance: the server executes but the response never arrives
+    (injected drop_response). The keyed retry replays the stored outcome —
+    the mutation lands exactly once."""
+    app = make_app(tmp_path)
+    if setup is not None:
+        setup(app)
+    app.start()
+    try:
+        key = f"drop-{ep}"
+        faults.arm_fault(f"{method} {path}:drop_response")
+        with pytest.raises((ConnectionError, http.client.HTTPException,
+                            OSError)):
+            call(app, method, path, body,
+                 headers={"Idempotency-Key": key})
+        faults.disarm_faults()
+        fp = _state_fingerprint(app)   # the mutation DID happen
+        status, hdrs, out = call(app, method, path, body,
+                                 headers={"Idempotency-Key": key})
+        assert out["code"] == 200, (ep, out)
+        assert hdrs.get("Idempotency-Replayed") == "true", ep
+        assert _state_fingerprint(app) == fp, \
+            f"{ep}: retry after dropped response re-executed"
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+@pytest.mark.parametrize("ep,setup,method,path,body", ENDPOINTS, ids=IDS)
+def test_overload_shed_sweep(ep, setup, method, path, body, tmp_path):
+    """Acceptance: with the gate full, every mutating endpoint sheds with
+    429 + Retry-After BEFORE touching any state."""
+    app = make_app(tmp_path)
+    if setup is not None:
+        setup(app)
+    app.start()
+    try:
+        fp = _state_fingerprint(app)
+        # fill the gate from a fake foreign client so the request under
+        # test is shed at the semaphore, not the per-client cap
+        app.gate.max_inflight = 1
+        app.gate.max_waiting = 0
+        assert app.gate.acquire("hog") is None
+        try:
+            status, hdrs, out = call(app, method, path, body)
+            assert status == 429, (ep, status, out)
+            assert out["code"] == 429, (ep, out)
+            assert int(hdrs["Retry-After"]) >= 1, ep
+            assert _state_fingerprint(app) == fp, \
+                f"{ep}: shed request touched state"
+        finally:
+            app.gate.release("hog")
+        # gate free again: the same request goes through
+        _, _, out = call(app, method, path, body)
+        assert out["code"] == 200, (ep, out)
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+# ------------------------------------------------- crash between attempts
+
+# crashpoint -> (setup, method, path, body, expectation after retry)
+# "replay": the intent rolled FORWARD at boot — the retry must replay,
+# not re-execute. "reexecute": the intent was unwound — the retry is a
+# fresh execution and must succeed against the restored state.
+CRASH_CASES = [
+    ("run.after_grant", None, "POST", "/api/v1/replicaSet",
+     {"imageName": "i", "replicaSetName": "fresh", "tpuCount": 2},
+     "reexecute"),
+    ("run.after_start", None, "POST", "/api/v1/replicaSet",
+     {"imageName": "i", "replicaSetName": "fresh", "tpuCount": 2},
+     "reexecute"),
+    # pre-'created' crashes: NOTHING committed — finalizing these as
+    # success would fabricate a mutation that never happened
+    ("rollback.after_grant", setup_demo_v2, "PATCH",
+     "/api/v1/replicaSet/demo/rollback", {"version": 1}, "reexecute"),
+    ("restart.after_grant", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo/restart", None, "reexecute"),
+    ("replace.after_create", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo", {"tpuPatch": {"tpuCount": 4}}, "replay"),
+    ("replace.after_copy", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo", {"tpuPatch": {"tpuCount": 4}}, "replay"),
+    ("replace.after_start_new", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo", {"tpuPatch": {"tpuCount": 4}}, "replay"),
+    ("stop.after_backend_stop", setup_demo, "PATCH",
+     "/api/v1/replicaSet/demo/stop", None, "replay"),
+    ("delete.after_remove", setup_demo, "DELETE",
+     "/api/v1/replicaSet/demo", None, "replay"),
+]
+
+
+@pytest.mark.parametrize("cp,setup,method,path,body,expect", CRASH_CASES,
+                         ids=[c[0] for c in CRASH_CASES])
+def test_crash_between_attempts(cp, setup, method, path, body, expect,
+                                tmp_path):
+    """Acceptance: attempt 1 dies at a crashpoint (client saw nothing);
+    the daemon reboots; attempt 2 arrives with the same key. The boot
+    reconciler settled BOTH the mutation and its cache entry, so the key
+    observes exactly one state change either way."""
+    app = make_app(tmp_path)
+    if setup is not None:
+        setup(app)
+    key = f"crash-{cp}"
+    faults.arm(cp)
+    with pytest.raises(InjectedCrash):
+        direct(app, method, path, body, headers={"Idempotency-Key": key})
+    faults.disarm_all()
+    # abandon like a daemon death (test_crash_recovery protocol)
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    resp = direct(app2, method, path, body,
+                  headers={"Idempotency-Key": key})
+    payload = json.loads(resp.payload())
+    assert payload["code"] == 200, (cp, payload)
+    if expect == "replay":
+        assert resp.headers.get("Idempotency-Replayed") == "true", cp
+    else:
+        assert "Idempotency-Replayed" not in resp.headers, cp
+    stored = assert_no_leaks(app2)
+    if cp.startswith("run."):
+        # exactly one run: version 1, not 2
+        assert stored["fresh"].version == 1
+    elif cp == "rollback.after_grant":
+        # re-executed rollback: exactly one new version on top of v2
+        assert stored["demo"].version == 3
+        assert len(stored["demo"].spec.tpu_chips) == 2   # v1's count
+    elif cp == "restart.after_grant":
+        assert stored["demo"].version == 2
+    elif cp.startswith("replace."):
+        # exactly one replace: version 2, linear history [2, 1]
+        assert stored["demo"].version == 2
+        assert len(stored["demo"].spec.tpu_chips) == 4
+        versions = [v for v, _ in
+                    app2.client.entity_versions("containers", "demo")]
+        assert versions == [1, 2]
+    elif cp.startswith("stop."):
+        assert stored["demo"].resourcesReleased
+    elif cp.startswith("delete."):
+        assert "demo" not in stored
+
+
+def test_crash_after_commit_before_response_store(tmp_path):
+    """The nastiest window: the service COMMITTED (intent.done ran) but
+    the daemon died before the middleware stored the response. The
+    executed marker — written before the intent key cleared — makes the
+    boot reconciler finalize the key, so the retry replays instead of
+    double-applying."""
+    from gpu_docker_api_tpu import idempotency as idem_mod
+    from gpu_docker_api_tpu.dtos import PatchRequest, TpuPatch
+
+    app = make_app(tmp_path)
+    setup_demo(app)
+    key = "late-crash"
+    body = json.dumps({"tpuPatch": {"tpuCount": 4}}).encode()
+    fp = idem_mod.fingerprint("PATCH", "/api/v1/replicaSet/demo", body, {})
+    state, _ = app.idempotency.begin(key, fp)
+    assert state == idem_mod.NEW
+    with idem_mod.context(key):
+        app.replicasets.patch_container(
+            "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+    # daemon dies HERE: response never stored (no finish() call)
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    assert app2.last_reconcile["idempotency"]["finalized"] == 1
+    resp = direct(app2, "PATCH", "/api/v1/replicaSet/demo",
+                  {"tpuPatch": {"tpuCount": 4}},
+                  headers={"Idempotency-Key": key})
+    assert json.loads(resp.payload())["code"] == 200
+    assert resp.headers.get("Idempotency-Replayed") == "true"
+    stored = assert_no_leaks(app2)
+    assert stored["demo"].version == 2      # exactly ONE bump, not two
+    versions = [v for v, _ in
+                app2.client.entity_versions("containers", "demo")]
+    assert versions == [1, 2]
+
+
+def test_crash_mid_drain_keyed_retry_reexecutes(tmp_path):
+    """Drain journals one intent PER replicaSet: completing one migration
+    must not finalize the whole keyed request as success — the retry
+    re-executes and finishes the remaining migrations."""
+    from gpu_docker_api_tpu.dtos import ContainerRun
+
+    app = make_app(tmp_path)
+    for name in ("aa", "bb"):
+        app.replicasets.run_container(ContainerRun(
+            imageName="img", replicaSetName=name, tpuCount=2))
+    stored = stored_containers(app)
+    app.tpu.cordon([stored["aa"].spec.tpu_chips[0],
+                    stored["bb"].spec.tpu_chips[0]])
+    key = "drain-key"
+    faults.arm("replace.after_copy")     # dies migrating the FIRST set
+    with pytest.raises(InjectedCrash):
+        direct(app, "POST", "/api/v1/tpus/drain", None,
+               headers={"Idempotency-Key": key})
+    faults.disarm_all()
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    # the key was dropped, not finalized: the retry RE-EXECUTES
+    resp = direct(app2, "POST", "/api/v1/tpus/drain", None,
+                  headers={"Idempotency-Key": key})
+    payload = json.loads(resp.payload())
+    assert payload["code"] == 200
+    assert "Idempotency-Replayed" not in resp.headers
+    stored = assert_no_leaks(app2)
+    cordoned = set(app2.tpu.cordoned)
+    for name, info in stored.items():
+        assert not set(info.spec.tpu_chips) & cordoned, \
+            f"{name} still on cordoned chips after keyed drain retry"
+
+
+def test_query_string_part_of_fingerprint(tmp_path):
+    """?noall turns a volume delete into a different operation: reusing
+    the key without it must be rejected, not replayed."""
+    app = make_app(tmp_path)
+    setup_vol(app)
+    app.start()
+    try:
+        _, _, out = call(app, "DELETE", "/api/v1/volumes/vol?noall",
+                         headers={"Idempotency-Key": "qk"})
+        assert out["code"] == 200
+        _, _, out = call(app, "DELETE", "/api/v1/volumes/vol",
+                         headers={"Idempotency-Key": "qk"})
+        assert out["code"] == 1000, out     # mismatch, not a replay
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+# ------------------------------------------------------ If-Match / races
+
+def test_if_match_precondition(tmp_path):
+    app = make_app(tmp_path)
+    setup_demo(app)
+    app.start()
+    try:
+        # wrong version: 412 + current version, no state change
+        status, hdrs, out = call(app, "PATCH", "/api/v1/replicaSet/demo",
+                                 {"tpuPatch": {"tpuCount": 4}},
+                                 headers={"If-Match": "7"})
+        assert status == 412 and out["code"] == 412, out
+        assert hdrs["X-Current-Version"] == "1"
+        assert out["data"]["currentVersion"] == 1
+        assert stored_containers(app)["demo"].version == 1
+        # matching version: proceeds
+        status, _, out = call(app, "PATCH", "/api/v1/replicaSet/demo",
+                              {"tpuPatch": {"tpuCount": 4}},
+                              headers={"If-Match": "1"})
+        assert out["code"] == 200, out
+        assert out["data"]["version"] == 2
+        # garbage If-Match is a client error, not a 500
+        _, _, out = call(app, "PATCH", "/api/v1/replicaSet/demo/stop",
+                         body=None, headers={"If-Match": "abc"})
+        assert out["code"] == 1000
+        # stop honors it too (and quoted etags parse)
+        status, _, out = call(app, "PATCH", "/api/v1/replicaSet/demo/stop",
+                              body=None, headers={"If-Match": '"2"'})
+        assert out["code"] == 200
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+def test_racing_patches_one_winner(tmp_path):
+    """Satellite: two concurrent patches, both based on version 1, both
+    sending If-Match: 1 — exactly one wins, the loser gets 412 under the
+    name lock, zero leaked grants, linear version history."""
+    app = make_app(tmp_path)
+    setup_demo(app)
+    app.start()
+    results = []
+    barrier = threading.Barrier(2)
+
+    def racer(count):
+        barrier.wait()
+        status, hdrs, out = call(app, "PATCH", "/api/v1/replicaSet/demo",
+                                 {"tpuPatch": {"tpuCount": count}},
+                                 headers={"If-Match": "1"})
+        results.append((status, out["code"],
+                        hdrs.get("X-Current-Version")))
+
+    try:
+        threads = [threading.Thread(target=racer, args=(n,))
+                   for n in (3, 4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        codes = sorted(c for _, c, _ in results)
+        assert codes == [200, 412], results
+        loser = next(r for r in results if r[1] == 412)
+        assert loser[2] == "2"          # told the version that beat it
+        stored = assert_no_leaks(app)
+        assert stored["demo"].version == 2
+        versions = [v for v, _ in
+                    app.client.entity_versions("containers", "demo")]
+        assert versions == [1, 2]       # linear: no forked/ghost version
+    finally:
+        app.stop()
+
+
+def test_volume_if_match(tmp_path):
+    app = make_app(tmp_path)
+    setup_vol(app)
+    with pytest.raises(xerrors.PreconditionFailedError) as ei:
+        app.volumes.patch_volume_size("vol", "32MB", if_match=9)
+    assert ei.value.current == 1
+    app.volumes.patch_volume_size("vol", "32MB", if_match=1)
+    with pytest.raises(xerrors.PreconditionFailedError):
+        app.volumes.delete_volume("vol", if_match=1)
+    app.volumes.delete_volume("vol", if_match=2)
+    assert_no_leaks(app)
+    app.stop()
+
+
+# ------------------------------------------------------ overload details
+
+def test_per_client_fairness(tmp_path):
+    """One address hogging the gate is shed at its cap while another
+    client still gets through."""
+    gate = MutationGate(max_inflight=8, max_waiting=8, per_client=2)
+    assert gate.acquire("10.0.0.1") is None
+    assert gate.acquire("10.0.0.1") is None
+    assert gate.acquire("10.0.0.1") == "per_client"     # over the cap
+    assert gate.acquire("10.0.0.2") is None             # others unaffected
+    gate.release("10.0.0.1")
+    assert gate.acquire("10.0.0.1") is None             # slot freed
+    d = gate.describe()
+    assert d["shedTotal"] == 1 and d["shedByReason"]["per_client"] == 1
+    assert d["inflight"] == 3       # 2x .1 admitted, 1 released, .2, .1
+
+
+def test_gate_fifo_no_barging(tmp_path):
+    """Newcomers must not steal a freed slot from parked waiters: the
+    queue is FIFO, so the oldest waiter is admitted first and a sustained
+    arrival stream cannot starve the queue into timeout sheds."""
+    gate = MutationGate(max_inflight=2, max_waiting=4, wait_timeout=2.0)
+    assert gate.acquire("a") is None
+    assert gate.acquire("b") is None
+    results = {}
+
+    def waiter(name):
+        results[name] = gate.acquire(name)
+
+    t1 = threading.Thread(target=waiter, args=("w1",))
+    t1.start()
+    time.sleep(0.05)
+    t2 = threading.Thread(target=waiter, args=("w2",))
+    t2.start()
+    time.sleep(0.05)
+    gate.release("a")               # ONE slot: must go to w1, the head
+    t1.join(2)
+    assert not t1.is_alive() and results.get("w1") is None
+    assert "w2" not in results      # w2 still parked behind the full gate
+    gate.release("b")
+    t2.join(2)
+    assert results.get("w2") is None
+    gate.release("w1")
+    gate.release("w2")
+    assert gate.describe()["shedTotal"] == 0
+
+
+def test_gate_queue_timeout_and_watermark(tmp_path):
+    gate = MutationGate(max_inflight=1, max_waiting=1, wait_timeout=0.05)
+    assert gate.acquire("a") is None
+    t = threading.Thread(target=lambda: gate.acquire("b"))  # queues, times out
+    t.start()
+    time.sleep(0.01)
+    assert gate.acquire("c") == "queue_full"            # watermark hit
+    t.join()
+    d = gate.describe()
+    assert d["shedByReason"]["queue_timeout"] == 1
+    assert d["shedByReason"]["queue_full"] == 1
+    gate.release("a")
+    assert gate.acquire("b") is None
+
+
+def test_overload_metrics_exported(tmp_path):
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        app.gate.max_inflight, app.gate.max_waiting = 1, 0
+        assert app.gate.acquire("hog") is None
+        status, _, out = call(app, "POST", "/api/v1/volumes",
+                              {"name": "v", "size": "1MB"})
+        assert status == 429
+        app.gate.release("hog")
+        conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                          timeout=10)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert "tdapi_mutations_shed_total 1" in text
+        assert "tdapi_mutations_inflight 0" in text
+        assert "tdapi_idempotency_records" in text
+        shed = [e for e in app.events.recent()
+                if e["op"] == "admission.shed"]
+        assert shed and shed[0]["code"] == 429
+    finally:
+        app.stop()
+
+
+def test_duplicate_in_flight_409(tmp_path):
+    """A duplicate arriving while the original is still executing answers
+    409 (neither executes twice nor fabricates a result)."""
+    app = make_app(tmp_path)
+    app.start()
+    release = threading.Event()
+    entered = threading.Event()
+    orig_create = app.backend.create
+
+    def slow_create(name, spec):
+        entered.set()
+        release.wait(5)
+        return orig_create(name, spec)
+
+    app.backend.create = slow_create
+    body = {"imageName": "i", "replicaSetName": "slow", "tpuCount": 1}
+    first = []
+
+    def runner():
+        first.append(call(app, "POST", "/api/v1/replicaSet", body,
+                          headers={"Idempotency-Key": "k-slow"}))
+
+    try:
+        t = threading.Thread(target=runner)
+        t.start()
+        assert entered.wait(5)
+        status, hdrs, out = call(app, "POST", "/api/v1/replicaSet", body,
+                                 headers={"Idempotency-Key": "k-slow"})
+        assert status == 409 and out["code"] == 409, out
+        assert hdrs["Retry-After"] == "1"
+        release.set()
+        t.join()
+        assert first[0][2]["code"] == 200
+        # now the duplicate replays
+        _, hdrs, out = call(app, "POST", "/api/v1/replicaSet", body,
+                            headers={"Idempotency-Key": "k-slow"})
+        assert out["code"] == 200
+        assert hdrs.get("Idempotency-Replayed") == "true"
+        assert_no_leaks(app)
+    finally:
+        release.set()
+        app.stop()
+
+
+def test_error_outcomes_not_cached(tmp_path):
+    """Failed mutations changed nothing (services unwind), so their
+    responses are NOT cached: a retry under the same key re-executes
+    instead of replaying a possibly-transient failure for the TTL."""
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        body = {"imageName": "i", "replicaSetName": "big", "tpuCount": 99}
+        _, h1, o1 = call(app, "POST", "/api/v1/replicaSet", body,
+                         headers={"Idempotency-Key": "err-key"})
+        assert o1["code"] == 1013        # not enough chips
+        assert app.idempotency.record_count() == 0   # claim dropped
+        # the retry re-executes — here with capacity that now fits
+        body["tpuCount"] = 2
+        _, h2, o2 = call(app, "POST", "/api/v1/replicaSet", body,
+                         headers={"Idempotency-Key": "err-key"})
+        assert o2["code"] == 200, o2
+        assert "Idempotency-Replayed" not in h2
+        assert_no_leaks(app)
+    finally:
+        app.stop()
+
+
+def test_client_polls_in_flight_conflict(tmp_path):
+    """A keyed retry racing its still-executing original (client-side
+    timeout, server still working) gets 409 and POLLS for the stored
+    result per Retry-After instead of surfacing a terminal error."""
+    app = make_app(tmp_path)
+    app.start()
+    orig_create = app.backend.create
+
+    def slow_create(name, spec):
+        time.sleep(0.6)
+        return orig_create(name, spec)
+
+    app.backend.create = slow_create
+    try:
+        c = ApiClient("127.0.0.1", app.server.port, timeout=0.25,
+                      retry_backoff=0.01)
+        run = c.runReplicaSet(body={"imageName": "x",
+                                    "replicaSetName": "racy",
+                                    "tpuCount": 1})
+        assert run["name"] == "racy-1"
+        st = c.stats()
+        assert st["replays"] >= 1        # answered from the result cache
+        assert st["mutationRetries"] >= 1
+        app.backend.create = orig_create
+        stored = assert_no_leaks(app)
+        assert stored["racy"].version == 1   # exactly one execution
+    finally:
+        app.backend.create = orig_create
+        app.stop()
+
+
+# ------------------------------------------------------- graceful drain
+
+def test_stop_drains_inflight_mutation(tmp_path):
+    """ApiServer.stop() must let an in-flight mutation finish and deliver
+    its response instead of resetting the socket mid-write."""
+    app = make_app(tmp_path)
+    app.start()
+    release = threading.Event()
+    entered = threading.Event()
+    orig_create = app.backend.create
+
+    def slow_create(name, spec):
+        entered.set()
+        release.wait(5)
+        return orig_create(name, spec)
+
+    app.backend.create = slow_create
+    result = []
+
+    def runner():
+        result.append(call(app, "POST", "/api/v1/replicaSet",
+                           {"imageName": "i", "replicaSetName": "drainme",
+                            "tpuCount": 1}))
+
+    t = threading.Thread(target=runner)
+    t.start()
+    assert entered.wait(5)
+    stopper = threading.Thread(target=app.stop)
+    stopper.start()
+    time.sleep(0.05)            # stop() is now draining
+    release.set()
+    t.join(10)
+    stopper.join(15)
+    assert result, "in-flight request was cut off by stop()"
+    status, hdrs, out = result[0]
+    assert out["code"] == 200, out
+    assert hdrs.get("Connection") == "close"     # told to re-connect
+
+
+# --------------------------------------------------------- TTL lifecycle
+
+def test_idempotency_ttl_and_boot_sweep(tmp_path):
+    from gpu_docker_api_tpu.idempotency import NEW, REPLAY, IdempotencyCache
+
+    app = make_app(tmp_path)
+    cache = IdempotencyCache(app.client, ttl=0.05)
+    state, _ = cache.begin("k1", "fp")
+    assert state == NEW
+    cache.finish("k1", 200, 200, b'{"code": 200}')
+    assert cache.begin("k1", "fp")[0] == REPLAY
+    time.sleep(0.08)
+    assert cache.begin("k1", "fp")[0] == NEW    # expired: fresh claim
+    cache.finish("k1", 200, 200, b'{"code": 200}')
+    time.sleep(0.08)
+    assert cache.sweep() >= 1                   # maintenance path
+    # boot sweep: an in_progress record with NO intent outcome (crashed
+    # before any side effect) is dropped so the retry re-executes
+    app.idempotency.begin("orphan", "fp")
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    assert app2.last_reconcile["idempotency"]["dropped"] == 1
+    from gpu_docker_api_tpu.idempotency import NEW as NEW2
+    assert app2.idempotency.begin("orphan", "fp")[0] == NEW2
+    app2.idempotency.abandon("orphan")
+    app2.stop()
+
+
+# ------------------------------------------------------ client satellites
+
+def test_client_close_releases_all_threads(tmp_path):
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        c = ApiClient("127.0.0.1", app.server.port)
+        c.ping()
+        ready = threading.Barrier(4)
+
+        def worker():
+            c.ping()
+            ready.wait(5)
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        ready.wait(5)
+        for t in threads:
+            t.join()
+        with c._conns_lock:
+            pooled = list(c._conns)
+        assert len(pooled) == 4         # one socket per thread
+        c.close()
+        assert all(conn.sock is None for conn in pooled), \
+            "close() left another thread's socket open"
+        with c._conns_lock:
+            assert not c._conns
+        assert c.ping() == {"status": "pong"}   # lazily re-pools
+    finally:
+        app.stop()
+
+
+def test_client_disables_idempotency_for_old_server_spec(tmp_path):
+    """Against a daemon whose spec doesn't advertise Idempotency-Key,
+    the client must fall back to never retrying mutations — a resend
+    there would double-apply."""
+    import copy
+
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        c = ApiClient("127.0.0.1", app.server.port)
+        assert c.idempotency is True
+        old_spec = copy.deepcopy(c.spec)
+        for methods in old_spec["paths"].values():
+            for op in methods.values():
+                if isinstance(op, dict) and "parameters" in op:
+                    op["parameters"] = [
+                        p for p in op["parameters"]
+                        if p.get("name") != "Idempotency-Key"]
+        old = ApiClient("127.0.0.1", app.server.port, spec=old_spec)
+        assert old.idempotency is False
+    finally:
+        app.stop()
+
+
+def test_client_transparent_retry_on_dropped_response(tmp_path):
+    """End to end: the server drops the response; the client's keyed
+    retry machinery absorbs it; the mutation lands exactly once and the
+    stats surface what happened."""
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        c = ApiClient("127.0.0.1", app.server.port, retry_backoff=0.01)
+        faults.arm_fault("POST /api/v1/replicaSet:drop_response")
+        run = c.runReplicaSet(body={"imageName": "x",
+                                    "replicaSetName": "once",
+                                    "tpuCount": 2})
+        assert run["name"] == "once-1"
+        st = c.stats()
+        assert st["mutationRetries"] + st["staleRetries"] >= 1
+        assert st["replays"] == 1
+        stored = assert_no_leaks(app)
+        assert stored["once"].version == 1
+        # client-side If-Match plumbing rides the generated methods
+        with pytest.raises(ApiError) as ei:
+            c.patchReplicaSet(name="once",
+                              body={"tpuPatch": {"tpuCount": 1}},
+                              if_match=9)
+        assert ei.value.code == 412
+        out = c.patchReplicaSet(name="once",
+                                body={"tpuPatch": {"tpuCount": 1}},
+                                if_match=1)
+        assert out["version"] == 2
+    finally:
+        faults.disarm_faults()
+        app.stop()
