@@ -964,6 +964,89 @@ def scheduling_bench() -> dict:
         app.stop()
 
 
+def replace_bench() -> dict:
+    """Rolling-replace fast path (utils/copyfast.py): build a replica set
+    whose writable layer holds a synthetic multi-hundred-MB tree, then
+    PATCH it through the full REST stack and measure (a) end-to-end
+    replace latency and (b) the stop->start DOWNTIME window — the time
+    the chips sit idle — for the serial seed path (TDAPI_PRECOPY=0 +
+    TDAPI_COPY_MODE=serial: one in-window single-threaded copy, what the
+    repo did before the fast path) vs the shipped default (pre-copy while
+    the old container runs + delta pass + mode-ladder copy). Knobs
+    honored: TDAPI_COPY_MODE, TDAPI_COPY_WORKERS, TDAPI_PRECOPY,
+    TDAPI_BENCH_LAYER_MB (default 256)."""
+    import shutil
+
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+    from gpu_docker_api_tpu.utils import copyfast
+
+    layer_mb = int(os.environ.get("TDAPI_BENCH_LAYER_MB", "") or 256)
+    file_mb = 8
+    n_files = max(1, layer_mb // file_mb)
+    blob = os.urandom(file_mb * 1024 * 1024)
+
+    def one_variant(tag: str, env: dict) -> dict:
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        state_dir = tempfile.mkdtemp(prefix=f"tdapi-replace-{tag}-")
+        app = App(state_dir=state_dir, backend="mock", addr="127.0.0.1:0",
+                  topology=make_topology("v4-32"), api_key="", cpu_cores=8)
+        app.start()
+        try:
+            port = app.server.port
+            call(port, "POST", "/api/v1/replicaSet",
+                 {"imageName": "x", "replicaSetName": "rb", "tpuCount": 4})
+            upper = app.backend.inspect("rb-1").upper_dir
+            for i in range(n_files):
+                sub = os.path.join(upper, f"shard{i % 8}")
+                os.makedirs(sub, exist_ok=True)
+                with open(os.path.join(sub, f"w{i}.bin"), "wb") as f:
+                    f.write(blob)
+            t0 = time.perf_counter()
+            call(port, "PATCH", "/api/v1/replicaSet/rb",
+                 {"memoryPatch": {"memory": "8GB"}})
+            replace_s = time.perf_counter() - t0
+            copied = [e for e in app.events.recent(limit=50)
+                      if e["op"] == "replace.copied"]
+            evt = copied[-1] if copied else {}
+            return {
+                "replace_s": round(replace_s, 3),
+                "downtime_ms": evt.get("downtimeMs"),
+                "mode": evt.get("mode"),
+                "precopied": evt.get("precopied"),
+                "delta_files": evt.get("deltaFiles"),
+                "copy_seconds": evt.get("copySeconds"),
+            }
+        finally:
+            app.stop()
+            shutil.rmtree(state_dir, ignore_errors=True)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    serial = one_variant("serial", {"TDAPI_PRECOPY": "0",
+                                    "TDAPI_COPY_MODE": "serial"})
+    fast = one_variant("fast", {})      # shipped defaults / operator env
+    out = {
+        "layer_mb": n_files * file_mb,
+        "files": n_files,
+        "serial": serial,
+        "fast": fast,
+        "workers": copyfast.default_workers(),
+        "copy_mode_knob": copyfast.default_mode(),
+    }
+    if serial.get("downtime_ms") and fast.get("downtime_ms"):
+        out["downtime_speedup"] = round(
+            serial["downtime_ms"] / max(fast["downtime_ms"], 1e-9), 2)
+    if serial.get("replace_s") and fast.get("replace_s"):
+        out["replace_speedup"] = round(
+            serial["replace_s"] / max(fast["replace_s"], 1e-9), 2)
+    return out
+
+
 def check_claims(extra: dict) -> dict:
     """Diff this run's extras against BASELINE.json's machine-readable
     claims table (the same numbers BASELINE.md publishes). Any ratio
@@ -1061,6 +1144,11 @@ def main() -> None:
         extra["store"] = store_bench()
     except Exception as e:  # noqa: BLE001
         log(f"store bench failed: {type(e).__name__}: {e}")
+    try:
+        log("replace fast-path bench (synthetic multi-hundred-MB layer)...")
+        extra["replace"] = replace_bench()
+    except Exception as e:  # noqa: BLE001
+        log(f"replace bench failed: {type(e).__name__}: {e}")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
@@ -1132,6 +1220,8 @@ def main() -> None:
             "host8b_b1_tok_s": _dig("host8b", "b1", "tokens_per_sec"),
             "host8b_b8_tok_s": _dig("host8b", "b8", "tokens_per_sec"),
             "host8b_warm_rest_s": _dig("host8b", "warm_rest_s_32tok"),
+            "replace_downtime_ms": _dig("replace", "fast", "downtime_ms"),
+            "replace_downtime_speedup": _dig("replace", "downtime_speedup"),
             "claims_ok": _dig("claims", "ok"),
             "claims_failed": len(_dig("claims", "failed", default=[]) or []),
         },

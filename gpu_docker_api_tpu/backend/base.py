@@ -48,19 +48,57 @@ class VolumeState:
 
 
 def copy_container_layer(backend: "Backend", old_name: str,
-                         new_name: str) -> bool:
+                         new_name: str, snapshot=None):
     """Carry one container's writable layer forward to another (reference
     CopyOldMergedToNewContainerMerged, utils/copy.go:31-46). Shared by the
-    rolling-replace step and the crash reconciler's replay of it. Returns
-    True when a copy actually happened."""
-    from ..utils.file import copy_dir
+    rolling-replace step and the crash reconciler's replay of it.
+
+    Without ``snapshot`` this is a full tree clone through the copyfast
+    mode ladder (reflink -> copy_file_range -> threaded pool). With a
+    ``snapshot`` from :func:`precopy_container_layer` it is the DELTA pass
+    of the pre-copy protocol: only files dirtied since the warm copy move,
+    and files deleted in between are removed — O(dirty set) inside the
+    stop->start window instead of O(layer). Returns the CopyStats when a
+    copy actually ran, None when either layer dir is unavailable (falsy,
+    preserving the old boolean contract)."""
+    from ..utils.copyfast import METRICS, delta_sync, sync_tree
     old_state = backend.inspect(old_name)
     new_state = backend.inspect(new_name)
     if (old_state.exists and new_state.exists
             and old_state.upper_dir and new_state.upper_dir):
-        copy_dir(old_state.upper_dir, new_state.upper_dir)
-        return True
-    return False
+        if snapshot is not None:
+            stats = delta_sync(old_state.upper_dir, new_state.upper_dir,
+                               snapshot)
+        else:
+            # sync (clone + symlink-protected delete), not a bare clone:
+            # the reconciler replays this over a dest a crashed pre-copy
+            # may have warm-populated — files the old container deleted
+            # since must not ghost into the new layer
+            stats = sync_tree(old_state.upper_dir, new_state.upper_dir)
+        METRICS.observe_copy(stats)
+        return stats
+    return None
+
+
+def precopy_container_layer(backend: "Backend", old_name: str,
+                            new_name: str):
+    """Warm-copy ``old``'s writable layer into ``new`` while ``old`` is
+    still RUNNING (the pre-copy half of the pre-copy/delta replace).
+    Returns ``(snapshot, stats)`` to feed the later
+    :func:`copy_container_layer` delta pass, or ``None`` when either layer
+    dir is unavailable (caller falls back to the in-window full copy)."""
+    from ..utils.copyfast import METRICS, clone_tree, snapshot_tree
+    old_state = backend.inspect(old_name)
+    new_state = backend.inspect(new_name)
+    if not (old_state.exists and new_state.exists
+            and old_state.upper_dir and new_state.upper_dir):
+        return None
+    # snapshot BEFORE the warm copy: a write racing the copy then shows as
+    # a (size, mtime) mismatch in the delta pass — the safe direction
+    snap = snapshot_tree(old_state.upper_dir, new_state.upper_dir)
+    stats = clone_tree(old_state.upper_dir, new_state.upper_dir)
+    METRICS.observe_copy(stats)
+    return snap, stats
 
 
 def resolve_tier_root(default_root: str, tiers: dict, tier: str) -> str:

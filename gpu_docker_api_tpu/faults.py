@@ -65,7 +65,8 @@ CRASHPOINTS: dict[str, str] = {
     "run.after_start": "container started, latest pointer not yet persisted",
     # rolling replace (patch / rollback / restart all funnel through it)
     "replace.after_create": "new version created+persisted, old still running",
-    "replace.after_stop_old": "old stopped, layer not yet copied",
+    "replace.after_stop_old": "old stopped, layer not yet (delta-)copied — "
+                              "the pre-copy may already have warm-copied it",
     "replace.after_copy": "layer copied, new version not yet started",
     "replace.after_start_new": "new running, old container not yet removed",
     "replace.after_remove_old": "old removed, stale grants not yet freed",

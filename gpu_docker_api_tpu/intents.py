@@ -93,8 +93,8 @@ class Intent:
         for any marker the boot-time reconciler CONSULTS to pick a replay
         branch ("created" with its container/version meta, "copied",
         "migrated": reconcile.py). sync=False is the journal-slimming hot
-        path for purely-informational markers (granted/stopped_old/
-        started_new/...): the step is folded into the in-memory record and
+        path for purely-informational markers (granted/precopied/
+        stopped_old/started_new/...): the step is folded into the in-memory record and
         rides along with the NEXT synchronous write — or is discarded by
         done(), which deletes the key anyway. Crash semantics are
         unchanged because the reconciler's decisions never read lazy

@@ -54,7 +54,7 @@ from typing import Optional
 from .backend.base import copy_container_layer
 from .dtos import StoredContainerInfo, StoredVolumeInfo
 from .intents import IntentRecord
-from .utils.file import move_dir_contents
+from .utils.copyfast import move_dir_contents
 
 log = logging.getLogger(__name__)
 

@@ -35,6 +35,7 @@ from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
 from ..services import ReplicaSetService, VolumeService
 from ..store import StateClient, open_store
 from ..topology import TpuTopology, discover_topology
+from ..utils import copyfast
 from ..utils.file import valid_size_unit
 from ..version import (
     CONTAINER_VERSION_MAP_KEY, VOLUME_VERSION_MAP_KEY, MergeMap, VersionMap,
@@ -309,7 +310,7 @@ class App:
         self.replicasets = ReplicaSetService(
             self.backend, self.client, self.wq, self.tpu, self.cpu, self.ports,
             self.container_versions, self.merges, xla_cache_dir=xla_cache,
-            intents=self.intents)
+            intents=self.intents, events=self.events)
         self.volumes = VolumeService(self.backend, self.client, self.wq,
                                      self.volume_versions,
                                      intents=self.intents)
@@ -851,6 +852,29 @@ class App:
             "# TYPE tdapi_chip_health_failures gauge",
             f"tdapi_chip_health_failures "
             f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",
+        ]
+        # rolling-replace data movement (utils/copyfast.py): how many bytes
+        # layer/volume copies moved, through which ladder rung, and the
+        # last stop->start downtime window the pre-copy/delta path produced
+        cf = copyfast.METRICS.snapshot()
+        lines += [
+            "# TYPE tdapi_replace_copy_bytes counter",
+            f"tdapi_replace_copy_bytes {cf['copyBytes']}",
+            "# TYPE tdapi_replace_copy_seconds counter",
+            f"tdapi_replace_copy_seconds {cf['copySeconds']}",
+            "# TYPE tdapi_replace_copy_mode counter",
+            "# layer copies per resolved copy-ladder rung",
+        ]
+        for mode in sorted(cf["copiesByMode"]):
+            lines.append(f'tdapi_replace_copy_mode{{mode="{mode}"}} '
+                         f'{cf["copiesByMode"][mode]}')
+        lines += [
+            "# TYPE tdapi_replace_downtime_ms gauge",
+            "# last replace's stop->start window (the chips-idle time)",
+            f"tdapi_replace_downtime_ms {cf['lastDowntimeMs']}",
+            "# TYPE tdapi_copy_delta_files counter",
+            "# files re-copied by delta passes (the dirty sets)",
+            f"tdapi_copy_delta_files {cf['deltaFiles']}",
         ]
         gate = self.gate.describe()
         lines += [

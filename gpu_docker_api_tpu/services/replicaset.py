@@ -79,13 +79,16 @@ class ReplicaSetService:
                  tpu: TpuScheduler, cpu: CpuScheduler, ports: PortScheduler,
                  version_map: VersionMap, merge_map: MergeMap,
                  xla_cache_dir: str = "",
-                 intents: Optional[IntentJournal] = None):
+                 intents: Optional[IntentJournal] = None,
+                 events=None):
         # host-shared XLA persistent-compile-cache dir: injected into every
         # scheduled workload so the Nth launch of the same program skips the
         # 20-40s XLA compile — the single biggest lever on the north-star
         # cold-start -> first-XLA-step metric. Bound into docker containers
         # at the SAME path so one env value works on every substrate.
         self.xla_cache_dir = xla_cache_dir
+        # operation event log (replace.copied events); None in bare tests
+        self.events = events
         self.backend = backend
         self.client = client
         self.wq = wq
@@ -361,14 +364,27 @@ class ReplicaSetService:
     def _rolling_replace(self, name: str, old: StoredContainerInfo,
                          new_spec: ContainerSpec,
                          intent: Optional[Intent] = None) -> StoredContainerInfo:
-        """create new version -> stop old (chip exclusivity) -> copy writable
-        layer -> start new -> delete old (reference :318-353, reordered).
+        """create new version -> pre-copy writable layer (old still
+        running) -> stop old (chip exclusivity) -> delta-copy dirtied
+        files -> start new -> delete old (reference :318-353, reordered).
+
+        The pre-copy/delta split (utils/copyfast.py) moves the O(layer
+        bytes) copy OUT of the stop->start downtime window: only the files
+        dirtied between the warm copy and the stop move while the chips
+        sit idle, so the window is O(dirty set). TDAPI_PRECOPY=0 restores
+        the seed's single in-window copy. Crash/unwind semantics are
+        unchanged: pre-copied files live in the new container's layer and
+        vanish with it on unwind, and the reconciler's replay of a missing
+        'copied' step is a full (idempotent) sync — clone plus
+        symlink-protected delete — over whatever the pre-copy left behind.
 
         On success, resources held by the old version and not reused by the
         new one are freed. On failure, the world is restored: new container
         removed, new-only grants freed by the caller, version counter and
         latest pointer reverted, old container restarted.
         """
+        from ..backend.base import precopy_container_layer
+        from ..utils import copyfast
         old_holds = not old.resourcesReleased
         old_ports = list(old.spec.port_bindings.values())
         container_ports = list(new_spec.port_bindings.keys())
@@ -377,17 +393,40 @@ class ReplicaSetService:
                                       start=False, intent=intent)
         crashpoint("replace.after_create")
         old_state = self.backend.inspect(old.containerName)
+        pre_snap = pre_stats = None
+        downtime_ms = None
         try:
+            if copyfast.precopy_enabled():
+                try:
+                    pre = precopy_container_layer(
+                        self.backend, old.containerName, info.containerName)
+                except Exception:  # noqa: BLE001 — warm copy is best-effort;
+                    log.exception("pre-copy %s -> %s; falling back to "
+                                  "in-window copy", old.containerName,
+                                  info.containerName)
+                    pre = None     # the in-window full copy still runs
+                if pre is not None:
+                    pre_snap, pre_stats = pre
+                    if intent is not None:
+                        intent.step("precopied", sync=False,
+                                    bytes=pre_stats.bytes,
+                                    files=pre_stats.files,
+                                    mode=pre_stats.mode)
+            t_window = time.perf_counter()
             if old_state.exists and (old_state.running or old_state.paused):
                 self.backend.stop(old.containerName)
             if intent is not None:
                 intent.step("stopped_old", sync=False)
             crashpoint("replace.after_stop_old")
-            self._copy_layer(old.containerName, info.containerName)
+            copy_stats = self._copy_layer(old.containerName,
+                                          info.containerName,
+                                          snapshot=pre_snap)
             if intent is not None:
                 intent.step("copied")
             crashpoint("replace.after_copy")
             self.backend.start(info.containerName)
+            downtime_ms = (time.perf_counter() - t_window) * 1e3
+            copyfast.METRICS.observe_downtime(downtime_ms)
             if intent is not None:
                 intent.step("started_new", sync=False)
             crashpoint("replace.after_start_new")
@@ -411,6 +450,22 @@ class ReplicaSetService:
                 except Exception:  # noqa: BLE001
                     log.exception("cleanup: restarting old container")
             raise
+        if self.events is not None:
+            self.events.record(
+                "replace.copied", target=name,
+                precopied=pre_snap is not None,
+                precopyBytes=pre_stats.bytes if pre_stats else 0,
+                windowBytes=copy_stats.bytes if copy_stats else 0,
+                deltaFiles=copy_stats.delta_files if copy_stats else 0,
+                # report the rung that actually moved bytes: an empty delta
+                # pass never exercises its ladder, so its mode is noise
+                mode=(copy_stats.mode if copy_stats and copy_stats.files
+                      else pre_stats.mode if pre_stats
+                      else copy_stats.mode if copy_stats else "none"),
+                copySeconds=round(
+                    (pre_stats.seconds if pre_stats else 0.0)
+                    + (copy_stats.seconds if copy_stats else 0.0), 6),
+                downtimeMs=round(downtime_ms, 3))
         self._record_merge(name, info.containerName)
         # delete-old-for-update (reference :660-679): drop it, free the old
         # version's resources that the new version did not take over — only
@@ -431,11 +486,14 @@ class ReplicaSetService:
             self.ports.restore(old_ports, name)
         return info
 
-    def _copy_layer(self, old_name: str, new_name: str) -> None:
+    def _copy_layer(self, old_name: str, new_name: str, snapshot=None):
         """Carry the writable layer forward (shared with the crash
-        reconciler's replay of this step — backend/base.py)."""
+        reconciler's replay of this step — backend/base.py). With a
+        pre-copy snapshot this is the delta pass; without, a full clone.
+        Returns the CopyStats (or None when layer dirs are unavailable)."""
         from ..backend.base import copy_container_layer
-        copy_container_layer(self.backend, old_name, new_name)
+        return copy_container_layer(self.backend, old_name, new_name,
+                                    snapshot=snapshot)
 
     def _record_merge(self, name: str, ctr_name: str) -> None:
         """Track the merged-layer path per version (reference setToMergeMap,

@@ -22,7 +22,8 @@ from ..dtos import HistoryItem, StoredVolumeInfo
 from ..faults import crashpoint
 from ..intents import KIND_VOLUME, Intent, IntentJournal
 from ..store.client import StateClient
-from ..utils.file import move_dir_contents, to_bytes
+from ..utils.copyfast import move_dir_contents
+from ..utils.file import to_bytes
 from ..version import VersionMap
 from ..workqueue import Call, PutKeyValue, WorkQueue
 
@@ -140,8 +141,13 @@ class VolumeService:
                 raise
             new_state = self.backend.volume_inspect(out["name"])
             try:
-                move_dir_contents(old_state.mountpoint, new_state.mountpoint)
-                intent.step("migrated")
+                # same-FS rename fast path / parallel cross-FS fallback
+                # (utils/copyfast.py); collision-tolerant so the crash
+                # reconciler's re-run of a partial move converges
+                mv = move_dir_contents(old_state.mountpoint,
+                                       new_state.mountpoint)
+                intent.step("migrated", movedEntries=mv.files,
+                            movedBytes=mv.bytes)
                 crashpoint("volume.scale.after_migrate")
             except Exception:
                 # migration failed: drop the new version, keep the old live,

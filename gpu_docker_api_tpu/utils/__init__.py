@@ -1,1 +1,5 @@
 from .file import dir_size, to_bytes, from_bytes, is_dir, copy_dir  # noqa: F401
+from .copyfast import (  # noqa: F401
+    CopyStats, METRICS, clone_tree, delta_sync, move_dir_contents,
+    snapshot_tree, sync_tree,
+)

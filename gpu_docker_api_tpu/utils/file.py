@@ -10,7 +10,7 @@ sizes loudly instead of returning 0.
 from __future__ import annotations
 
 import os
-import shutil
+import stat
 
 SIZE_UNITS = ("KB", "MB", "GB", "TB")
 
@@ -65,16 +65,28 @@ def from_bytes(n: int) -> str:
 
 
 def dir_size(path: str) -> int:
-    """Total size in bytes of all regular files under path (utils/file.go:12-21)."""
+    """Total size in bytes of all regular files under path (utils/file.go:12-21).
+
+    Hardlinked files are counted ONCE (deduped by (st_dev, st_ino)) — they
+    occupy one set of blocks, and quota checks billing them per link would
+    refuse legitimate volume shrinks."""
     total = 0
+    seen: set[tuple[int, int]] = set()
     for root, _dirs, files in os.walk(path):
         for f in files:
             fp = os.path.join(root, f)
             try:
-                if not os.path.islink(fp):
-                    total += os.path.getsize(fp)
+                st = os.lstat(fp)
             except OSError:
-                pass
+                continue
+            if stat.S_ISLNK(st.st_mode):
+                continue
+            if st.st_nlink > 1:
+                key = (st.st_dev, st.st_ino)
+                if key in seen:
+                    continue
+                seen.add(key)
+            total += st.st_size
     return total
 
 
@@ -89,27 +101,22 @@ def copy_dir(src: str, dest: str) -> None:
 
     Existing symlinks in dest are kept (not clobbered): during rolling
     replacement the NEW container's bind mounts are already materialized as
-    links, and the new spec's binds must win over the old layer's."""
-    os.makedirs(dest, exist_ok=True)
-    for entry in os.scandir(src):
-        d = os.path.join(dest, entry.name)
-        if entry.is_symlink():
-            if not os.path.lexists(d):
-                os.symlink(os.readlink(entry.path), d)
-        elif entry.is_dir():
-            if os.path.islink(d):
-                continue  # bind link in dest wins over a directory in src too
-            copy_dir(entry.path, d)
-        else:
-            if os.path.lexists(d) and os.path.islink(d):
-                continue  # bind link in dest wins over a regular file in src
-            shutil.copy2(entry.path, d, follow_symlinks=False)
+    links, and the new spec's binds must win over the old layer's.
+
+    Since the copyfast subsystem this is a thin wrapper over
+    :func:`copyfast.clone_tree` — same semantics plus directory-metadata
+    preservation (the old os.makedirs dropped src's mode/times) and the
+    reflink / copy_file_range / threaded-pool mode ladder."""
+    from .copyfast import clone_tree
+    clone_tree(src, dest)
 
 
 def move_dir_contents(src: str, dest: str) -> None:
     """Move src/* into dest. Used for volume scale data migration — the
     reference does this with a throwaway ubuntu:22.04 helper container
-    running `mv` (utils/copy.go:75-128); we move in-process."""
-    os.makedirs(dest, exist_ok=True)
-    for entry in os.listdir(src):
-        shutil.move(os.path.join(src, entry), os.path.join(dest, entry))
+    running `mv` (utils/copy.go:75-128); we move in-process, via
+    :func:`copyfast.move_dir_contents`: same-FS rename fast path, parallel
+    cross-FS fallback, and collision tolerance (a crashed partial move
+    re-runs clean instead of raising from shutil.move)."""
+    from .copyfast import move_dir_contents as _fast_move
+    _fast_move(src, dest)

@@ -260,7 +260,13 @@ def test_events_endpoint(app):
     evts_t = resp["data"]["events"]
     assert evts_t and all(e["target"] == "evt" for e in evts_t)
     evts = evts_t
-    assert all(e["durationMs"] >= 0 and e["requestId"] for e in evts)
+    # the patch's rolling replace emits an internal replace.copied event
+    # (no requestId — it is not an HTTP request) with the copy/downtime record
+    copied = [e for e in evts if e["op"] == "replace.copied"]
+    assert copied and all(e["downtimeMs"] >= 0 for e in copied)
+    http_evts = [e for e in evts if " /" in e["op"]]
+    assert http_evts
+    assert all(e["durationMs"] >= 0 and e["requestId"] for e in http_evts)
     assert all(e["code"] == 200 for e in evts)
     # events.jsonl persisted on disk
     import os

@@ -1,0 +1,626 @@
+"""Data-movement subsystem (utils/copyfast.py): clone-mode fallback
+matrix, symlink-wins regression under every mode, pre-copy/delta
+correctness for files created/modified/deleted between the warm copy and
+the stop, collision-tolerant moves, and the pre-copy rolling replace
+end-to-end through ReplicaSetService on the mock substrate."""
+
+import os
+import time
+
+import pytest
+
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import ContainerRun, MemoryPatch, PatchRequest
+from gpu_docker_api_tpu.events import EventLog
+from gpu_docker_api_tpu.schedulers import (
+    CpuScheduler, PortScheduler, TpuScheduler,
+)
+from gpu_docker_api_tpu.services import ReplicaSetService
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.utils import copyfast
+from gpu_docker_api_tpu.utils.copyfast import (
+    _Unsupported, clone_tree, delta_sync, move_dir_contents, snapshot_tree,
+)
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import WorkQueue
+
+ALL_MODES = ("auto", "reflink", "server", "threaded", "serial")
+
+
+def _mktree(root):
+    """A source tree with nesting, a symlink, and an executable bit."""
+    os.makedirs(os.path.join(root, "sub", "deep"))
+    with open(os.path.join(root, "a.bin"), "wb") as f:
+        f.write(b"x" * 4096)
+    with open(os.path.join(root, "sub", "b.bin"), "wb") as f:
+        f.write(b"y" * 123)
+    with open(os.path.join(root, "sub", "deep", "c.txt"), "w") as f:
+        f.write("deep")
+    os.symlink("a.bin", os.path.join(root, "link"))
+    os.chmod(os.path.join(root, "sub", "b.bin"), 0o750)
+    os.chmod(os.path.join(root, "sub"), 0o700)
+
+
+def _assert_copied(src, dst):
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+    assert open(os.path.join(dst, "sub", "b.bin"), "rb").read() == b"y" * 123
+    assert open(os.path.join(dst, "sub", "deep", "c.txt")).read() == "deep"
+    assert os.path.islink(os.path.join(dst, "link"))
+    assert os.readlink(os.path.join(dst, "link")) == "a.bin"
+
+
+# ------------------------------------------------------- clone-mode matrix
+
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_clone_tree_every_mode(tmp_path, mode):
+    """Every requested mode produces a correct copy — on filesystems
+    without reflink/copy_file_range the ladder demotes instead of failing."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    stats = clone_tree(src, dst, mode=mode)
+    _assert_copied(src, dst)
+    assert stats.files == 3
+    assert stats.bytes == 4096 + 123 + 4
+    assert stats.mode in ("reflink", "server", "threaded", "serial")
+    assert stats.seconds >= 0
+
+
+def test_clone_mode_ladder_demotes(tmp_path, monkeypatch):
+    """reflink unsupported -> copy_file_range unsupported -> threaded pool:
+    each refused rung demotes exactly one step, only once per tree."""
+    calls = {"reflink": 0, "server": 0}
+
+    def refuse_reflink(src, dst):
+        calls["reflink"] += 1
+        raise _Unsupported("no FICLONE here")
+
+    def refuse_server(src, dst):
+        calls["server"] += 1
+        raise _Unsupported("no copy_file_range here")
+
+    monkeypatch.setitem(copyfast._RUNG_FN, "reflink", refuse_reflink)
+    monkeypatch.setitem(copyfast._RUNG_FN, "server", refuse_server)
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    stats = clone_tree(src, dst, mode="auto", workers=1)
+    _assert_copied(src, dst)
+    assert stats.mode == "threaded"
+    # serial walk: the demotion happens on the FIRST file and sticks
+    assert calls == {"reflink": 1, "server": 1}
+
+
+def test_clone_mode_ladder_stops_at_reflink_when_supported(tmp_path,
+                                                           monkeypatch):
+    """A filesystem that accepts FICLONE keeps every copy on the CoW rung."""
+    cloned = []
+
+    def fake_reflink(src, dst):
+        with open(src, "rb") as s, open(dst, "wb") as d:
+            d.write(s.read())
+        cloned.append(src)
+
+    monkeypatch.setitem(copyfast._RUNG_FN, "reflink", fake_reflink)
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    stats = clone_tree(src, dst, mode="auto")
+    _assert_copied(src, dst)
+    assert stats.mode == "reflink"
+    assert len(cloned) == 3
+
+
+def test_env_knobs(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDAPI_COPY_MODE", "serial")
+    monkeypatch.setenv("TDAPI_COPY_WORKERS", "3")
+    assert copyfast.default_mode() == "serial"
+    assert copyfast.default_workers() == 3
+    monkeypatch.setenv("TDAPI_COPY_MODE", "bogus")
+    monkeypatch.setenv("TDAPI_COPY_WORKERS", "junk")
+    assert copyfast.default_mode() == "auto"
+    assert copyfast.default_workers() >= 1
+    monkeypatch.setenv("TDAPI_PRECOPY", "0")
+    assert not copyfast.precopy_enabled()
+    monkeypatch.setenv("TDAPI_PRECOPY", "1")
+    assert copyfast.precopy_enabled()
+
+
+# --------------------------------------------------- symlink-wins matrix
+
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_symlink_wins_every_mode(tmp_path, mode):
+    """The rolling-replace bind-mount rule: an existing symlink in dest
+    beats a file, a dir, or a different symlink in src — on every rung."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(os.path.join(src, "asdir"))
+    with open(os.path.join(src, "asdir", "inner.txt"), "w") as f:
+        f.write("from src")
+    with open(os.path.join(src, "asfile"), "w") as f:
+        f.write("old layer content")
+    os.symlink("elsewhere", os.path.join(src, "aslink"))
+    os.makedirs(dst)
+    target = str(tmp_path / "bindtarget")
+    os.makedirs(target)
+    for name in ("asdir", "asfile", "aslink"):
+        os.symlink(target, os.path.join(dst, name))
+    clone_tree(src, dst, mode=mode)
+    for name in ("asdir", "asfile", "aslink"):
+        p = os.path.join(dst, name)
+        assert os.path.islink(p), f"{name} clobbered under mode={mode}"
+        assert os.readlink(p) == target
+
+
+def test_copy_dir_preserves_directory_metadata(tmp_path):
+    """Satellite: the seed's os.makedirs dropped src dir mode/mtime."""
+    from gpu_docker_api_tpu.utils.file import copy_dir
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    old = time.time() - 86400
+    os.utime(os.path.join(src, "sub"), (old, old))
+    copy_dir(src, dst)
+    st = os.stat(os.path.join(dst, "sub"))
+    assert (st.st_mode & 0o777) == 0o700
+    assert abs(st.st_mtime - old) < 2
+
+
+# ------------------------------------------------------- pre-copy / delta
+
+def test_delta_created_modified_deleted(tmp_path):
+    """Files created, modified, and deleted between the warm copy and the
+    stop all converge in the delta pass — and only the dirty set moves."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    # ... the old container keeps running and dirties its layer:
+    with open(os.path.join(src, "a.bin"), "wb") as f:       # modified
+        f.write(b"Z" * 999)
+    with open(os.path.join(src, "created.log"), "w") as f:  # created
+        f.write("fresh")
+    os.makedirs(os.path.join(src, "newdir"))                # created dir
+    with open(os.path.join(src, "newdir", "n.txt"), "w") as f:
+        f.write("n")
+    os.unlink(os.path.join(src, "sub", "b.bin"))            # deleted
+    os.unlink(os.path.join(src, "link"))                    # deleted link
+    stats = delta_sync(src, dst, snap)
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"Z" * 999
+    assert open(os.path.join(dst, "created.log")).read() == "fresh"
+    assert open(os.path.join(dst, "newdir", "n.txt")).read() == "n"
+    assert not os.path.exists(os.path.join(dst, "sub", "b.bin"))
+    assert not os.path.lexists(os.path.join(dst, "link"))
+    assert os.path.exists(os.path.join(dst, "sub", "deep", "c.txt"))
+    # only the dirty set moved: 3 copies (a.bin, created.log, n.txt)
+    assert stats.delta_files == 3
+    assert stats.deleted == 2
+    # idempotent: a second pass finds nothing to do
+    again = delta_sync(src, dst, snap)
+    assert again.delta_files == 0 and again.deleted == 0
+
+
+def test_delta_never_touches_preexisting_dest_entries(tmp_path):
+    """Bind links materialized in dest BEFORE the pre-copy survive both
+    the overwrite and the delete halves of the delta pass."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    os.makedirs(dst)
+    target = str(tmp_path / "bind")
+    os.makedirs(target)
+    os.symlink(target, os.path.join(dst, "a.bin"))    # bind over src file
+    os.symlink(target, os.path.join(dst, "mounted"))  # bind with no src twin
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    with open(os.path.join(src, "a.bin"), "wb") as f:
+        f.write(b"dirty")
+    delta_sync(src, dst, snap)
+    assert os.path.islink(os.path.join(dst, "a.bin"))
+    assert os.path.islink(os.path.join(dst, "mounted"))
+
+
+def test_delta_no_ghost_files(tmp_path):
+    """A file created AFTER the snapshot and deleted BEFORE the stop was
+    warm-copied into dest but is in neither the snapshot nor src — the
+    dest-scan deletion must remove it (snapshot-driven deletion leaked
+    exactly these: checkpoints' .tmp files, unlinked scratch)."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(src)
+    with open(os.path.join(src, "keep"), "w") as f:
+        f.write("keep")
+    snap = snapshot_tree(src, dst)
+    # post-snapshot, pre-warm-copy: a transient file appears...
+    with open(os.path.join(src, "ghost.tmp"), "w") as f:
+        f.write("transient")
+    os.makedirs(os.path.join(src, "ghostdir"))
+    with open(os.path.join(src, "ghostdir", "x"), "w") as f:
+        f.write("x")
+    clone_tree(src, dst)
+    # ...and vanishes before the stop
+    os.unlink(os.path.join(src, "ghost.tmp"))
+    os.unlink(os.path.join(src, "ghostdir", "x"))
+    os.rmdir(os.path.join(src, "ghostdir"))
+    stats = delta_sync(src, dst, snap)
+    assert sorted(os.listdir(dst)) == ["keep"], os.listdir(dst)
+    assert stats.deleted >= 2
+
+
+def test_delta_file_to_dir_transition(tmp_path):
+    """src path flips from file to directory between snapshot and stop:
+    the delta pass must replace the warm-copied file with the dir, not
+    crash in os.makedirs (FileExistsError only tolerates existing DIRS)."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(src)
+    with open(os.path.join(src, "x"), "w") as f:
+        f.write("file-shaped")
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    os.unlink(os.path.join(src, "x"))
+    os.makedirs(os.path.join(src, "x"))
+    with open(os.path.join(src, "x", "inner"), "w") as f:
+        f.write("dir-shaped")
+    delta_sync(src, dst, snap)
+    assert open(os.path.join(dst, "x", "inner")).read() == "dir-shaped"
+    # and the reverse (dir -> file) still converges too
+    import shutil
+    shutil.rmtree(os.path.join(src, "x"))
+    with open(os.path.join(src, "x"), "w") as f:
+        f.write("file again")
+    delta_sync(src, dst, snap)
+    assert open(os.path.join(dst, "x")).read() == "file again"
+
+
+def test_delta_serial_mode_forces_one_worker(tmp_path, monkeypatch):
+    """TDAPI_COPY_MODE=serial must mean single-threaded on the delta pass
+    too, not just the warm copy."""
+    seen = {}
+    real_ladder = copyfast._Ladder
+
+    class SpyPool:
+        def __init__(self, max_workers=None, **kw):
+            seen["workers"] = max_workers
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def map(self, fn, jobs):
+            return [fn(j) for j in jobs]
+
+    monkeypatch.setattr(copyfast, "ThreadPoolExecutor", SpyPool)
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst, mode="serial")
+    assert "workers" not in seen        # serial clone: no pool at all
+    for name in ("a.bin", "sub/b.bin"):
+        with open(os.path.join(src, name), "wb") as f:
+            f.write(b"D" * 777)
+    delta_sync(src, dst, snap, mode="serial")
+    assert "workers" not in seen        # serial delta: no pool either
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"D" * 777
+    assert real_ladder is copyfast._Ladder
+
+
+def test_delta_never_writes_through_bind_dir(tmp_path):
+    """A dest directory that is a bind-mount symlink prunes the whole src
+    subtree in the delta pass: files under it must NOT be copied THROUGH
+    the link into the bind target on the host."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(os.path.join(src, "data"))
+    with open(os.path.join(src, "data", "f.bin"), "wb") as f:
+        f.write(b"old layer bytes")
+    bind = str(tmp_path / "hostbind")
+    os.makedirs(bind)
+    os.makedirs(dst)
+    os.symlink(bind, os.path.join(dst, "data"))
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    assert os.listdir(bind) == []         # warm copy respected the link
+    # dirty the subtree after the warm copy — delta must still prune it
+    with open(os.path.join(src, "data", "f.bin"), "wb") as f:
+        f.write(b"dirtied after snapshot")
+    with open(os.path.join(src, "data", "g.bin"), "wb") as f:
+        f.write(b"created after snapshot")
+    delta_sync(src, dst, snap)
+    assert os.listdir(bind) == [], "delta wrote through the bind link"
+    assert os.path.islink(os.path.join(dst, "data"))
+
+
+def test_delta_file_to_dir_over_preexisting_dest_file(tmp_path):
+    """src flips rel `x` from file to dir, but dest had its OWN
+    pre-existing regular file at `x`: protected entries are never
+    deleted — the subtree is skipped instead."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(src)
+    with open(os.path.join(src, "x"), "w") as f:
+        f.write("src file")
+    os.makedirs(dst)
+    with open(os.path.join(dst, "x"), "w") as f:
+        f.write("dest pre-existing")
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    os.unlink(os.path.join(src, "x"))
+    os.makedirs(os.path.join(src, "x"))
+    with open(os.path.join(src, "x", "inner"), "w") as f:
+        f.write("new dir content")
+    delta_sync(src, dst, snap)
+    assert os.path.isfile(os.path.join(dst, "x"))
+
+
+def test_clone_skips_files_vanishing_mid_copy(tmp_path, monkeypatch):
+    """The warm copy runs against a LIVE source: a file unlinked between
+    the scan and its copy must be skipped, not abort the whole pre-copy
+    (an abort silently falls back to the O(layer) in-window copy)."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+
+    real = copyfast._copy2_file
+
+    def vanishing_copy(s, d):
+        if s.endswith("b.bin"):
+            raise FileNotFoundError(s)    # unlinked after the scan
+        real(s, d)
+
+    monkeypatch.setitem(copyfast._RUNG_FN, "threaded", vanishing_copy)
+    monkeypatch.setitem(copyfast._RUNG_FN, "serial", vanishing_copy)
+    stats = clone_tree(src, dst, mode="threaded")
+    assert stats.files == 2               # a.bin + c.txt; b.bin skipped
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+    assert not os.path.exists(os.path.join(dst, "sub", "b.bin"))
+
+
+def test_cross_fs_move_reports_copy_mode(tmp_path, monkeypatch):
+    """An EXDEV fallback must not report mode='rename' for a copy that
+    moved real bytes."""
+    import errno as errno_mod
+    real_rename = os.rename
+
+    def exdev_rename(a, b):
+        raise OSError(errno_mod.EXDEV, "cross-device link")
+
+    monkeypatch.setattr(os, "rename", exdev_rename)
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    stats = move_dir_contents(src, dst)
+    monkeypatch.setattr(os, "rename", real_rename)
+    assert stats.mode != "rename"
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+    assert not os.listdir(src)
+
+
+def test_sync_tree_removes_unmatched_but_keeps_symlinks(tmp_path):
+    """The no-snapshot layer carry (reconciler replay / TDAPI_PRECOPY=0)
+    is an exact sync: dest files with no src counterpart go, symlinks
+    (bind materializations) and their parent dirs stay."""
+    from gpu_docker_api_tpu.utils.copyfast import sync_tree
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    os.makedirs(os.path.join(dst, "stale"))
+    with open(os.path.join(dst, "stale", "leftover.tmp"), "w") as f:
+        f.write("from an interrupted pre-copy")
+    os.makedirs(os.path.join(dst, "mnt"))
+    os.symlink("/somewhere", os.path.join(dst, "mnt", "bind"))
+    stats = sync_tree(src, dst)
+    _assert_copied(src, dst)
+    assert not os.path.exists(os.path.join(dst, "stale"))
+    assert os.path.islink(os.path.join(dst, "mnt", "bind"))
+    assert stats.deleted >= 2
+
+
+def test_delta_catches_write_racing_the_warm_copy(tmp_path):
+    """A write AFTER the snapshot but BEFORE the warm copy scan must not
+    be trusted: src no longer matches the snapshot, so the file re-copies
+    even when dest looks plausibly fresh."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    snap = snapshot_tree(src, dst)
+    clone_tree(src, dst)
+    # dirty the file and FORGE the dest copy stale (simulates the racing
+    # write landing mid-copy: dest holds half-old bytes, src moved on)
+    with open(os.path.join(src, "a.bin"), "wb") as f:
+        f.write(b"W" * 4096)      # same size as the original
+    delta_sync(src, dst, snap)
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"W" * 4096
+
+
+def test_delta_catches_torn_same_size_write_mid_warm_copy(tmp_path):
+    """The nasty tear: a same-size in-place write lands WHILE the warm
+    copy reads the file, so dest ends up stamped with src's NEW mtime but
+    holding torn/old bytes. src-vs-dest comparison calls that clean; the
+    snapshot (taken before the warm copy) does not — the delta pass must
+    re-copy."""
+    import shutil
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(src)
+    with open(os.path.join(src, "db.bin"), "wb") as f:
+        f.write(b"OLD!" * 1024)
+    snap = snapshot_tree(src, dst)
+    # forge the torn outcome: src rewritten same-size AFTER the snapshot,
+    # dest holds the OLD bytes but carries src's NEW stamp (copystat ran
+    # after the racing write)
+    with open(os.path.join(src, "db.bin"), "wb") as f:
+        f.write(b"NEW!" * 1024)
+    os.makedirs(dst)
+    with open(os.path.join(dst, "db.bin"), "wb") as f:
+        f.write(b"OLD!" * 1024)
+    shutil.copystat(os.path.join(src, "db.bin"), os.path.join(dst, "db.bin"))
+    stats = delta_sync(src, dst, snap)
+    assert stats.delta_files == 1
+    assert open(os.path.join(dst, "db.bin"), "rb").read() == b"NEW!" * 1024
+    # the re-copy came from the quiescent post-stop src: a second pass
+    # trusts it (snap.verified) and stays a no-op
+    again = delta_sync(src, dst, snap)
+    assert again.delta_files == 0
+
+
+def test_clone_tree_refuses_special_files(tmp_path):
+    """A FIFO in the layer must fail LOUDLY (seed copy2 semantics: the
+    mutation unwinds) — the reflink rung's blocking open must not hang
+    the replace while it holds the name lock."""
+    import shutil
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    os.makedirs(src)
+    with open(os.path.join(src, "ok.txt"), "w") as f:
+        f.write("ok")
+    os.mkfifo(os.path.join(src, "pipe"))
+    with pytest.raises(shutil.SpecialFileError):
+        clone_tree(src, dst)
+    with pytest.raises(shutil.SpecialFileError):
+        snapshot_tree(src, dst)
+
+
+def test_move_feeds_metrics(tmp_path):
+    before = copyfast.METRICS.snapshot()
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    move_dir_contents(src, dst)
+    after = copyfast.METRICS.snapshot()
+    assert after["copiesByMode"].get("rename", 0) \
+        > before["copiesByMode"].get("rename", 0)
+
+
+# ------------------------------------------------------------------ move
+
+def test_move_same_fs_rename(tmp_path):
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    stats = move_dir_contents(src, dst)
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+    assert not os.listdir(src)
+    assert stats.mode == "rename"
+    assert stats.files >= 3
+
+
+def test_move_collision_skip_if_identical(tmp_path):
+    """Satellite: a retry after a partial move must not raise — identical
+    entries are skipped (src copy dropped), colliding dirs merge, and a
+    differing dest file is replaced by the src authority."""
+    import shutil
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    move_dir_contents(src, dst)
+    # simulate the partial state a crash leaves: some entries back in src
+    shutil.copy2(os.path.join(dst, "a.bin"), os.path.join(src, "a.bin"))
+    os.makedirs(os.path.join(src, "sub"))
+    with open(os.path.join(src, "sub", "late.txt"), "w") as f:
+        f.write("late")                               # dir merge case
+    with open(os.path.join(src, "stale.txt"), "w") as f:
+        f.write("src wins")
+    with open(os.path.join(dst, "stale.txt"), "w") as f:
+        f.write("dest had a different one")
+    move_dir_contents(src, dst)                       # seed raised here
+    assert not os.listdir(src)
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+    assert open(os.path.join(dst, "sub", "late.txt")).read() == "late"
+    assert open(os.path.join(dst, "stale.txt")).read() == "src wins"
+    # original merged content untouched
+    assert open(os.path.join(dst, "sub", "b.bin"), "rb").read() == b"y" * 123
+
+
+def test_move_rerun_is_idempotent(tmp_path):
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _mktree(src)
+    move_dir_contents(src, dst)
+    move_dir_contents(src, dst)       # nothing left: clean no-op
+    assert open(os.path.join(dst, "a.bin"), "rb").read() == b"x" * 4096
+
+
+# ------------------------------------- pre-copy replace through the service
+
+class _DirtyOnStopBackend(MockBackend):
+    """Writes into the stopping container's layer just before the stop
+    lands — models the workload flushing state on SIGTERM, the exact
+    window the delta pass exists for."""
+
+    def __init__(self, state_dir):
+        super().__init__(state_dir)
+        self.dirty_on_stop = True
+
+    def stop(self, name, timeout=10.0):
+        if self.dirty_on_stop:
+            st = self.inspect(name)
+            if st.exists and st.upper_dir:
+                with open(os.path.join(st.upper_dir, "flushed.state"),
+                          "w") as f:
+                    f.write("written during stop")
+        super().stop(name, timeout)
+
+
+@pytest.fixture()
+def world(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    backend = _DirtyOnStopBackend(str(tmp_path / "state"))
+    tpu = TpuScheduler(client, wq, topology=make_topology("v4-32"))
+    cpu = CpuScheduler(client, wq, core_count=16)
+    ports = PortScheduler(client, wq, port_range=(43000, 43100), seed=7)
+    events = EventLog()
+    rs = ReplicaSetService(backend, client, wq, tpu, cpu, ports,
+                           VersionMap("containerVersionMap", client, wq),
+                           MergeMap(client, wq), events=events)
+    yield rs, backend, events
+    wq.close()
+
+
+def _patch_memory(rs, name, mem):
+    return rs.patch_container(name, PatchRequest(
+        memoryPatch=MemoryPatch(memory=mem)))
+
+
+def test_precopy_replace_carries_stop_time_writes(world):
+    """End-to-end: warm copy runs while v1 is live, v1 dirties its layer
+    during stop, the delta pass carries the late write into v2."""
+    rs, backend, events = world
+    rs.run_container(ContainerRun(imageName="img", replicaSetName="pre",
+                                  tpuCount=2, memory="4GB"))
+    upper = backend.inspect("pre-1").upper_dir
+    with open(os.path.join(upper, "model.ckpt"), "wb") as f:
+        f.write(b"c" * 20000)
+    resp = _patch_memory(rs, "pre", "8GB")
+    assert resp["name"] == "pre-2"
+    new_upper = backend.inspect("pre-2").upper_dir
+    assert open(os.path.join(new_upper, "model.ckpt"), "rb").read() \
+        == b"c" * 20000
+    # the write that landed DURING stop still made it across
+    assert open(os.path.join(new_upper, "flushed.state")).read() \
+        == "written during stop"
+    evts = [e for e in events.recent() if e["op"] == "replace.copied"]
+    assert evts and evts[-1]["precopied"] is True
+    assert evts[-1]["deltaFiles"] >= 1          # flushed.state at minimum
+    assert evts[-1]["downtimeMs"] >= 0
+
+
+def test_precopy_disabled_still_replaces(world, monkeypatch):
+    """TDAPI_PRECOPY=0 restores the seed's single in-window copy."""
+    monkeypatch.setenv("TDAPI_PRECOPY", "0")
+    rs, backend, events = world
+    rs.run_container(ContainerRun(imageName="img", replicaSetName="ser",
+                                  tpuCount=1, memory="4GB"))
+    upper = backend.inspect("ser-1").upper_dir
+    with open(os.path.join(upper, "data.bin"), "wb") as f:
+        f.write(b"d" * 5000)
+    _patch_memory(rs, "ser", "8GB")
+    new_upper = backend.inspect("ser-2").upper_dir
+    assert open(os.path.join(new_upper, "data.bin"), "rb").read() \
+        == b"d" * 5000
+    assert open(os.path.join(new_upper, "flushed.state")).read() \
+        == "written during stop"
+    evts = [e for e in events.recent() if e["op"] == "replace.copied"]
+    assert evts and evts[-1]["precopied"] is False
+
+
+def test_replace_metrics_accumulate(world):
+    rs, backend, _ = world
+    before = copyfast.METRICS.snapshot()
+    rs.run_container(ContainerRun(imageName="img", replicaSetName="met",
+                                  tpuCount=1, memory="4GB"))
+    upper = backend.inspect("met-1").upper_dir
+    with open(os.path.join(upper, "blob"), "wb") as f:
+        f.write(b"m" * 10000)
+    _patch_memory(rs, "met", "8GB")
+    after = copyfast.METRICS.snapshot()
+    assert after["copyBytes"] >= before["copyBytes"] + 10000
+    assert sum(after["copiesByMode"].values()) \
+        > sum(before["copiesByMode"].values())
+    assert after["lastDowntimeMs"] >= 0

@@ -86,6 +86,64 @@ def test_scheduling_throughput_floor(app):
         f"< floor {FLOOR_CHIPS_PER_SEC} (was the hot path re-serialized?)")
 
 
+def test_clone_tree_beats_serial_seed_copy(tmp_path):
+    """The copy fast path (utils/copyfast.py) must not fall behind the
+    serial seed walk it replaced. Fixture: 96 x 256 KB files (24 MB) —
+    enough files that the pool's parallelism (sendfile/copy_file_range
+    release the GIL) shows, small enough for any CI box. The margin is
+    DELIBERATELY generous (fast path may take up to 1.5x the serial walk's
+    time before this fails): the target failure mode is a rewrite that
+    re-serializes or re-buffers the copy path into a 3-10x regression, not
+    machine noise."""
+    import os
+    import shutil
+
+    from gpu_docker_api_tpu.utils.copyfast import clone_tree
+
+    src = tmp_path / "layer"
+    src.mkdir()
+    blob = os.urandom(256 * 1024)
+    for i in range(96):
+        sub = src / f"d{i % 8}"
+        sub.mkdir(exist_ok=True)
+        (sub / f"f{i}.bin").write_bytes(blob)
+
+    def serial_seed_copy(s: str, d: str) -> None:
+        # the pre-copyfast copy_dir: recursive scandir + copy2, one file
+        # at a time (utils/file.py at the seed)
+        os.makedirs(d, exist_ok=True)
+        for entry in os.scandir(s):
+            dp = os.path.join(d, entry.name)
+            if entry.is_dir():
+                serial_seed_copy(entry.path, dp)
+            else:
+                shutil.copy2(entry.path, dp, follow_symlinks=False)
+
+    # warm the page cache so the comparison is copy-path, not disk; the
+    # two sides are timed INTERLEAVED (serial, fast, serial, fast) with
+    # best-of per side, so a load spike on a busy CI box hits both rather
+    # than deciding the verdict
+    serial_seed_copy(str(src), str(tmp_path / "warm"))
+    t_serial = float("inf")
+    t_fast = float("inf")
+    stats = None
+    for i in range(2):
+        t0 = time.perf_counter()
+        serial_seed_copy(str(src), str(tmp_path / f"serial{i}"))
+        t_serial = min(t_serial, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        stats = clone_tree(str(src), str(tmp_path / f"fast{i}"))
+        t_fast = min(t_fast, time.perf_counter() - t0)
+
+    assert stats.files == 96 and stats.bytes == 96 * 256 * 1024
+    assert (tmp_path / "fast0" / "d0" / "f0.bin").read_bytes() == blob
+    assert t_fast <= t_serial * 2.0, (
+        f"copy fast path regressed: clone_tree {t_fast:.3f}s vs serial "
+        f"seed walk {t_serial:.3f}s (floor: 2.0x — generous; the target "
+        f"failure is a 3-10x re-serialization) — was the pool or the "
+        f"copy ladder re-serialized?")
+
+
 def test_store_put_throughput_floor(tmp_path):
     """WAL-backed store writes (group-commit path, 4 concurrent writers)
     must stay comfortably above FLOOR ops/sec on both engines."""

@@ -51,6 +51,19 @@ def test_dir_size_and_copy(tmp_path):
     assert os.path.islink(dest / "link")
 
 
+def test_dir_size_dedupes_hardlinks(tmp_path):
+    # a hardlinked file occupies ONE set of blocks; billing it per link
+    # over-charged quota checks (the shrink guard refused legitimate sizes)
+    d = tmp_path / "vol"
+    d.mkdir()
+    (d / "orig.bin").write_bytes(b"h" * 2048)
+    os.link(d / "orig.bin", d / "hard1.bin")
+    (d / "sub").mkdir()
+    os.link(d / "orig.bin", d / "sub" / "hard2.bin")
+    (d / "plain.bin").write_bytes(b"p" * 100)
+    assert dir_size(str(d)) == 2048 + 100
+
+
 def test_move_dir_contents(tmp_path):
     src = tmp_path / "old"
     src.mkdir()
