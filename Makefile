@@ -6,7 +6,8 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry bench serve serve-mock dryrun apidoc lint clean
+    verify-retry verify-migrate bench serve serve-mock dryrun apidoc lint \
+    clean
 
 all: native
 
@@ -20,6 +21,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-faults  (transient-fault sweep: -m faults)"
 	@echo "  make verify-retry   (exactly-once sweep: -m retry)"
 	@echo "  make verify-perf    (throughput-floor smoke: -m perf)"
+	@echo "  make verify-migrate (zero-loss migration sweep: -m migrate)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
 	$(PY) -m pytest tests/ -q -m crash
@@ -32,6 +34,9 @@ verify-retry:           ## exactly-once sweep: duplicate keys, dropped responses
 
 verify-perf:            ## control-plane throughput smoke (generous floors, tier-1-safe)
 	$(PY) -m pytest tests/ -q -m perf
+
+verify-migrate:         ## zero-loss migration sweep: quiesce protocol + e2e gapless patch
+	$(PY) -m pytest tests/ -q -m migrate
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

@@ -1047,6 +1047,125 @@ def replace_bench() -> dict:
     return out
 
 
+def migration_bench() -> dict:
+    """Zero-loss training migration (the quiesce protocol,
+    services/replicaset.py + backend quiesce contract): run a real (tiny,
+    CPU-forced — this measures control-plane migration mechanics, not chip
+    math) train_llama replicaSet through the REST stack, patch it 1->4
+    chips MID-RUN, and read the metrics.jsonl step sequence across the
+    migration: `steps_lost` (replayed training steps) and `gap_ms` (wall
+    clock between the last pre-migration step record and the first
+    post-migration one) — quiesce-enabled vs the kill-and-replay
+    baseline. Headline: migration_steps_lost / migration_gap_ms from the
+    quiesce variant (0 lost steps is the contract)."""
+    import shutil
+
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    def read_steps(path):
+        out = []
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    try:
+                        r = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if "step" in r:
+                        out.append((r["step"], r.get("time", 0.0)))
+        return out
+
+    def wait_steps(path, pred, timeout=300.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            recs = read_steps(path)
+            if pred(recs):
+                return recs
+            time.sleep(0.25)
+        raise TimeoutError(f"metrics predicate not met at {path}")
+
+    def one_variant(tag: str, quiesce: bool) -> dict:
+        state_dir = tempfile.mkdtemp(prefix=f"tdapi-migrate-{tag}-")
+        app = App(state_dir=state_dir, backend="process", addr="127.0.0.1:0",
+                  topology=make_topology("v5p-8"), api_key="",
+                  cpu_cores=max(os.cpu_count() or 1, 4))
+        app.start()
+        try:
+            port = app.server.port
+            vol = call(port, "POST", "/api/v1/volumes",
+                       {"name": "migdata", "size": "2GB"})
+            mp = vol["mountpoint"]
+            # persistent compile cache OFF (empty value blocks the
+            # daemon's auto-injection too): this jax build intermittently
+            # heap-corrupts reading a warm shared cache after a resume —
+            # the gap_ms number must price the migration, not a flake
+            env = [f"PYTHONPATH={REPO}",
+                   "JAX_PLATFORMS=cpu", "JAX_PLATFORM_NAME=cpu",
+                   "XLA_FLAGS=--xla_force_host_platform_device_count=1",
+                   "JAX_COMPILATION_CACHE_DIR=",
+                   f"TDAPI_QUIESCE={'1' if quiesce else '0'}"]
+            # relative --workdir: resolves inside the rootfs, where the
+            # bind is a symlink onto the volume mountpoint
+            cmd = [sys.executable, "-m",
+                   "gpu_docker_api_tpu.workloads.train_llama",
+                   "--config", "tiny", "--steps", "400",
+                   "--checkpoint-every", "10",
+                   "--batch", "2", "--seq", "32",
+                   "--workdir", "root/foo-tmp"]
+            call(port, "POST", "/api/v1/replicaSet", {
+                "imageName": "python", "replicaSetName": "mig",
+                "tpuCount": 1, "env": env, "cmd": cmd,
+                "binds": [{"src": mp, "dest": "/root/foo-tmp"}]})
+            metrics = os.path.join(mp, "metrics.jsonl")
+            # past the first periodic checkpoint so the baseline has a
+            # resume point that actually costs it replayed steps
+            wait_steps(metrics,
+                       lambda rs: max((s for s, _ in rs), default=0) >= 15)
+            call(port, "PATCH", "/api/v1/replicaSet/mig",
+                 {"tpuPatch": {"tpuCount": 4}})
+            pre = max(s for s, _ in read_steps(metrics))
+            recs = wait_steps(
+                metrics,
+                lambda rs: max((s for s, _ in rs), default=0) > pre)
+            seq = [s for s, _ in recs]
+            breaks = [i for i in range(1, len(seq)) if seq[i] <= seq[i - 1]]
+            if breaks:
+                i = breaks[0]
+                steps_lost = seq[i - 1] - (seq[i] - 1)
+            else:
+                # gapless (zero-loss): locate the boundary by the largest
+                # inter-record wall gap — the migration window (process
+                # restart + import + compile, seconds) dwarfs a tiny-model
+                # step (ms). Index-of-`pre` would race a fast resume: the
+                # PATCH returns after the new container starts, so `pre`
+                # can already be a post-migration step.
+                i = max(range(1, len(seq)),
+                        key=lambda j: recs[j][1] - recs[j - 1][1])
+                steps_lost = 0
+            gap_ms = (recs[i][1] - recs[i - 1][1]) * 1e3
+            evts = [e for e in app.events.recent(limit=50)
+                    if e["op"] == "replace.copied"]
+            call(port, "DELETE", "/api/v1/replicaSet/mig")
+            return {
+                "steps_lost": steps_lost,
+                "gap_ms": round(gap_ms, 1),
+                "quiesced": bool(evts and evts[-1].get("quiesced")),
+                "quiesce_step": evts[-1].get("quiesceStep") if evts else None,
+                "pre_patch_step": pre,
+            }
+        finally:
+            app.stop()
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+    q = one_variant("quiesce", quiesce=True)
+    base = one_variant("baseline", quiesce=False)
+    out = {"quiesce": q, "baseline": base}
+    if base["gap_ms"] and q["gap_ms"]:
+        out["gap_ratio"] = round(base["gap_ms"] / max(q["gap_ms"], 1e-9), 2)
+    return out
+
+
 def check_claims(extra: dict) -> dict:
     """Diff this run's extras against BASELINE.json's machine-readable
     claims table (the same numbers BASELINE.md publishes). Any ratio
@@ -1149,6 +1268,12 @@ def main() -> None:
         extra["replace"] = replace_bench()
     except Exception as e:  # noqa: BLE001
         log(f"replace bench failed: {type(e).__name__}: {e}")
+    try:
+        log("migration bench (tiny CPU-forced train_llama, mid-run 1->4 "
+            "patch, quiesce vs kill-and-replay)...")
+        extra["migration"] = migration_bench()
+    except Exception as e:  # noqa: BLE001
+        log(f"migration bench failed: {type(e).__name__}: {e}")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
@@ -1222,6 +1347,11 @@ def main() -> None:
             "host8b_warm_rest_s": _dig("host8b", "warm_rest_s_32tok"),
             "replace_downtime_ms": _dig("replace", "fast", "downtime_ms"),
             "replace_downtime_speedup": _dig("replace", "downtime_speedup"),
+            "migration_steps_lost": _dig("migration", "quiesce",
+                                         "steps_lost"),
+            "migration_gap_ms": _dig("migration", "quiesce", "gap_ms"),
+            "migration_baseline_steps_lost": _dig("migration", "baseline",
+                                                  "steps_lost"),
             "claims_ok": _dig("claims", "ok"),
             "claims_failed": len(_dig("claims", "failed", default=[]) or []),
         },

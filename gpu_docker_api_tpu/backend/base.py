@@ -152,6 +152,31 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def remove(self, name: str, force: bool = False) -> None: ...
 
+    def quiesce(self, name: str, timeout: float = 30.0) -> bool:
+        """Workload quiesce contract: deliver a checkpoint-now signal
+        (SIGUSR1) to the container's process group and wait up to
+        ``timeout`` seconds for the workload to acknowledge by writing the
+        ``.quiesced`` ack file into its writable layer root (the workload
+        half lives in train.py: finish the in-flight step, save an orbax
+        checkpoint plus a durable ``QUIESCED <step>`` marker next to it,
+        write the ack, park until stopped).
+
+        Returns True only when the ack appeared in time — the caller
+        (services/replicaset.py rolling replace) then knows the layer
+        holds a checkpoint at the exact parked step, so the migration
+        loses ZERO steps. False means not delivered / not acknowledged
+        (container not running, substrate can't signal, workload has no
+        handler, or the checkpoint outran the timeout): the caller falls
+        back to the plain stop, degrading to at most ``checkpoint-every``
+        replayed steps — a quiesce failure must never wedge a drain.
+
+        Base default: unsupported (False). Substrates that can signal
+        override it."""
+        return False
+
+    #: name of the ack file a quiescing workload writes at its layer root
+    QUIESCE_ACK = ".quiesced"
+
     @abc.abstractmethod
     def execute(self, name: str, cmd: list[str], workdir: str = "") -> tuple[int, str]:
         """Run cmd inside the container; returns (exit_code, combined output)."""

@@ -154,9 +154,16 @@ class DockerBackend(Backend):
     # ---- containers ----
 
     def create(self, name: str, spec: ContainerSpec) -> str:
+        env = list(spec.env) + [f"{k}={v}" for k, v in spec.tpu_env.items()]
+        if not any(e.startswith("CONTAINER_ROOT=") for e in env):
+            # the quiesce ack contract addresses the writable-layer ROOT
+            # ("/" from inside the container = the overlay2 UpperDir this
+            # backend polls); without this an image's WORKDIR would strand
+            # the ack in a subdirectory and quiesce would always time out
+            env.append("CONTAINER_ROOT=/")
         body = {
             "Image": spec.image,
-            "Env": list(spec.env) + [f"{k}={v}" for k, v in spec.tpu_env.items()],
+            "Env": env,
             "Cmd": spec.cmd or None,
             "ExposedPorts": {f"{p}/tcp": {} for p in spec.port_bindings},
             "HostConfig": self._host_config(spec),
@@ -169,6 +176,36 @@ class DockerBackend(Backend):
 
     def stop(self, name: str, timeout: float = 10.0) -> None:
         self._request("POST", f"/containers/{name}/stop?t={int(timeout)}")
+
+    def quiesce(self, name: str, timeout: float = 30.0) -> bool:
+        """Checkpoint-now over the Engine API: /containers/{name}/kill with
+        SIGUSR1, then wait for the workload's ack file in the overlay2
+        UpperDir (the same `.quiesced` contract every substrate shares).
+        A dockerd that exposes no UpperDir (remote daemon, exotic graph
+        driver) can't observe the ack — report not-quiesced and let the
+        caller's plain stop converge."""
+        import os
+        import time
+        state = self.inspect(name)
+        if not state.exists or not state.running or not state.upper_dir:
+            return False
+        ack = os.path.join(state.upper_dir, self.QUIESCE_ACK)
+        try:
+            os.unlink(ack)        # a stale ack must not satisfy this wait
+        except OSError:
+            pass
+        try:
+            self._request("POST", f"/containers/{name}/kill?signal=SIGUSR1")
+        except DockerError:
+            return False
+        deadline = time.time() + max(0.0, timeout)
+        while time.time() < deadline:
+            if os.path.exists(ack):
+                return True
+            if not self.inspect(name).running:
+                return False      # died on the signal: no ack is coming
+            time.sleep(0.05)
+        return os.path.exists(ack)
 
     def pause(self, name: str) -> None:
         self._request("POST", f"/containers/{name}/pause")

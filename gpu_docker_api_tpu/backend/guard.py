@@ -200,6 +200,13 @@ def _call_with_deadline(fn: Callable, deadline: float, op: str):
 #: ERROR is different: the substrate answered "no", nothing happened.
 NON_IDEMPOTENT = frozenset({"create", "commit", "volume_create"})
 
+#: best-effort ops: never retried. A quiesce retry would be DESTRUCTIVE —
+#: its stale-ack unlink deletes the ack a workload that already parked
+#: wrote, and re-signaling a parked workload can never produce a new one —
+#: and the caller's contract already degrades cleanly (fall back to the
+#: plain stop), so one attempt is the whole budget.
+BEST_EFFORT = frozenset({"quiesce"})
+
 
 class GuardedBackend(Backend):
     """Decorator implementing every Backend method through the guard."""
@@ -260,9 +267,11 @@ class GuardedBackend(Backend):
 
     # ---- the guard ----
 
-    def _guard(self, op: str, fn: Callable):
+    def _guard(self, op: str, fn: Callable,
+               deadline: Optional[float] = None):
         trial = self.breaker.admit()
-        deadline = self.deadlines.get(op, self.deadline)
+        if deadline is None:
+            deadline = self.deadlines.get(op, self.deadline)
         attempt = 0
 
         def one_attempt():
@@ -273,8 +282,9 @@ class GuardedBackend(Backend):
             try:
                 result = _call_with_deadline(one_attempt, deadline, op)
             except TRANSIENT as e:
-                retryable = not (isinstance(e, xerrors.BackendTimeoutError)
-                                 and op in NON_IDEMPOTENT)
+                retryable = (op not in BEST_EFFORT
+                             and not (isinstance(e, xerrors.BackendTimeoutError)
+                                      and op in NON_IDEMPOTENT))
                 if retryable and attempt < self.retries:
                     attempt += 1
                     # full jitter: decorrelates a thundering herd of
@@ -310,6 +320,20 @@ class GuardedBackend(Backend):
 
     def pause(self, name: str) -> None:
         return self._guard("pause", lambda: self.inner.pause(name))
+
+    def quiesce(self, name: str, timeout: float = 30.0) -> bool:
+        # a quiesce legitimately blocks up to its OWN timeout waiting for
+        # the workload's checkpoint ack, so the generic per-op deadline
+        # must not cut a healthy wait short — unless the operator pinned
+        # an explicit "quiesce" deadline, grant the call its timeout plus
+        # signaling slack. Single attempt (BEST_EFFORT): a retry's
+        # stale-ack unlink would destroy a parked workload's legitimate
+        # ack, and the caller falls back to the plain stop anyway.
+        dl = self.deadlines.get("quiesce",
+                                max(self.deadline, timeout + 5.0))
+        return self._guard("quiesce",
+                           lambda: self.inner.quiesce(name, timeout),
+                           deadline=dl)
 
     def restart_inplace(self, name: str) -> None:
         return self._guard("restart_inplace",

@@ -44,6 +44,12 @@ class MockBackend(Backend):
         self._ping_ok = True
         self._chip_health: dict[str, bool] = {}
         self._flaps: dict[str, int] = {}
+        # injectable quiesce behavior (no real workload to signal):
+        # "ok" acks instantly at _quiesce_step, "timeout" refuses,
+        # "error" raises a transient error like a flaky substrate would
+        self._quiesce_mode = "ok"
+        self._quiesce_step = 7
+        self.quiesce_log: list[str] = []
         os.makedirs(os.path.join(state_dir, "upper"), exist_ok=True)
         os.makedirs(os.path.join(state_dir, "volumes"), exist_ok=True)
 
@@ -66,6 +72,30 @@ class MockBackend(Backend):
 
     def flap_counts(self) -> dict[str, int]:
         return {n: c for n, c in self._flaps.items() if c > 0}
+
+    def set_quiesce(self, mode: str, step: int = 7) -> None:
+        """Inject the next quiesce outcome: "ok" | "timeout" | "error"."""
+        if mode not in ("ok", "timeout", "error"):
+            raise ValueError(f"bad quiesce mode {mode!r}")
+        self._quiesce_mode = mode
+        self._quiesce_step = step
+
+    def quiesce(self, name: str, timeout: float = 30.0) -> bool:
+        import json
+        with self._lock:
+            c = self._containers.get(name)
+            if c is None or not c.running:
+                return False
+            self.quiesce_log.append(name)
+            if self._quiesce_mode == "error":
+                raise ConnectionError(f"injected quiesce error on {name}")
+            if self._quiesce_mode == "timeout":
+                return False
+            # instant ack at the injected step, exactly where a real
+            # workload would leave it (base.py QUIESCE_ACK contract)
+            with open(os.path.join(c.upper_dir, self.QUIESCE_ACK), "w") as f:
+                json.dump({"step": self._quiesce_step}, f)
+            return True
 
     # ---- containers ----
 

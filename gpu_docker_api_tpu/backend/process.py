@@ -115,6 +115,9 @@ class ProcessBackend(Backend):
         # optional EventLog; the App wires it so quota mount/umount stalls
         # surface on /api/v1/events (see _run_quiet)
         self.events = None
+        # stop() escalations to SIGKILL — workloads that ignored SIGTERM
+        # for the whole stop timeout; exported as tdapi_backend_stop_kills
+        self.stop_kills = 0
         for sub in ("rootfs", "volumes", "images", "logs"):
             os.makedirs(os.path.join(state_dir, sub), exist_ok=True)
         # warm worker pool (warmpool.py): python workloads start in a
@@ -191,6 +194,13 @@ class ProcessBackend(Backend):
             p = self._get(name)
             if p.popen is not None and p.popen.poll() is None:
                 return
+            # a stale quiesce ack (prior quiesce, or one cloned in by the
+            # replace layer copy) must not let a future quiesce() read a
+            # dead workload's acknowledgment as this run's
+            try:
+                os.unlink(os.path.join(p.rootfs, self.QUIESCE_ACK))
+            except OSError:
+                pass
             env = self._build_env(p)
             cmd = list(p.spec.cmd) or ["sleep", "infinity"]
             p.popen = self._start_warm(p, cmd, env)
@@ -249,12 +259,58 @@ class ProcessBackend(Backend):
         try:
             po.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
+            # escalation is an operator-visible symptom, never silent: the
+            # workload ignored SIGTERM for the whole stop window (wedged
+            # checkpoint write, masked signal, stuck device teardown)
+            self.stop_kills += 1
+            log.warning("stop: %s ignored SIGTERM for %.0fs — escalating "
+                        "to SIGKILL", name, timeout)
+            self._log_line(p, f"supervisor: SIGTERM ignored for {timeout:.0f}s"
+                              " — escalating to SIGKILL")
+            if self.events is not None:
+                try:
+                    self.events.record("backend.stop_killed", target=name,
+                                       code=500, timeoutSec=timeout)
+                except Exception:  # noqa: BLE001 — observability must not kill
+                    log.exception("recording stop_killed event")
             try:
                 os.killpg(po.pid, signal.SIGKILL)
             except ProcessLookupError:
                 pass
             po.wait(timeout=5)
         p.exit_code = po.returncode
+
+    def quiesce(self, name: str, timeout: float = 30.0) -> bool:
+        """Checkpoint-now: SIGUSR1 to the container's process group, then
+        wait for the workload's `.quiesced` ack at the rootfs root (the
+        contract in base.py / train.py). A workload that dies instead of
+        parking (no handler installed — SIGUSR1's default action is
+        terminate) reads as not-quiesced, and the caller's plain stop
+        still converges."""
+        with self._lock:
+            p = self._procs.get(name)
+            if p is None:
+                return False
+            po = p.popen
+            if po is None or po.poll() is not None or p.paused:
+                return False
+            ack = os.path.join(p.rootfs, self.QUIESCE_ACK)
+        try:
+            os.unlink(ack)        # a stale ack must not satisfy this wait
+        except OSError:
+            pass
+        try:
+            os.killpg(po.pid, signal.SIGUSR1)
+        except ProcessLookupError:
+            return False
+        deadline = time.time() + max(0.0, timeout)
+        while time.time() < deadline:
+            if os.path.exists(ack):
+                return True
+            if po.poll() is not None:
+                return False      # died on the signal: no ack is coming
+            time.sleep(0.02)
+        return os.path.exists(ack)
 
     def pause(self, name: str) -> None:
         with self._lock:

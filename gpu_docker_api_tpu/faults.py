@@ -65,6 +65,10 @@ CRASHPOINTS: dict[str, str] = {
     "run.after_start": "container started, latest pointer not yet persisted",
     # rolling replace (patch / rollback / restart all funnel through it)
     "replace.after_create": "new version created+persisted, old still running",
+    "replace.after_quiesce": "quiesce attempt settled (workload checkpoint "
+                             "parked or fallback chosen), old not yet "
+                             "stopped — the QUIESCED marker is idempotent, "
+                             "so recovery resumes from the same checkpoint",
     "replace.after_stop_old": "old stopped, layer not yet (delta-)copied — "
                               "the pre-copy may already have warm-copied it",
     "replace.after_copy": "layer copied, new version not yet started",

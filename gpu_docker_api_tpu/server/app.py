@@ -852,6 +852,11 @@ class App:
             "# TYPE tdapi_chip_health_failures gauge",
             f"tdapi_chip_health_failures "
             f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",
+            "# TYPE tdapi_backend_stop_kills counter",
+            "# stop() escalations: workload ignored SIGTERM for the whole "
+            "stop timeout and ate a SIGKILL",
+            f"tdapi_backend_stop_kills "
+            f"{getattr(getattr(self.backend, 'inner', self.backend), 'stop_kills', 0)}",
         ]
         # rolling-replace data movement (utils/copyfast.py): how many bytes
         # layer/volume copies moved, through which ladder rung, and the

@@ -74,6 +74,54 @@ def _now() -> str:
     return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
 
 
+# ---- workload quiesce knobs (checkpoint-on-drain; backend/base.py) ----
+
+def quiesce_enabled() -> bool:
+    """Global kill switch: TDAPI_QUIESCE=0 restores the plain
+    stop-and-replay migration everywhere (read per call so a live daemon
+    can be flipped)."""
+    import os
+    return os.environ.get("TDAPI_QUIESCE", "1").lower() not in (
+        "0", "false", "no")
+
+
+def quiesce_timeout() -> float:
+    """Bound on the checkpoint-now wait (TDAPI_QUIESCE_TIMEOUT, seconds).
+    On expiry the replace falls back to today's stop — a slow checkpoint
+    must never wedge a drain."""
+    import os
+    try:
+        return float(os.environ.get("TDAPI_QUIESCE_TIMEOUT", "") or 30.0)
+    except ValueError:
+        return 30.0
+
+
+def spec_wants_quiesce(spec: ContainerSpec) -> bool:
+    """Per-workload opt-in: the container's env carries TDAPI_QUIESCE=1
+    (set by the operator who wired the SIGUSR1 handler — train.py). A
+    workload WITHOUT a handler dies on SIGUSR1 (default disposition), so
+    quiesce is never sprayed at arbitrary containers."""
+    for kv in spec.env:
+        k, _, v = kv.partition("=")
+        if k == "TDAPI_QUIESCE":
+            return v.lower() not in ("", "0", "false", "no")
+    return False
+
+
+def _read_quiesce_ack(upper_dir: str):
+    """The parked step from the workload's ack file, or None. Best-effort:
+    the ack's existence (backend.quiesce returning True) is the contract;
+    the step inside is observability."""
+    import json
+    import os
+    try:
+        with open(os.path.join(upper_dir, Backend.QUIESCE_ACK)) as f:
+            step = json.load(f).get("step")
+        return int(step) if step is not None else None
+    except (OSError, ValueError, TypeError, json.JSONDecodeError):
+        return None
+
+
 class ReplicaSetService:
     def __init__(self, backend: Backend, client: StateClient, wq: WorkQueue,
                  tpu: TpuScheduler, cpu: CpuScheduler, ports: PortScheduler,
@@ -363,10 +411,25 @@ class ReplicaSetService:
 
     def _rolling_replace(self, name: str, old: StoredContainerInfo,
                          new_spec: ContainerSpec,
-                         intent: Optional[Intent] = None) -> StoredContainerInfo:
+                         intent: Optional[Intent] = None,
+                         meta_out: Optional[dict] = None) -> StoredContainerInfo:
         """create new version -> pre-copy writable layer (old still
-        running) -> stop old (chip exclusivity) -> delta-copy dirtied
-        files -> start new -> delete old (reference :318-353, reordered).
+        running) -> QUIESCE the workload (checkpoint-now, bounded) -> stop
+        old (chip exclusivity) -> delta-copy dirtied files (now including
+        the quiesce checkpoint) -> start new -> delete old (reference
+        :318-353, reordered).
+
+        The quiesce step is the zero-loss half of training migration: a
+        workload that opted in (spec env TDAPI_QUIESCE=1, handler wired in
+        train.py) checkpoints its EXACT current step and parks before the
+        stop, so the restarted version resumes with no replayed work. It
+        is strictly best-effort — timeout, error, or an un-acked signal
+        all fall back to today's plain stop (≤ checkpoint-every steps
+        replayed), and a crash at any point reconciles exactly like an
+        interrupted replace: the QUIESCED marker is idempotent, an
+        unwound new container restarts the old one, which resumes from
+        the same checkpoint. meta_out (when given) receives the
+        per-migration quiesced/stepsLost outcome for the drain response.
 
         The pre-copy/delta split (utils/copyfast.py) moves the O(layer
         bytes) copy OUT of the stop->start downtime window: only the files
@@ -395,6 +458,8 @@ class ReplicaSetService:
         old_state = self.backend.inspect(old.containerName)
         pre_snap = pre_stats = None
         downtime_ms = None
+        quiesced = False
+        quiesce_step = None
         try:
             if copyfast.precopy_enabled():
                 try:
@@ -412,6 +477,33 @@ class ReplicaSetService:
                                     bytes=pre_stats.bytes,
                                     files=pre_stats.files,
                                     mode=pre_stats.mode)
+            # workload quiesce: after the warm copy (training continued
+            # through it), while the old container still runs and holds
+            # its chips, ask the workload to checkpoint-now and park. The
+            # checkpoint it writes dirties files AFTER the pre-copy
+            # snapshot, so the delta pass below carries the now-final
+            # checkpoint dir inside the stop->start window — O(checkpoint)
+            # not O(layer). Bounded and best-effort: never wedge a drain.
+            if (quiesce_enabled() and spec_wants_quiesce(old.spec)
+                    and old_state.exists and old_state.running):
+                try:
+                    quiesced = self.backend.quiesce(
+                        old.containerName, timeout=quiesce_timeout())
+                except Exception:  # noqa: BLE001 — fall back to plain stop
+                    log.exception("quiesce %s failed; falling back to "
+                                  "plain stop", old.containerName)
+                    quiesced = False
+                if quiesced and old_state.upper_dir:
+                    quiesce_step = _read_quiesce_ack(old_state.upper_dir)
+            if intent is not None:
+                # informational (sync=False): the reconciler's replay
+                # branches don't consult it — recovery is identical to any
+                # interrupted replace because the checkpoint + QUIESCED
+                # marker are idempotent workload state, not control-plane
+                # state
+                intent.step("quiesced", sync=False, ok=quiesced,
+                            step=quiesce_step)
+            crashpoint("replace.after_quiesce")
             t_window = time.perf_counter()
             if old_state.exists and (old_state.running or old_state.paused):
                 self.backend.stop(old.containerName)
@@ -450,9 +542,17 @@ class ReplicaSetService:
                 except Exception:  # noqa: BLE001
                     log.exception("cleanup: restarting old container")
             raise
+        if meta_out is not None:
+            meta_out["quiesced"] = quiesced
+            # quiesced => the checkpoint sits at the exact parked step:
+            # zero replayed steps by construction. Fallback => unknown to
+            # the control plane (bounded by the workload's
+            # --checkpoint-every), reported honestly as null.
+            meta_out["stepsLost"] = 0 if quiesced else None
         if self.events is not None:
             self.events.record(
                 "replace.copied", target=name,
+                quiesced=quiesced, quiesceStep=quiesce_step,
                 precopied=pre_snap is not None,
                 precopyBytes=pre_stats.bytes if pre_stats else 0,
                 windowBytes=copy_stats.bytes if copy_stats else 0,
@@ -555,12 +655,17 @@ class ReplicaSetService:
 
         Each migration is an ordinary replace (via="drain") — journaled
         through the intent journal, so a crash mid-drain reconciles like
-        any other interrupted replace. The re-grant offers the old chips
-        for in-place reuse; apply() itself filters cordoned chips out of
-        both the free pool and the reuse set, so the new placement keeps
-        healthy chips where it can and never re-grants a cordoned one.
-        Failures (e.g. not enough healthy capacity) are reported per
-        replicaSet and do not abort the rest of the drain."""
+        any other interrupted replace. Training workloads that opted into
+        the quiesce contract are checkpointed at their exact step before
+        the move (zero-loss; per-item quiesced/stepsLost report it). The
+        re-grant offers the old chips for in-place reuse; apply() itself
+        filters cordoned chips out of both the free pool and the reuse
+        set, so the new placement keeps healthy chips where it can and
+        never re-grants a cordoned one. Failures (e.g. not enough healthy
+        capacity) are reported per replicaSet and do not abort the rest
+        of the drain — and a re-POST is idempotent: already-migrated sets
+        no longer hold cordoned chips and are passed over, failed ones
+        are retried."""
         cordoned = set(self.tpu.cordoned)
         result: dict = {"cordoned": sorted(cordoned), "drained": [],
                         "skipped": [], "failed": {}}
@@ -592,12 +697,14 @@ class ReplicaSetService:
                     "replace", name, via="drain", oldVersion=old.version,
                     oldContainer=old.containerName,
                     oldReleased=old.resourcesReleased, idemPartial=True)
+                migration_meta: dict = {}
                 try:
                     self._grant_tpus(new_spec, self.tpu.apply(
                         len(old.spec.tpu_chips), name,
                         reuse=list(old.spec.tpu_chips)))
                     intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
-                    info = self._rolling_replace(name, old, new_spec, intent)
+                    info = self._rolling_replace(name, old, new_spec, intent,
+                                                 meta_out=migration_meta)
                 except xerrors.BackendUnavailableError:
                     # breaker open: the WHOLE substrate is refusing — abort
                     # the drain (503 to the caller) instead of logging one
@@ -615,7 +722,13 @@ class ReplicaSetService:
                 result["drained"].append({
                     "name": name, "version": info.version,
                     "fromChips": sorted(old.spec.tpu_chips),
-                    "toChips": sorted(info.spec.tpu_chips)})
+                    "toChips": sorted(info.spec.tpu_chips),
+                    # zero-loss contract surface: quiesced=True means the
+                    # workload checkpointed its exact step before the move
+                    # (stepsLost 0); False means plain stop-and-replay
+                    # (stepsLost null — bounded by its checkpoint cadence)
+                    "quiesced": migration_meta.get("quiesced", False),
+                    "stepsLost": migration_meta.get("stepsLost")})
         return result
 
     # ---------------------------------------------------- stop / restart etc

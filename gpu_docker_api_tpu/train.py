@@ -12,6 +12,7 @@ replacement via the layer/volume copy).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -363,8 +364,30 @@ def save_checkpoint(path: str, state, step: int) -> None:
         mngr.wait_until_finished()
 
 
+def purge_incomplete_checkpoints(path: str) -> int:
+    """Remove uncommitted orbax step dirs (`*.orbax-checkpoint-tmp-*`) —
+    the debris a SIGTERM/SIGKILL lands mid-save (exactly what a rolling
+    replace's stop does to a workload whose quiesce window expired). They
+    are garbage by definition (never committed), orbax ignores them for
+    latest_step(), but this orbax/tensorstore build intermittently
+    corrupts its heap when a fresh CheckpointManager meets one — so the
+    resume path sweeps them FIRST. Returns how many were removed."""
+    import shutil
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return 0
+    n = 0
+    for entry in entries:
+        if ".orbax-checkpoint-tmp-" in entry:
+            shutil.rmtree(os.path.join(path, entry), ignore_errors=True)
+            n += 1
+    return n
+
+
 def restore_checkpoint(path: str, abstract_state=None) -> tuple[Any, int]:
     import orbax.checkpoint as ocp
+    purge_incomplete_checkpoints(path)
     with ocp.CheckpointManager(path) as mngr:
         step = mngr.latest_step()
         if step is None:
@@ -375,3 +398,102 @@ def restore_checkpoint(path: str, abstract_state=None) -> tuple[Any, int]:
         else:
             state = mngr.restore(step)
         return state, step
+
+
+# ---- workload quiesce (checkpoint-on-drain) --------------------------------
+#
+# The workload half of the backend quiesce contract (backend/base.py
+# Backend.quiesce): the control plane delivers SIGUSR1 when it is about to
+# migrate this container (drain / patch / rollback rolling replace). The
+# workload then finishes its in-flight step, saves a checkpoint at that
+# exact step, writes a durable `QUIESCED <step>` marker next to it, writes
+# the `.quiesced` ack the backend is polling for, and PARKS until the
+# control plane stops it. The restarted version resumes from that
+# checkpoint with ZERO replayed steps. Every piece is idempotent: a crash
+# anywhere re-resumes from the same checkpoint, and a stale marker is
+# consumed (cleared) on the next resume.
+
+QUIESCE_MARKER = "QUIESCED"
+
+
+class QuiesceSignal:
+    """Installs the SIGUSR1 handler; the training loop polls `requested`
+    at step boundaries (the handler only flips a flag — the in-flight
+    step must complete before the checkpoint is cut)."""
+
+    def __init__(self):
+        import signal
+        self.requested = False
+        signal.signal(signal.SIGUSR1, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.requested = True
+
+    @staticmethod
+    def park() -> None:
+        """Hold the process alive (checkpoint durable, chips idle) until
+        the control plane's stop delivers SIGTERM."""
+        import signal
+        while True:
+            signal.pause()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _durable_write(path: str, payload: str) -> None:
+    """Atomic + durable: tmp-write, fsync, rename, fsync dir — a host
+    crash can never leave a torn or unpersisted marker/ack."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def write_quiesce_marker(ckpt_dir: str, step: int) -> None:
+    """Durable `QUIESCED <step>` next to the checkpoints: the workload's
+    own record that step `step` was parked with a checkpoint — written
+    AFTER the orbax save completes, so marker implies checkpoint."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    _durable_write(os.path.join(ckpt_dir, QUIESCE_MARKER), f"{step}\n")
+
+
+def read_quiesce_marker(ckpt_dir: str):
+    """The parked step, or None when no quiesce marker exists."""
+    try:
+        with open(os.path.join(ckpt_dir, QUIESCE_MARKER)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def clear_quiesce_marker(ckpt_dir: str) -> None:
+    """Consume the marker on resume. Idempotent — a crash between restore
+    and clear just re-clears on the next boot, still resuming from the
+    same checkpoint."""
+    try:
+        os.unlink(os.path.join(ckpt_dir, QUIESCE_MARKER))
+    except OSError:
+        return
+    _fsync_dir(ckpt_dir)
+
+
+def write_quiesce_ack(step: int) -> None:
+    """The ack the backend polls for (base.py QUIESCE_ACK) at the
+    container's writable-layer root — written LAST, after checkpoint and
+    marker are durable, because it is the 'safe to stop me' promise."""
+    import json
+    root = os.environ.get("CONTAINER_ROOT") or os.getcwd()
+    _durable_write(os.path.join(root, ".quiesced"),
+                   json.dumps({"step": step}))

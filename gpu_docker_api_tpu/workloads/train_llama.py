@@ -75,7 +75,17 @@ def main(argv=None) -> int:
 
     from ..models import named_config
     from ..parallel.mesh import MeshPlan, best_tp_for
-    from ..train import Trainer, TrainConfig, restore_checkpoint, save_checkpoint
+    from ..train import (
+        QuiesceSignal, Trainer, TrainConfig, clear_quiesce_marker,
+        read_quiesce_marker, restore_checkpoint, save_checkpoint,
+    )
+
+    # checkpoint-on-drain: the control plane signals SIGUSR1 before a
+    # migration (backend quiesce contract); install the handler BEFORE the
+    # training loop so a drain arriving any time after startup is honored.
+    # The handler only flips a flag — the loop cuts the checkpoint at the
+    # next step boundary (train.py QuiesceSignal).
+    quiesce = QuiesceSignal()
 
     os.makedirs(args.workdir, exist_ok=True)
     ckpt_dir = os.path.abspath(os.path.join(args.workdir, "checkpoints"))
@@ -106,6 +116,14 @@ def main(argv=None) -> int:
     try:
         abstract = trainer.abstract_state(jax.random.key(0))
         state, start_step = restore_checkpoint(ckpt_dir, abstract)
+        q_step = read_quiesce_marker(ckpt_dir)
+        if q_step is not None:
+            # a prior generation parked here via quiesce; the marker is
+            # idempotent (crash-replayed resumes land on this same branch)
+            # and consumed now that this generation owns the run
+            print(f"resuming quiesced run: marker step {q_step}, "
+                  f"checkpoint step {start_step}", flush=True)
+            clear_quiesce_marker(ckpt_dir)
         print(f"resumed from checkpoint step {start_step}", flush=True)
     except FileNotFoundError:
         # no checkpoint yet: fresh start. Anything else (shape mismatch
@@ -131,7 +149,8 @@ def main(argv=None) -> int:
     metrics_f = open(metrics_path, "a", encoding="utf-8")
     try:
         _train_loop(args, trainer, state, start_step, prefetch, metrics_f,
-                    ckpt_dir, n_dev, plan, cluster, save_checkpoint)
+                    ckpt_dir, n_dev, plan, cluster, save_checkpoint,
+                    quiesce=quiesce)
     finally:
         metrics_f.close()
         prefetch.close()
@@ -139,10 +158,24 @@ def main(argv=None) -> int:
     return 0
 
 
+def _ckpt_record(metrics_f, rec: dict) -> None:
+    """Checkpoint-marker jsonl append, flushed AND fsync'd: a host crash
+    right after save_checkpoint must never leave a durable checkpoint
+    with no marker line (the marker is what tailing operators and the
+    resume diagnostics trust)."""
+    import json
+    import os
+    metrics_f.write(json.dumps(rec) + "\n")
+    metrics_f.flush()
+    os.fsync(metrics_f.fileno())
+
+
 def _train_loop(args, trainer, state, start_step, prefetch, metrics_f,
-                ckpt_dir, n_dev, plan, cluster, save_checkpoint):
+                ckpt_dir, n_dev, plan, cluster, save_checkpoint,
+                quiesce=None):
     import time
     import json
+    from ..train import write_quiesce_ack, write_quiesce_marker
     for step in range(start_step, args.steps):
         tokens = next(prefetch)
         t0 = time.perf_counter()
@@ -155,14 +188,25 @@ def _train_loop(args, trainer, state, start_step, prefetch, metrics_f,
             rec["process"] = f"{cluster['process_id']}/{cluster['num_processes']}"
         metrics_f.write(json.dumps(rec) + "\n")
         metrics_f.flush()
+        if quiesce is not None and quiesce.requested:
+            # checkpoint-on-drain: the in-flight step just completed, so
+            # park at EXACTLY step+1 — checkpoint, durable marker, then
+            # the ack (the 'safe to stop me' promise the backend polls),
+            # strictly in that order so ack implies durable checkpoint
+            save_checkpoint(ckpt_dir, state, step + 1)
+            write_quiesce_marker(ckpt_dir, step + 1)
+            _ckpt_record(metrics_f, {"checkpoint": step + 1,
+                                     "quiesced": True, "time": time.time()})
+            write_quiesce_ack(step + 1)
+            print(f"quiesced at step {step + 1}; parking", flush=True)
+            quiesce.park()      # until the control plane's stop (SIGTERM)
         if (step + 1) % args.checkpoint_every == 0 or step + 1 == args.steps:
             # hand orbax the sharded state as-is: on multi-host runs
             # device_get would raise (arrays span non-addressable devices);
             # orbax coordinates the multi-process save itself
             save_checkpoint(ckpt_dir, state, step + 1)
-            metrics_f.write(json.dumps(
-                {"checkpoint": step + 1, "time": time.time()}) + "\n")
-            metrics_f.flush()
+            _ckpt_record(metrics_f, {"checkpoint": step + 1,
+                                     "time": time.time()})
 
 
 if __name__ == "__main__":

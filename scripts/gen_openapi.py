@@ -397,7 +397,19 @@ def build_spec() -> dict:
             {"cordoned": arr(i(), "Full cordoned set after the change")}),
         "DrainItem": obj(
             {"name": s("replicaSet base name"), "version": i("New version"),
-             "fromChips": arr(i()), "toChips": arr(i())}),
+             "fromChips": arr(i()), "toChips": arr(i()),
+             "quiesced": b("True when the workload acknowledged the "
+                           "checkpoint-now signal and parked with a "
+                           "checkpoint at its exact step before the move "
+                           "(the zero-loss path; backend quiesce "
+                           "contract). False = plain stop-and-replay."),
+             "stepsLost": {"type": "integer", "nullable": True,
+                           "description":
+                               "Training steps the migration forfeited: 0 "
+                               "when quiesced (by construction). null when "
+                               "not quiesced — unknown to the control "
+                               "plane, bounded by the workload's "
+                               "--checkpoint-every cadence."}}),
         "DrainResult": obj(
             {"cordoned": arr(i()),
              "drained": arr(ref("DrainItem")),
@@ -587,9 +599,16 @@ def build_spec() -> dict:
             envelope(ref("DrainResult")), tags=["resource"],
             desc="Each migration is an intent-journaled rolling "
                  "replacement (crash mid-drain reconciles at boot). "
+                 "Workloads that opted into the quiesce contract (spec "
+                 "env TDAPI_QUIESCE=1, SIGUSR1 handler) are asked to "
+                 "checkpoint-now and park before the stop, making the "
+                 "move zero-loss (per-item quiesced/stepsLost report "
+                 "it); on timeout the drain falls back to a plain stop. "
                  "Per-replicaSet failures are reported in `failed` and "
-                 "do not abort the rest. App error 503 when the backend "
-                 "circuit is open.")},
+                 "do not abort the rest — re-POSTing is idempotent: "
+                 "already-migrated sets are skipped, failed ones "
+                 "retried. App error 503 when the backend circuit is "
+                 "open.")},
         f"{v1}/reconcile": {"get": op(
             "reconcile", "Crash-recovery report from the boot-time "
             "reconciler; ?run=1 performs a fresh pass (admin; quiesce "
@@ -631,7 +650,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.6.0",
+            "version": "0.7.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

@@ -63,10 +63,10 @@ def make_app(tmp_path, breaker_threshold=50, breaker_cooldown=30.0):
                cpu_cores=N_CORES, store_maint_records=0)
 
 
-def run_demo(app, name="demo", tpus=2):
+def run_demo(app, name="demo", tpus=2, env=()):
     return app.replicasets.run_container(ContainerRun(
         imageName="img", replicaSetName=name, tpuCount=tpus, cpuCount=2,
-        containerPorts=["8888"]))
+        containerPorts=["8888"], env=list(env)))
 
 
 # ------------------------------------------------------------ invariants
@@ -162,6 +162,14 @@ def mut_vol_delete(app):
     app.volumes.delete_volume("vol")
 
 
+def mut_patch_quiesce(app):
+    """Quiesce-enabled replace: the spec opts in (TDAPI_QUIESCE=1), so the
+    patch crosses the backend quiesce op before stopping the old version."""
+    run_demo(app, env=["TDAPI_QUIESCE=1"])
+    app.replicasets.patch_container(
+        "demo", PatchRequest(tpuPatch=TpuPatch(tpuCount=4)))
+
+
 # every mutating endpoint x the backend ops it crosses. `swallowed` marks
 # ops whose failure the services layer deliberately tolerates (post-commit
 # cleanup — the endpoint still succeeds; the reconciler's orphan sweep is
@@ -181,6 +189,9 @@ SWEEP = [
     ("pause", mut_pause, "pause", False),
     ("continue", mut_continue, "restart_inplace", False),
     ("delete", mut_delete, "remove", False),
+    # quiesce is strictly best-effort: its failure falls back to the plain
+    # stop and the replace still succeeds, so every mode is "swallowed"
+    ("patchq", mut_patch_quiesce, "quiesce", True),
     ("vol.create", mut_vol_create, "volume_create", False),
     ("vol.patch", mut_vol_patch, "volume_create", False),
     ("vol.delete", mut_vol_delete, "volume_remove", True),  # logged, swept
@@ -200,7 +211,8 @@ def test_transient_fault_sweep(endpoint, mutate, op, swallowed, mode,
     (bounded-retry win) or fails clean with zero leaked grants and a
     fixpoint reconcile."""
     app = make_app(tmp_path)
-    if endpoint not in ("run", "vol.create", "vol.patch", "vol.delete"):
+    if endpoint not in ("run", "patchq", "vol.create", "vol.patch",
+                        "vol.delete"):
         run_demo(app)
     faults.arm_fault(f"{op}:{mode}")
     mode_name = mode.partition(":")[0]
@@ -499,6 +511,49 @@ def test_drain_skips_stopped_replicasets(tmp_path):
     assert result["skipped"] == ["demo"]
     assert result["drained"] == [] and result["failed"] == {}
     assert_no_leaks(app)
+
+
+def test_drain_repost_after_partial_failure_is_idempotent(tmp_path):
+    """Re-POSTing /tpus/drain after a partial failure (some sets in
+    `failed`) is idempotent: already-migrated sets are skipped (they no
+    longer hold cordoned chips), the failed ones are retried, and no
+    grant leaks across either attempt."""
+    app = make_app(tmp_path)
+    run_demo(app, name="aaa")
+    run_demo(app, name="bbb")
+    stored = stored_containers(app)
+    bad = {stored["aaa"].spec.tpu_chips[0], stored["bbb"].spec.tpu_chips[0]}
+    app.tpu.cordon(sorted(bad))
+    app.start()
+    try:
+        # fail exactly the FIRST migration (drain scans names sorted):
+        # error_n outlasts the guard's retry budget once, then runs dry
+        faults.arm_fault(f"create:error_n:{RETRIES + 1}")
+        status, _, body = call(app, "POST", "/api/v1/tpus/drain")
+        faults.disarm_faults()
+        first = body["data"]["drain"]
+        assert "aaa" in first["failed"]
+        assert [d["name"] for d in first["drained"]] == ["bbb"]
+        assert_no_leaks(app)
+        bbb_version = stored_containers(app)["bbb"].version
+        # the retry migrates the failed set and leaves the migrated one
+        # alone — no second rolling replace, no version churn
+        status, _, body = call(app, "POST", "/api/v1/tpus/drain")
+        second = body["data"]["drain"]
+        assert [d["name"] for d in second["drained"]] == ["aaa"]
+        assert second["failed"] == {}
+        stored = stored_containers(app)
+        assert stored["bbb"].version == bbb_version
+        for info in stored.values():
+            assert not set(info.spec.tpu_chips) & bad
+        # a third drain is a full no-op
+        status, _, body = call(app, "POST", "/api/v1/tpus/drain")
+        third = body["data"]["drain"]
+        assert third["drained"] == [] and third["failed"] == {}
+        assert_no_leaks(app)
+    finally:
+        faults.disarm_faults()
+        app.stop()
 
 
 def test_crash_mid_drain_reconciles(tmp_path):
