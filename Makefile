@@ -6,8 +6,8 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry verify-migrate bench serve serve-mock dryrun apidoc lint \
-    clean
+    verify-retry verify-migrate verify-mt bench serve serve-mock dryrun \
+    apidoc lint clean
 
 all: native
 
@@ -22,6 +22,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-retry   (exactly-once sweep: -m retry)"
 	@echo "  make verify-perf    (throughput-floor smoke: -m perf)"
 	@echo "  make verify-migrate (zero-loss migration sweep: -m migrate)"
+	@echo "  make verify-mt      (fractional multi-tenancy sweep: -m mt)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
 	$(PY) -m pytest tests/ -q -m crash
@@ -37,6 +38,9 @@ verify-perf:            ## control-plane throughput smoke (generous floors, tier
 
 verify-migrate:         ## zero-loss migration sweep: quiesce protocol + e2e gapless patch
 	$(PY) -m pytest tests/ -q -m migrate
+
+verify-mt:              ## fractional multi-tenancy sweep: share ledger + regulator isolation
+	$(PY) -m pytest tests/ -q -m mt
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

@@ -39,14 +39,22 @@ class Bind:
         return cls(d.get("src", ""), d.get("dest", ""))
 
 
+def _num(v) -> float:
+    """Parse a tpuCount that may be whole (2) or fractional (0.5);
+    integral values stay int so whole-chip arithmetic is exact."""
+    f = float(v or 0)
+    return int(f) if f == int(f) else f
+
+
 @dataclass
 class ContainerRun:
     """POST /api/v1/replicaSet body (reference models/container.go ContainerRun)."""
     imageName: str = ""
     replicaSetName: str = ""
-    tpuCount: int = 0
+    tpuCount: float = 0           # whole chips, or a 0.25-multiple share < 1
     cpuCount: int = 0
     memory: str = ""              # e.g. "8GB"; units KB/MB/GB/TB
+    priority: str = ""            # "" | "latency" | "best_effort" (regulator class)
     binds: list[Bind] = field(default_factory=list)
     env: list[str] = field(default_factory=list)
     cmd: list[str] = field(default_factory=list)
@@ -58,9 +66,10 @@ class ContainerRun:
             imageName=d.get("imageName", ""),
             replicaSetName=d.get("replicaSetName", ""),
             # tpuCount is the native field; gpuCount accepted for drop-in clients
-            tpuCount=int(d.get("tpuCount", d.get("gpuCount", 0)) or 0),
+            tpuCount=_num(d.get("tpuCount", d.get("gpuCount", 0))),
             cpuCount=int(d.get("cpuCount", 0) or 0),
             memory=d.get("memory", "") or "",
+            priority=d.get("priority", "") or "",
             binds=[Bind.from_json(b) for b in d.get("binds", []) if b],
             env=list(d.get("env", []) or []),
             cmd=list(d.get("cmd", []) or []),
@@ -70,7 +79,7 @@ class ContainerRun:
 
 @dataclass
 class TpuPatch:
-    tpuCount: int = 0
+    tpuCount: float = 0           # whole chips, or a 0.25-multiple share < 1
 
 
 @dataclass
@@ -104,7 +113,7 @@ class PatchRequest:
         mp = d.get("memoryPatch")
         vp = d.get("volumePatch")
         return cls(
-            tpuPatch=TpuPatch(int(tp.get("tpuCount", tp.get("gpuCount", 0)) or 0)) if tp else None,
+            tpuPatch=TpuPatch(_num(tp.get("tpuCount", tp.get("gpuCount", 0)))) if tp else None,
             cpuPatch=CpuPatch(int(cp.get("cpuCount", 0) or 0)) if cp else None,
             memoryPatch=MemoryPatch(mp.get("memory", "") or "") if mp else None,
             volumePatch=VolumePatch(Bind.from_json(vp.get("oldBind")),
@@ -161,6 +170,14 @@ class ContainerSpec:
     restart_policy: str = "unless-stopped"
     port_bindings: dict[str, int] = field(default_factory=dict)  # containerPort -> hostPort
     tpu_chips: list[int] = field(default_factory=list)
+    # fractional grant: quanta (of schedulers.SHARE_QUANTA) held on the
+    # single chip in tpu_chips; 0 = whole-chip grant (every pre-fractional
+    # stored spec deserializes to 0, keeping old records whole)
+    tpu_shares: int = 0
+    # regulator class for the serving-path time-slicer: "latency" streams
+    # preempt "best_effort" co-tenants at chunk boundaries ("" = default
+    # best-effort)
+    priority: str = ""
     tpu_env: dict[str, str] = field(default_factory=dict)
     devices: list[str] = field(default_factory=list)        # /dev/accel* passthrough
 

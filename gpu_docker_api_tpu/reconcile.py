@@ -246,6 +246,8 @@ class Reconciler:
         if chips:
             self.tpu.restore(chips, owner)
             report["grantsFreed"]["tpu"] += len(chips)
+        shared = self.tpu.release_owner_shares(owner)
+        report["grantsFreed"]["tpu"] += len(shared)
         cores = [i for i, o in self.cpu.status.items() if o == owner]
         if cores:
             self.cpu.restore(cores, owner)
@@ -331,7 +333,11 @@ class Reconciler:
             except Exception:  # noqa: BLE001
                 log.exception("completing stop of %s", stored.containerName)
         spec = stored.spec
-        self.tpu.restore(spec.tpu_chips, rec.target)
+        if spec.tpu_shares and spec.tpu_chips:
+            self.tpu.restore_shares(spec.tpu_chips[0], spec.tpu_shares,
+                                    rec.target)
+        else:
+            self.tpu.restore(spec.tpu_chips, rec.target)
         self.cpu.restore(spec.cpuset, rec.target)
         self.ports.restore(list(spec.port_bindings.values()), rec.target)
         stored.resourcesReleased = True
@@ -433,17 +439,41 @@ class Reconciler:
     def _reconcile_grants(self, report: dict) -> None:
         stored = self._stored_containers()
         exp_tpu: dict[int, str] = {}
+        exp_shares: dict[tuple[int, str], int] = {}
         exp_cpu: dict[int, str] = {}
         exp_ports: dict[int, str] = {}
         for name, info in stored.items():
             if info.resourcesReleased:
                 continue
-            for c in info.spec.tpu_chips:
-                exp_tpu[c] = name
+            if info.spec.tpu_shares and info.spec.tpu_chips:
+                # fractional grant: expected in the SHARE ledger, never
+                # the whole-chip bitmap (whole-marking a shared chip
+                # would evict its co-tenants)
+                exp_shares[(info.spec.tpu_chips[0], name)] = \
+                    info.spec.tpu_shares
+            else:
+                for c in info.spec.tpu_chips:
+                    exp_tpu[c] = name
             for c in self.cpu._cores(info.spec.cpuset):
                 exp_cpu[c] = name
             for p in info.spec.port_bindings.values():
                 exp_ports[int(p)] = name
+
+        # share-ledger sweep: the stored records are authoritative — every
+        # ledger holding is forced to exactly what a live record backs
+        # (leaked quanta freed, lost quanta re-marked; owner+chip keyed,
+        # so co-tenants on the same chip settle independently)
+        want = dict(exp_shares)
+        for chip, owners in list(self.tpu.shares.items()):
+            for owner, q in list(owners.items()):
+                expect = want.pop((chip, owner), 0)
+                if q != expect:
+                    self.tpu.set_shares(chip, owner, expect)
+                    key = "grantsFreed" if expect < q else "grantsRemarked"
+                    report[key]["tpu"] += 1
+        for (chip, owner), q in want.items():
+            self.tpu.set_shares(chip, owner, q)
+            report["grantsRemarked"]["tpu"] += 1
 
         def sweep(status: dict, expected: dict, restore, mark, key: str):
             # free grants whose owner the store doesn't back (leaked), or

@@ -1,4 +1,4 @@
 from .base import Scheduler  # noqa: F401
-from .tpu import TpuScheduler  # noqa: F401
+from .tpu import SHARE_QUANTA, TpuScheduler, parse_tpu_count  # noqa: F401
 from .cpu import CpuScheduler  # noqa: F401
 from .port import PortScheduler  # noqa: F401

@@ -29,6 +29,34 @@ from ..topology import TpuTopology, chips_per_host_for, discover_topology
 from ..workqueue import WorkQueue
 from .base import FREE, Scheduler, _norm_owner, merge_stored_status
 
+# Fractional-grant quantum: one chip divides into SHARE_QUANTA equal
+# shares (0.25 chip each). A fractional replicaSet holds 1-3 quanta of
+# exactly ONE chip; the per-chip ledger sums to at most SHARE_QUANTA, so
+# a chip can never be oversubscribed (Tally / ParvaGPU: sharing with a
+# hard capacity invariant, time-multiplexed by the serving-path
+# regulator — regulator.py).
+SHARE_QUANTA = 4
+
+
+def parse_tpu_count(count) -> tuple[int, int]:
+    """Split a request's tpuCount into (whole_chips, share_quanta).
+
+    Whole counts (1, 2, 4.0, ...) return (n, 0). Fractional counts are a
+    share of ONE chip and must be a multiple of 1/SHARE_QUANTA below 1
+    (0.25, 0.5, 0.75) -> (0, quanta). Anything else — negative, 1.5,
+    0.3 — raises ValueError with a client-facing message."""
+    c = float(count)
+    if c < 0:
+        raise ValueError("tpuCount must be >= 0")
+    if c == int(c):
+        return int(c), 0
+    q = c * SHARE_QUANTA
+    if abs(q - round(q)) > 1e-9 or c > 1:
+        raise ValueError(
+            f"fractional tpuCount must be a multiple of "
+            f"{1 / SHARE_QUANTA} below 1 (a share of one chip); got {count}")
+    return 0, int(round(q))
+
 
 class TpuScheduler(Scheduler):
     resource = "tpus"
@@ -80,6 +108,18 @@ class TpuScheduler(Scheduler):
             int(i) for i in (state.get("cordoned", [])
                              if state is not None else [])
             if int(i) in self.status}
+        # fractional-share ledger: chip index -> {owner: quanta}. Chips
+        # with any entry here are invisible to whole-chip placement, and
+        # the per-chip quanta sum never exceeds SHARE_QUANTA (checked
+        # under the lock on every grant). Persisted with the status map;
+        # indices that no longer exist under an overriding topology drop.
+        self.shares: dict[int, dict[str, int]] = {}
+        for k, owners in (state.get("shares", {})
+                          if state is not None else {}).items():
+            ik = int(k)
+            if ik in self.status and owners:
+                self.shares[ik] = {str(o): int(q) for o, q in owners.items()
+                                   if int(q) > 0}
         with self._lock:
             self._persist()
 
@@ -106,12 +146,17 @@ class TpuScheduler(Scheduler):
             reusable = {i for i in (reuse or [])
                         if self.status.get(i) == owner
                         and i not in self.cordoned}
+            # chips carrying fractional shares are invisible to whole-chip
+            # placement: granting one whole would oversubscribe its
+            # co-tenants
             free = ({i for i, s in self.status.items()
-                     if s is FREE and i not in self.cordoned} | reusable)
+                     if s is FREE and i not in self.cordoned
+                     and not self.shares.get(i)} | reusable)
             if len(free) < n:
                 raise xerrors.TpuNotEnoughError(
                     f"want {n}, only {len(free)} of {len(self.status)} "
-                    f"allocatable ({len(self.cordoned)} cordoned)")
+                    f"allocatable ({len(self.cordoned)} cordoned, "
+                    f"{len(self.shares)} share-split)")
             grant = self._find_box(n, free, prefer=reusable)
             if grant is None:
                 grant = self._find_connected(n, free, prefer=reusable)
@@ -148,6 +193,99 @@ class TpuScheduler(Scheduler):
             for i in grant:
                 if i in self.status and self.status[i] in (FREE, owner):
                     self.status[i] = owner
+            self._persist()
+
+    # ---- fractional shares ----
+
+    def _shares_used(self, chip: int) -> int:
+        return sum(self.shares.get(chip, {}).values())
+
+    def apply_shares(self, quanta: int, owner: str,
+                     prefer: Optional[int] = None) -> int:
+        """Grant `quanta` shares (quanta/SHARE_QUANTA of a chip) on ONE
+        chip; returns the chip index. Placement is bin-packing: the
+        already-most-shared chip with capacity wins (fills partial chips
+        before splitting a fresh one — whole-chip placements keep the
+        most contiguous free space), `prefer` (the lift-in-place chip on
+        a patch) beating everything when it still fits. Never a cordoned
+        or whole-granted chip; the per-chip ledger can never exceed
+        SHARE_QUANTA. Raises TpuOversubscribedError when no chip fits."""
+        if not 0 < quanta < SHARE_QUANTA:
+            raise ValueError(f"share quanta must be 1..{SHARE_QUANTA - 1}, "
+                             f"got {quanta}")
+        with self._lock:
+            cands = [i for i, s in self.status.items()
+                     if s is FREE and i not in self.cordoned
+                     and self._shares_used(i) + quanta <= SHARE_QUANTA]
+            if not cands:
+                raise xerrors.TpuOversubscribedError(
+                    f"want {quanta}/{SHARE_QUANTA} of a chip; no chip has "
+                    f"that much free share capacity "
+                    f"({len(self.shares)} share-split, "
+                    f"{len(self.cordoned)} cordoned)")
+            if prefer in cands:
+                chip = prefer
+            else:
+                chip = min(cands, key=lambda i: (-self._shares_used(i), i))
+            owners = self.shares.setdefault(chip, {})
+            owners[owner] = owners.get(owner, 0) + quanta
+            self._persist()
+            return chip
+
+    def restore_shares(self, chip: int, quanta: int, owner: str) -> int:
+        """Return share quanta to the pool — owner-checked and EXACT: at
+        most what `owner` still holds on `chip` is freed, so a stale or
+        duplicated release can never free a co-tenant's shares (the same
+        double-free class restore() guards for whole chips). Returns the
+        quanta actually freed."""
+        with self._lock:
+            owners = self.shares.get(chip)
+            if not owners or owner not in owners:
+                return 0
+            take = min(owners[owner], max(quanta, 0))
+            if take:
+                left = owners[owner] - take
+                if left:
+                    owners[owner] = left
+                else:
+                    del owners[owner]
+                if not owners:
+                    del self.shares[chip]
+                self._persist()
+            return take
+
+    def release_owner_shares(self, owner: str) -> list[int]:
+        """Drop every share grant held by `owner` (the reconciler's
+        free-all path for unwound replicaSets). Returns the chips
+        touched."""
+        with self._lock:
+            touched = [i for i, owners in self.shares.items()
+                       if owner in owners]
+            for i in touched:
+                del self.shares[i][owner]
+                if not self.shares[i]:
+                    del self.shares[i]
+            if touched:
+                self._persist()
+            return touched
+
+    def set_shares(self, chip: int, owner: str, quanta: int) -> None:
+        """Force `owner`'s holding on `chip` to exactly `quanta` (0
+        removes) — the reconciler's repair primitive when the stored
+        records and the ledger disagree. Clamped so the chip's total can
+        never exceed SHARE_QUANTA even against a corrupt store."""
+        with self._lock:
+            if chip not in self.status:
+                return
+            owners = self.shares.setdefault(chip, {})
+            others = sum(q for o, q in owners.items() if o != owner)
+            want = max(0, min(quanta, SHARE_QUANTA - others))
+            if want:
+                owners[owner] = want
+            else:
+                owners.pop(owner, None)
+            if not owners:
+                self.shares.pop(chip, None)
             self._persist()
 
     # ---- cordon / uncordon ----
@@ -322,19 +460,35 @@ class TpuScheduler(Scheduler):
                 "id": c.id,
                 "device": c.device_path,
                 "coord": list(c.coord),
-                "used": self.status[c.index] is not FREE,
+                "used": (self.status[c.index] is not FREE
+                         or bool(self.shares.get(c.index))),
                 "owner": self.status[c.index] or "",
                 "cordoned": c.index in self.cordoned,
+                "shares": dict(self.shares.get(c.index, {})),
+                "freeShares": self._allocatable_quanta(c.index),
             } for c in self.topology.chips]
+            free_q = sum(self._allocatable_quanta(i) for i in self.status)
+            fc = free_q / SHARE_QUANTA
             return {
                 "topology": self.topology.serialize(),
                 "chips": chips,
-                # freeCount = ALLOCATABLE capacity: a cordoned-but-unowned
-                # chip is not capacity anyone can be granted
-                "freeCount": sum(1 for i, s in self.status.items()
-                                 if s is FREE and i not in self.cordoned),
+                # freeCount = ALLOCATABLE capacity in chip units,
+                # fractional capacity included: a half-shared chip counts
+                # its remaining shares (int when integral so share-unaware
+                # clients keep seeing whole numbers); a cordoned-but-
+                # unowned chip is not capacity anyone can be granted
+                "freeCount": int(fc) if fc == int(fc) else fc,
+                "freeShares": free_q,
                 "cordoned": sorted(self.cordoned),
             }
+
+    def _allocatable_quanta(self, chip: int) -> int:
+        """Share quanta still grantable on `chip`: 0 when cordoned or
+        whole-granted, else the ledger remainder (SHARE_QUANTA when the
+        chip is fully free)."""
+        if chip in self.cordoned or self.status.get(chip) is not FREE:
+            return 0
+        return SHARE_QUANTA - self._shares_used(chip)
 
     def env_for(self, grant: list[int]) -> dict[str, str]:
         """TPU env plumbing for a grant (SURVEY §5.7)."""
@@ -348,6 +502,7 @@ class TpuScheduler(Scheduler):
             "topology": self.topology.serialize(),
             "status": {str(k): v for k, v in self.status.items()},
             "cordoned": sorted(self.cordoned),
+            "shares": {str(k): dict(v) for k, v in self.shares.items()},
         }
 
 

@@ -31,7 +31,10 @@ from ..health import HealthMonitor
 from ..idempotency import IdempotencyCache
 from ..intents import IntentJournal
 from ..reconcile import Reconciler
-from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from .. import regulator
+from ..schedulers import (
+    SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
+)
 from ..services import ReplicaSetService, VolumeService
 from ..store import StateClient, open_store
 from ..topology import TpuTopology, discover_topology
@@ -325,6 +328,10 @@ class App:
             idempotency=self.idempotency)
         self._reconcile_lock = threading.Lock()
         self.last_reconcile = self.reconciler.run()
+        # per-chip concurrency regulators (fractional co-tenancy): route
+        # their preempt events onto this App's event log and export their
+        # counters at /metrics
+        regulator.set_events(self.events)
         self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
                                 events=self.events)
 
@@ -455,6 +462,13 @@ class App:
             return err(ResCode.ContainerNameCannotContainDash)
         if spec.tpuCount < 0:
             return err(ResCode.TpuCountMustBeGreaterThanOrEqualZero)
+        try:
+            parse_tpu_count(spec.tpuCount)
+        except ValueError as e:
+            return err(ResCode.InvalidParams, str(e))
+        if spec.priority not in regulator.PRIORITIES:
+            return err(ResCode.InvalidParams,
+                       f"priority must be one of {regulator.PRIORITIES[1:]}")
         if spec.cpuCount < 0:
             return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
         if spec.memory and not valid_size_unit(spec.memory):
@@ -463,6 +477,8 @@ class App:
             return ok(self.replicasets.run_container(spec))
         except xerrors.ContainerExistedError:
             return err(ResCode.ContainerAlreadyExist)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
         except xerrors.CpuNotEnoughError:
@@ -480,8 +496,13 @@ class App:
         body = req.json()
         patch = PatchRequest.from_json(body)
         tp = patch.tpuPatch
-        if tp is not None and tp.tpuCount < 0:
-            return err(ResCode.TpuCountMustBeGreaterThanOrEqualZero)
+        if tp is not None:
+            if tp.tpuCount < 0:
+                return err(ResCode.TpuCountMustBeGreaterThanOrEqualZero)
+            try:
+                parse_tpu_count(tp.tpuCount)
+            except ValueError as e:
+                return err(ResCode.InvalidParams, str(e))
         cp = patch.cpuPatch
         if cp is not None and cp.cpuCount < 0:
             return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
@@ -495,6 +516,8 @@ class App:
             return precondition_failed(e)
         except xerrors.NoPatchRequiredError:
             return err(ResCode.ContainerNoNeedPatch)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
         except xerrors.CpuNotEnoughError:
@@ -523,6 +546,8 @@ class App:
             return err(ResCode.ContainerNoNeedRollback)
         except (xerrors.NotExistInStoreError, xerrors.VersionNotFoundError):
             return err(ResCode.ContainerRollbackFailed)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
         except xerrors.BackendUnavailableError as e:
@@ -554,6 +579,8 @@ class App:
             return precondition_failed(e)
         except xerrors.NotExistInStoreError:
             return err(ResCode.ContainerGetInfoFailed)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
         except xerrors.TpuNotEnoughError:
             return err(ResCode.ContainerTpuNotEnough)
         except xerrors.BackendUnavailableError as e:
@@ -881,6 +908,51 @@ class App:
             "# files re-copied by delta passes (the dirty sets)",
             f"tdapi_copy_delta_files {cf['deltaFiles']}",
         ]
+        # fractional multi-tenancy: per-chip share ledger + the serving-
+        # path regulators (time-slice admission, preemption). Per-chip
+        # lines only for chips that are actually share-split / regulated,
+        # so the exposition stays bounded on big slices.
+        total_q = SHARE_QUANTA * len(tpu["chips"])
+        alloc_q = sum(sum(c["shares"].values()) for c in tpu["chips"])
+        lines += [
+            "# TYPE tdapi_tpu_shares_allocated gauge",
+            "# fractional-grant quanta held, per share-split chip "
+            f"({SHARE_QUANTA} quanta = 1 chip)",
+        ]
+        for c in tpu["chips"]:
+            if c["shares"]:
+                lines.append(
+                    f'tdapi_tpu_shares_allocated{{chip="{c["index"]}"}} '
+                    f'{sum(c["shares"].values())}')
+        lines += [
+            "# TYPE tdapi_tpu_shares_allocated_total gauge",
+            f"tdapi_tpu_shares_allocated_total {alloc_q}",
+            "# TYPE tdapi_tpu_shares_allocatable gauge",
+            "# quanta still grantable to fractional requests "
+            "(excludes cordoned and whole-granted chips)",
+            f"tdapi_tpu_shares_allocatable {tpu.get('freeShares', 0)}",
+            "# TYPE tdapi_tpu_shares_utilization gauge",
+            f"tdapi_tpu_shares_utilization "
+            f"{round(alloc_q / total_q, 6) if total_q else 0}",
+        ]
+        regs = regulator.snapshot()
+        lines += [
+            "# TYPE tdapi_regulator_queue_depth gauge",
+            "# tenants parked waiting for their next decode chunk",
+            "# TYPE tdapi_regulator_preemptions_total counter",
+            "# best-effort chunks flagged to yield to a latency tenant",
+            "# TYPE tdapi_regulator_chunks_total counter",
+            "# TYPE tdapi_regulator_tenants gauge",
+        ]
+        for r in regs:
+            lbl = f'{{chip="{r["chip"]}"}}'
+            lines += [
+                f"tdapi_regulator_queue_depth{lbl} {r['queueDepth']}",
+                f"tdapi_regulator_preemptions_total{lbl} "
+                f"{r['preemptTotal']}",
+                f"tdapi_regulator_chunks_total{lbl} {r['chunksTotal']}",
+                f"tdapi_regulator_tenants{lbl} {len(r['tenants'])}",
+            ]
         gate = self.gate.describe()
         lines += [
             "# TYPE tdapi_mutations_inflight gauge",

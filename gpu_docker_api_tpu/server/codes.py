@@ -52,6 +52,7 @@ class ResCode(enum.IntEnum):
     ContainerCpuNotEnough = 1023
     CpuCountMustBeGreaterThanOrEqualZero = 1024
     ContainerMemorySizeNotSupported = 1025
+    ContainerTpuOversubscribed = 1026
 
     VolumeCreateFailed = 1100
     VolumeNameCannotBeEmpty = 1101
@@ -123,6 +124,9 @@ _MESSAGES: dict[ResCode, str] = {
         "CPU count must be greater than or equal to 0",
     ResCode.ContainerMemorySizeNotSupported:
         "Memory size units are not supported, supported units: KB, MB, GB, TB",
+    ResCode.ContainerTpuOversubscribed:
+        "No chip has enough free share capacity for this fractional TPU "
+        "request — retry after a co-tenant releases, or request fewer shares",
 
     ResCode.VolumeCreateFailed: "Failed to create volume",
     ResCode.VolumeNameCannotBeEmpty: "Volume name cannot be empty",

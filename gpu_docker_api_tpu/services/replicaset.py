@@ -59,7 +59,9 @@ from ..dtos import (
 )
 from ..faults import crashpoint
 from ..intents import Intent, IntentJournal
-from ..schedulers import CpuScheduler, PortScheduler, TpuScheduler
+from ..schedulers import (
+    SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
+)
 from ..store.client import StateClient
 from ..utils.file import to_bytes
 from ..version import MergeMap, VersionMap
@@ -200,14 +202,23 @@ class ReplicaSetService:
                 env=list(req.env),
                 cmd=list(req.cmd),
                 binds=[b.format() for b in req.binds if b.format()],
+                priority=req.priority,
             )
             if req.memory:
                 spec.memory_bytes = to_bytes(req.memory)
 
+            whole, quanta = parse_tpu_count(req.tpuCount)
             intent = self.intents.begin("run", name)
             try:
-                if req.tpuCount > 0:
-                    self._grant_tpus(spec, self.tpu.apply(req.tpuCount, name))
+                if quanta:
+                    # fractional grant: `quanta`/SHARE_QUANTA of one chip —
+                    # the chip is shared with co-tenants; the serving-path
+                    # regulator time-slices it by this weight
+                    self._grant_tpus(spec,
+                                     [self.tpu.apply_shares(quanta, name)],
+                                     shares=quanta)
+                elif whole > 0:
+                    self._grant_tpus(spec, self.tpu.apply(whole, name))
                 if req.cpuCount > 0:
                     spec.cpuset = self.cpu.apply(req.cpuCount, name)
                     spec.cpu_count = req.cpuCount
@@ -221,7 +232,7 @@ class ReplicaSetService:
                 # owner-checked so over-release is impossible. The unwind
                 # completes here, so the intent closes; an InjectedCrash
                 # (BaseException) skips both — exactly a daemon death.
-                self.tpu.restore(spec.tpu_chips, name)
+                self._release_tpus(spec, name)
                 self.cpu.restore(spec.cpuset, name)
                 intent.done()
                 raise
@@ -244,10 +255,30 @@ class ReplicaSetService:
         if bind not in spec.binds:
             spec.binds.append(bind)
 
-    def _grant_tpus(self, spec: ContainerSpec, grant: list[int]) -> None:
+    def _grant_tpus(self, spec: ContainerSpec, grant: list[int],
+                    shares: int = 0) -> None:
         spec.tpu_chips = grant
+        spec.tpu_shares = shares
         spec.tpu_env = self.tpu.env_for(grant) if grant else {}
         spec.devices = self.tpu.device_paths(grant)
+
+    def _release_tpus(self, spec: ContainerSpec, name: str) -> None:
+        """Return a spec's TPU grant — whole chips or the share ledger
+        entry, depending on how it was granted. Owner-checked (and, for
+        shares, exact-quanta) in the scheduler, so stale/duplicate
+        releases can never free a co-tenant's capacity."""
+        if spec.tpu_shares and spec.tpu_chips:
+            self.tpu.restore_shares(spec.tpu_chips[0], spec.tpu_shares, name)
+        else:
+            self.tpu.restore(spec.tpu_chips, name)
+
+    @staticmethod
+    def _spec_tpu_count(spec: ContainerSpec) -> float:
+        """A spec's grant expressed as the tpuCount that requested it
+        (whole chips, or quanta/SHARE_QUANTA for a fractional grant)."""
+        if spec.tpu_shares:
+            return spec.tpu_shares / SHARE_QUANTA
+        return len(spec.tpu_chips)
 
     def _create_and_start(self, name: str, spec: ContainerSpec,
                           container_ports: list[str],
@@ -267,8 +298,18 @@ class ReplicaSetService:
                 port_grant = self.ports.apply(len(container_ports), name)
                 spec.port_bindings = {
                     cp_: hp for cp_, hp in zip(container_ports, port_grant)}
-            spec.env = [e for e in spec.env if not e.startswith("CONTAINER_VERSION=")]
+            spec.env = [e for e in spec.env
+                        if not e.startswith(("CONTAINER_VERSION=",
+                                             "TDAPI_TPU_SHARES=",
+                                             "TDAPI_PRIORITY="))]
             spec.env.append(f"CONTAINER_VERSION={version}")
+            # multi-tenancy contract for the workload: its serving loop
+            # registers with the per-chip regulator at this weight/class
+            # (workloads/serve.py tenant_from_env)
+            if spec.tpu_shares:
+                spec.env.append(f"TDAPI_TPU_SHARES={spec.tpu_shares}")
+            if spec.priority:
+                spec.env.append(f"TDAPI_PRIORITY={spec.priority}")
             self._inject_xla_cache(spec)
             self.backend.create(ctr_name, spec)
             created = True
@@ -354,16 +395,29 @@ class ReplicaSetService:
             return self._run_response(info)
 
     def _patch_tpu(self, name: str, spec: ContainerSpec,
-                   old: StoredContainerInfo, count: int) -> bool:
+                   old: StoredContainerInfo, count: float) -> bool:
         """Re-grant chips when the count changes (reference patchGpu
-        :448-495) — in place: the old grant is offered for reuse, never
-        released to the pool mid-patch."""
-        old_grant = list(old.spec.tpu_chips)
-        if count == len(old_grant):
+        :448-495) — in place: a whole-chip old grant is offered for
+        reuse, never released to the pool mid-patch. Fractional targets
+        take a FRESH share grant (preferring the chip already held, so
+        an unchanged-chip resize stays put when capacity allows); the
+        old holding is released only after the replace commits, and the
+        ledger sums both during the window — capacity-checked, so the
+        transition can never oversubscribe a co-tenant."""
+        whole, quanta = parse_tpu_count(count)
+        if count == self._spec_tpu_count(old.spec):
             return False
-        reuse = old_grant if not old.resourcesReleased else []
-        self._grant_tpus(spec, self.tpu.apply(count, name, reuse=reuse)
-                         if count > 0 else [])
+        if quanta:
+            prefer = (old.spec.tpu_chips[0]
+                      if old.spec.tpu_shares and old.spec.tpu_chips else None)
+            self._grant_tpus(spec, [self.tpu.apply_shares(
+                quanta, name, prefer=prefer)], shares=quanta)
+            return True
+        reuse = (list(old.spec.tpu_chips)
+                 if not old.resourcesReleased and not old.spec.tpu_shares
+                 else [])
+        self._grant_tpus(spec, self.tpu.apply(whole, name, reuse=reuse)
+                         if whole > 0 else [])
         return True
 
     def _patch_cpu(self, name: str, spec: ContainerSpec,
@@ -401,8 +455,18 @@ class ReplicaSetService:
         The old container's grants were never released (in-place reuse), so
         there is nothing to re-mark — and owner checks make this safe even
         if this unwind itself races."""
-        new_tpu = sorted(set(new_spec.tpu_chips) - set(old_spec.tpu_chips))
-        self.tpu.restore(new_tpu, name)
+        if new_spec.tpu_shares:
+            # a share grant is new only when it differs from the old
+            # holding — a spec merely COPIED from a fractional old (e.g. a
+            # failed memory patch) carries the same chip+quanta and took
+            # no fresh grant, so releasing it would free live capacity
+            if (new_spec.tpu_shares != old_spec.tpu_shares
+                    or new_spec.tpu_chips != old_spec.tpu_chips):
+                self.tpu.restore_shares(new_spec.tpu_chips[0],
+                                        new_spec.tpu_shares, name)
+        else:
+            new_tpu = sorted(set(new_spec.tpu_chips) - set(old_spec.tpu_chips))
+            self.tpu.restore(new_tpu, name)
         old_cores = set(self.cpu._cores(old_spec.cpuset))
         new_cores = set(self.cpu._cores(new_spec.cpuset)) - old_cores
         self.cpu.restore(sorted(new_cores), name)
@@ -578,8 +642,22 @@ class ReplicaSetService:
             intent.step("removed_old", sync=False)
         crashpoint("replace.after_remove_old")
         if old_holds:
-            stale_tpu = sorted(set(old.spec.tpu_chips) - set(new_spec.tpu_chips))
-            self.tpu.restore(stale_tpu, name)
+            if old.spec.tpu_shares:
+                # fractional old grant: release its exact quanta — unless
+                # the new version carried the identical holding over
+                # untouched (e.g. a memory patch copied the spec; no fresh
+                # share grant exists, so a release here would free live
+                # capacity under the new container)
+                if (new_spec.tpu_shares != old.spec.tpu_shares
+                        or new_spec.tpu_chips != old.spec.tpu_chips):
+                    self.tpu.restore_shares(old.spec.tpu_chips[0],
+                                            old.spec.tpu_shares, name)
+            else:
+                stale_tpu = sorted(set(old.spec.tpu_chips) -
+                                   set(new_spec.tpu_chips)
+                                   if not new_spec.tpu_shares
+                                   else set(old.spec.tpu_chips))
+                self.tpu.restore(stale_tpu, name)
             stale_cores = sorted(set(self.cpu._cores(old.spec.cpuset)) -
                                  set(self.cpu._cores(new_spec.cpuset)))
             self.cpu.restore(stale_cores, name)
@@ -625,6 +703,7 @@ class ReplicaSetService:
             # the replicaSet holds NOW, re-granting (with in-place reuse)
             # only where the historical COUNT differs
             target_spec.tpu_chips = old.spec.tpu_chips
+            target_spec.tpu_shares = old.spec.tpu_shares
             target_spec.tpu_env = old.spec.tpu_env
             target_spec.devices = old.spec.devices
             target_spec.cpuset = old.spec.cpuset
@@ -634,7 +713,8 @@ class ReplicaSetService:
                 oldContainer=old.containerName, targetVersion=version,
                 oldReleased=old.resourcesReleased)
             try:
-                self._patch_tpu(name, target_spec, old, len(hist.spec.tpu_chips))
+                self._patch_tpu(name, target_spec, old,
+                                self._spec_tpu_count(hist.spec))
                 self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
                 intent.step("granted", sync=False, tpuChips=target_spec.tpu_chips,
                             cpuset=target_spec.cpuset)
@@ -699,9 +779,19 @@ class ReplicaSetService:
                     oldReleased=old.resourcesReleased, idemPartial=True)
                 migration_meta: dict = {}
                 try:
-                    self._grant_tpus(new_spec, self.tpu.apply(
-                        len(old.spec.tpu_chips), name,
-                        reuse=list(old.spec.tpu_chips)))
+                    if old.spec.tpu_shares:
+                        # fractional co-tenant on a cordoned chip: fresh
+                        # share grant (apply_shares excludes cordoned
+                        # chips); its exact old quanta release when the
+                        # replace commits — zero leaked shares per
+                        # migrated co-tenant
+                        self._grant_tpus(new_spec, [self.tpu.apply_shares(
+                            old.spec.tpu_shares, name)],
+                            shares=old.spec.tpu_shares)
+                    else:
+                        self._grant_tpus(new_spec, self.tpu.apply(
+                            len(old.spec.tpu_chips), name,
+                            reuse=list(old.spec.tpu_chips)))
                     intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
                     info = self._rolling_replace(name, old, new_spec, intent,
                                                  meta_out=migration_meta)
@@ -753,7 +843,7 @@ class ReplicaSetService:
                     intent.done(committed=True)
                     return
                 spec = info.spec
-                self.tpu.restore(spec.tpu_chips, name)
+                self._release_tpus(spec, name)
                 self.cpu.restore(spec.cpuset, name)
                 self.ports.restore(list(spec.port_bindings.values()), name)
                 intent.step("restored", sync=False)
@@ -774,6 +864,7 @@ class ReplicaSetService:
             xerrors.PreconditionFailedError.check(name, old.version, if_match)
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             fresh_tpu: list[int] = []
+            fresh_shares = 0
             fresh_cpu = ""
             intent = self.intents.begin(
                 "replace", name, via="restart", oldVersion=old.version,
@@ -782,7 +873,12 @@ class ReplicaSetService:
             try:
                 if old.resourcesReleased:
                     # stopped: grants were returned at stop; re-apply counts
-                    if old.spec.tpu_chips:
+                    if old.spec.tpu_shares:
+                        fresh_shares = old.spec.tpu_shares
+                        fresh_tpu = [self.tpu.apply_shares(fresh_shares, name)]
+                        self._grant_tpus(new_spec, fresh_tpu,
+                                         shares=fresh_shares)
+                    elif old.spec.tpu_chips:
                         fresh_tpu = self.tpu.apply(len(old.spec.tpu_chips), name)
                         self._grant_tpus(new_spec, fresh_tpu)
                     if old.spec.cpu_count:
@@ -797,7 +893,10 @@ class ReplicaSetService:
                 info = self._rolling_replace(name, old, new_spec, intent)
             except Exception:
                 # free only what THIS restart freshly applied
-                self.tpu.restore(fresh_tpu, name)
+                if fresh_shares:
+                    self.tpu.restore_shares(fresh_tpu[0], fresh_shares, name)
+                else:
+                    self.tpu.restore(fresh_tpu, name)
                 self.cpu.restore(fresh_cpu, name)
                 intent.done()
                 raise
@@ -902,7 +1001,7 @@ class ReplicaSetService:
                     crashpoint("delete.after_remove")
                     if not info.resourcesReleased:
                         spec = info.spec
-                        self.tpu.restore(spec.tpu_chips, name)
+                        self._release_tpus(spec, name)
                         self.cpu.restore(spec.cpuset, name)
                         self.ports.restore(list(spec.port_bindings.values()), name)
                     intent.step("restored", sync=False)
@@ -942,6 +1041,10 @@ class ReplicaSetService:
             "name": info.containerName,
             "version": info.version,
             "tpuChips": info.spec.tpu_chips,
+            # fractional multi-tenancy surface: quanta held on tpuChips[0]
+            # (0 = whole-chip grant) and the regulator priority class
+            "tpuShares": info.spec.tpu_shares,
+            "priority": info.spec.priority,
             "cpuset": info.spec.cpuset,
             "portBindings": info.spec.port_bindings,
         }

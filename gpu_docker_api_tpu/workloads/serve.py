@@ -27,6 +27,7 @@ injects), then streams from the compiled KV-cache decode loop (infer.py).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import threading
@@ -88,13 +89,19 @@ class _Batcher:
                  restarts: int = 3, kv_quant: bool = False,
                  kv_block: int = 0, kv_pool_blocks: int = 0,
                  decode_chunk: int = 1, seed: int | None = None,
-                 draft: tuple | None = None, gamma: int = 4):
+                 draft: tuple | None = None, gamma: int = 4,
+                 regulator=None):
         import collections
         import queue
 
         self.config = config
         self.params = params
         self.max_len = max_len
+        # multi-tenant chip sharing: a regulator.Tenant handle gates every
+        # device chunk this batcher issues (admission by share weight;
+        # latency-class co-tenants preempt at the chunk boundary). None =
+        # dedicated chip, zero added cost.
+        self._regulator = regulator
         # speculative decoding INSIDE the batch: a draft model (own slot
         # cache) proposes gamma tokens per active row each round; the
         # target verifies every row's gamma+1 positions in ONE multi-token
@@ -873,26 +880,42 @@ class _Batcher:
             if not self._tick(*fns):
                 _time.sleep(0.002)
 
+    def _chip_slice(self, tokens: int = 0):
+        """Admission for one device chunk: the co-tenancy regulator's
+        slice when this batcher shares its chip, else free."""
+        if self._regulator is None:
+            return contextlib.nullcontext()
+        return self._regulator.slice(tokens=tokens)
+
     def _tick(self, slot_decode, decode_pick, decode_multi) -> bool:
         """One scheduler tick: admit, feed one prefill piece, one decode
         step (or spec round / decode chunk) for the active rows. Returns
-        False when there was nothing to do (the loop sleeps)."""
+        False when there was nothing to do (the loop sleeps).
+
+        Every device dispatch runs inside a _chip_slice: on a shared
+        chip the regulator admits chunks by share weight, and a waiting
+        latency-class co-tenant both preempts at the chunk boundary and
+        (via should_yield below) drops this batcher back to single-step
+        chunks so the next boundary arrives one step away."""
         import jax
         import jax.numpy as jnp
 
-        self._admit()
-        fed = self._prefill_tick()      # one prompt piece per tick
+        with self._chip_slice():
+            self._admit()
+            fed = self._prefill_tick()      # one prompt piece per tick
         # decodable = prefill finished (mid-prefill slots sit out the
         # step: their lengths must not advance)
         active = [s is not None and s.get("stream") is not None
                   for s in self.slots]
         if not any(active):
             return fed
+        n_active = sum(active)
         toks = jnp.array(
             [s["last"] if active[i] else 0
              for i, s in enumerate(self.slots)], jnp.int32)
         if self._draft is not None:
-            self._spec_round(active, toks)
+            with self._chip_slice(tokens=n_active * (self.gamma + 1)):
+                self._spec_round(active, toks)
             return True
         # chunked decode only when nothing is waiting to join (and no
         # prefill mid-flight — implied by `not fed`, which scanned all
@@ -905,7 +928,12 @@ class _Batcher:
         # token (the whole wall through a high-RTT link), and a
         # power-of-two chunk ladder pays one XLA compile per rung.
         chunk = self.decode_chunk
-        idle = chunk > 1 and not fed and not self._has_waiters()
+        # a contended shared chip also forces single steps: the latency
+        # co-tenant's stall bound shrinks from one chunk to one step
+        contended = (self._regulator is not None
+                     and self._regulator.should_yield())
+        idle = (chunk > 1 and not fed and not self._has_waiters()
+                and not contended)
         # greedy fast path: no sampling row DECODING -> the
         # pure-argmax programs (no per-step full-vocab sort for
         # traffic that doesn't need it; a sampler still mid-prefill
@@ -916,12 +944,13 @@ class _Batcher:
             remaining = jnp.array(
                 [s["max_new"] - len(s["stream"]) if active[i] else 0
                  for i, s in enumerate(self.slots)], jnp.int32)
-            steps, self.cache = decode_multi(
-                self.params, toks, self.cache, jnp.array(active),
-                remaining, self.config, chunk,
-                sample=((*self._sample_vectors(), self._sample_key())
-                        if sampling else None))
-            steps = jax.device_get(steps)           # [chunk, slots]
+            with self._chip_slice(tokens=n_active * chunk):
+                steps, self.cache = decode_multi(
+                    self.params, toks, self.cache, jnp.array(active),
+                    remaining, self.config, chunk,
+                    sample=((*self._sample_vectors(), self._sample_key())
+                            if sampling else None))
+                steps = jax.device_get(steps)       # [chunk, slots]
             for i, s in enumerate(self.slots):
                 if not active[i]:
                     continue
@@ -933,17 +962,18 @@ class _Batcher:
                     s["done"].set()
                     self._release_slot(i)
             return True
-        if sampling:
-            picked, self.cache = decode_pick(
-                self.params, toks, self.cache, jnp.array(active),
-                *self._sample_vectors(), self._sample_key(),
-                self.config)
-            nxt = jax.device_get(picked)
-        else:
-            logits, self.cache = slot_decode(
-                self.params, toks, self.cache,
-                jnp.array(active), self.config)
-            nxt = jax.device_get(jnp.argmax(logits, axis=-1))
+        with self._chip_slice(tokens=n_active):
+            if sampling:
+                picked, self.cache = decode_pick(
+                    self.params, toks, self.cache, jnp.array(active),
+                    *self._sample_vectors(), self._sample_key(),
+                    self.config)
+                nxt = jax.device_get(picked)
+            else:
+                logits, self.cache = slot_decode(
+                    self.params, toks, self.cache,
+                    jnp.array(active), self.config)
+                nxt = jax.device_get(jnp.argmax(logits, axis=-1))
         for i, s in enumerate(self.slots):
             if not active[i]:
                 continue
@@ -1786,6 +1816,7 @@ def main(argv=None) -> int:
               f"gamma {args.gamma}", flush=True)
     srv = _Server(config, params, kv_quant=args.kv_quant, draft=draft,
                   gamma=args.gamma)
+    reg_tenant = None
     if args.batch_slots > 0:
         # --draft-config composes: the batcher runs speculative rounds
         # over the whole slot batch (per-slot proposals, one shared
@@ -1796,6 +1827,15 @@ def main(argv=None) -> int:
         # reserves the verify-overshoot headroom). decode_chunk is
         # superseded in speculative mode: a spec round already emits up
         # to gamma+1 tokens per host sync.
+        # fractional co-tenancy: a share-granted container (control plane
+        # injects TDAPI_TPU_SHARES/TDAPI_PRIORITY) registers with the
+        # chip's regulator so its decode chunks time-slice against
+        # co-tenants by share weight
+        from .. import regulator as _regmod
+        reg_tenant = _regmod.tenant_from_env()
+        if reg_tenant is not None:
+            print(f"chip co-tenancy: weight {reg_tenant.weight}, "
+                  f"class {reg_tenant.priority}", flush=True)
         try:
             srv.batcher = _Batcher(config, params, slots=args.batch_slots,
                                    max_len=args.batch_max_len
@@ -1806,7 +1846,8 @@ def main(argv=None) -> int:
                                    kv_block=args.kv_block,
                                    kv_pool_blocks=args.kv_pool,
                                    decode_chunk=args.decode_chunk,
-                                   draft=draft, gamma=args.gamma)
+                                   draft=draft, gamma=args.gamma,
+                                   regulator=reg_tenant)
         except ValueError as e:
             raise SystemExit(str(e))
         mode = (f"paged ({srv.batcher.kv_pool_blocks} x {args.kv_block} "
@@ -1833,6 +1874,11 @@ def main(argv=None) -> int:
         pass
     finally:
         httpd.server_close()
+        if reg_tenant is not None:
+            # leave the chip's regulator clean: a replaced/restarted
+            # version must not leave a dead tenant accumulating in the
+            # process-global registry
+            reg_tenant.unregister()
     return 0
 
 

@@ -25,6 +25,17 @@ class TpuNotEnoughError(XError):
     sentinel = "tpu not enough"
 
 
+class TpuOversubscribedError(TpuNotEnoughError):
+    """A fractional-share request found no chip with enough free quanta.
+    Subclasses TpuNotEnoughError so share-unaware callers keep their
+    existing handling; routes map it to its own app code (1026) so
+    clients can tell 'the fleet is full' from 'no chip has this much
+    spare share capacity' (bin-packing failure — retryable after any
+    co-tenant releases)."""
+
+    sentinel = "tpu shares oversubscribed"
+
+
 class CpuNotEnoughError(XError):
     sentinel = "cpu not enough"
 

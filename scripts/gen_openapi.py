@@ -175,6 +175,7 @@ def build_spec() -> dict:
     }
     run_resp_example = {
         "name": "train-1", "version": 1, "tpuChips": [0, 1, 2, 3],
+        "tpuShares": 0, "priority": "",
         "cpuset": "0-7", "portBindings": {"8000": 40001},
     }
     spec_example = {
@@ -207,10 +208,26 @@ def build_spec() -> dict:
             {"imageName": s("Image to run (required)"),
              "replicaSetName": s("Base name (required; no '-'; versions "
                                  "are named {name}-{v})"),
-             "tpuCount": i("ICI-contiguous TPU chips to grant "
-                           "(gpuCount accepted as a legacy alias)",
-                           minimum=0),
-             "gpuCount": i("Legacy alias for tpuCount", minimum=0),
+             "tpuCount": {
+                 "type": "number", "minimum": 0, "multipleOf": 0.25,
+                 "description":
+                     "Whole ICI-contiguous chips (1, 2, ...), or a "
+                     "FRACTIONAL share of one chip (exactly 0.25, 0.5 "
+                     "or 0.75 — any other fraction, including values "
+                     "like 1.5, is rejected with app error 1000: counts "
+                     "above 1 must be whole). Fractional tenants "
+                     "co-locate on a share-split chip and time-slice it "
+                     "through the per-chip regulator by share weight "
+                     "(gpuCount accepted as a legacy alias). App error "
+                     "1026 when no chip has enough free share "
+                     "capacity."},
+             "gpuCount": {"type": "number", "minimum": 0,
+                          "description": "Legacy alias for tpuCount"},
+             "priority": s("Regulator class for fractional co-tenancy: "
+                           "'latency' streams preempt 'best_effort' "
+                           "co-tenants at decode-chunk boundaries "
+                           "('' = best_effort)",
+                           enum=["", "latency", "best_effort"]),
              "cpuCount": i("CPU cores to pin (cpuset)", minimum=0),
              "memory": s("Memory limit, e.g. '16GB' (units KB/MB/GB/TB)"),
              "binds": arr(ref("Bind")),
@@ -222,8 +239,14 @@ def build_spec() -> dict:
             required=["imageName", "replicaSetName"],
             desc="POST /api/v1/replicaSet body (dtos.ContainerRun; "
                  "reference models/container.go ContainerRun)"),
-        "TpuPatch": obj({"tpuCount": i(minimum=0),
-                         "gpuCount": i("Legacy alias", minimum=0)}),
+        "TpuPatch": obj({"tpuCount": {"type": "number", "minimum": 0,
+                                      "multipleOf": 0.25,
+                                      "description": "Whole chips, or "
+                                      "exactly 0.25/0.5/0.75 (counts "
+                                      "above 1 must be whole; else app "
+                                      "error 1000)"},
+                         "gpuCount": {"type": "number", "minimum": 0,
+                                      "description": "Legacy alias"}}),
         "CpuPatch": obj({"cpuCount": i(minimum=0)}),
         "MemoryPatch": obj({"memory": s("e.g. '32GB'")}),
         "VolumePatch": obj({"oldBind": ref("Bind"),
@@ -263,6 +286,10 @@ def build_spec() -> dict:
              "port_bindings": obj({}, additional=i(),
                                   desc="containerPort -> hostPort"),
              "tpu_chips": arr(i(), "Granted global chip indices"),
+             "tpu_shares": i("Fractional grant: share quanta (of 4) held "
+                             "on tpu_chips[0]; 0 = whole-chip grant"),
+             "priority": s("Regulator class ('' | 'latency' | "
+                           "'best_effort')"),
              "tpu_env": obj({}, additional=s(),
                             desc="TPU env injected into the container "
                                  "(TPU_VISIBLE_CHIPS etc.)"),
@@ -284,7 +311,11 @@ def build_spec() -> dict:
             desc="Persisted volume version (dtos.StoredVolumeInfo)"),
         "RunResponse": obj(
             {"name": s("Versioned container name"), "version": i(),
-             "tpuChips": arr(i()), "cpuset": s(),
+             "tpuChips": arr(i()),
+             "tpuShares": i("Share quanta (of 4) held on tpuChips[0]; "
+                            "0 = whole-chip grant"),
+             "priority": s("Regulator class for fractional co-tenancy"),
+             "cpuset": s(),
              "portBindings": obj({}, additional=i())},
             desc="run/patch/rollback/restart payload "
                  "(services/replicaset.py _run_response)"),
@@ -331,9 +362,16 @@ def build_spec() -> dict:
             {"index": i("Global chip index"), "id": s(),
              "device": s("/dev/accel* path"),
              "coord": arr(i(), "ICI mesh coordinate"),
-             "used": b(), "owner": s("Granting replicaSet ('' = free)"),
+             "used": b("Whole-granted OR share-split"),
+             "owner": s("Whole-chip granting replicaSet ('' = free or "
+                        "share-split)"),
              "cordoned": b("Excluded from placement (health monitor or "
-                           "operator cordon)")}),
+                           "operator cordon)"),
+             "shares": obj({}, additional=i(),
+                           desc="Fractional co-tenants: replicaSet -> "
+                                "share quanta held (sums to <= 4)"),
+             "freeShares": i("Share quanta still grantable on this chip "
+                             "(0 when cordoned or whole-granted)")}),
         "TpuTopology": obj(
             {"acceleratorType": s("e.g. 'v5p-8'"), "generation": s(),
              "shape": arr(i(), "ICI mesh shape"), "wraparound": b(),
@@ -342,7 +380,15 @@ def build_spec() -> dict:
             desc="topology.Topology.serialize()"),
         "TpuStatus": obj(
             {"topology": ref("TpuTopology"), "chips": arr(ref("TpuChip")),
-             "freeCount": i("ALLOCATABLE chips: free and not cordoned"),
+             "freeCount": {
+                 "type": "number",
+                 "description":
+                     "ALLOCATABLE capacity in chip units, fractional "
+                     "share capacity included (a half-shared chip "
+                     "contributes its remaining quarters); integer when "
+                     "no chip is share-split"},
+             "freeShares": i("Total share quanta grantable to fractional "
+                             "requests (4 = one whole free chip)"),
              "cordoned": arr(i(), "Cordoned chip indices")},
             desc="GET /resources/tpus payload (schedulers/tpu.py "
                  "get_status; reference GetGpuStatus)"),
@@ -456,11 +502,12 @@ def build_spec() -> dict:
             "Create + start a container under a new replicaSet",
             envelope(ref("RunResponse"), run_resp_example),
             body=ref("ContainerRun"), tags=["replicaSet"],
-            desc="Grants tpuCount ICI-contiguous chips, cpuCount cores, "
+            desc="Grants tpuCount ICI-contiguous chips (or a fractional "
+                 "share of one chip when tpuCount < 1), cpuCount cores, "
                  "and one host port per containerPort, then starts "
                  "version 1 ({name}-1) on the substrate. App errors: "
-                 "1001 exists, 1013/1014/1015 not enough "
-                 "tpu/cpu/port.")},
+                 "1001 exists, 1013/1014/1015 not enough tpu/cpu/port, "
+                 "1026 fractional share capacity oversubscribed.")},
         f"{v1}/replicaSet/{{name}}": {
             "get": op("getReplicaSet", "Current-version info",
                       envelope(obj({"info": ref("ContainerInfo")})),
@@ -650,7 +697,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.7.0",
+            "version": "0.8.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

@@ -1,0 +1,608 @@
+"""Fractional-chip multi-tenancy sweep (`mt` marker; make verify-mt).
+
+Three layers, matching the feature's structure:
+
+1. scheduler share-ledger invariants (schedulers/tpu.py): no
+   oversubscription under concurrent applies, whole/fractional mixing,
+   exact owner-checked release, serialize/restore round-trip, cordon
+   exclusion;
+2. service plumbing (services/replicaset.py): grant lifecycle through
+   run/patch/stop/restart/delete, failure unwind, drain of co-tenants
+   with zero leaked shares, crash-mid-replace reconcile;
+3. the per-chip concurrency regulator (regulator.py): weighted time
+   sharing, latency-class preemption with bounded stall, preempt events,
+   and the REST/metrics surface.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import regulator as regmod
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import ContainerRun, PatchRequest, TpuPatch
+from gpu_docker_api_tpu.schedulers import (
+    SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
+)
+from gpu_docker_api_tpu.services import ReplicaSetService
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import WorkQueue
+
+pytestmark = pytest.mark.mt
+
+
+# ---------------------------------------------------------------- scheduler
+
+def test_parse_tpu_count():
+    assert parse_tpu_count(2) == (2, 0)
+    assert parse_tpu_count(4.0) == (4, 0)
+    assert parse_tpu_count(0) == (0, 0)
+    assert parse_tpu_count(0.25) == (0, 1)
+    assert parse_tpu_count(0.5) == (0, 2)
+    assert parse_tpu_count(0.75) == (0, 3)
+    for bad in (-1, -0.25, 0.3, 1.5, 2.25):
+        with pytest.raises(ValueError):
+            parse_tpu_count(bad)
+
+
+def test_fractional_packing_and_freecount():
+    s = TpuScheduler(topology=make_topology("v4-16"))       # 8 chips
+    a = s.apply_shares(2, "a")
+    b = s.apply_shares(2, "b")
+    assert a == b                                   # packed onto one chip
+    c = s.apply_shares(3, "c")
+    assert c != a                                   # no room left on a
+    st = s.get_status()
+    assert st["freeCount"] == 6.25                  # 6 whole + 1 quantum
+    assert st["freeShares"] == 25
+    chip = next(ch for ch in st["chips"] if ch["index"] == a)
+    assert chip["shares"] == {"a": 2, "b": 2}
+    assert chip["used"] and chip["owner"] == ""
+    assert chip["freeShares"] == 0
+
+
+def test_no_oversubscription_under_concurrent_applies():
+    s = TpuScheduler(topology=make_topology("v4-16"))       # 8 chips = 32 q
+    granted: list[tuple[str, int]] = []
+    lock = threading.Lock()
+
+    def worker(i):
+        for j in range(8):
+            owner = f"t{i}-{j}"
+            try:
+                chip = s.apply_shares(3, owner)
+            except xerrors.TpuOversubscribedError:
+                continue
+            with lock:
+                granted.append((owner, chip))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # every chip's ledger within capacity, and the ledger exactly matches
+    # the successful grants
+    for chip, owners in s.shares.items():
+        assert sum(owners.values()) <= SHARE_QUANTA
+    assert len(granted) == sum(
+        1 for owners in s.shares.values() for _ in owners)
+    for owner, chip in granted:
+        assert s.shares[chip][owner] == 3
+
+
+def test_whole_and_fractional_mixing():
+    s = TpuScheduler(topology=make_topology("v4-16"))
+    chip = s.apply_shares(2, "frac")
+    whole = s.apply(4, "whole")
+    assert chip not in whole                # shared chip invisible to whole
+    # whole-granted chips invisible to fractional placement: 8 chips,
+    # 4 whole-granted, chip has 2/4 used -> exactly 14 quanta left
+    for _ in range(14):
+        c = s.apply_shares(1, "more")
+        assert c not in whole
+    with pytest.raises(xerrors.TpuOversubscribedError):
+        s.apply_shares(1, "flood")
+    # the oversubscribed error is still a TpuNotEnoughError for
+    # share-unaware callers
+    assert issubclass(xerrors.TpuOversubscribedError,
+                      xerrors.TpuNotEnoughError)
+
+
+def test_release_exact_and_owner_checked():
+    s = TpuScheduler(topology=make_topology("v4-16"))
+    chip = s.apply_shares(2, "a")
+    s.apply_shares(2, "b")
+    # wrong owner / wrong chip: no-ops
+    assert s.restore_shares(chip, 2, "ghost") == 0
+    assert s.restore_shares(chip + 1, 2, "a") == 0
+    assert s.shares[chip] == {"a": 2, "b": 2}
+    # over-release clamps to the holding; double release frees nothing
+    assert s.restore_shares(chip, 99, "a") == 2
+    assert s.restore_shares(chip, 2, "a") == 0
+    assert s.shares[chip] == {"b": 2}
+    assert s.restore_shares(chip, 2, "b") == 2
+    assert chip not in s.shares
+    assert s.get_status()["freeCount"] == 8
+
+
+def test_serialize_restore_roundtrip():
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    try:
+        s = TpuScheduler(client, wq, topology=make_topology("v4-16"))
+        chip = s.apply_shares(3, "a")
+        s.apply_shares(1, "b")
+        s.apply(2, "whole")
+        s.cordon([7])
+        wq.join()
+        s.flush()
+        s2 = TpuScheduler(client, wq)
+        assert s2.shares == s.shares
+        assert s2.status == s.status
+        assert s2.cordoned == s.cordoned
+        assert s2.get_status()["freeCount"] == s.get_status()["freeCount"]
+        # restored ledger still enforces capacity
+        with pytest.raises(xerrors.TpuOversubscribedError):
+            s2.apply_shares(1, "c", prefer=chip)    # prefer ignored: full
+            for _ in range(64):
+                s2.apply_shares(3, "c")
+    finally:
+        wq.close()
+
+
+def test_cordon_excludes_shared_chips():
+    s = TpuScheduler(topology=make_topology("v4-16"))
+    chip = s.apply_shares(1, "a")
+    s.cordon([chip])
+    # remaining quanta of a cordoned chip are not allocatable
+    st = s.get_status()
+    assert st["freeCount"] == 7
+    assert next(c for c in st["chips"]
+                if c["index"] == chip)["freeShares"] == 0
+    c2 = s.apply_shares(1, "b")
+    assert c2 != chip
+    # the existing tenant keeps its shares (cordon never yanks)
+    assert s.shares[chip] == {"a": 1}
+
+
+# ------------------------------------------------------------------ service
+
+@pytest.fixture()
+def world(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    backend = MockBackend(str(tmp_path / "state"))
+    tpu = TpuScheduler(client, wq, topology=make_topology("v4-16"))
+    cpu = CpuScheduler(client, wq, core_count=16)
+    ports = PortScheduler(client, wq, port_range=(42000, 42100), seed=11)
+    rs = ReplicaSetService(backend, client, wq, tpu, cpu, ports,
+                           VersionMap("containerVersionMap", client, wq),
+                           MergeMap(client, wq))
+    yield rs, backend, tpu, wq, client
+    wq.close()
+
+
+def _run_frac(rs, name, count=0.5, priority="best_effort"):
+    return rs.run_container(ContainerRun(
+        imageName="ubuntu:22.04", replicaSetName=name, tpuCount=count,
+        priority=priority))
+
+
+def test_run_fractional_co_tenants(world):
+    rs, backend, tpu, wq, _ = world
+    r1 = _run_frac(rs, "hi", 0.5, "latency")
+    r2 = _run_frac(rs, "lo", 0.5)
+    assert r1["tpuShares"] == 2 and r1["priority"] == "latency"
+    assert r1["tpuChips"] == r2["tpuChips"]          # co-located
+    st = backend.inspect("hi-1")
+    assert "TDAPI_TPU_SHARES=2" in st.spec.env
+    assert "TDAPI_PRIORITY=latency" in st.spec.env
+    assert st.spec.tpu_env.get("TPU_VISIBLE_CHIPS")
+    assert tpu.get_status()["freeCount"] == 7
+
+
+def test_patch_transitions_and_unwind(world):
+    rs, backend, tpu, wq, _ = world
+    _run_frac(rs, "t", 0.5)
+    chip = rs._stored_info("t").spec.tpu_chips[0]
+    # fraction -> fraction (same chip preferred when capacity allows:
+    # 2 held + 1 new = 3 <= 4, so the resize stays put)
+    r = rs.patch_container("t", PatchRequest(tpuPatch=TpuPatch(0.25)))
+    assert r["tpuShares"] == 1 and r["tpuChips"] == [chip]
+    assert tpu.shares[chip] == {"t": 1}
+    # fraction -> whole
+    r = rs.patch_container("t", PatchRequest(tpuPatch=TpuPatch(2)))
+    assert r["tpuShares"] == 0 and len(r["tpuChips"]) == 2
+    assert tpu.shares == {}
+    # whole -> fraction
+    r = rs.patch_container("t", PatchRequest(tpuPatch=TpuPatch(0.75)))
+    assert r["tpuShares"] == 3
+    assert tpu.shares[r["tpuChips"][0]] == {"t": 3}
+    assert tpu.get_status()["freeCount"] == 7.25
+    # failed patch (impossible whole count) leaves the ledger untouched
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        rs.patch_container("t", PatchRequest(tpuPatch=TpuPatch(64)))
+    assert tpu.shares[r["tpuChips"][0]] == {"t": 3}
+    assert tpu.get_status()["freeCount"] == 7.25
+
+
+def test_stop_restart_delete_release_exact(world):
+    rs, backend, tpu, wq, _ = world
+    _run_frac(rs, "a", 0.25)
+    _run_frac(rs, "b", 0.5)
+    chip = rs._stored_info("a").spec.tpu_chips[0]
+    rs.stop_container("a")
+    assert tpu.shares[chip] == {"b": 2}             # exact release, b kept
+    rs.restart_container("a")
+    assert tpu.shares[chip]["a"] == 1               # re-granted (packed)
+    rs.delete_container("a")
+    rs.delete_container("b")
+    assert tpu.shares == {}
+    assert tpu.get_status()["freeCount"] == 8
+
+
+def test_drain_migrates_co_tenants_zero_leaked_shares(world):
+    rs, backend, tpu, wq, _ = world
+    for n in ("t1", "t2", "t3"):
+        _run_frac(rs, n, 0.25)
+    _run_frac(rs, "big", 0.75)                      # second chip
+    chip = rs._stored_info("t1").spec.tpu_chips[0]
+    tpu.cordon([chip])
+    res = rs.drain_cordoned()
+    moved = {d["name"] for d in res["drained"]}
+    assert {"t1", "t2", "t3"} <= moved
+    assert not res["failed"]
+    # zero leaked shares: cordoned chip's ledger empty, every tenant's
+    # quanta intact elsewhere, totals conserved
+    assert chip not in tpu.shares
+    total = sum(q for owners in tpu.shares.values()
+                for q in owners.values())
+    assert total == 1 + 1 + 1 + 3
+    for d in res["drained"]:
+        assert chip not in d["toChips"]
+
+
+def test_crash_mid_replace_reconciles_shares(world, monkeypatch):
+    from gpu_docker_api_tpu import faults
+    from gpu_docker_api_tpu.intents import IntentJournal
+    from gpu_docker_api_tpu.reconcile import Reconciler
+    rs, backend, tpu, wq, client = world
+    _run_frac(rs, "t", 0.5)
+    _run_frac(rs, "peer", 0.25)
+    chip = rs._stored_info("t").spec.tpu_chips[0]
+    faults.arm("replace.after_create")
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            rs.patch_container("t", PatchRequest(tpuPatch=TpuPatch(0.75)))
+    finally:
+        faults.disarm_all()
+    # daemon died mid-replace: replay intents + cross-check on a fresh
+    # reconciler; the ledger must settle to exactly the stored records
+    # (t back at 0.5 on its chip, peer untouched, no orphan quanta)
+    rec = Reconciler(backend, client, wq, tpu,
+                     CpuScheduler(client, wq, core_count=16),
+                     PortScheduler(client, wq, port_range=(42000, 42100)),
+                     VersionMap("containerVersionMap", client, wq),
+                     VersionMap("volumeVersionMap", client, wq),
+                     MergeMap(client, wq), IntentJournal(client),
+                     replicasets=rs)
+    rec.run()
+    rs.invalidate("t")
+    info = rs._stored_info("t")
+    # the replace settles forward (new record persisted before the crash)
+    # or unwinds — either way the ledger must EXACTLY match the surviving
+    # records: t's quanta where its record says, peer untouched, not one
+    # orphan quantum anywhere
+    assert info.spec.tpu_shares in (2, 3)
+    t_chip = info.spec.tpu_chips[0]
+    assert tpu.shares[t_chip]["t"] == info.spec.tpu_shares
+    assert tpu.shares[chip]["peer"] == 1
+    total = sum(q for owners in tpu.shares.values() for q in owners.values())
+    assert total == info.spec.tpu_shares + 1
+
+
+# ---------------------------------------------------------------- regulator
+
+class _EventSink:
+    def __init__(self):
+        self.events = []
+
+    def record(self, op, **kw):
+        self.events.append((op, kw))
+
+
+def test_weighted_sharing_converges():
+    reg = regmod.ChipRegulator(0)
+    a = reg.register("a", weight=3)
+    b = reg.register("b", weight=1)
+    stop = time.monotonic() + 0.6
+
+    def run(t):
+        while time.monotonic() < stop:
+            with t.slice(tokens=1):
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in (a, b)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # chip time under saturation converges to the 3:1 weight ratio;
+    # generous window for scheduler jitter
+    ratio = a.busy_seconds / max(b.busy_seconds, 1e-9)
+    assert 1.8 < ratio < 5.0, ratio
+    assert reg.chunks_total == a.chunks + b.chunks
+
+
+def test_latency_preempts_best_effort_bounded_stall():
+    sink = _EventSink()
+    reg = regmod.ChipRegulator(3, events=sink)
+    be = reg.register("be", weight=4)
+    hi = reg.register("hi", weight=1, priority="latency")
+    chunk_s = 0.05
+    saw_yield = []
+
+    def holder():
+        with be.slice():
+            time.sleep(chunk_s)
+            saw_yield.append(be.should_yield())
+
+    th = threading.Thread(target=holder)
+    th.start()
+    time.sleep(0.01)                    # holder mid-chunk
+    t0 = time.perf_counter()
+    with hi.slice():
+        waited = time.perf_counter() - t0
+    th.join()
+    # bounded stall: the latency tenant waited at most the in-flight
+    # chunk (+ scheduler slack), never a full round of co-tenants
+    assert waited < chunk_s + 0.05, waited
+    assert saw_yield == [True]          # holder was told to yield
+    assert be.preempted == 1
+    assert reg.preempt_total == 1
+    assert [e for e in sink.events if e[0] == "regulator.preempt"]
+    # flag clears with the release
+    assert not be.should_yield()
+
+
+def test_latency_class_skips_the_queue():
+    reg = regmod.ChipRegulator(0)
+    be1 = reg.register("be1", weight=2)
+    be2 = reg.register("be2", weight=2)
+    hi = reg.register("hi", weight=1, priority="latency")
+    order = []
+    lock = threading.Lock()
+
+    def run(t, n):
+        for _ in range(n):
+            with t.slice():
+                with lock:
+                    order.append(t.name)
+                time.sleep(0.004)
+
+    threads = [threading.Thread(target=run, args=(t, 10))
+               for t in (be1, be2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    run(hi, 5)
+    for t in threads:
+        t.join()
+    # every hi admission happened before the best-effort queue drained:
+    # hi never waited behind more than the chunk in flight
+    last_hi = max(i for i, n in enumerate(order) if n == "hi")
+    assert last_hi < len(order) - 1, order
+
+
+def test_single_tenant_uncontended():
+    reg = regmod.ChipRegulator(0)
+    t = reg.register("solo", weight=4)
+    for _ in range(100):
+        with t.slice(tokens=1):
+            pass
+    assert t.chunks == 100 and t.tokens == 100
+    assert reg.queue_depth() == 0
+    assert not t.should_yield()
+
+
+def test_duplicate_names_never_displace_a_tenant():
+    """Two tenants registering the same label must BOTH stay admittable
+    — a silent dict replace would strand the displaced tenant's
+    acquire() forever (its serving loop would deadlock)."""
+    reg = regmod.ChipRegulator(0)
+    a = reg.register("tenant-v1", weight=2)
+    b = reg.register("tenant-v1", weight=2)
+    assert a is not b
+    done = []
+
+    def run(t):
+        for _ in range(5):
+            with t.slice():
+                time.sleep(0.001)
+        done.append(t)
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in (a, b)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(done) == 2               # neither deadlocked
+    assert a.chunks == 5 and b.chunks == 5
+    a.unregister()
+    assert len(reg.describe()["tenants"]) == 1
+
+
+def test_registry_and_snapshot():
+    regmod.reset()
+    try:
+        r0 = regmod.for_chip(0)
+        assert regmod.for_chip(0) is r0
+        t = r0.register("x", weight=2)
+        with t.slice(tokens=3):
+            pass
+        snap = regmod.snapshot()
+        assert any(r["chip"] == 0 and r["chunksTotal"] == 1
+                   and r["tenants"][0]["tokens"] == 3 for r in snap)
+    finally:
+        regmod.reset()
+
+
+def test_batcher_ticks_through_regulator():
+    """serve._Batcher issues its device chunks through a tenant slice:
+    two tiny batchers sharing one regulator both complete, and the
+    regulator accounts their chunks."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from gpu_docker_api_tpu.models.llama import LlamaConfig, init_params
+    from gpu_docker_api_tpu.workloads.serve import _Batcher
+
+    regmod.reset()
+    try:
+        config = LlamaConfig.tiny()
+        params = init_params(config, jax.random.key(0))
+        reg = regmod.for_chip(0)
+        hi = reg.register("hi", weight=2, priority="latency")
+        lo = reg.register("lo", weight=2)
+        b_hi = _Batcher(config, params, slots=2, max_len=64,
+                        regulator=hi, seed=0)
+        b_lo = _Batcher(config, params, slots=2, max_len=64,
+                        regulator=lo, seed=0, decode_chunk=4)
+        try:
+            prompt = jnp.ones((8,), jnp.int32)
+            outs = []
+
+            def ask(b):
+                outs.append(b.submit(prompt, 12))
+
+            threads = [threading.Thread(target=ask, args=(b,))
+                       for b in (b_hi, b_lo) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(outs) == 4
+            assert all(len(o) == 12 for o in outs)
+        finally:
+            b_hi.close()
+            b_lo.close()
+        d = reg.describe()
+        by = {t["name"]: t for t in d["tenants"]}
+        assert by["hi"]["chunks"] > 0 and by["lo"]["chunks"] > 0
+        assert d["queueDepth"] == 0
+    finally:
+        regmod.reset()
+
+
+# ------------------------------------------------------------- REST surface
+
+@pytest.fixture()
+def app(tmp_path):
+    from gpu_docker_api_tpu.server.app import App
+    regmod.reset()
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43000, 43100),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16)
+    a.start()
+    yield a
+    a.stop()
+    regmod.reset()
+
+
+def _call(app, method, path, body=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=10)
+    payload = json.dumps(body) if body is not None else None
+    conn.request(method, path, payload,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, json.loads(raw) if raw else None
+
+
+def test_api_fractional_run_freecount_and_oversubscription(app):
+    # fractional run via the wire format
+    _, body = _call(app, "POST", "/api/v1/replicaSet", {
+        "imageName": "img", "replicaSetName": "frac", "tpuCount": 0.5,
+        "priority": "latency"})
+    assert body["code"] == 200, body
+    assert body["data"]["tpuShares"] == 2
+    assert body["data"]["priority"] == "latency"
+    chip = body["data"]["tpuChips"][0]
+    # freeCount reports allocatable SHARES in chip units (the small fix:
+    # fractional capacity visible to clients)
+    _, body = _call(app, "GET", "/api/v1/resources/tpus")
+    tpus = body["data"]["tpus"]
+    assert tpus["freeCount"] == 15.5
+    assert tpus["freeShares"] == 62
+    assert tpus["chips"][chip]["shares"] == {"frac": 2}
+    # invalid fraction and invalid priority are client errors
+    for bad in ({"tpuCount": 0.3}, {"tpuCount": 1.5},
+                {"priority": "urgent"}):
+        req = {"imageName": "img", "replicaSetName": "bad", "tpuCount": 1}
+        req.update(bad)
+        _, body = _call(app, "POST", "/api/v1/replicaSet", req)
+        assert body["code"] == 1000, (bad, body)
+    # fill the fleet's shares, then expect the oversubscribed code
+    for i in range(1000):
+        _, body = _call(app, "POST", "/api/v1/replicaSet", {
+            "imageName": "img", "replicaSetName": f"f{i}",
+            "tpuCount": 0.75})
+        if body["code"] != 200:
+            break
+    assert body["code"] == 1026, body
+
+
+def test_api_metrics_export_shares_and_regulator(app):
+    _, body = _call(app, "POST", "/api/v1/replicaSet", {
+        "imageName": "img", "replicaSetName": "frac", "tpuCount": 0.25})
+    assert body["code"] == 200
+    chip = body["data"]["tpuChips"][0]
+    # exercise a regulator so its gauges exist
+    t = regmod.for_chip(chip).register("frac", weight=1)
+    with t.slice(tokens=4):
+        pass
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=10)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    assert f'tdapi_tpu_shares_allocated{{chip="{chip}"}} 1' in text
+    assert "tdapi_tpu_shares_allocated_total 1" in text
+    assert "tdapi_tpu_shares_allocatable 63" in text
+    assert "tdapi_tpu_shares_utilization" in text
+    assert f'tdapi_regulator_chunks_total{{chip="{chip}"}} 1' in text
+    assert f'tdapi_regulator_queue_depth{{chip="{chip}"}} 0' in text
+    assert f'tdapi_regulator_preemptions_total{{chip="{chip}"}} 0' in text
+
+
+def test_api_regulator_preempt_event_lands_on_event_log(app):
+    reg = regmod.for_chip(0)
+    be = reg.register("be", weight=4)
+    hi = reg.register("hi", weight=1, priority="latency")
+
+    def holder():
+        with be.slice():
+            time.sleep(0.03)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    time.sleep(0.005)
+    with hi.slice():
+        pass
+    th.join()
+    _, body = _call(app, "GET", "/api/v1/events?limit=50")
+    ops = [e["op"] for e in body["data"]["events"]]
+    assert "regulator.preempt" in ops
