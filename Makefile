@@ -6,8 +6,8 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry verify-migrate verify-mt bench serve serve-mock dryrun \
-    apidoc lint clean
+    verify-retry verify-migrate verify-mt verify-races bench serve \
+    serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -23,6 +23,8 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-perf    (throughput-floor smoke: -m perf)"
 	@echo "  make verify-migrate (zero-loss migration sweep: -m migrate)"
 	@echo "  make verify-mt      (fractional multi-tenancy sweep: -m mt)"
+	@echo "  make verify-races   (race stress sweep: -m races)"
+	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
 	$(PY) -m pytest tests/ -q -m crash
@@ -41,6 +43,14 @@ verify-migrate:         ## zero-loss migration sweep: quiesce protocol + e2e gap
 
 verify-mt:              ## fractional multi-tenancy sweep: share ledger + regulator isolation
 	$(PY) -m pytest tests/ -q -m mt
+
+verify-races:           ## race stress sweep: concurrent mutation mixes + invariant checks
+	$(PY) -m pytest tests/ -q -m races
+
+lint:                   ## compile baseline + tdlint concurrency-invariant rules + rule liveness
+	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py
+	$(PY) -m tools.tdlint
+	$(PY) -m pytest tests/test_tdlint.py -q
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

@@ -1,0 +1,9 @@
+"""Runtime concurrency analysis for the control plane.
+
+`lockwatch` is the dynamic half of the correctness suite (the static half
+is `tools/tdlint`): instrumented Lock/RLock/Condition wrappers that build
+the global lock-order graph while the test suite (or a live daemon) runs,
+flag potential-deadlock cycles and locks held across backend operations,
+and dump a report at exit. Armed via TDAPI_LOCKWATCH=1; see
+docs/correctness.md.
+"""

@@ -42,6 +42,7 @@ import time
 from typing import Callable, Optional
 
 from .. import faults, xerrors
+from ..analysis import lockwatch
 from ..dtos import ContainerSpec
 from .base import Backend, ContainerState, VolumeState
 
@@ -269,6 +270,10 @@ class GuardedBackend(Backend):
 
     def _guard(self, op: str, fn: Callable,
                deadline: Optional[float] = None):
+        # lockwatch seam: flag watched locks the CALLING thread holds at
+        # op entry (the deadline worker thread below holds nothing). Fast
+        # no-op unless TDAPI_LOCKWATCH armed a watcher.
+        lockwatch.note_backend_op(op)
         trial = self.breaker.admit()
         if deadline is None:
             deadline = self.deadlines.get(op, self.deadline)

@@ -12,12 +12,21 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import threading
 
-from .server.app import App
-from .topology import make_topology
+# arm the lock-order watcher BEFORE the App import pulls in every
+# control-plane module, so module-level locks (faults, regulator registry)
+# are watched too — a live daemon then doubles as a race sweep, reporting
+# at exit (docs/correctness.md). Off by default: zero wrappers, zero cost.
+if os.environ.get("TDAPI_LOCKWATCH") == "1":
+    from .analysis import lockwatch as _lockwatch
+    _lockwatch.install(report_at_exit=True)
+
+from .server.app import App                                    # noqa: E402
+from .topology import make_topology                            # noqa: E402
 
 log = logging.getLogger("tpu-docker-api")
 
@@ -76,6 +85,11 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=getattr(logging, args.logLevel.upper()),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from .analysis import lockwatch
+    if lockwatch.installed():
+        log.info("lockwatch armed: lock-order + held-across-backend "
+                 "report at exit")
 
     topology = make_topology(args.topology) if args.topology else None
     tiers = {}

@@ -66,16 +66,18 @@ class HealthMonitor:
     def probe_once(self) -> dict:
         """One full probe cycle; returns the fresh report. Safe to call
         concurrently with the background loop (scores are lock-guarded)."""
+        # probe failure IS the signal here, not an error to surface:
+        # an exploding ping means unreachable
         try:
             substrate_ok = bool(self.backend.ping())
-        except Exception:  # noqa: BLE001 — an exploding ping IS unreachable
+        except Exception:  # noqa: BLE001  # tdlint: disable=silent-swallow -- failure is the probe result
             substrate_ok = False
 
         # flap evidence first, so it lands in the same cycle's scores
         try:
             flaps = {n: c for n, c in self.backend.flap_counts().items()
                      if c >= self.flap_threshold}
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # tdlint: disable=silent-swallow -- failure is the probe result
             flaps = {}
         flap_chips: set[int] = set()
         for name in flaps:
@@ -83,8 +85,23 @@ class HealthMonitor:
                 state = self.backend.inspect(name)
                 if state.spec is not None:
                     flap_chips.update(state.spec.tpu_chips)
-            except Exception:  # noqa: BLE001 — container may be mid-removal
+            except Exception:  # noqa: BLE001  # tdlint: disable=silent-swallow -- container may be mid-removal
                 continue
+
+        # ALL backend probing happens before taking the monitor lock: a
+        # hung device node must stall only this prober, never park
+        # report() (served at /healthz) behind a dead substrate — and
+        # lockwatch flags any lock held across a backend op. The topology
+        # object is immutable after construction, so walking its chips
+        # without a lock is safe.
+        presence: dict[int, bool] = {}
+        for chip in self.tpu.topology.chips:
+            try:
+                presence[chip.index] = bool(
+                    self.backend.chip_available(chip.device_path))
+            except Exception:  # noqa: BLE001  # tdlint: disable=silent-swallow -- failure is the probe result
+                presence[chip.index] = False
+        already_cordoned = self.tpu.cordoned_snapshot()
 
         to_cordon: list[int] = []
         with self._lock:
@@ -93,11 +110,8 @@ class HealthMonitor:
             self._substrate_ok = substrate_ok
             self._flapping = flaps
             for chip in self.tpu.topology.chips:
-                try:
-                    present = self.backend.chip_available(chip.device_path)
-                except Exception:  # noqa: BLE001
-                    present = False
-                failed = (not present) or (chip.index in flap_chips)
+                failed = (not presence.get(chip.index, False)
+                          or chip.index in flap_chips)
                 if failed:
                     self._scores[chip.index] = \
                         self._scores.get(chip.index, 0) + 1
@@ -105,7 +119,7 @@ class HealthMonitor:
                     self._scores[chip.index] = 0
                 if (self.auto_cordon
                         and self._scores[chip.index] >= self.fail_threshold
-                        and chip.index not in self.tpu.cordoned):
+                        and chip.index not in already_cordoned):
                     to_cordon.append(chip.index)
 
         if to_cordon:
@@ -129,13 +143,14 @@ class HealthMonitor:
             flapping = dict(self._flapping)
             probes = self._probes
             last_at = self._last_probe_at
-        cordoned = sorted(self.tpu.cordoned)
+        cordoned_set = self.tpu.cordoned_snapshot()
+        cordoned = sorted(cordoned_set)
         chips = [{
             "index": c.index,
             "device": c.device_path,
             "failureScore": scores.get(c.index, 0),
             "healthy": scores.get(c.index, 0) == 0,
-            "cordoned": c.index in self.tpu.cordoned,
+            "cordoned": c.index in cordoned_set,
         } for c in self.tpu.topology.chips]
         degraded = (not substrate_ok or bool(cordoned) or bool(flapping)
                     or any(s > 0 for s in scores.values()))

@@ -67,6 +67,25 @@ VOLUMES = "volumes"
 # that also runs other workloads) everything else is not ours to remove.
 _MANAGED_NAME = re.compile(r"[^-]+-\d+$")
 
+# ---- intent-journal registry (enforced by tdlint's unknown-step rule) ----
+# Every step name the services may write MUST appear below, or a linted
+# build fails: a step the reconciler has never heard of would otherwise be
+# silently skipped at boot — the drift lands exactly when a crash needs it.
+
+#: steps the replay branches actually READ (has_step/step_meta); these are
+#: written synchronously by the services (intents.Intent.step sync=True)
+CONSULTED_STEPS = frozenset({"created", "copied", "migrated"})
+
+#: steps recorded for observability only (sync=False journal slimming);
+#: replay never branches on them, but they are registered so the linter
+#: can tell "known informational" from "forgot to teach the reconciler"
+INFORMATIONAL_STEPS = frozenset({
+    "granted", "persisted", "precopied", "quiesced", "stopped_old",
+    "started_new", "removed_old", "stopped", "restored", "removed",
+})
+
+KNOWN_STEPS = CONSULTED_STEPS | INFORMATIONAL_STEPS
+
 
 class Reconciler:
     def __init__(self, backend, client, wq, tpu, cpu, ports,
@@ -106,6 +125,7 @@ class Reconciler:
             "volumesMigrated": 0,
             "droppedReplayed": 0,
             "idempotency": {"finalized": 0, "dropped": 0, "expired": 0},
+            "unknownIntentOps": [],
         }
         # make store reads current before cross-checking anything
         self.wq.join()
@@ -162,7 +182,10 @@ class Reconciler:
             # TTL-expired records are routine hygiene, not evidence of a
             # dirty shutdown — only settled crash leftovers count
             + report["idempotency"]["finalized"]
-            + report["idempotency"]["dropped"])
+            + report["idempotency"]["dropped"]
+            # an op this reconciler cannot replay is version drift — loud,
+            # not a silent skip (it still clears, but the operator must see)
+            + len(report["unknownIntentOps"]))
         if self.events is not None:
             self.events.record("reconcile", code=200,
                                actions=report["actions"],
@@ -213,9 +236,30 @@ class Reconciler:
             "volume.delete": self._replay_volume_delete,
         }.get(rec.op)
         if handler is None:
+            # an op nobody here can replay means a NEWER (or corrupt)
+            # daemon journaled it: surface it on the event log and the
+            # reconcile report instead of silently clearing — the mutation
+            # it describes is in an unknown half-done state
             log.warning("unknown intent op %r for %s — clearing",
                         rec.op, rec.target)
+            report["unknownIntentOps"].append(
+                f"{rec.kind}:{rec.target}:{rec.op}")
+            if self.events is not None:
+                # key is intentOp: EventLog.record's first positional IS
+                # `op` (the event name) — passing op= again would TypeError
+                self.events.record("reconcile.unknown_op", target=rec.target,
+                                   code=500, intentOp=rec.op, kind=rec.kind)
             return
+        unknown_steps = [s for s in rec.step_names() if s not in KNOWN_STEPS]
+        if unknown_steps:
+            # same drift class, finer grain: the op replays, but markers
+            # this build has never heard of contribute nothing to it
+            log.warning("intent %s:%s carries unknown step(s) %s",
+                        rec.kind, rec.target, unknown_steps)
+            if self.events is not None:
+                self.events.record("reconcile.unknown_step",
+                                   target=rec.target, code=500,
+                                   steps=unknown_steps, intentOp=rec.op)
         handler(rec, report)
 
     def _purge_container_state(self, name: str, report: dict) -> None:
@@ -241,18 +285,24 @@ class Reconciler:
             self.replicasets.invalidate(name)
 
     def _free_all_owned(self, owner: str, report: dict) -> None:
-        """Free every scheduler grant held by `owner` (owner-checked)."""
-        chips = [i for i, o in self.tpu.status.items() if o == owner]
+        """Free every scheduler grant held by `owner` (owner-checked).
+        Reads go through the locked snapshot accessors: the runtime
+        `?run=1` reconcile runs while the API serves, and iterating a
+        scheduler's LIVE dict races concurrent grants (dict-changed-size
+        mid-iteration). The restore below is owner-checked, so acting on
+        a snapshot that a concurrent mutation has already outdated can
+        never free someone else's grant."""
+        chips = [i for i, o in self.tpu.owners().items() if o == owner]
         if chips:
             self.tpu.restore(chips, owner)
             report["grantsFreed"]["tpu"] += len(chips)
         shared = self.tpu.release_owner_shares(owner)
         report["grantsFreed"]["tpu"] += len(shared)
-        cores = [i for i, o in self.cpu.status.items() if o == owner]
+        cores = [i for i, o in self.cpu.owners().items() if o == owner]
         if cores:
             self.cpu.restore(cores, owner)
             report["grantsFreed"]["cpu"] += len(cores)
-        ports = [p for p, o in self.ports.used.items() if o == owner]
+        ports = [p for p, o in self.ports.owners().items() if o == owner]
         if ports:
             self.ports.restore(ports, owner)
             report["grantsFreed"]["ports"] += len(ports)
@@ -464,8 +514,8 @@ class Reconciler:
         # (leaked quanta freed, lost quanta re-marked; owner+chip keyed,
         # so co-tenants on the same chip settle independently)
         want = dict(exp_shares)
-        for chip, owners in list(self.tpu.shares.items()):
-            for owner, q in list(owners.items()):
+        for chip, owners in self.tpu.shares_snapshot().items():
+            for owner, q in owners.items():
                 expect = want.pop((chip, owner), 0)
                 if q != expect:
                     self.tpu.set_shares(chip, owner, expect)
@@ -491,11 +541,15 @@ class Reconciler:
                     mark([idx], owner)
                     report["grantsRemarked"][key] += 1
 
-        sweep(self.tpu.status, exp_tpu, self.tpu.restore,
+        # snapshots, not live maps (see _free_all_owned): the sweep's
+        # restore/mark calls are owner-checked per index, so a stale
+        # snapshot entry resolves safely — but iterating the live dict
+        # while a request thread grants would not
+        sweep(self.tpu.owners(), exp_tpu, self.tpu.restore,
               self.tpu.mark_used, "tpu")
-        sweep(self.cpu.status, exp_cpu, self.cpu.restore,
+        sweep(self.cpu.owners(), exp_cpu, self.cpu.restore,
               self.cpu.mark_used, "cpu")
-        sweep(self.ports.used, exp_ports, self.ports.restore,
+        sweep(self.ports.owners(), exp_ports, self.ports.restore,
               self.ports.mark_used, "ports")
 
     # ---------------------------------------------- container cross-check

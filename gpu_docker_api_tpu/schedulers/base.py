@@ -96,6 +96,9 @@ class Scheduler(abc.ABC):
             self._client.put(self.resource, self.state_key,
                              json.dumps(snap, sort_keys=True))
 
+    # tdlint: disable=io-under-lock -- deliberate: the shutdown flush must
+    # write under the lock, or a concurrent mutation's persist could be
+    # overwritten by this (then-stale) snapshot
     def flush(self) -> None:
         """Synchronous persist for graceful shutdown (reference Stop flush,
         cmd/gpu-docker-api/main.go:139-154). The put happens under the lock —
@@ -106,6 +109,18 @@ class Scheduler(abc.ABC):
         with self._lock:
             self._client.put(self.resource, self.state_key,
                              json.dumps(self.serialize(), sort_keys=True))
+
+    # ---- cross-thread read surface ----
+
+    def owners(self) -> dict:
+        """Locked snapshot of the ownership map ({index: owner}). This is
+        the only sanctioned way for ANOTHER object (reconciler, health
+        monitor, route handlers) to read a scheduler's state: iterating the
+        live dict races its writers — a concurrent grant mutates it
+        mid-iteration (RuntimeError) or yields a torn multi-key view.
+        Enforced by tdlint's unlocked-state rule."""
+        with self._lock:
+            return dict(self.status)
 
     # ---- contract ----
 

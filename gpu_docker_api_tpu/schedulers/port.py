@@ -108,6 +108,12 @@ class PortScheduler(Scheduler):
                     self.used[p] = owner
             self._persist()
 
+    def owners(self) -> dict:
+        """Locked snapshot of {port: owner} (see Scheduler.owners — the
+        port map's ownership lives in `used`, not `status`)."""
+        with self._lock:
+            return dict(self.used)
+
     def get_status(self) -> dict:
         """Reference GetPortStatus shape: availableCount already net of used
         (the reference subtracts in the handler, routers/resource.go:33-37 —

@@ -490,6 +490,31 @@ class TpuScheduler(Scheduler):
             return 0
         return SHARE_QUANTA - self._shares_used(chip)
 
+    def shares_snapshot(self) -> dict[int, dict[str, int]]:
+        """Locked deep copy of the share ledger ({chip: {owner: quanta}}) —
+        the cross-object read surface (see Scheduler.owners): the live
+        nested dicts mutate under concurrent grants/releases."""
+        with self._lock:
+            return {c: dict(o) for c, o in self.shares.items()}
+
+    def cordoned_snapshot(self) -> set[int]:
+        """Locked copy of the cordoned set — reading the live set from
+        another thread races cordon/uncordon mutations."""
+        with self._lock:
+            return set(self.cordoned)
+
+    def snapshot(self) -> dict:
+        """ONE consistent locked view {status, shares, cordoned}. The race
+        sweep's invariant checker asserts cross-map invariants (bitmap/
+        ledger disjointness, per-chip quanta caps) that two separately
+        locked snapshots cannot establish race-free — a chip whole-granted
+        between an owners() and a shares_snapshot() call would look
+        double-booked when it never was."""
+        with self._lock:
+            return {"status": dict(self.status),
+                    "shares": {c: dict(o) for c, o in self.shares.items()},
+                    "cordoned": set(self.cordoned)}
+
     def env_for(self, grant: list[int]) -> dict[str, str]:
         """TPU env plumbing for a grant (SURVEY §5.7)."""
         return self.topology.visible_chips_env(grant)

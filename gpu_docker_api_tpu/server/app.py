@@ -798,7 +798,7 @@ class App:
 
     def _chip_index(self, req: Request) -> int:
         idx = int(req.params["id"])
-        if idx not in self.tpu.status:
+        if idx not in self.tpu.owners():
             raise ValueError(f"unknown chip index {idx}")
         return idx
 

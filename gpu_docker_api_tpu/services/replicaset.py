@@ -746,7 +746,7 @@ class ReplicaSetService:
         of the drain — and a re-POST is idempotent: already-migrated sets
         no longer hold cordoned chips and are passed over, failed ones
         are retried."""
-        cordoned = set(self.tpu.cordoned)
+        cordoned = self.tpu.cordoned_snapshot()
         result: dict = {"cordoned": sorted(cordoned), "drained": [],
                         "skipped": [], "failed": {}}
         if not cordoned:

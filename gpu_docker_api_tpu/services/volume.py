@@ -157,7 +157,11 @@ class VolumeService:
                 try:
                     self.backend.volume_remove(out["name"])
                 except Exception:  # noqa: BLE001
-                    pass
+                    # the new volume survives its failed scale: without a
+                    # trace here the orphan is invisible until the next
+                    # boot reconcile sweeps it
+                    log.exception("cleanup: removing failed new volume %s",
+                                  out["name"])
                 failed_version = self.versions.get(name)
                 self.versions.rollback_bump(name, info.version)
                 self._latest[name] = info

@@ -114,6 +114,7 @@ class MVCCStore:
         self._commit(seq)
         return True
 
+    # tdlint: disable=unlocked-state -- contract: caller holds _lock
     def _apply_put(self, key: str, value: str, rev: int) -> None:
         revs = self._log.setdefault(key, [])
         if revs and not revs[-1].tombstone:
@@ -122,6 +123,7 @@ class MVCCStore:
         else:
             revs.append(_Rev(rev, rev, 1, value))
 
+    # tdlint: disable=unlocked-state -- contract: caller holds _lock
     def _apply_delete(self, key: str, rev: int) -> None:
         revs = self._log.setdefault(key, [])
         revs.append(_Rev(rev, 0, 0, "", tombstone=True))
@@ -216,6 +218,7 @@ class MVCCStore:
         self._commit(seq)
         return dropped
 
+    # tdlint: disable=unlocked-state -- contract: caller holds _lock
     def _compact_locked(self, revision: int,
                         keep_history_prefixes: tuple[str, ...]) -> int:
         dropped = 0
@@ -407,6 +410,8 @@ class MVCCStore:
         with self._commit_cond:
             return self._flush_batch_max
 
+    # tdlint: disable=unlocked-state -- boot-time only: runs from __init__
+    # before any other thread can hold a reference to this store
     def _replay(self, path: str) -> None:
         with open(path, "r", encoding="utf-8") as f:
             for line in f:

@@ -155,7 +155,7 @@ class NativeMVCCStore:
     def __del__(self):  # noqa: D105 — last-resort handle cleanup
         try:
             self.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # tdlint: disable=silent-swallow -- logging during interpreter teardown is unsafe
             pass
 
 

@@ -100,6 +100,8 @@ class VersionMap:
         else:
             self._client.put(_MAPS_RESOURCE, self._key, payload)
 
+    # tdlint: disable=io-under-lock -- deliberate: shutdown flush writes
+    # under the lock so a concurrent mutation's persist can't be overwritten
     def flush(self) -> None:
         with self._lock:
             self._client.put(_MAPS_RESOURCE, self._key,
@@ -156,6 +158,8 @@ class MergeMap:
         else:
             self._client.put(_MAPS_RESOURCE, MERGE_MAP_KEY, payload)
 
+    # tdlint: disable=io-under-lock -- deliberate: shutdown flush writes
+    # under the lock so a concurrent mutation's persist can't be overwritten
     def flush(self) -> None:
         with self._lock:
             self._client.put(_MAPS_RESOURCE, MERGE_MAP_KEY,

@@ -106,6 +106,10 @@ class PreconditionFailedError(XError):
                       f"{current or 0}", current=current or 0)
 
 
+# tdlint: disable=unmapped-xerror -- deliberate: the guard retries timeouts
+# with backoff; exhausted retries surface through each route's catch-all as
+# that op's *Failed envelope code (wire-compatible with the reference), and
+# REPEATED timeouts escalate to 503 via the circuit breaker, which IS mapped
 class BackendTimeoutError(XError):
     """A backend call overran its per-op deadline (GuardedBackend). Treated
     as transient: retried with backoff, counted by the circuit breaker."""

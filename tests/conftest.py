@@ -1,9 +1,31 @@
 """Test fixtures. Platform forcing lives in pytest_force_cpu.py (loaded
 via pytest.ini addopts before capture starts)."""
 
+import os
 import time
 
 import pytest  # noqa: E402
+
+# Arm lockwatch BEFORE any test module imports the package, so locks
+# created at module import time (faults._lock, regulator._LOCK) and every
+# lock any test constructs are watched. With this on, the whole suite
+# doubles as a race sweep: the session-end fixture below fails the run on
+# any lock-order cycle or non-exempt lock held across a backend op.
+if os.environ.get("TDAPI_LOCKWATCH") == "1":
+    from gpu_docker_api_tpu.analysis import lockwatch as _lockwatch
+    _lockwatch.install(report_at_exit=True)
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockwatch_session_sweep():
+    """When TDAPI_LOCKWATCH=1, sweep the accumulated lock-order graph at
+    session end and error the run on cycles / held-across-backend
+    findings (tests that EXPECT findings build their own LockWatcher and
+    never touch the global one)."""
+    yield
+    from gpu_docker_api_tpu.analysis import lockwatch
+    if lockwatch.installed():
+        lockwatch.assert_clean()
 
 
 @pytest.fixture(autouse=True, scope="module")

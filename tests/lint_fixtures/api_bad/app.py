@@ -1,0 +1,10 @@
+"""Route layer for the `unmapped-xerror` bad corpus: handles only
+HandledError."""
+from . import xerrors
+
+
+def run_handler(req):
+    try:
+        return do_run(req)
+    except xerrors.HandledError:
+        return {"code": 1001}

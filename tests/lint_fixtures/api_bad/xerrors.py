@@ -1,0 +1,15 @@
+"""Seeded violation for `unmapped-xerror`: OrphanedError is never caught
+in the route layer (api_bad/app.py), so it would fall into the catch-all
+and surface as a generic op-failed code."""
+
+
+class XError(Exception):
+    pass
+
+
+class HandledError(XError):
+    pass
+
+
+class OrphanedError(XError):              # VIOLATION: no route catches it
+    pass

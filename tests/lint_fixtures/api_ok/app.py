@@ -1,0 +1,12 @@
+"""Route layer for the `unmapped-xerror` clean corpus: every xerrors
+class maps to a stable app code."""
+from . import xerrors
+
+
+def run_handler(req):
+    try:
+        return do_run(req)
+    except xerrors.HandledError:
+        return {"code": 1001}
+    except (xerrors.AlsoHandledError, ValueError):
+        return {"code": 1002}

@@ -1,0 +1,14 @@
+"""Clean twin for `unmapped-xerror`: every error class is caught in the
+route layer (api_ok/app.py)."""
+
+
+class XError(Exception):
+    pass
+
+
+class HandledError(XError):
+    pass
+
+
+class AlsoHandledError(XError):
+    pass

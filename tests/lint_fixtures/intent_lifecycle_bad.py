@@ -1,0 +1,10 @@
+"""Seeded violation for `intent-lifecycle`: the intent is closed on the
+success path but a failure between begin() and done() leaves the journal
+entry open forever (no done() in any exception handler)."""
+
+
+class BadService:
+    def run(self, name):
+        intent = self.intents.begin("container.run", name)   # VIOLATION
+        self.backend.create(name, {})
+        intent.done(committed=True)

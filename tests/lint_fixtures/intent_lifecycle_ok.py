@@ -1,0 +1,13 @@
+"""Clean twin for `intent-lifecycle`: done() reached on the success path
+AND from the unwind handler."""
+
+
+class GoodService:
+    def run(self, name):
+        intent = self.intents.begin("container.run", name)
+        try:
+            self.backend.create(name, {})
+        except Exception:
+            intent.done()
+            raise
+        intent.done(committed=True)

@@ -1,0 +1,13 @@
+"""Seeded violation for `io-under-lock`: a backend op dispatched while a
+lock is held — every other writer queues behind the substrate."""
+import threading
+
+
+class BadService:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def stop(self, name):
+        with self._lock:
+            self.backend.stop(name)       # VIOLATION: backend op under lock
+            self.running = False

@@ -1,0 +1,13 @@
+"""Clean twin for `io-under-lock`: state flip under the lock, substrate
+call outside it."""
+import threading
+
+
+class GoodService:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def stop(self, name):
+        with self._lock:
+            self.running = False
+        self.backend.stop(name)

@@ -1,0 +1,24 @@
+"""Pragma fixtures: each would-be violation below is suppressed by a
+`# tdlint: disable=<rule>` pragma in one of the three honored positions —
+same line, line above, and function header (def line or its contiguous
+leading comment block). test_tdlint asserts this file lints clean with
+every pragma counted as used."""
+import threading
+
+
+class PragmaScheduler:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.status = {}
+
+    def same_line(self, idx):
+        self.status[idx] = "x"    # tdlint: disable=unlocked-state -- demo
+
+    def line_above(self, idx):
+        # tdlint: disable=unlocked-state -- demo: pragma on the line above
+        self.status[idx] = "y"
+
+    # tdlint: disable=unlocked-state -- demo: header pragma covers the body
+    def whole_function(self, idx):
+        self.status[idx] = "z"
+        del self.status[idx]

@@ -1,0 +1,14 @@
+"""Miniature reconciler registry the `unknown-step` rule reads: the step
+sets plus the handler table inside _replay_intent, exactly the shapes
+gpu_docker_api_tpu/reconcile.py declares."""
+
+CONSULTED_STEPS = frozenset({"created", "copied"})
+INFORMATIONAL_STEPS = frozenset({"granted", "stopped"})
+
+
+def _replay_intent(rec, report):
+    handler = {
+        "container.run": None,
+        "container.replace": None,
+    }.get(rec.op)
+    return handler

@@ -1,0 +1,9 @@
+"""Seeded violation for `silent-swallow`: a broad except whose body leaves
+no trace — a mutation-path failure disappears."""
+
+
+def cleanup(backend, name):
+    try:
+        backend.remove(name)
+    except Exception:                     # VIOLATION: swallowed silently
+        pass

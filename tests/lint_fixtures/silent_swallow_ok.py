@@ -1,0 +1,12 @@
+"""Clean twin for `silent-swallow`: the broad except logs before moving
+on (re-raising or events.record() would equally satisfy the rule)."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def cleanup(backend, name):
+    try:
+        backend.remove(name)
+    except Exception:
+        log.exception("cleanup: removing %s failed", name)

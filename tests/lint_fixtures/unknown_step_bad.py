@@ -1,0 +1,15 @@
+"""Seeded violations for `unknown-step`: a step name the reconciler has
+never heard of (silently skipped at boot) and an intent op with no replay
+handler (a crash mid-operation would never be replayed)."""
+
+
+class BadService:
+    def run(self, name):
+        intent = self.intents.begin("container.run", name)
+        intent.step("granted")
+        intent.step("warped")                          # VIOLATION: step
+        intent.done(committed=True)
+
+    def teleport(self, name):
+        intent = self.intents.begin("container.teleport", name)  # VIOLATION
+        intent.done()

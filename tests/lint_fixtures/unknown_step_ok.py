@@ -1,0 +1,14 @@
+"""Clean twin for `unknown-step`: every op and step name is registered."""
+
+
+class GoodService:
+    def run(self, name):
+        intent = self.intents.begin("container.run", name)
+        intent.step("granted")
+        intent.step("created")
+        intent.done(committed=True)
+
+    def replace(self, name):
+        intent = self.intents.begin("container.replace", name)
+        intent.step("stopped", sync=False)
+        intent.done()

@@ -1,0 +1,23 @@
+"""Seeded violations for `unlocked-state`: a guarded-attr mutation outside
+the owning lock, a raw cross-object read of another scheduler's map, and —
+the subtler variant — a cross-object read made under the reader's OWN lock
+(holding your lock never makes someone else's state safe)."""
+import threading
+
+
+class BadScheduler:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.status = {}
+
+    def grant(self, idx, owner):
+        self.status[idx] = owner          # VIOLATION: mutation, no lock
+
+    def free_count(self, other):
+        return len(other.tpu.status)      # VIOLATION: raw cross-object read
+
+    def probe(self, other):
+        with self._lock:
+            # VIOLATION: own lock held, but other.tpu.cordoned is guarded
+            # by the OTHER object's lock (the pre-fix health.py bug)
+            return 3 in other.tpu.cordoned

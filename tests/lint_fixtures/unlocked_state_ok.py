@@ -1,0 +1,20 @@
+"""Clean twin for `unlocked-state`: same shape, mutations under the lock,
+cross-object reads through the locked snapshot accessor."""
+import threading
+
+
+class GoodScheduler:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.status = {}
+
+    def grant(self, idx, owner):
+        with self._lock:
+            self.status[idx] = owner
+
+    def owners(self):
+        with self._lock:
+            return dict(self.status)
+
+    def free_count(self, other):
+        return len(other.tpu.owners())

@@ -1,0 +1,282 @@
+"""lockwatch unit tests: the wrapped primitives, the lock-order graph, a
+real two-thread A->B/B->A cycle, held-across-backend detection with the
+name-lock exemption, and the install()/uninstall() threading seam.
+
+Tests that EXPECT findings run against a private LockWatcher (or swap the
+module global for one), so a TDAPI_LOCKWATCH=1 session's graph never
+inherits a deliberate violation."""
+
+import threading
+
+import pytest
+
+from gpu_docker_api_tpu.analysis import lockwatch
+from gpu_docker_api_tpu.analysis.lockwatch import (
+    LockWatcher, _WatchedCondition, _WatchedLock,
+)
+
+
+# ------------------------------------------------------------ primitives
+
+def test_watched_lock_contract():
+    w = LockWatcher()
+    lk = w.make_lock(site="L")
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+        assert not lk.acquire(blocking=False)   # it's a real Lock under
+    assert not lk.locked()
+    assert w.acquires == 1
+    assert w.report()["lockSites"] == {"L": 1}
+
+
+def test_watched_rlock_reentrancy_no_self_edge():
+    w = LockWatcher()
+    rl = w.make_rlock(site="R")
+    with rl:
+        with rl:                                 # reentrant: no R->R edge
+            pass
+    assert w.report()["edges"] == []
+    assert w.report()["cycles"] == []
+
+
+def test_out_of_lifo_release_keeps_stack_honest():
+    w = LockWatcher()
+    a, b = w.make_lock(site="A"), w.make_lock(site="B")
+    a.acquire()
+    b.acquire()
+    a.release()                 # non-LIFO: legal
+    w.note_backend_op("stop")   # only B still held
+    b.release()
+    found = w.report()["heldAcrossBackend"]
+    assert [f["lock"] for f in found] == ["B"]
+
+
+def test_condition_wrapper_wait_notify():
+    w = LockWatcher()
+    cond = w.make_condition(site="C")
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.05)
+    with cond:
+        hits.append("sent")
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive() and hits == ["sent", "woke"]
+
+
+def test_condition_over_watched_lock_shares_the_real_lock():
+    w = LockWatcher()
+    lk = w.make_rlock(site="L")
+    cond = w.make_condition(lock=lk, site="C")
+    with lk:
+        # the condition is bound to the SAME underlying primitive: its
+        # non-blocking acquire from another thread must fail
+        grabbed = []
+        t = threading.Thread(
+            target=lambda: grabbed.append(cond._inner.acquire(False)))
+        t.start()
+        t.join()
+        assert grabbed == [False]
+
+
+# ------------------------------------------------------- lock-order graph
+
+def test_nested_acquire_records_edge():
+    w = LockWatcher()
+    a, b = w.make_lock(site="A"), w.make_lock(site="B")
+    with a:
+        with b:
+            pass
+    rep = w.report()
+    assert rep["edges"] == [{"from": "A", "to": "B", "count": 1}]
+    assert rep["cycles"] == []
+    w.assert_clean()            # one direction only: no hazard
+
+
+def test_real_two_thread_abba_cycle_detected():
+    """Two threads take {A then B} and {B then A}, interleaved so the run
+    itself never deadlocks — lockwatch must still flag the cycle."""
+    w = LockWatcher()
+    a, b = w.make_lock(site="A"), w.make_lock(site="B")
+    t1_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        t1_done.wait(5)         # sequenced: real threads, no deadlock
+        with b:
+            with a:
+                pass
+
+    th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
+    th1.start()
+    th2.start()
+    th1.join(5)
+    th2.join(5)
+    rep = w.report()
+    assert rep["cycles"] == [{"sites": ["A", "B"]}]
+    assert {(e["from"], e["to"]) for e in rep["cycleEdges"]} == {
+        ("A", "B"), ("B", "A")}
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        w.assert_clean()
+
+
+def test_three_site_cycle_detected():
+    w = LockWatcher()
+    a, b, c = (w.make_lock(site=s) for s in "ABC")
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    assert w.report()["cycles"] == [{"sites": ["A", "B", "C"]}]
+
+
+def test_same_site_peer_instances_skip_edge():
+    w = LockWatcher()
+    a1 = w.make_lock(site="base.py:65")
+    a2 = w.make_lock(site="base.py:65")
+    with a1:
+        with a2:
+            pass
+    assert w.report()["edges"] == []    # documented granularity limit
+
+
+# --------------------------------------------------- held-across-backend
+
+def test_lock_held_across_backend_op_flagged():
+    w = LockWatcher()
+    lk = w.make_lock(site="sched")
+    with lk:
+        w.note_backend_op("create")
+    found = w.report()["heldAcrossBackend"]
+    assert [(f["lock"], f["op"]) for f in found] == [("sched", "create")]
+    with pytest.raises(AssertionError, match="held across backend"):
+        w.assert_clean()
+
+
+def test_no_finding_when_nothing_held():
+    w = LockWatcher()
+    lk = w.make_lock(site="sched")
+    with lk:
+        pass
+    w.note_backend_op("create")
+    assert w.report()["heldAcrossBackend"] == []
+    w.assert_clean()
+
+
+def test_name_lock_exemptions():
+    w = LockWatcher()
+    # creation-time exemption (IO_EXEMPT_FUNCS path sets exempt=True)
+    name_lock = w.make_lock(site="replicaset.py:173", exempt=True)
+    with name_lock:
+        w.note_backend_op("stop")
+    assert w.report()["heldAcrossBackend"] == []
+    # post-hoc allowlist by site
+    other = w.make_lock(site="special")
+    w.exempt_io("special")
+    with other:
+        w.note_backend_op("stop")
+    assert w.report()["heldAcrossBackend"] == []
+    assert "special" in w.report()["exemptSites"]
+
+
+def test_guard_seam_reports_callers_held_locks(tmp_path, monkeypatch):
+    """GuardedBackend._guard calls lockwatch.note_backend_op on the
+    CALLER's thread — a watched lock held over a guarded op is caught
+    end-to-end (the fixed health.py probe was exactly this bug class)."""
+    from gpu_docker_api_tpu.backend import MockBackend
+    from gpu_docker_api_tpu.backend.guard import GuardedBackend
+    w = LockWatcher()
+    monkeypatch.setattr(lockwatch, "_watcher", w)
+    gb = GuardedBackend(MockBackend(str(tmp_path / "state")))
+    lk = w.make_lock(site="monitor._lock")
+    with lk:
+        gb.ping()               # unguarded health hook: no finding
+        gb.list_names()         # guarded op: finding
+    found = w.report()["heldAcrossBackend"]
+    assert ("monitor._lock", "list_names") in [
+        (f["lock"], f["op"]) for f in found]
+    assert all(f["op"] != "ping" for f in found)
+
+
+# ------------------------------------------------------ install seam
+
+def test_install_patches_package_lock_creation_only():
+    was_installed = lockwatch.installed()
+    w = lockwatch.install()
+    try:
+        # a lock created HERE (tests/, outside the package) stays real
+        ours = threading.Lock()
+        assert not isinstance(ours, _WatchedLock)
+        # a lock created inside the package is watched, keyed by site
+        from gpu_docker_api_tpu.schedulers import TpuScheduler
+        from gpu_docker_api_tpu.topology import make_topology
+        s = TpuScheduler(topology=make_topology("v4-8"))
+        assert isinstance(s._lock, _WatchedLock)
+        assert "schedulers/base.py" in s._lock._site
+        site_count = w.report()["lockSites"][s._lock._site]
+        assert site_count >= 1
+        # conditions created in-package are watched too
+        from gpu_docker_api_tpu.regulator import ChipRegulator
+        r = ChipRegulator(chip=0)
+        assert isinstance(r._cond, _WatchedCondition)
+    finally:
+        if not was_installed:
+            lockwatch.uninstall()
+
+
+def test_reset_clears_in_place_so_existing_locks_stay_watched(monkeypatch):
+    """reset() must clear the SAME watcher instance: already-created locks
+    hold a reference to it, so a swap-for-fresh would silently route their
+    edges into a graph nobody reports."""
+    w = LockWatcher()
+    monkeypatch.setattr(lockwatch, "_watcher", w)
+    a, b = w.make_lock(site="A"), w.make_lock(site="B")
+    with a:
+        with b:
+            pass
+    assert len(w.report()["edges"]) == 1
+    lockwatch.reset()
+    assert w.report()["edges"] == []
+    assert w.report()["acquires"] == 0
+    # phase 2 on the SAME pre-existing locks: the inverse order now forms
+    # a cycle that must land in the REPORTED graph
+    with b:
+        with a:
+            pass
+    with a:
+        with b:
+            pass
+    assert lockwatch.report()["cycles"] == [{"sites": ["A", "B"]}]
+
+
+def test_uninstall_restores_and_watched_locks_survive():
+    was_installed = lockwatch.installed()
+    if was_installed:
+        pytest.skip("session-armed lockwatch stays installed")
+    lockwatch.install()
+    from gpu_docker_api_tpu.schedulers import CpuScheduler
+    s = CpuScheduler(core_count=4)
+    lockwatch.uninstall()
+    assert not lockwatch.installed()
+    assert threading.Lock is lockwatch._REAL_LOCK
+    # the orphaned wrapper keeps functioning
+    grant = s.apply(2, "o")
+    s.restore(grant, "o")
+    assert lockwatch.report() == {}
+    lockwatch.assert_clean()    # no-op when not installed
+    lockwatch.note_backend_op("stop")   # fast no-op path

@@ -1,0 +1,352 @@
+"""Race stress sweep (`races` marker; make verify-races) + regression
+tests for the concurrency findings tdlint/lockwatch flagged in existing
+code.
+
+The stress harness fires randomized concurrent run/patch/stop/restart/
+delete/drain/fractional-grant mixes from many threads against one world
+while a checker thread continuously asserts the scheduler's cross-map
+invariants on ONE consistent locked snapshot:
+
+- per-chip share-ledger sum never exceeds SHARE_QUANTA;
+- bitmap/ledger disjointness: a whole-owned chip never carries share
+  entries (share-split chips are invisible to whole placement, and vice
+  versa);
+- share quanta are always 1..SHARE_QUANTA with real owners.
+
+Only domain errors (xerrors.XError — not-enough, oversubscribed, existed,
+no-patch-required...) are expected under contention; any OTHER exception
+(KeyError, RuntimeError: dict changed size during iteration — the classic
+torn-read crash) fails the sweep. At the end every replicaSet is deleted
+and the harness asserts zero leaked grants across all three schedulers.
+
+Regression tests (the genuine pre-existing findings this PR fixed):
+
+1. health.py probed the substrate while holding the monitor lock — a hung
+   device node parked /healthz's report() behind a dead backend
+   (lockwatch: lock held across backend op).
+2. reconcile.py iterated LIVE scheduler dicts from the runtime
+   `?run=1` path while request threads grant concurrently (tdlint:
+   unlocked-state cross-object access).
+3. reconcile.py silently skipped intent records whose op/step this build
+   doesn't know — version drift cleared a half-done mutation without a
+   trace (tdlint: unknown-step is the static half; the runtime half now
+   surfaces on the report and the event log).
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+from gpu_docker_api_tpu.backend import MockBackend
+from gpu_docker_api_tpu.dtos import ContainerRun, PatchRequest, TpuPatch
+from gpu_docker_api_tpu.health import HealthMonitor
+from gpu_docker_api_tpu.intents import IntentJournal
+from gpu_docker_api_tpu.reconcile import KNOWN_STEPS, Reconciler
+from gpu_docker_api_tpu.schedulers import (
+    SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler,
+)
+from gpu_docker_api_tpu.services import ReplicaSetService
+from gpu_docker_api_tpu.store import MVCCStore, StateClient
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.version import MergeMap, VersionMap
+from gpu_docker_api_tpu.workqueue import WorkQueue
+
+pytestmark = pytest.mark.races
+
+
+@pytest.fixture()
+def world(tmp_path):
+    store = MVCCStore()
+    client = StateClient(store)
+    wq = WorkQueue(client)
+    wq.start()
+    backend = MockBackend(str(tmp_path / "state"))
+    tpu = TpuScheduler(client, wq, topology=make_topology("v4-16"))
+    cpu = CpuScheduler(client, wq, core_count=64)
+    ports = PortScheduler(client, wq, port_range=(43000, 43400), seed=7)
+    rs = ReplicaSetService(backend, client, wq, tpu, cpu, ports,
+                           VersionMap("containerVersionMap", client, wq),
+                           MergeMap(client, wq))
+    yield rs, backend, tpu, cpu, ports, wq, client
+    wq.close()
+
+
+def _check_invariants(snap) -> list:
+    """Invariant assertions over ONE locked snapshot (tpu.snapshot())."""
+    bad = []
+    for chip, owners in snap["shares"].items():
+        total = sum(owners.values())
+        if total > SHARE_QUANTA:
+            bad.append(f"chip {chip} ledger oversubscribed: {owners}")
+        for owner, q in owners.items():
+            if not owner or not (1 <= q <= SHARE_QUANTA):
+                bad.append(f"chip {chip} bogus grant {owner!r}={q}")
+        if owners and snap["status"].get(chip) is not None:
+            bad.append(
+                f"chip {chip} both whole-owned by "
+                f"{snap['status'][chip]!r} and share-split: {owners}")
+    return bad
+
+
+# ------------------------------------------------------------ the sweep
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_concurrent_mutation_stress(world, seed):
+    rs, backend, tpu, cpu, ports, wq, _client = world
+    n_workers, n_ops = 6, 22
+    unexpected: list = []
+    invariant_violations: list = []
+    stop_checking = threading.Event()
+
+    def checker():
+        while not stop_checking.is_set():
+            invariant_violations.extend(_check_invariants(tpu.snapshot()))
+            if invariant_violations:
+                return
+            time.sleep(0.002)
+
+    def attempt(fn):
+        try:
+            fn()
+        except (xerrors.XError, ValueError):
+            pass                     # domain outcome under contention
+        except Exception as e:       # noqa: BLE001 — the race signal
+            unexpected.append(f"{type(e).__name__}: {e}")
+
+    def worker(wid):
+        rng = random.Random(seed * 100 + wid)
+        names = [f"w{wid}a", f"w{wid}b", f"w{wid}c"]
+        for _ in range(n_ops):
+            name = rng.choice(names)
+            roll = rng.random()
+            if roll < 0.30:
+                count = rng.choice([1, 2, 0.25, 0.5, 0.75])
+                attempt(lambda: rs.run_container(ContainerRun(
+                    imageName="ubuntu:22.04", replicaSetName=name,
+                    tpuCount=count,
+                    priority=rng.choice(["", "latency", "best_effort"]))))
+            elif roll < 0.50:
+                count = rng.choice([1, 2, 0.25, 0.5, 0.75])
+                attempt(lambda: rs.patch_container(
+                    name, PatchRequest(tpuPatch=TpuPatch(count))))
+            elif roll < 0.62:
+                attempt(lambda: rs.stop_container(name))
+            elif roll < 0.72:
+                attempt(lambda: rs.restart_container(name))
+            elif roll < 0.82:
+                attempt(lambda: rs.delete_container(name))
+            elif roll < 0.90:
+                # cross-worker read/stop: name-lock + snapshot contention
+                other = f"w{(wid + 1) % n_workers}{rng.choice('abc')}"
+                attempt(lambda: rs.get_container_info(other))
+            elif roll < 0.96:
+                attempt(lambda: tpu.get_status())
+            else:
+                # cordon a chip, drain its tenants, uncordon
+                chip = rng.randrange(8)
+                def drain_cycle():
+                    tpu.cordon([chip])
+                    try:
+                        rs.drain_cordoned()
+                    finally:
+                        tpu.uncordon([chip])
+                attempt(drain_cycle)
+
+    chk = threading.Thread(target=checker)
+    chk.start()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "stress worker wedged (deadlock?)"
+    stop_checking.set()
+    chk.join(timeout=10)
+
+    assert unexpected == []
+    assert invariant_violations == []
+
+    # drain everything and prove zero leaked grants anywhere
+    for wid in range(n_workers):
+        for suffix in "abc":
+            try:
+                rs.delete_container(f"w{wid}{suffix}")
+            except xerrors.XError:
+                pass
+    wq.join()
+    snap = tpu.snapshot()
+    assert _check_invariants(snap) == []
+    assert all(o is None for o in snap["status"].values()), snap["status"]
+    assert snap["shares"] == {}
+    assert snap["cordoned"] == set()
+    assert cpu.owners() == {} or all(
+        o is None for o in cpu.owners().values())
+    assert all(o is None for o in ports.owners().values())
+
+
+# ----------------------------------------------- regression: health probe
+
+class _HangableBackend:
+    """Health-hook stub whose chip probe can hang forever."""
+
+    def __init__(self):
+        self.gate = threading.Event()   # unset = chip_available hangs
+        self.gate.set()
+
+    def ping(self):
+        return True
+
+    def flap_counts(self):
+        return {}
+
+    def chip_available(self, device_path):
+        self.gate.wait()                # a dead device node, in effect
+        return True
+
+
+def test_report_not_parked_behind_hung_probe():
+    """REGRESSION (lockwatch: lock held across backend op): probing used
+    to call backend.chip_available chip-by-chip INSIDE the monitor lock,
+    so one hung device node parked report() — served at /healthz, the
+    endpoint an operator needs exactly when the substrate is sick. All
+    substrate probing now happens before the lock is taken."""
+    backend = _HangableBackend()
+    tpu = TpuScheduler(topology=make_topology("v4-8"))
+    mon = HealthMonitor(backend, tpu, auto_cordon=False)
+    mon.probe_once()                    # healthy warm-up cycle
+    backend.gate.clear()                # device node wedges
+    t = threading.Thread(target=mon.probe_once, daemon=True)
+    t.start()
+    time.sleep(0.1)                     # prober is now inside the hang
+    done = threading.Event()
+    out: dict = {}
+
+    def read_report():
+        out.update(mon.report())
+        done.set()
+
+    threading.Thread(target=read_report, daemon=True).start()
+    ok = done.wait(timeout=5)
+    backend.gate.set()                  # unwedge before asserting
+    t.join(timeout=5)
+    assert ok, "report() blocked behind a hung substrate probe"
+    assert out["probes"] == 1           # the wedged cycle hadn't landed
+
+
+# ------------------------------------- regression: live-dict iteration
+
+def test_scheduler_snapshots_safe_under_concurrent_grants():
+    """REGRESSION (tdlint: unlocked-state): the runtime reconcile path
+    iterated self.tpu.status / .shares / ports.used LIVE while request
+    threads grant — a dict mutated mid-iteration raises RuntimeError and
+    a torn multi-key read frees the wrong grants. The locked snapshot
+    accessors (owners()/shares_snapshot()/cordoned_snapshot()) must stay
+    stable under a concurrent grant/release storm."""
+    tpu = TpuScheduler(topology=make_topology("v4-32"))     # 16 chips
+    errors: list = []
+    stop = threading.Event()
+
+    def churn(wid):
+        rng = random.Random(wid)
+        while not stop.is_set():
+            try:
+                if rng.random() < 0.5:
+                    grant = tpu.apply(rng.choice([1, 2]), f"o{wid}")
+                    tpu.restore(grant, f"o{wid}")
+                else:
+                    q = rng.choice([1, 2])
+                    chip = tpu.apply_shares(q, f"s{wid}")
+                    tpu.restore_shares(chip, q, f"s{wid}")
+            except xerrors.XError:
+                pass
+            except Exception as e:      # noqa: BLE001
+                errors.append(f"churn: {type(e).__name__}: {e}")
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                for _idx, _owner in tpu.owners().items():
+                    pass
+                for _chip, owners in tpu.shares_snapshot().items():
+                    sum(owners.values())
+                tpu.cordoned_snapshot()
+                tpu.snapshot()
+            except Exception as e:      # noqa: BLE001
+                errors.append(f"read: {type(e).__name__}: {e}")
+
+    threads = ([threading.Thread(target=churn, args=(i,)) for i in range(4)]
+               + [threading.Thread(target=read_loop) for _ in range(2)])
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert errors == []
+
+
+def test_owners_returns_snapshot_not_live_map():
+    tpu = TpuScheduler(topology=make_topology("v4-8"))
+    before = tpu.owners()
+    grant = tpu.apply(1, "a")
+    assert all(o is None for o in before.values())       # copy, not alias
+    assert tpu.owners()[grant[0]] == "a"
+    shares_before = tpu.shares_snapshot()
+    chip = tpu.apply_shares(2, "b")
+    assert chip not in shares_before
+    assert tpu.shares_snapshot()[chip] == {"b": 2}
+
+
+# --------------------------------- regression: unknown intent op / step
+
+def _reconciler(world, events=None):
+    rs, backend, tpu, cpu, ports, wq, client = world
+    return Reconciler(
+        backend, client, wq, tpu, cpu, ports,
+        VersionMap("containerVersionMap", client, wq),
+        VersionMap("volumeVersionMap", client, wq),
+        MergeMap(client, wq), IntentJournal(client),
+        events=events, replicasets=rs)
+
+
+def test_reconcile_surfaces_unknown_intent_op(world):
+    """REGRESSION: an intent op this build has no replay handler for
+    (journaled by a newer daemon, or corrupt) was logged at debug level
+    and silently cleared — the mutation it describes stays half-done with
+    zero operator-visible evidence. It now lands on the reconcile report
+    (counted as an action) and the event log. Uses the REAL EventLog: a
+    stub with a different record() signature once hid a keyword collision
+    with its first positional (`op`)."""
+    from gpu_docker_api_tpu.events import EventLog
+    _rs, _backend, _tpu, _cpu, _ports, wq, client = world
+    journal = IntentJournal(client)
+    journal.begin("teleport", "ghost-1")
+    wq.join()
+    events = EventLog()
+    report = _reconciler(world, events=events).run()
+    assert report["unknownIntentOps"] == ["container:ghost-1:teleport"]
+    assert report["actions"] >= 1
+    rows = [e for e in events.recent()
+            if e["op"] == "reconcile.unknown_op"]
+    assert rows and rows[0]["intentOp"] == "teleport"
+    assert rows[0]["target"] == "ghost-1"
+
+
+def test_reconcile_surfaces_unknown_step(world):
+    from gpu_docker_api_tpu.events import EventLog
+    _rs, _backend, _tpu, _cpu, _ports, wq, client = world
+    journal = IntentJournal(client)
+    intent = journal.begin("run", "w0x-1")
+    intent.step("hyperdrive")           # a step no reconciler branch reads
+    assert "hyperdrive" not in KNOWN_STEPS
+    wq.join()
+    events = EventLog()
+    _reconciler(world, events=events).run()
+    rows = [e for e in events.recent()
+            if e["op"] == "reconcile.unknown_step"]
+    assert rows and rows[0]["steps"] == ["hyperdrive"]
+    assert rows[0]["intentOp"] == "run"

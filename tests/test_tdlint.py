@@ -1,0 +1,194 @@
+"""tdlint self-tests: every rule is proven LIVE against a seeded-violation
+fixture (tests/lint_fixtures/) and SILENT on its clean twin; the pragma
+machinery (all three placements, used-counting, stale detection) and the
+repo gate (`make lint` must exit 0 on the tree as committed) are covered
+here too. Runs in the default tier and from `make lint` itself, so a rule
+that rots into never-firing fails the build that relies on it."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import tdlint
+from tools.tdlint import lint_paths, run as lint_run
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(names, rules):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    rep = lint_paths(paths, FIXTURES, rules=rules)
+    return rep["violations"]
+
+
+# ------------------------------------------------------- rule liveness
+
+def test_unlocked_state_fires_and_clean_twin_silent():
+    vs = _lint(["unlocked_state_bad.py"], ["unlocked-state"])
+    assert len(vs) == 3
+    assert {v.rule for v in vs} == {"unlocked-state"}
+    assert "mutation of guarded state '.status'" in vs[0].message
+    assert "raw access to another object's guarded state" in vs[1].message
+    # holding your OWN lock must not exempt reads of ANOTHER object's
+    # guarded state (the pre-fix health.py probe pattern)
+    assert "raw access to another object's guarded state" in vs[2].message
+    assert _lint(["unlocked_state_ok.py"], ["unlocked-state"]) == []
+
+
+def test_intent_lifecycle_fires_and_clean_twin_silent():
+    vs = _lint(["intent_lifecycle_bad.py"], ["intent-lifecycle"])
+    assert len(vs) == 1
+    assert vs[0].rule == "intent-lifecycle"
+    assert "no done() on an exception handler" in vs[0].message
+    assert _lint(["intent_lifecycle_ok.py"], ["intent-lifecycle"]) == []
+
+
+def test_unknown_step_fires_and_clean_twin_silent():
+    vs = _lint(["unknown_step_bad.py", "registry.py"], ["unknown-step"])
+    assert len(vs) == 2
+    msgs = " | ".join(v.message for v in vs)
+    assert "'warped' is not in the reconciler's step registry" in msgs
+    assert "'container.teleport' has no handler" in msgs
+    assert _lint(["unknown_step_ok.py", "registry.py"],
+                 ["unknown-step"]) == []
+
+
+def test_io_under_lock_fires_and_clean_twin_silent():
+    vs = _lint(["io_under_lock_bad.py"], ["io-under-lock"])
+    assert len(vs) == 1
+    assert "backend op '.backend.stop()' while holding a lock" \
+        in vs[0].message
+    assert _lint(["io_under_lock_ok.py"], ["io-under-lock"]) == []
+
+
+def test_unmapped_xerror_fires_and_clean_twin_silent():
+    vs = _lint([os.path.join("api_bad", "xerrors.py"),
+                os.path.join("api_bad", "app.py")], ["unmapped-xerror"])
+    assert len(vs) == 1
+    assert "OrphanedError is never caught" in vs[0].message
+    assert _lint([os.path.join("api_ok", "xerrors.py"),
+                  os.path.join("api_ok", "app.py")],
+                 ["unmapped-xerror"]) == []
+
+
+def test_silent_swallow_fires_and_clean_twin_silent():
+    vs = _lint(["silent_swallow_bad.py"], ["silent-swallow"])
+    assert len(vs) == 1
+    assert "swallows the failure silently" in vs[0].message
+    assert _lint(["silent_swallow_ok.py"], ["silent-swallow"]) == []
+
+
+# ------------------------------------------------------------- pragmas
+
+def test_pragma_all_three_placements_honored_and_counted():
+    rep = lint_run(FIXTURES, scope=("pragma_usage.py",),
+                   rules=["unlocked-state"])
+    assert rep["violations"] == []
+    assert rep["pragmas"]["total"] == 3
+    assert rep["pragmas"]["used"] == 3
+    assert rep["pragmas"]["stale"] == []
+
+
+def test_stale_pragma_reported(tmp_path):
+    f = tmp_path / "stale.py"
+    f.write_text("# tdlint: disable=unlocked-state -- suppresses nothing\n"
+                 "X = 1\n")
+    rep = lint_run(str(tmp_path), scope=("stale.py",),
+                   rules=["unlocked-state"])
+    assert rep["violations"] == []
+    assert rep["pragmas"]["total"] == 1
+    assert rep["pragmas"]["used"] == 0
+    assert rep["pragmas"]["stale"] == [("stale.py", 1, ["unlocked-state"])]
+
+
+def test_rules_subset_does_not_mark_other_pragmas_stale():
+    """`--rules silent-swallow` must not call the unlocked-state pragmas
+    in pragma_usage.py stale — their rule never ran this invocation."""
+    rep = lint_run(FIXTURES, scope=("pragma_usage.py",),
+                   rules=["silent-swallow"])
+    assert rep["pragmas"]["stale"] == []
+    assert rep["pragmas"]["used"] == 0
+
+
+def test_misspelled_pragma_rule_always_reported(tmp_path):
+    f = tmp_path / "typo.py"
+    f.write_text("# tdlint: disable=unlockd-state -- typo'd rule name\n"
+                 "X = 1\n")
+    rep = lint_run(str(tmp_path), scope=("typo.py",),
+                   rules=["silent-swallow"])
+    assert rep["pragmas"]["stale"] == [("typo.py", 1, ["unlockd-state"])]
+
+
+def test_io_under_lock_context_expr_ordering(tmp_path):
+    """`with open(p) as f, self._lock:` runs the open BEFORE the lock is
+    taken — no violation; the reversed order IS one."""
+    ok = tmp_path / "open_then_lock.py"
+    ok.write_text("def f(self, p):\n"
+                  "    with open(p) as fh, self._lock:\n"
+                  "        self.x = fh.read()\n")
+    bad = tmp_path / "lock_then_open.py"
+    bad.write_text("def f(self, p):\n"
+                   "    with self._lock, open(p) as fh:\n"
+                   "        self.x = fh.read()\n")
+    assert lint_paths([str(ok)], str(tmp_path),
+                      rules=["io-under-lock"])["violations"] == []
+    vs = lint_paths([str(bad)], str(tmp_path),
+                    rules=["io-under-lock"])["violations"]
+    assert len(vs) == 1 and "open() while holding a lock" in vs[0].message
+
+
+def test_pragma_does_not_suppress_other_rules(tmp_path):
+    f = tmp_path / "wrong_rule.py"
+    f.write_text(
+        "def f(backend):\n"
+        "    try:\n"
+        "        backend.remove('x')\n"
+        "    # tdlint: disable=unlocked-state -- wrong rule name\n"
+        "    except Exception:\n"
+        "        pass\n")
+    rep = lint_paths([str(f)], str(tmp_path), rules=["silent-swallow"])
+    assert len(rep["violations"]) == 1
+
+
+# ------------------------------------------------------------ repo gate
+
+def test_unknown_rule_name_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        from tools.tdlint.rules import all_rules
+        all_rules(["no-such-rule"])
+
+
+def test_repo_lints_clean_via_cli():
+    """The committed tree must pass its own linter — the same invocation
+    `make lint` runs, minus compileall."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tdlint", "--root", REPO],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stdout
+
+
+def test_repo_scope_covers_the_concurrent_core():
+    ctxs = tdlint.collect_files(REPO)
+    rels = {c.rel for c in ctxs}
+    for must in ("gpu_docker_api_tpu/schedulers/tpu.py",
+                 "gpu_docker_api_tpu/store/mvcc.py",
+                 "gpu_docker_api_tpu/services/replicaset.py",
+                 "gpu_docker_api_tpu/reconcile.py",
+                 "gpu_docker_api_tpu/regulator.py",
+                 "gpu_docker_api_tpu/server/app.py"):
+        assert must in rels
+
+
+def test_live_registry_matches_reconciler():
+    """The unknown-step rule reads the REAL reconciler's registry when
+    linting the repo — a step written by services but missing from
+    reconcile.KNOWN_STEPS must fail the build, not silently pass."""
+    from gpu_docker_api_tpu import reconcile
+    assert "created" in reconcile.CONSULTED_STEPS
+    assert "precopied" in reconcile.INFORMATIONAL_STEPS
+    assert reconcile.KNOWN_STEPS == (
+        reconcile.CONSULTED_STEPS | reconcile.INFORMATIONAL_STEPS)

@@ -1,0 +1,2 @@
+"""Developer tooling (not shipped with the daemon). tools.tdlint is the
+project-specific concurrency-invariant linter (`make lint`)."""

@@ -1,0 +1,222 @@
+"""tdlint — AST-level linter for this control plane's concurrency invariants.
+
+Generic linters check style; the bugs that actually corrupt this system are
+project-specific: a share-ledger write outside the scheduler lock, an intent
+journal entry whose `done()` is skipped on one control-flow exit, a step name
+the boot reconciler silently skips, backend I/O performed while a scheduler
+lock is held. Each of those is a *named rule* here (tools/tdlint/rules.py),
+checked lexically over the AST — the direct analog of `go vet` for the Go
+reference repo, which this Python rebuild never had.
+
+Intentional exceptions are annotated in the source with a pragma the linter
+honors and counts:
+
+    # tdlint: disable=<rule>[,<rule>...] [-- free-text reason]
+
+placed on the offending line, the line above it, or a function's `def` line
+(which suppresses the rule for the whole function). A pragma that suppresses
+nothing is reported as stale (warning, not an error), so dead annotations
+don't accumulate.
+
+Run: `python -m tools.tdlint` (from the repo root; `make lint` wraps it).
+Exit status 0 = clean, 1 = violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Violation", "FileCtx", "run", "lint_paths", "DEFAULT_SCOPE"]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tdlint:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*--.*)?$")
+
+
+@dataclass
+class Violation:
+    path: str          # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: set[str]
+    used: int = 0      # violations this pragma suppressed
+
+
+@dataclass
+class FileCtx:
+    """One parsed source file plus its pragma map and function spans."""
+    path: str                      # absolute
+    rel: str                       # repo-relative, '/'-separated
+    text: str
+    tree: ast.AST
+    pragmas: list[Pragma] = field(default_factory=list)
+    # (start_line, end_line, header_lines) per function; header_lines is
+    # the def line plus the contiguous comment block directly above it, so
+    # a pragma in a function's leading comment governs the whole function
+    func_spans: list[tuple[int, int, frozenset]] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str, root: str) -> Optional["FileCtx"]:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=rel)
+        except (OSError, SyntaxError):
+            return None
+        ctx = cls(path=path, rel=rel, text=text, tree=tree)
+        for i, line in enumerate(text.splitlines(), 1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                ctx.pragmas.append(Pragma(line=i, rules=rules))
+        src_lines = text.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                header = {node.lineno}
+                i = node.lineno - 1
+                while i >= 1 and src_lines[i - 1].lstrip().startswith("#"):
+                    header.add(i)
+                    i -= 1
+                ctx.func_spans.append(
+                    (node.lineno, node.end_lineno or node.lineno,
+                     frozenset(header)))
+        return ctx
+
+    def suppressed(self, v: Violation) -> bool:
+        """A pragma covers a violation when it sits on the violating line,
+        the line above it, or in the header (def/class line + contiguous
+        leading comment block) of an enclosing function or class."""
+        header_lines: set = set()
+        for s, e, header in self.func_spans:
+            if s <= v.line <= e:
+                header_lines |= header
+        for p in self.pragmas:
+            if v.rule not in p.rules:
+                continue
+            if p.line in (v.line, v.line - 1) or p.line in header_lines:
+                p.used += 1
+                return True
+        return False
+
+
+# Files the rules reason about: the concurrent control plane. Workload
+# runtimes (workloads/, models/, train/serve), the process supervisor
+# (backend/process.py, warmpool.py — child-script generators and a
+# supervisor loop with its own never-die error policy), and tests are out
+# of scope by design (documented in docs/correctness.md).
+DEFAULT_SCOPE = (
+    "gpu_docker_api_tpu/schedulers/",
+    "gpu_docker_api_tpu/services/",
+    "gpu_docker_api_tpu/store/",
+    "gpu_docker_api_tpu/server/",
+    "gpu_docker_api_tpu/backend/guard.py",
+    "gpu_docker_api_tpu/backend/base.py",
+    "gpu_docker_api_tpu/reconcile.py",
+    "gpu_docker_api_tpu/intents.py",
+    "gpu_docker_api_tpu/idempotency.py",
+    "gpu_docker_api_tpu/health.py",
+    "gpu_docker_api_tpu/regulator.py",
+    "gpu_docker_api_tpu/workqueue.py",
+    "gpu_docker_api_tpu/events.py",
+    "gpu_docker_api_tpu/version.py",
+    "gpu_docker_api_tpu/xerrors.py",
+)
+
+
+def _in_scope(rel: str, scope: tuple[str, ...]) -> bool:
+    return any(rel == s or rel.startswith(s) for s in scope)
+
+
+def collect_files(root: str, scope: tuple[str, ...] = DEFAULT_SCOPE,
+                  ) -> list[FileCtx]:
+    ctxs = []
+    for prefix in scope:
+        path = os.path.join(root, prefix)
+        if os.path.isfile(path):
+            ctx = FileCtx.load(path, root)
+            if ctx is not None:
+                ctxs.append(ctx)
+        elif os.path.isdir(path):
+            for dirpath, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if not name.endswith(".py"):
+                        continue
+                    ctx = FileCtx.load(os.path.join(dirpath, name), root)
+                    if ctx is not None:
+                        ctxs.append(ctx)
+    ctxs.sort(key=lambda c: c.rel)
+    return ctxs
+
+
+def run(root: str, scope: tuple[str, ...] = DEFAULT_SCOPE,
+        rules: Optional[list[str]] = None) -> dict:
+    """Lint the repo at `root`. Returns a report dict:
+    {"violations": [Violation], "pragmas": {"total": N, "used": N,
+    "stale": [(rel, line, rules)]}, "files": N}."""
+    from . import rules as rule_mod
+    ctxs = collect_files(root, scope)
+    active = rule_mod.all_rules(rules)
+    violations: list[Violation] = []
+    by_rel = {c.rel: c for c in ctxs}
+    for rule in active:
+        for v in rule.check_repo(root, ctxs):
+            ctx = by_rel.get(v.path)
+            if ctx is not None and ctx.suppressed(v):
+                continue
+            violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    total = used = 0
+    stale = []
+    active_names = {r.name for r in active}
+    all_names = {r.name for r in rule_mod.RULES}
+    for ctx in ctxs:
+        for p in ctx.pragmas:
+            total += 1
+            if p.used:
+                used += 1
+            elif p.rules <= active_names or (p.rules - all_names):
+                # unused is only evidence of staleness when every rule the
+                # pragma names actually RAN (a --rules subset must not
+                # call the other rules' load-bearing pragmas stale);
+                # misspelled rule names are always reported
+                stale.append((ctx.rel, p.line, sorted(p.rules)))
+    return {
+        "violations": violations,
+        "pragmas": {"total": total, "used": used, "stale": stale},
+        "files": len(ctxs),
+        "rules": [r.name for r in active],
+    }
+
+
+def lint_paths(paths: list[str], root: str,
+               rules: Optional[list[str]] = None) -> dict:
+    """Lint explicit files (the fixture-test entry point): every per-file
+    rule runs regardless of the default scope."""
+    from . import rules as rule_mod
+    ctxs = [c for c in (FileCtx.load(p, root) for p in paths)
+            if c is not None]
+    active = rule_mod.all_rules(rules)
+    violations: list[Violation] = []
+    by_rel = {c.rel: c for c in ctxs}
+    for rule in active:
+        for v in rule.check_files(ctxs, scoped=False):
+            ctx = by_rel.get(v.path)
+            if ctx is not None and ctx.suppressed(v):
+                continue
+            violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return {"violations": violations, "files": len(ctxs)}

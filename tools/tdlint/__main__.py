@@ -1,0 +1,53 @@
+"""CLI: `python -m tools.tdlint [--root DIR] [--rules a,b] [files...]`.
+
+With no file arguments, lints the control-plane scope (tools.tdlint
+DEFAULT_SCOPE) of the repo at --root (default: cwd). With files, lints
+exactly those (the seeded-violation fixture path). Exit 1 on violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import DEFAULT_SCOPE, lint_paths, run
+from .rules import RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tdlint")
+    ap.add_argument("files", nargs="*", help="explicit files to lint")
+    ap.add_argument("--root", default=".", help="repo root (default: cwd)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.name:18s} {r.description}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    if args.files:
+        report = lint_paths(args.files, args.root, rules)
+    else:
+        report = run(args.root, DEFAULT_SCOPE, rules)
+
+    for v in report["violations"]:
+        print(v.format())
+    n = len(report["violations"])
+    pragmas = report.get("pragmas")
+    summary = f"tdlint: {n} violation(s) in {report['files']} file(s)"
+    if pragmas is not None:
+        summary += (f"; {pragmas['total']} pragma(s), "
+                    f"{pragmas['used']} honored")
+        for rel, line, rls in pragmas["stale"]:
+            print(f"{rel}:{line}: [pragma] stale pragma "
+                  f"(suppresses nothing): {','.join(rls)}")
+    print(summary)
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

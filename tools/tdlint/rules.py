@@ -1,0 +1,583 @@
+"""tdlint rules — each encodes one invariant of this control plane.
+
+| rule            | invariant                                                  |
+|-----------------|------------------------------------------------------------|
+| unlocked-state  | scheduler/ledger/MVCC/regulator state is only touched under |
+|                 | its owning lock; cross-object scheduler state goes through  |
+|                 | locked snapshot accessors                                   |
+| intent-lifecycle| every intents.begin() reaches done() on all exits           |
+| unknown-step    | every journaled op/step name is in the reconciler registry  |
+| io-under-lock   | no backend/store I/O while a scheduler/service lock is held |
+| unmapped-xerror | every xerrors class maps to an app code; every code used is |
+|                 | documented in the generated OpenAPI                         |
+| silent-swallow  | no `except Exception` swallows a failure without log/event  |
+
+All checks are lexical (AST). That is deliberately conservative: code that
+needs a lock held by its CALLER (e.g. MVCCStore._apply_put) carries a
+`# tdlint: disable=unlocked-state` pragma on its def line stating the
+contract — the annotation is the documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Iterable, Optional
+
+from . import FileCtx, Violation
+
+# ---------------------------------------------------------------- shared
+
+#: attributes guarded by a lock somewhere in the control plane
+GUARDED_ATTRS = frozenset({
+    # schedulers (base._lock): chip/core/port ownership + share ledger
+    "status", "shares", "cordoned", "used",
+    # store/mvcc.py (_lock / _commit_cond)
+    "_log", "_rev", "_compacted", "_durable_seq", "_flushing",
+    # regulator.py (_cond)
+    "_tenants", "_holder", "_global_vt", "vt", "waiting", "yield_flag",
+    # idempotency.py (_lock)
+    "_claims", "_count", "_replays",
+    # regulator module registry (_LOCK)
+    "_REGULATORS",
+})
+
+#: attributes that ARE locks — `with <x>.<attr>:` marks a guarded region
+LOCK_ATTRS = frozenset({
+    "_lock", "_cond", "_commit_cond", "_guard", "_name_locks_guard",
+    "_dropped_lock", "_stats_lock", "_conns_lock", "_reconcile_lock",
+})
+#: module-level lock names (regulator._LOCK)
+LOCK_NAMES = frozenset({"_LOCK"})
+
+#: cross-object scheduler state: accessing these on anything but `self`
+#: must go through a locked snapshot accessor (owners()/shares_snapshot()/
+#: cordoned_snapshot()) — reading another object's raw dict races its
+#: writers (dict-changed-size mid-iteration, torn multi-key reads)
+XOBJ_ATTRS = frozenset({"status", "shares", "cordoned", "used"})
+
+MUTATING_METHODS = frozenset({
+    "update", "pop", "append", "clear", "setdefault", "add", "remove",
+    "discard", "difference_update", "extend", "insert", "popitem",
+})
+
+
+def _with_locks(node: ast.With) -> bool:
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and e.attr in LOCK_ATTRS:
+            return True
+        if isinstance(e, ast.Name) and e.id in LOCK_NAMES:
+            return True
+    return False
+
+
+def _guarded_target(node: ast.AST) -> Optional[str]:
+    """The guarded attr a store-target mutates, if any: `x.status`,
+    `x.status[i]`, `x.shares[i][o]` ..."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in GUARDED_ATTRS:
+        return node.attr
+    return None
+
+
+def _is_self(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+class Rule:
+    name = ""
+    description = ""
+    #: rel-path predicate; None = every scoped file
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        return ()
+
+    def check_files(self, ctxs: list[FileCtx],
+                    scoped: bool = True) -> list[Violation]:
+        out: list[Violation] = []
+        for ctx in ctxs:
+            if scoped and not self.applies(ctx.rel):
+                continue
+            out.extend(self.check_file(ctx))
+        return out
+
+    def check_repo(self, root: str, ctxs: list[FileCtx]) -> list[Violation]:
+        return self.check_files(ctxs, scoped=True)
+
+
+# ---------------------------------------------------------- unlocked-state
+
+class UnlockedState(Rule):
+    name = "unlocked-state"
+    description = ("guarded state (scheduler bitmaps, share ledger, MVCC "
+                   "internals, regulator queue) mutated outside its lock, "
+                   "or another object's scheduler state accessed raw")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, under: bool, in_init: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def/lambda runs later, outside this lock scope
+                init = node.name == "__init__"
+                for child in node.body:
+                    visit(child, False, init)
+                return
+            if isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, ast.With):
+                under_here = under or _with_locks(node)
+                for item in node.items:
+                    visit(item.context_expr, under, in_init)
+                for child in node.body:
+                    visit(child, under_here, in_init)
+                return
+            if not in_init:
+                self._check_node(ctx, node, under, out)
+            for child in ast.iter_child_nodes(node):
+                visit(child, under, in_init)
+
+        for top in ast.iter_child_nodes(ctx.tree):
+            visit(top, False, False)
+        return out
+
+    def _check_node(self, ctx: FileCtx, node: ast.AST, under: bool,
+                    out: list[Violation]) -> None:
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for t in targets:
+            attr = _guarded_target(t)
+            if attr and not under:
+                out.append(Violation(
+                    ctx.rel, t.lineno, self.name,
+                    f"mutation of guarded state '.{attr}' outside its "
+                    f"owning lock"))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS):
+                attr = _guarded_target(f.value)
+                if attr and not under:
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"mutating call '.{attr}.{f.attr}()' outside the "
+                        f"owning lock"))
+        # cross-object raw access (read OR write): x.tpu.status,
+        # x.ports.used. Deliberately NOT gated on `under`: holding your
+        # OWN lock never makes another object's state safe to read — the
+        # pre-fix health.py probe held the monitor lock while reading
+        # tpu.cordoned raw, exactly this bug class
+        if (isinstance(node, ast.Attribute) and node.attr in XOBJ_ATTRS
+                and not _is_self(node.value)):
+            # plain locals named e.g. `status` aliasing a snapshot are fine;
+            # only attribute chains reaching INTO another object count
+            if isinstance(node.value, ast.Attribute):
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    f"raw access to another object's guarded state "
+                    f"'.{node.attr}' — use a locked snapshot accessor "
+                    f"(owners()/shares_snapshot()/cordoned_snapshot())"))
+
+
+# -------------------------------------------------------- intent-lifecycle
+
+def _is_intents_begin(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "begin"):
+        return False
+    v = f.value
+    if isinstance(v, ast.Attribute):
+        return v.attr in ("intents", "journal")
+    if isinstance(v, ast.Name):
+        return v.id in ("intents", "journal")
+    return False
+
+
+class IntentLifecycle(Rule):
+    name = "intent-lifecycle"
+    description = ("a function that opens an intent (intents.begin) must "
+                   "close it on every exit: done() in an exception handler "
+                   "AND on the success path")
+
+    def applies(self, rel: str) -> bool:
+        return "/services/" in rel or rel.endswith("app.py")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            begins: list[tuple[str, int]] = []
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _is_intents_begin(node.value)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    begins.append((node.targets[0].id, node.lineno))
+            for name, line in begins:
+                in_except, on_success = self._done_paths(fn, name)
+                if not (in_except and on_success):
+                    missing = []
+                    if not in_except:
+                        missing.append("an exception handler")
+                    if not on_success:
+                        missing.append("the success path")
+                    out.append(Violation(
+                        ctx.rel, line, self.name,
+                        f"intent '{name}' opened here has no done() on "
+                        f"{' or '.join(missing)} — a failure would leave "
+                        f"the journal entry open forever"))
+        return out
+
+    @staticmethod
+    def _done_paths(fn: ast.AST, name: str) -> tuple[bool, bool]:
+        in_except = on_success = False
+
+        def visit(node: ast.AST, inside_handler: bool) -> None:
+            nonlocal in_except, on_success
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "done"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == name):
+                    if inside_handler:
+                        in_except = True
+                    else:
+                        on_success = True
+            for child in ast.iter_child_nodes(node):
+                visit(child, inside_handler
+                      or isinstance(node, ast.ExceptHandler))
+
+        visit(fn, False)
+        return in_except, on_success
+
+
+# ------------------------------------------------------------ unknown-step
+
+class UnknownStep(Rule):
+    name = "unknown-step"
+    description = ("every intents.begin() op and intent.step() name must be "
+                   "registered in the reconciler (CONSULTED_STEPS / "
+                   "INFORMATIONAL_STEPS / the _replay_intent handler table) "
+                   "— an unknown one is silently skipped at boot")
+
+    def applies(self, rel: str) -> bool:
+        return ("/services/" in rel or rel.endswith("reconcile.py")
+                or rel.endswith("intents.py"))
+
+    def check_files(self, ctxs: list[FileCtx],
+                    scoped: bool = True) -> list[Violation]:
+        known_steps, known_ops = self._registry(ctxs)
+        if known_steps is None and known_ops is None:
+            return []   # no reconciler in this file set — nothing to check
+        out: list[Violation] = []
+        for ctx in ctxs:
+            if scoped and not self.applies(ctx.rel):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute) or not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                if (f.attr == "step" and isinstance(f.value, ast.Name)
+                        and f.value.id.startswith("intent")
+                        and known_steps is not None
+                        and arg.value not in known_steps):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"step {arg.value!r} is not in the reconciler's "
+                        f"step registry (reconcile.KNOWN_STEPS) — it would "
+                        f"be silently ignored at boot"))
+                if (f.attr == "begin" and _is_intents_begin(node)
+                        and known_ops is not None
+                        and arg.value not in known_ops):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"intent op {arg.value!r} has no handler in the "
+                        f"reconciler's _replay_intent table — a crash "
+                        f"mid-operation would not be replayed"))
+        return out
+
+    @staticmethod
+    def _registry(ctxs: list[FileCtx]):
+        """(known_steps, known_ops) from the reconciler module in `ctxs`:
+        the CONSULTED_STEPS/INFORMATIONAL_STEPS set literals plus the dict
+        keys of the handler table inside _replay_intent."""
+        steps: Optional[set] = None
+        ops: Optional[set] = None
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Name) and t.id in
+                                ("CONSULTED_STEPS", "INFORMATIONAL_STEPS")):
+                            vals = UnknownStep._str_elts(node.value)
+                            if vals is not None:
+                                steps = (steps or set()) | vals
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name == "_replay_intent"):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Dict):
+                            keys = {k.value for k in sub.keys
+                                    if isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)}
+                            if keys:
+                                ops = (ops or set()) | keys
+        return steps, ops
+
+    @staticmethod
+    def _str_elts(node: ast.AST) -> Optional[set]:
+        if isinstance(node, ast.Call) and node.args:   # frozenset({...})
+            node = node.args[0]
+        if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            return {e.value for e in node.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+        return None
+
+
+# ----------------------------------------------------------- io-under-lock
+
+#: store methods that hit the WAL (writes); reads are in-memory and fine
+STORE_WRITE_METHODS = frozenset({
+    "put", "delete", "put_entity_version", "delete_entity_version",
+    "delete_entity_versions", "compact", "maintain",
+})
+STORE_RECEIVERS = frozenset({"client", "_client"})
+
+
+class IoUnderLock(Rule):
+    name = "io-under-lock"
+    description = ("blocking backend/store I/O (backend ops, WAL-backed "
+                   "store writes, sleeps, file opens) inside a `with "
+                   "<lock>:` block — holding a hot lock across I/O "
+                   "serializes every other writer behind the disk/substrate")
+
+    def applies(self, rel: str) -> bool:
+        # the MVCC store IS the I/O layer: its WAL writes under its own
+        # lock are the group-commit design, not a smell
+        if rel.endswith(("store/mvcc.py", "store/native.py")):
+            return False
+        return True
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, under: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                body = node.body if not isinstance(node, ast.Lambda) else []
+                for child in body:
+                    visit(child, False)   # runs later / other thread
+                return
+            if isinstance(node, ast.With):
+                # items acquire left to right: item i's context expr runs
+                # BEFORE its own lock is taken but AFTER items 0..i-1's —
+                # `with open(p) as f, self._lock:` must not flag the open,
+                # `with self._lock, open(p) as f:` must
+                running = under
+                for item in node.items:
+                    visit(item.context_expr, running)
+                    e = item.context_expr
+                    if ((isinstance(e, ast.Attribute)
+                         and e.attr in LOCK_ATTRS)
+                            or (isinstance(e, ast.Name)
+                                and e.id in LOCK_NAMES)):
+                        running = True
+                for child in node.body:
+                    visit(child, running)
+                return
+            if under and isinstance(node, ast.Call):
+                what = self._blocking_call(node)
+                if what:
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"{what} while holding a lock"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, under)
+
+        for top in ast.iter_child_nodes(ctx.tree):
+            visit(top, False)
+        return out
+
+    @staticmethod
+    def _blocking_call(node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            v = f.value
+            if isinstance(v, ast.Attribute) and v.attr == "backend":
+                return f"backend op '.backend.{f.attr}()'"
+            if (isinstance(v, ast.Attribute) and v.attr in STORE_RECEIVERS
+                    and f.attr in STORE_WRITE_METHODS):
+                return f"store write '.{v.attr}.{f.attr}()'"
+            if (isinstance(v, ast.Name) and v.id == "time"
+                    and f.attr == "sleep"):
+                return "time.sleep()"
+            if (isinstance(v, ast.Name) and v.id == "os"
+                    and f.attr in ("fsync", "replace")):
+                return f"os.{f.attr}()"
+        if isinstance(f, ast.Name) and f.id == "open":
+            return "open()"
+        return None
+
+
+# --------------------------------------------------------- unmapped-xerror
+
+class UnmappedXerror(Rule):
+    name = "unmapped-xerror"
+    description = ("every xerrors class must be explicitly caught in the "
+                   "route layer (server/app.py) so it maps to a stable app "
+                   "code; every code used must appear in the generated "
+                   "OpenAPI document")
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith(("xerrors.py", "app.py", "codes.py"))
+
+    def check_files(self, ctxs: list[FileCtx],
+                    scoped: bool = True) -> list[Violation]:
+        xerr = next((c for c in ctxs if c.rel.endswith("xerrors.py")), None)
+        apps = [c for c in ctxs if c.rel.endswith("app.py")]
+        if xerr is None or not apps:
+            return []
+        handled: set[str] = set()
+        for app in apps:
+            for node in ast.walk(app.tree):
+                if isinstance(node, ast.ExceptHandler) and node.type:
+                    for t in ([node.type] if not isinstance(node.type, ast.Tuple)
+                              else list(node.type.elts)):
+                        if isinstance(t, ast.Attribute):
+                            handled.add(t.attr)
+                        elif isinstance(t, ast.Name):
+                            handled.add(t.id)
+        out: list[Violation] = []
+        for node in xerr.tree.body:
+            if not isinstance(node, ast.ClassDef) or not node.bases:
+                continue
+            if node.name == "XError" or not node.name.endswith("Error"):
+                continue
+            if node.name not in handled:
+                out.append(Violation(
+                    xerr.rel, node.lineno, self.name,
+                    f"{node.name} is never caught in the route layer — it "
+                    f"falls into the catch-all and surfaces as a generic "
+                    f"op-failed code"))
+        return out
+
+    def check_repo(self, root: str, ctxs: list[FileCtx]) -> list[Violation]:
+        out = self.check_files(ctxs, scoped=True)
+        codes = next((c for c in ctxs if c.rel.endswith("server/codes.py")),
+                     None)
+        spec_path = os.path.join(root, "api", "openapi.json")
+        if codes is None or not os.path.exists(spec_path):
+            return out
+        try:
+            with open(spec_path, "r", encoding="utf-8") as f:
+                spec_text = json.dumps(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            return out
+        for node in ast.walk(codes.tree):
+            if not isinstance(node, ast.ClassDef) or node.name != "ResCode":
+                continue
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, int)
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    name = stmt.targets[0].id
+                    value = stmt.value.value
+                    if f"{value} {name}" not in spec_text:
+                        out.append(Violation(
+                            codes.rel, stmt.lineno, self.name,
+                            f"app code {value} ({name}) is not documented "
+                            f"in api/openapi.json — regenerate with `make "
+                            f"apidoc`"))
+        return out
+
+
+# ---------------------------------------------------------- silent-swallow
+
+LOGGING_METHODS = frozenset({
+    "exception", "warning", "error", "info", "debug", "critical", "log",
+    "record",   # events.record
+})
+
+
+class SilentSwallow(Rule):
+    name = "silent-swallow"
+    description = ("`except Exception` (or bare except) whose body neither "
+                   "re-raises nor logs nor emits an event — a mutation-path "
+                   "failure disappears without a trace")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not self._body_surfaces(node):
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    "broad except swallows the failure silently — raise, "
+                    "log.exception(), or events.record() it"))
+        return out
+
+    @staticmethod
+    def _is_broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True     # bare except
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        for n in names:
+            if isinstance(n, ast.Name) and n.id == "Exception":
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == "Exception":
+                return True
+        return False
+
+    @staticmethod
+    def _body_surfaces(handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in LOGGING_METHODS:
+                    return True
+        return False
+
+
+# ----------------------------------------------------------------- registry
+
+RULES: list[Rule] = [
+    UnlockedState(),
+    IntentLifecycle(),
+    UnknownStep(),
+    IoUnderLock(),
+    UnmappedXerror(),
+    SilentSwallow(),
+]
+
+
+def all_rules(names: Optional[list[str]] = None) -> list[Rule]:
+    if names is None:
+        return list(RULES)
+    by_name = {r.name: r for r in RULES}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {unknown} "
+                         f"(known: {sorted(by_name)})")
+    return [by_name[n] for n in names]
