@@ -6,8 +6,8 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry verify-migrate verify-mt verify-races bench serve \
-    serve-mock dryrun apidoc lint clean
+    verify-retry verify-migrate verify-mt verify-races verify-obs bench \
+    serve serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -24,6 +24,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-migrate (zero-loss migration sweep: -m migrate)"
 	@echo "  make verify-mt      (fractional multi-tenancy sweep: -m mt)"
 	@echo "  make verify-races   (race stress sweep: -m races)"
+	@echo "  make verify-obs     (observability sweep: -m obs)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -46,6 +47,9 @@ verify-mt:              ## fractional multi-tenancy sweep: share ledger + regula
 
 verify-races:           ## race stress sweep: concurrent mutation mixes + invariant checks
 	$(PY) -m pytest tests/ -q -m races
+
+verify-obs:             ## observability sweep: trace trees over HTTP, Prometheus validity, SSE
+	$(PY) -m pytest tests/ -q -m obs
 
 lint:                   ## compile baseline + tdlint concurrency-invariant rules + rule liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

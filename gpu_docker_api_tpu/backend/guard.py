@@ -44,6 +44,8 @@ from typing import Callable, Optional
 from .. import faults, xerrors
 from ..analysis import lockwatch
 from ..dtos import ContainerSpec
+from ..obs import metrics as obs_metrics
+from ..obs import trace
 from .base import Backend, ContainerState, VolumeState
 
 log = logging.getLogger(__name__)
@@ -274,7 +276,29 @@ class GuardedBackend(Backend):
         # op entry (the deadline worker thread below holds nothing). Fast
         # no-op unless TDAPI_LOCKWATCH armed a watcher.
         lockwatch.note_backend_op(op)
-        trial = self.breaker.admit()
+        with trace.span(f"backend.{op}") as sp:
+            try:
+                trial = self.breaker.admit()
+            except xerrors.BackendUnavailableError as e:
+                # breaker refusal: visible as a span event, not a timed
+                # child — no substrate call happened, so it must not feed
+                # the op-latency histogram either (thousands of ~0ms
+                # rejections during an outage would drag the percentiles
+                # toward zero exactly when they matter)
+                if sp is not None:
+                    sp.event("breaker.rejected", state=self.breaker.state,
+                             retryAfter=round(
+                                 getattr(e, "retry_after", 0.0), 1))
+                raise
+            t0 = time.perf_counter()
+            try:
+                return self._guarded(op, fn, deadline, trial, sp)
+            finally:
+                obs_metrics.BACKEND_OP_LATENCY.observe(
+                    (time.perf_counter() - t0) * 1e3, op=op)
+
+    def _guarded(self, op: str, fn: Callable, deadline: Optional[float],
+                 trial, sp) -> object:
         if deadline is None:
             deadline = self.deadlines.get(op, self.deadline)
         attempt = 0
@@ -300,9 +324,16 @@ class GuardedBackend(Backend):
                     log.debug("backend %s transient (%s) — retry %d/%d "
                               "in %.3fs", op, e, attempt, self.retries,
                               delay)
+                    if sp is not None:
+                        sp.event("retry", attempt=attempt,
+                                 error=type(e).__name__,
+                                 backoffMs=round(delay * 1e3, 1))
                     time.sleep(delay)
                     continue
                 self.breaker.record_failure(trial)
+                if sp is not None:
+                    sp.event("failed", attempts=attempt + 1,
+                             error=type(e).__name__)
                 raise
             except Exception:
                 # semantic error: the substrate answered, just not the

@@ -23,17 +23,27 @@ import json
 import re
 import threading
 import time
+import urllib.parse
 import uuid
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
+
+from .obs.trace import format_traceparent, new_span_id, new_trace_id
 
 
 class ApiError(RuntimeError):
-    """App-level envelope error (code != 200)."""
+    """App-level envelope error (code != 200).
 
-    def __init__(self, code: int, msg: str, op: str):
-        super().__init__(f"{op}: code {code} ({msg})")
+    `trace_id` is the W3C trace id the failed request ran under (from the
+    error envelope when the server traced it, else the id this client
+    generated) — `grep traces.jsonl` or `GET /api/v1/traces/{trace_id}`
+    server-side shows exactly where the mutation failed."""
+
+    def __init__(self, code: int, msg: str, op: str, trace_id: str = ""):
+        tail = f" [trace {trace_id}]" if trace_id else ""
+        super().__init__(f"{op}: code {code} ({msg}){tail}")
         self.code = code
         self.msg = msg
+        self.trace_id = trace_id
 
 
 class SchemaError(ValueError):
@@ -41,9 +51,11 @@ class SchemaError(ValueError):
 
 
 def _resolve(spec: dict, schema: dict) -> dict:
+    """Follow $refs into components — schemas AND parameters (the spec
+    $refs the shared traceparent header param into every operation)."""
     while "$ref" in schema:
-        name = schema["$ref"].rsplit("/", 1)[-1]
-        schema = spec["components"]["schemas"][name]
+        section, name = schema["$ref"].rsplit("/", 2)[-2:]
+        schema = spec["components"][section][name]
     return schema
 
 
@@ -162,7 +174,8 @@ class ApiClient:
                 if not isinstance(op, dict):
                     continue
                 for p in op.get("parameters", []):
-                    if p.get("name") == "Idempotency-Key":
+                    if _resolve(self.spec, p).get("name") == \
+                            "Idempotency-Key":
                         return True
         return False
 
@@ -272,6 +285,12 @@ class ApiClient:
             headers["Authorization"] = f"Bearer {self.api_key}"
         if extra_headers:
             headers.update(extra_headers)
+        # W3C trace context: ONE trace id per logical request (resends
+        # included — they are the same logical operation), so the server's
+        # trace shows the retry history end-to-end
+        if "traceparent" not in headers:
+            headers["traceparent"] = format_traceparent(new_trace_id(),
+                                                        new_span_id())
         while True:
             conn = self._connection()
             reused = self._pool.reused
@@ -328,6 +347,7 @@ class ApiClient:
             extra["Idempotency-Key"] = str(idem_key or uuid.uuid4().hex)
         query = []
         for p in op.get("parameters", []):
+            p = _resolve(self.spec, p)
             if p.get("in") == "header":
                 continue        # documentation-only; sent via `extra`
             val = params.pop(p["name"], None)
@@ -336,6 +356,15 @@ class ApiClient:
                                   f"'{p['name']}'")
             if val is None:
                 continue
+            if p["name"] == "follow":
+                # follow switches the server to an unbounded SSE stream
+                # (presence-based, like every flag param): the generic
+                # request/response path would read it forever and pin the
+                # pooled keep-alive connection — streaming has a
+                # dedicated generator
+                raise SchemaError(
+                    f"{op_id}: 'follow' streams Server-Sent Events; use "
+                    f"follow_events() instead")
             validate(self.spec, p.get("schema", {}), val,
                      f"${{{p['name']}}}")
             if p["in"] == "path":
@@ -368,13 +397,116 @@ class ApiClient:
         # auto-retry requires SERVER-side dedup: an explicit key is still
         # sent (caller's choice), but against a daemon whose spec doesn't
         # advertise the header a resend would double-apply — never retry
+        tid = new_trace_id()
+        extra["traceparent"] = format_traceparent(tid, new_span_id())
         raw = self._raw(method, path, payload, extra_headers=extra,
                         idempotent=(self.idempotency
                                     and bool(extra.get("Idempotency-Key"))))
         ok = op["responses"].get("200", {})
         if "application/json" not in ok.get("content", {}):
             return raw                       # /metrics, /openapi.json
+        return self._envelope(raw, op_id, fallback_tid=tid).get("data")
+
+    @staticmethod
+    def _envelope(raw, op_id: str, fallback_tid: str = "") -> dict:
+        """Parse a `{code, msg, data}` envelope; app errors raise ApiError
+        carrying the server's traceId (or the request's own trace id when
+        the envelope predates tracing)."""
         out = json.loads(raw)
         if out.get("code") != 200:
-            raise ApiError(out.get("code", -1), out.get("msg", ""), op_id)
-        return out.get("data")
+            raise ApiError(out.get("code", -1), out.get("msg", ""), op_id,
+                           trace_id=out.get("traceId") or fallback_tid)
+        return out
+
+    # ---- observability helpers (obs subsystem) ----
+
+    def traces(self, trace_id: Optional[str] = None, op: str = "",
+               min_duration_ms: float = 0.0, limit: int = 100):
+        """Server-side trace store: summaries (slowest first, optionally
+        filtered by root-op substring / duration floor), or — with
+        `trace_id` — one full trace with its assembled span tree. Pass an
+        ApiError's `.trace_id` to see exactly where that call's time (or
+        failure) went."""
+        if trace_id:
+            path = f"/api/v1/traces/{urllib.parse.quote(trace_id, safe='')}"
+        else:
+            q = {"limit": int(limit)}
+            if op:
+                # root ops contain spaces ('POST /api/v1/...') — encode
+                q["op"] = op
+            if min_duration_ms:
+                q["minDurationMs"] = min_duration_ms
+            path = "/api/v1/traces?" + urllib.parse.urlencode(q)
+        out = self._envelope(self._raw("GET", path), "traces")
+        data = out.get("data") or {}
+        return data.get("trace") if trace_id else data.get("traces")
+
+    def follow_events(self, target: str = "",
+                      last_event_id: Optional[int] = None,
+                      heartbeat: Optional[float] = None,
+                      yield_heartbeats: bool = False) -> Iterator[dict]:
+        """Generator over `GET /api/v1/events?follow=1` (Server-Sent
+        Events): yields event dicts as the daemon records them — subscribe
+        instead of polling. Runs on a DEDICATED connection (the stream
+        holds it open indefinitely; the keep-alive pool must stay usable
+        for request/response calls). Resume after a disconnect by passing
+        the last seen event's `seq` as `last_event_id`. Closing the
+        generator closes the connection; heartbeat comment frames are
+        skipped unless `yield_heartbeats` (then `{"heartbeat": True}`)."""
+        # the stream idles legitimately between heartbeats, so the
+        # request/response timeout would tear down a healthy connection
+        # whenever it undercuts the heartbeat cadence (server default
+        # 15s); two missed heartbeats still surface a dead server
+        hb = heartbeat if heartbeat is not None else 15.0
+        if not 0.0 <= hb <= 3600.0:   # mirror the server clamp; inf/nan
+            hb = 3600.0               # values are refused server-side
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=max(self.timeout, 2.0 * hb + 10.0))
+        path = "/api/v1/events?follow=1"
+        if target:
+            path += "&" + urllib.parse.urlencode({"target": target})
+        if heartbeat is not None:
+            path += f"&heartbeat={heartbeat}"
+        headers: dict[str, str] = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        if last_event_id is not None:
+            headers["Last-Event-ID"] = str(last_event_id)
+        try:
+            conn.request("GET", path, None, headers)
+            resp = conn.getresponse()
+            ct = resp.getheader("Content-Type") or ""
+            if resp.status != 200 or "text/event-stream" not in ct:
+                # refusals (auth, bad params) come back as HTTP 200 with a
+                # JSON error envelope, not an event stream — surface them
+                # instead of yielding a silent empty stream
+                body = resp.read(65536)
+                try:
+                    self._envelope(body, "follow_events")
+                except ApiError:
+                    raise
+                except Exception:  # noqa: BLE001 — a non-JSON refusal body
+                    pass
+                raise ApiError(resp.status, "event stream refused",
+                               "follow_events")
+            data_lines: list[str] = []
+            while True:
+                raw = resp.readline()
+                if not raw:          # server closed (drain/shutdown)
+                    return
+                line = raw.decode("utf-8").rstrip("\r\n")
+                if not line:         # frame boundary
+                    if data_lines:
+                        yield json.loads("\n".join(data_lines))
+                        data_lines = []
+                    continue
+                if line.startswith(":"):
+                    if yield_heartbeats:
+                        yield {"heartbeat": True}
+                elif line.startswith("data:"):
+                    data_lines.append(line[5:].strip())
+                # id:/retry: fields ride inside the data JSON (seq) — no
+                # separate bookkeeping needed here
+        finally:
+            conn.close()

@@ -1,21 +1,32 @@
 """Operation event log — the observability the reference lacks.
 
-SURVEY §5.1: the reference has no tracing; its only observability is leveled
-logs. The north-star metric (replicaSet cold-start -> first XLA step) needs
-timestamped per-operation events. Every API request is recorded with its
-request id, app code, and latency; events land in a bounded in-memory ring
-(served at GET /api/v1/events) and append to events.jsonl in the state dir
-for offline analysis.
+SURVEY §5.1: the reference has no tracing; its only observability is
+leveled logs. Every API request and internal state transition is recorded
+as one event with its request id, app code, latency — and, since the obs
+subsystem, the TRACE id of whatever request caused it, so an
+/api/v1/events row links straight to its span tree at
+/api/v1/traces/{traceId}.
+
+Events land in a bounded in-memory ring (served at GET /api/v1/events)
+and append to events.jsonl in the state dir for offline analysis; the
+file is size-rotated (current + one predecessor, TDAPI_EVENTS_MAX_MB —
+obs/rotate.py), so a long-lived daemon's telemetry can't fill the state
+volume. Each event carries a monotonically increasing `seq`, which is
+the SSE event id: `GET /api/v1/events?follow=1` streams the ring from a
+`Last-Event-ID` resume point, and `wait_since()` is the condition-variable
+primitive that stream rides on.
 """
 
 from __future__ import annotations
 
 import collections
 import json
-import os
 import threading
 import time
 from typing import Optional
+
+from .obs import trace
+from .obs.rotate import RotatingWriter
 
 
 class EventLog:
@@ -30,13 +41,15 @@ class EventLog:
 
     def __init__(self, state_dir: Optional[str] = None, capacity: int = 2048):
         self._lock = threading.Lock()
+        # SSE followers park on this until a record() moves _seq past
+        # their resume point
+        self._cond = threading.Condition(self._lock)
         self._ring: collections.deque = collections.deque(maxlen=capacity)
-        self._f = None
+        self._w: Optional[RotatingWriter] = None
         self._last_flush = 0.0
+        self._seq = 0
         if state_dir:
-            os.makedirs(state_dir, exist_ok=True)
-            self._f = open(os.path.join(state_dir, "events.jsonl"), "a",
-                           encoding="utf-8")
+            self._w = RotatingWriter(f"{state_dir}/events.jsonl")
 
     def record(self, op: str, target: str = "", code: int = 200,
                duration_ms: float = 0.0, request_id: str = "",
@@ -49,29 +62,83 @@ class EventLog:
             "durationMs": round(duration_ms, 2),
             "requestId": request_id,
         }
+        # causal link: any event recorded while a traced request is on
+        # this thread inherits its trace id (explicit traceId= wins)
+        tid = trace.current_trace_id()
+        if tid:
+            evt["traceId"] = tid
         if extra:
             evt.update(extra)
-        with self._lock:
+        with self._cond:
+            self._seq += 1
+            evt["seq"] = self._seq
             self._ring.append(evt)
-            if self._f is not None:
-                self._f.write(json.dumps(evt) + "\n")
+            if self._w is not None:
+                self._w.write(json.dumps(evt) + "\n")
                 now = time.monotonic()
                 if now - self._last_flush >= self.FLUSH_INTERVAL_S:
-                    self._f.flush()
+                    self._w.flush()
                     self._last_flush = now
+            self._cond.notify_all()
 
     def recent(self, limit: int = 200, target: str = "") -> list[dict]:
         with self._lock:
             evts = list(self._ring)
-            if self._f is not None:     # reads drain the offline buffer
-                self._f.flush()
+            if self._w is not None:     # reads drain the offline buffer
+                self._w.flush()
                 self._last_flush = time.monotonic()
         if target:
             evts = [e for e in evts if e.get("target") == target]
         return evts[-limit:]
 
+    # ---- follow/streaming surface (SSE; server/app.py h_events) ----
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def _newer_than(self, seq: int) -> list[dict]:
+        """Ring events with seq > `seq`, oldest first. Caller holds the
+        lock. The ring is seq-ordered, so walk it backwards and stop at
+        the resume point — a follower that is 1 event behind pays O(1),
+        not O(capacity) (the scan runs under the same lock record()
+        needs, so this is the hot path's contention)."""
+        out: list[dict] = []
+        for e in reversed(self._ring):
+            if e["seq"] <= seq:
+                break
+            out.append(e)
+        out.reverse()
+        return out
+
+    def since(self, seq: int, limit: int = 0) -> list[dict]:
+        """Ring events with seq > `seq`, oldest first — the Last-Event-ID
+        resume read. A resume point older than the ring's tail simply
+        yields everything retained (the gap is visible as a seq jump)."""
+        with self._lock:
+            out = self._newer_than(seq)
+        return out[:limit] if limit else out
+
+    def wait_since(self, seq: int, timeout: float) -> list[dict]:
+        """Block until events newer than `seq` exist (or timeout, or a
+        wake_all(); then []). One condition-variable park per idle
+        follower — a thousand SSE clients cost no polling. A wake with
+        nothing new returns [] early so the caller re-checks its own exit
+        condition (the SSE generator re-reads the server's drain flag)."""
+        with self._cond:
+            if self._seq <= seq and timeout > 0:
+                self._cond.wait(timeout)
+            return self._newer_than(seq)
+
+    def wake_all(self) -> None:
+        """Wake every parked wait_since() (server drain: followers must
+        notice their severed sockets NOW, not at the next heartbeat)."""
+        with self._cond:
+            self._cond.notify_all()
+
     def close(self) -> None:
         with self._lock:
-            if self._f is not None:
-                self._f.close()
-                self._f = None
+            if self._w is not None:
+                self._w.close()
+                self._w = None

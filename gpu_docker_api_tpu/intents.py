@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .idempotency import active_key
+from .obs import trace
 from .store.client import StateClient
 
 INTENTS = "intents"
@@ -85,6 +86,10 @@ class Intent:
         self._journal = journal
         self.record = record
         self.closed = False
+        # non-lexical trace span spanning begin->done: step markers become
+        # span events, so a trace shows WHERE inside the mutation the time
+        # went. None when no request trace is active (bare service tests).
+        self._span = trace.start(f"intent.{record.op}", target=record.target)
 
     def step(self, name: str, sync: bool = True, **meta) -> None:
         """Record "step `name` is complete".
@@ -105,6 +110,8 @@ class Intent:
         entry = {"name": name, "at": round(time.time(), 4)}
         entry.update(meta)
         self.record.steps.append(entry)
+        if self._span is not None:
+            self._span.event(name, sync=sync)
         if sync:
             self._journal._write(self.record)
 
@@ -125,6 +132,10 @@ class Intent:
             if key and cache is not None:
                 cache.mark_executed(key)
         self._journal._clear(self.record)
+        # outcome stays "ok" — an unwound mutation's FAILURE is recorded
+        # by the enclosing service span's exception; the intent span only
+        # times the journaled window. committed is still visible:
+        trace.finish(self._span, status="committed" if committed else "ok")
 
 
 class IntentJournal:
@@ -146,6 +157,13 @@ class IntentJournal:
         key = active_key()
         if key:
             meta.setdefault("idemKey", key)
+        # ... and the request's trace identity: a crash mid-mutation hands
+        # the reconciler these ids, so its replay spans land on the
+        # ORIGINAL request's trace (obs/trace.py resume_trace)
+        trace_id, span_id = trace.current_ids()
+        if trace_id:
+            meta.setdefault("traceId", trace_id)
+            meta.setdefault("spanId", span_id)
         rec = IntentRecord(op=op, target=target, kind=kind,
                            begun_at=round(time.time(), 4), meta=meta)
         self._write(rec)

@@ -1,0 +1,33 @@
+"""obs — end-to-end observability: tracing, metrics registry, streaming.
+
+SURVEY §5.1: the reference's only observability is leveled logs. PRs 1-8
+added the EventLog (counts + one latency number per request) and a
+hand-assembled /metrics string; at production scale (ROADMAP items 3-4)
+that is not enough — a slow `PATCH /containers/{name}/tpu` is a single
+`durationMs` with no way to tell whether the time went to the scheduler
+grant, the WAL fsync, the CoW copy, or a GuardedBackend retry. Gavel
+(arxiv 2008.09213) and Tally (2410.07381) both drive placement and
+sharing decisions off per-stage timing profiles — exactly what this
+subsystem records.
+
+Three legs:
+
+- **trace.py** — W3C-`traceparent`-aware causal tracing: a root span is
+  opened at HTTP ingress and propagated via contextvars through the
+  service layer, intent journal, GuardedBackend, schedulers, store,
+  workqueue drainer, and copyfast. Finished traces land in a bounded
+  in-memory ring (keep-slowest retention) + traces.jsonl, served at
+  GET /api/v1/traces[/{traceId}].
+- **metrics.py** — thread-safe instrument registry (Counter, Gauge,
+  labeled variants, Histogram with fixed buckets + _sum/_count) that
+  renders valid Prometheus text exposition; replaces the hand-assembled
+  /metrics string while keeping every pre-existing tdapi_* series name.
+- **names.py** — the catalog of event op strings and metric family
+  names. tdlint's `untraced-op` rule checks every `events.record(...)`
+  literal and every instrument name against it, so ad-hoc telemetry
+  literals fail the build instead of silently fragmenting dashboards.
+"""
+
+from . import metrics, names, trace  # noqa: F401 — re-export the legs
+
+__all__ = ["metrics", "names", "trace"]

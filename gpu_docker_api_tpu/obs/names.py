@@ -1,0 +1,113 @@
+"""Telemetry name catalog — the single source of truth for event op
+strings and Prometheus metric family names.
+
+Every `events.record("<op>", ...)` literal in the control plane and every
+instrument name handed to the metrics registry must appear below; tdlint's
+`untraced-op` rule (tools/tdlint/rules.py) parses THIS module's set
+literals and fails the build on an ad-hoc literal. That is what keeps a
+dashboard's `sum(rate(tdapi_...))` and an operator's
+`grep '"op": "replace.copied"'` stable across refactors: telemetry names
+are API, and APIs live in a registry, not scattered string literals.
+
+Two deliberate gaps the lexical rule cannot close (documented here so the
+next reader doesn't re-derive them):
+
+- HTTP request events use the computed op `f"{method} {path}"`
+  (server/http.py) — one name per route would be unbounded; the rule
+  skips non-literal ops by design.
+- breaker transition events are `f"breaker.{state}"` (backend/guard.py);
+  all three expansions are registered below so consumers can still rely
+  on the catalog.
+"""
+
+from __future__ import annotations
+
+#: every event-log op string the control plane records (events.record's
+#: first argument). Grep anchor: docs/observability.md catalogs these.
+EVENT_OPS = frozenset({
+    # admission / exactly-once middleware (server/app.py)
+    "admission.shed",
+    "idempotency.replay",
+    # chip lifecycle + health (server/app.py, health.py)
+    "tpu.cordon",
+    "tpu.uncordon",
+    "health.cordon",
+    # rolling replace data movement (services/replicaset.py)
+    "replace.copied",
+    # boot/runtime reconciler (reconcile.py)
+    "reconcile",
+    "reconcile.unknown_op",
+    "reconcile.unknown_step",
+    # substrate guard (backend/guard.py: f"breaker.{state}" expansions)
+    "breaker.closed",
+    "breaker.half_open",
+    "breaker.open",
+    # substrate tooling (backend/process.py)
+    "backend.tool_timeout",
+    "backend.stop_killed",
+    # write-behind persistence (workqueue.py)
+    "workqueue.drop",
+    # co-tenancy regulator (regulator.py)
+    "regulator.preempt",
+})
+
+#: every Prometheus metric family name the /metrics exposition may emit.
+#: Histograms register their FAMILY name (the _bucket/_sum/_count suffixes
+#: are the render's job, not the catalog's).
+METRIC_NAMES = frozenset({
+    # resource inventories (server/app.py collect callback)
+    "tdapi_tpu_chips",
+    "tdapi_cpu_cores",
+    "tdapi_ports",
+    "tdapi_replicasets",
+    "tdapi_volumes",
+    # write-behind queue
+    "tdapi_workqueue_pending",
+    "tdapi_workqueue_dropped",
+    "tdapi_workqueue_coalesced",
+    # reconciler / store
+    "tdapi_reconcile_actions",
+    "tdapi_store_wal_records",
+    "tdapi_store_wal_flushes",
+    "tdapi_store_wal_flushed_records",
+    "tdapi_store_wal_flush_batch_max",
+    # health / substrate
+    "tdapi_chip_health_failures",
+    "tdapi_backend_stop_kills",
+    "tdapi_breaker_state",
+    "tdapi_breaker_consecutive_failures",
+    # replace fast path (utils/copyfast.py METRICS)
+    "tdapi_replace_copy_bytes",
+    "tdapi_replace_copy_seconds",
+    "tdapi_replace_copy_mode",
+    "tdapi_replace_downtime_ms",
+    "tdapi_copy_delta_files",
+    # fractional multi-tenancy
+    "tdapi_tpu_shares_allocated",
+    "tdapi_tpu_shares_allocated_total",
+    "tdapi_tpu_shares_allocatable",
+    "tdapi_tpu_shares_utilization",
+    "tdapi_regulator_queue_depth",
+    "tdapi_regulator_preemptions_total",
+    "tdapi_regulator_chunks_total",
+    "tdapi_regulator_tenants",
+    # admission gate + idempotency cache
+    "tdapi_mutations_inflight",
+    "tdapi_mutations_waiting",
+    "tdapi_mutations_admitted_total",
+    "tdapi_mutations_shed_total",
+    "tdapi_idempotency_records",
+    "tdapi_idempotency_replays_total",
+    # latency distributions (obs/metrics.py module instruments)
+    "tdapi_http_request_duration_ms",
+    "tdapi_backend_op_duration_ms",
+    "tdapi_sched_grant_duration_ms",
+    "tdapi_wal_flush_duration_ms",
+    "tdapi_store_put_duration_ms",
+    "tdapi_replace_downtime_window_ms",
+    "tdapi_regulator_chunk_duration_ms",
+    # tracing + streaming self-observation
+    "tdapi_traces_retained",
+    "tdapi_trace_spans_total",
+    "tdapi_events_stream_clients",
+})

@@ -54,6 +54,7 @@ from typing import Optional
 from .backend.base import copy_container_layer
 from .dtos import StoredContainerInfo, StoredVolumeInfo
 from .intents import IntentRecord
+from .obs import trace as obs_trace
 from .utils.copyfast import move_dir_contents
 
 log = logging.getLogger(__name__)
@@ -91,7 +92,7 @@ class Reconciler:
     def __init__(self, backend, client, wq, tpu, cpu, ports,
                  container_versions, volume_versions, merges, intents,
                  events=None, replicasets=None, volumes=None,
-                 idempotency=None):
+                 idempotency=None, traces=None):
         self.backend = backend
         self.client = client
         self.wq = wq
@@ -106,6 +107,7 @@ class Reconciler:
         self.replicasets = replicasets   # for cache invalidation only
         self.volumes = volumes
         self.idempotency = idempotency   # keyed-mutation result cache
+        self.traces = traces             # crash-stitched replay spans
 
     # ------------------------------------------------------------- entry
 
@@ -137,7 +139,16 @@ class Reconciler:
             ops_before = len(report["opsCompleted"])
             replay_ok = True
             try:
-                self._replay_intent(rec, report)
+                # crash stitching: the intent record carries the ORIGINAL
+                # request's (traceId, spanId) — the replay's spans (backend
+                # ops, store writes, layer copies) join that trace, so
+                # GET /api/v1/traces/{traceId} after a crash shows ingress
+                # -> mutation -> crash -> recovery as ONE causal tree
+                with obs_trace.resume_trace(
+                        self.traces, rec.meta.get("traceId", ""),
+                        rec.meta.get("spanId", ""),
+                        f"reconcile.{rec.op}", target=rec.target):
+                    self._replay_intent(rec, report)
             except Exception:  # noqa: BLE001 — one bad intent must not
                 log.exception("replaying intent %s:%s", rec.kind, rec.target)
                 replay_ok = False

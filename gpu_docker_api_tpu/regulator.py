@@ -52,6 +52,8 @@ import threading
 import time
 from typing import Optional
 
+from .obs import metrics as obs_metrics
+
 LATENCY = "latency"
 BEST_EFFORT = "best_effort"
 #: accepted spec values ("" defaults to best-effort)
@@ -219,6 +221,10 @@ class ChipRegulator:
             self.busy_seconds += dt
             self._holder = None
             self._cond.notify_all()
+        # outside the condition: one histogram update per device chunk —
+        # the distribution IS the preemption stall bound (a latency
+        # tenant waits at most one chunk of the holder)
+        obs_metrics.REGULATOR_CHUNK.observe(dt * 1e3)
 
     def contended_for(self, tenant: Tenant) -> bool:
         with self._cond:

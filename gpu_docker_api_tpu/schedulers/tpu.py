@@ -20,10 +20,14 @@ semantics reference.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Optional
 
 from .. import xerrors
+from ..obs import metrics as obs_metrics
+from ..obs import trace
 from ..store.client import StateClient
 from ..topology import TpuTopology, chips_per_host_for, discover_topology
 from ..workqueue import WorkQueue
@@ -125,6 +129,19 @@ class TpuScheduler(Scheduler):
 
     # ---- allocation ----
 
+    @contextlib.contextmanager
+    def _granting(self, kind: str):
+        """Hold the scheduler lock for a grant, observing the grant
+        latency AFTER the lock releases — the histogram's own lock and
+        bucket scan must not lengthen the hottest serialized section
+        (every concurrent mutation queues on self._lock). Failed grants
+        (no placement) propagate without an observation, as before."""
+        t0 = time.perf_counter()
+        with self._lock:
+            yield
+        obs_metrics.GRANT_LATENCY.observe(
+            (time.perf_counter() - t0) * 1e3, kind=kind)
+
     def apply(self, n: int, owner: str = "",
               reuse: Optional[list[int]] = None) -> list[int]:
         """Grant n chips as an ICI-contiguous set; returns chip indices.
@@ -139,7 +156,8 @@ class TpuScheduler(Scheduler):
         """
         if n <= 0:
             return []
-        with self._lock:
+        with trace.span("sched.tpu.apply", target=owner, n=n) as sp, \
+                self._granting("tpu"):
             # cordoned chips are invisible to placement — not free, and not
             # reusable either: the whole point of a drain's re-grant is to
             # move the workload OFF them
@@ -169,6 +187,8 @@ class TpuScheduler(Scheduler):
             for i in grant:
                 self.status[i] = owner
             self._persist()
+            if sp is not None:
+                sp.set(chips=sorted(grant))
             return sorted(grant)
 
     def restore(self, grant: list[int], owner: Optional[str] = None) -> None:
@@ -178,7 +198,8 @@ class TpuScheduler(Scheduler):
         can, SURVEY §2 bug 3). owner=None is the administrative force-free."""
         if not grant:
             return
-        with self._lock:
+        with trace.span("sched.tpu.restore", target=owner or "",
+                        chips=list(grant)), self._lock:
             for i in grant:
                 if i in self.status and (owner is None or self.status[i] == owner):
                     self.status[i] = FREE
@@ -213,7 +234,8 @@ class TpuScheduler(Scheduler):
         if not 0 < quanta < SHARE_QUANTA:
             raise ValueError(f"share quanta must be 1..{SHARE_QUANTA - 1}, "
                              f"got {quanta}")
-        with self._lock:
+        with trace.span("sched.tpu.apply_shares", target=owner,
+                        quanta=quanta) as sp, self._granting("tpu_shares"):
             cands = [i for i, s in self.status.items()
                      if s is FREE and i not in self.cordoned
                      and self._shares_used(i) + quanta <= SHARE_QUANTA]
@@ -230,6 +252,8 @@ class TpuScheduler(Scheduler):
             owners = self.shares.setdefault(chip, {})
             owners[owner] = owners.get(owner, 0) + quanta
             self._persist()
+            if sp is not None:
+                sp.set(chip=chip)
             return chip
 
     def restore_shares(self, chip: int, quanta: int, owner: str) -> int:
@@ -238,7 +262,8 @@ class TpuScheduler(Scheduler):
         duplicated release can never free a co-tenant's shares (the same
         double-free class restore() guards for whole chips). Returns the
         quanta actually freed."""
-        with self._lock:
+        with trace.span("sched.tpu.restore_shares", target=owner,
+                        chip=chip, quanta=quanta), self._lock:
             owners = self.shares.get(chip)
             if not owners or owner not in owners:
                 return 0

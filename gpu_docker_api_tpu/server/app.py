@@ -13,7 +13,9 @@ store -> workqueue -> schedulers -> version maps -> backend -> services.
 
 from __future__ import annotations
 
+import json
 import logging
+import math
 import os
 import threading
 import time
@@ -30,6 +32,9 @@ from ..events import EventLog
 from ..health import HealthMonitor
 from ..idempotency import IdempotencyCache
 from ..intents import IntentJournal
+from ..obs import metrics as obs_metrics
+from ..obs.metrics import Registry
+from ..obs.trace import TraceCollector
 from ..reconcile import Reconciler
 from .. import regulator
 from ..schedulers import (
@@ -46,8 +51,8 @@ from ..version import (
 from ..workqueue import WorkQueue
 from .codes import ResCode
 from .http import (
-    ApiServer, RawResponse, Request, Response, Router, err, ok,
-    precondition_failed, too_many, unavailable,
+    ApiServer, RawResponse, Request, Response, Router, StreamingResponse,
+    err, ok, precondition_failed, too_many, unavailable,
 )
 
 log = logging.getLogger(__name__)
@@ -252,6 +257,9 @@ class App:
         # --- reference Init order: docker -> etcd -> workQueue -> schedulers
         #     -> version maps (main.go:53-97) ---
         self.events = EventLog(state_dir)
+        # span sink: mutations traced end-to-end land here (bounded ring,
+        # keep-slowest retention, traces.jsonl) — GET /api/v1/traces
+        self.traces = TraceCollector(state_dir)
         self.store = open_store(wal_path=os.path.join(state_dir, "state.wal"),
                                 engine=store_engine)
         self.client = StateClient(self.store)
@@ -325,15 +333,20 @@ class App:
             self.ports, self.container_versions, self.volume_versions,
             self.merges, self.intents, events=self.events,
             replicasets=self.replicasets, volumes=self.volumes,
-            idempotency=self.idempotency)
+            idempotency=self.idempotency, traces=self.traces)
         self._reconcile_lock = threading.Lock()
         self.last_reconcile = self.reconciler.run()
         # per-chip concurrency regulators (fractional co-tenancy): route
         # their preempt events onto this App's event log and export their
         # counters at /metrics
         regulator.set_events(self.events)
+        # SSE follower count (tdapi_events_stream_clients) — mutated from
+        # stream generator threads under this lock
+        self._stream_lock = threading.Lock()
+        self._stream_clients = 0
+        self.metrics = self._build_registry()
         self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
-                                events=self.events)
+                                events=self.events, traces=self.traces)
 
     # ------------------------------------------------------------- routes
 
@@ -360,6 +373,8 @@ class App:
         r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
         r.add("GET", f"{v1}/events", self.h_events)
+        r.add("GET", f"{v1}/traces", self.h_traces)
+        r.add("GET", f"{v1}/traces/:traceId", self.h_trace)
         r.add("GET", f"{v1}/reconcile", self.h_reconcile)
         r.add("GET", f"{v1}/healthz", self.h_healthz)
         r.add("POST", f"{v1}/tpus/:id/cordon", self.h_cordon)
@@ -756,7 +771,100 @@ class App:
         if limit < 0:
             return err(ResCode.InvalidParams)
         target = req.query.get("target", [""])[0]
+        if req.query_flag("follow"):
+            return self._follow_events(req, target)
         return ok({"events": self.events.recent(limit=limit, target=target)})
+
+    #: SSE heartbeat cadence (seconds) — a comment frame per idle interval
+    #: keeps middleboxes from reaping the socket and tells the client the
+    #: stream is alive; ?heartbeat= overrides per request (tests), floor
+    #: 50ms so a typo can't busy-spin a connection thread
+    SSE_HEARTBEAT_S = 15.0
+
+    def _follow_events(self, req: Request, target: str) -> Response:
+        """`GET /api/v1/events?follow=1` — Server-Sent Events.
+
+        Subscribe instead of polling (the seed of ROADMAP item 3's watch
+        API): each event goes out as `id: <seq>` + `data: <json>`; a
+        reconnecting client sends `Last-Event-ID` (header, or the
+        lastEventId query param) and resumes from the ring — a resume
+        point older than the ring's tail yields what is retained, the gap
+        visible as a seq jump. Heartbeat comments mark idle intervals."""
+        try:
+            hb = float(req.query.get(
+                "heartbeat", [str(self.SSE_HEARTBEAT_S)])[0])
+        except ValueError:
+            return err(ResCode.InvalidParams)
+        if not math.isfinite(hb):
+            # inf/nan parse as floats but overflow Condition.wait's C
+            # timestamp — reject, don't crash the stream thread
+            return err(ResCode.InvalidParams)
+        hb = min(max(0.05, hb), 3600.0)
+        last_id = req.header("Last-Event-ID") or \
+            req.query.get("lastEventId", [""])[0]
+        try:
+            # no resume point -> only NEW events (subscribe-from-now)
+            since = int(last_id) if str(last_id).strip() else \
+                self.events.last_seq
+        except ValueError:
+            return err(ResCode.InvalidParams)
+
+        def gen(since: int):
+            with self._stream_lock:
+                self._stream_clients += 1
+            try:
+                yield b"retry: 2000\n\n"
+                last_sent = time.monotonic()
+                while not self.server._draining:
+                    evts = self.events.wait_since(since, timeout=hb)
+                    out = []
+                    for e in evts:
+                        since = e["seq"]
+                        # never echo this stream's OWN request event back
+                        # to its follower (it lands in the ring after the
+                        # subscribe point was captured)
+                        if e.get("requestId") == req.request_id:
+                            continue
+                        if target and e.get("target") != target:
+                            continue
+                        out.append(f"id: {e['seq']}\ndata: "
+                                   f"{json.dumps(e)}\n\n".encode())
+                    if out:
+                        yield b"".join(out)
+                        last_sent = time.monotonic()
+                    elif time.monotonic() - last_sent >= hb:
+                        # heartbeat on WRITE idleness, not event idleness:
+                        # a busy daemon whose events all filter out must
+                        # still keep the socket visibly alive
+                        yield b": heartbeat\n\n"
+                        last_sent = time.monotonic()
+            finally:
+                with self._stream_lock:
+                    self._stream_clients -= 1
+
+        return StreamingResponse(gen(since))
+
+    def h_traces(self, req: Request) -> Response:
+        """Finished-trace summaries, slowest first; ?op= substring-matches
+        the root op, ?minDurationMs= floors the duration."""
+        op = req.query.get("op", [""])[0]
+        try:
+            min_ms = float(req.query.get("minDurationMs", ["0"])[0])
+            limit = int(req.query.get("limit", ["100"])[0])
+        except ValueError:
+            return err(ResCode.InvalidParams)
+        return ok({"traces": self.traces.list(op=op, min_duration_ms=min_ms,
+                                              limit=limit),
+                   "stats": self.traces.stats()})
+
+    def h_trace(self, req: Request) -> Response:
+        """One full trace: flat span list + assembled span tree."""
+        t = self.traces.get(req.params["traceId"])
+        if t is None:
+            return err(ResCode.InvalidParams,
+                       f"unknown traceId {req.params['traceId']!r} "
+                       f"(evicted, or never seen)")
+        return ok({"trace": t})
 
     def h_reconcile(self, req: Request) -> Response:
         """Admin view of crash recovery: the boot-time reconcile report;
@@ -831,157 +939,178 @@ class App:
             log.exception("drain failed [%s]", req.request_id)
             return err(ResCode.ServerBusy)
 
+    def _build_registry(self) -> Registry:
+        """App-local metrics registry: every inventory/queue/gate series
+        whose truth lives on THIS App's components, refreshed by one
+        collect callback at scrape time. Module-global instruments (the
+        latency histograms fed by guard/store/schedulers/regulator) live
+        in obs_metrics.REGISTRY and render after these. Series names are
+        unchanged from the pre-registry hand-assembled exposition — and
+        registered in obs/names.py (tdlint untraced-op)."""
+        m = Registry()
+        g_chips = m.gauge("tdapi_tpu_chips", labels=("state",))
+        g_cores = m.gauge("tdapi_cpu_cores", labels=("state",))
+        g_ports = m.gauge("tdapi_ports", labels=("state",))
+        g_rs = m.gauge("tdapi_replicasets")
+        g_vols = m.gauge("tdapi_volumes")
+        g_wq_pend = m.gauge("tdapi_workqueue_pending")
+        g_wq_drop = m.gauge("tdapi_workqueue_dropped")
+        g_wq_coal = m.gauge(
+            "tdapi_workqueue_coalesced",
+            "puts superseded by a newer same-key put before hitting the "
+            "store", typ="counter")
+        g_rec = m.gauge("tdapi_reconcile_actions")
+        g_wal_rec = m.gauge("tdapi_store_wal_records")
+        g_wal_fl = m.gauge(
+            "tdapi_store_wal_flushes",
+            "flushed_records / flushes = avg group-commit batch size",
+            typ="counter")
+        g_wal_flr = m.gauge("tdapi_store_wal_flushed_records", typ="counter")
+        g_wal_max = m.gauge("tdapi_store_wal_flush_batch_max")
+        g_health = m.gauge("tdapi_chip_health_failures")
+        g_kills = m.gauge(
+            "tdapi_backend_stop_kills",
+            "stop() escalations: workload ignored SIGTERM for the whole "
+            "stop timeout and ate a SIGKILL", typ="counter")
+        # rolling-replace data movement (utils/copyfast.py)
+        g_cp_bytes = m.gauge("tdapi_replace_copy_bytes", typ="counter")
+        g_cp_secs = m.gauge("tdapi_replace_copy_seconds", typ="counter")
+        g_cp_mode = m.gauge(
+            "tdapi_replace_copy_mode",
+            "layer copies per resolved copy-ladder rung",
+            labels=("mode",), typ="counter")
+        g_downtime = m.gauge(
+            "tdapi_replace_downtime_ms",
+            "last replace's stop->start window (the chips-idle time)")
+        g_delta = m.gauge(
+            "tdapi_copy_delta_files",
+            "files re-copied by delta passes (the dirty sets)",
+            typ="counter")
+        # fractional multi-tenancy: share ledger + serving-path regulators
+        g_sh = m.gauge(
+            "tdapi_tpu_shares_allocated",
+            f"fractional-grant quanta held, per share-split chip "
+            f"({SHARE_QUANTA} quanta = 1 chip)", labels=("chip",))
+        g_sh_tot = m.gauge("tdapi_tpu_shares_allocated_total")
+        g_sh_free = m.gauge(
+            "tdapi_tpu_shares_allocatable",
+            "quanta still grantable to fractional requests (excludes "
+            "cordoned and whole-granted chips)")
+        g_sh_util = m.gauge("tdapi_tpu_shares_utilization")
+        g_reg_q = m.gauge("tdapi_regulator_queue_depth",
+                          "tenants parked waiting for their next decode "
+                          "chunk", labels=("chip",))
+        g_reg_pre = m.gauge("tdapi_regulator_preemptions_total",
+                            "best-effort chunks flagged to yield to a "
+                            "latency tenant", labels=("chip",),
+                            typ="counter")
+        g_reg_ch = m.gauge("tdapi_regulator_chunks_total", labels=("chip",),
+                           typ="counter")
+        g_reg_t = m.gauge("tdapi_regulator_tenants", labels=("chip",))
+        # admission gate + idempotency cache
+        g_mut_in = m.gauge("tdapi_mutations_inflight")
+        g_mut_wait = m.gauge("tdapi_mutations_waiting")
+        g_mut_adm = m.gauge("tdapi_mutations_admitted_total", typ="counter")
+        g_mut_shed = m.gauge(
+            "tdapi_mutations_shed_total",
+            "requests answered 429 before taking any grant", typ="counter")
+        g_idem = m.gauge("tdapi_idempotency_records")
+        g_idem_rep = m.gauge(
+            "tdapi_idempotency_replays_total",
+            "duplicate keyed mutations answered from the result cache",
+            typ="counter")
+        guarded = isinstance(self.backend, GuardedBackend)
+        if guarded:
+            g_brk = m.gauge("tdapi_breaker_state",
+                            "0 = closed, 1 = half-open, 2 = open")
+            g_brk_f = m.gauge("tdapi_breaker_consecutive_failures")
+        # tracing + streaming self-observation
+        g_traces = m.gauge("tdapi_traces_retained",
+                           "finished traces held in the ring "
+                           "(keep-slowest retention, obs/trace.py)")
+        g_followers = m.gauge("tdapi_events_stream_clients",
+                              "live SSE followers of /api/v1/events")
+
+        def collect() -> None:
+            tpu = self.tpu.get_status()
+            cpu = self.cpu.get_status()
+            ports = self.ports.get_status()
+            g_chips.set(tpu["freeCount"], state="free")
+            g_chips.set(sum(1 for c in tpu["chips"] if c["used"]),
+                        state="used")
+            g_chips.set(len(tpu["cordoned"]), state="cordoned")
+            g_cores.set(cpu["usedCount"], state="used")
+            g_cores.set(cpu["totalCount"] - cpu["usedCount"], state="free")
+            g_ports.set(ports["availableCount"], state="available")
+            g_ports.set(len(ports["usedPortSet"]), state="used")
+            g_rs.set(len(self.container_versions.items()))
+            g_vols.set(len(self.volume_versions.items()))
+            g_wq_pend.set(self.wq.pending())
+            g_wq_drop.set(self.wq.dropped_count())
+            g_wq_coal.set(self.wq.coalesced_count())
+            g_rec.set(self.last_reconcile["actions"])
+            g_wal_rec.set(self.store.wal_records)
+            g_wal_fl.set(getattr(self.store, "wal_flushes", 0))
+            g_wal_flr.set(getattr(self.store, "wal_flushed_records", 0))
+            g_wal_max.set(getattr(self.store, "wal_flush_batch_max", 0))
+            g_health.set(sum(c["failureScore"]
+                             for c in self.health.report()["chips"]))
+            g_kills.set(getattr(getattr(self.backend, "inner", self.backend),
+                                "stop_kills", 0))
+            cf = copyfast.METRICS.snapshot()
+            g_cp_bytes.set(cf["copyBytes"])
+            g_cp_secs.set(cf["copySeconds"])
+            g_cp_mode.reset()
+            for mode in cf["copiesByMode"]:
+                g_cp_mode.set(cf["copiesByMode"][mode], mode=mode)
+            g_downtime.set(cf["lastDowntimeMs"])
+            g_delta.set(cf["deltaFiles"])
+            # per-chip lines only for chips actually share-split /
+            # regulated, so the exposition stays bounded on big slices;
+            # reset() drops series for chips that since emptied
+            total_q = SHARE_QUANTA * len(tpu["chips"])
+            alloc_q = sum(sum(c["shares"].values()) for c in tpu["chips"])
+            g_sh.reset()
+            for c in tpu["chips"]:
+                if c["shares"]:
+                    g_sh.set(sum(c["shares"].values()), chip=c["index"])
+            g_sh_tot.set(alloc_q)
+            g_sh_free.set(tpu.get("freeShares", 0))
+            g_sh_util.set(round(alloc_q / total_q, 6) if total_q else 0)
+            for g in (g_reg_q, g_reg_pre, g_reg_ch, g_reg_t):
+                g.reset()
+            for r in regulator.snapshot():
+                g_reg_q.set(r["queueDepth"], chip=r["chip"])
+                g_reg_pre.set(r["preemptTotal"], chip=r["chip"])
+                g_reg_ch.set(r["chunksTotal"], chip=r["chip"])
+                g_reg_t.set(len(r["tenants"]), chip=r["chip"])
+            gate = self.gate.describe()
+            g_mut_in.set(gate["inflight"])
+            g_mut_wait.set(gate["waiting"])
+            g_mut_adm.set(gate["admittedTotal"])
+            g_mut_shed.set(gate["shedTotal"])
+            g_idem.set(self.idempotency.record_count())
+            g_idem_rep.set(self.idempotency.replays)
+            if guarded:
+                brk = self.backend.breaker.describe()
+                g_brk.set(breaker_gauge(brk["state"]))
+                g_brk_f.set(brk["consecutiveFailures"])
+            g_traces.set(self.traces.stats()["retained"])
+            with self._stream_lock:
+                g_followers.set(self._stream_clients)
+
+        m.collector(collect)
+        return m
+
     def h_metrics(self, req: Request) -> Response:
-        """Prometheus text exposition of the resource inventories and the
-        write-behind queue — the pull-metrics surface the reference lacks
-        (SURVEY §5.5: 'No Prometheus'; its /resources/* are JSON-only)."""
-        tpu = self.tpu.get_status()
-        cpu = self.cpu.get_status()
-        ports = self.ports.get_status()
-        free_chips = tpu["freeCount"]
-        lines = [
-            "# TYPE tdapi_tpu_chips gauge",
-            f'tdapi_tpu_chips{{state="free"}} {free_chips}',
-            f'tdapi_tpu_chips{{state="used"}} '
-            f'{sum(1 for c in tpu["chips"] if c["used"])}',
-            f'tdapi_tpu_chips{{state="cordoned"}} {len(tpu["cordoned"])}',
-            "# TYPE tdapi_cpu_cores gauge",
-            f'tdapi_cpu_cores{{state="used"}} {cpu["usedCount"]}',
-            f'tdapi_cpu_cores{{state="free"}} '
-            f'{cpu["totalCount"] - cpu["usedCount"]}',
-            "# TYPE tdapi_ports gauge",
-            f'tdapi_ports{{state="available"}} {ports["availableCount"]}',
-            f'tdapi_ports{{state="used"}} {len(ports["usedPortSet"])}',
-            "# TYPE tdapi_replicasets gauge",
-            f"tdapi_replicasets {len(self.container_versions.items())}",
-            "# TYPE tdapi_volumes gauge",
-            f"tdapi_volumes {len(self.volume_versions.items())}",
-            "# TYPE tdapi_workqueue_pending gauge",
-            f"tdapi_workqueue_pending {self.wq.pending()}",
-            "# TYPE tdapi_workqueue_dropped gauge",
-            f"tdapi_workqueue_dropped {self.wq.dropped_count()}",
-            "# TYPE tdapi_workqueue_coalesced counter",
-            "# puts superseded by a newer same-key put before hitting the store",
-            f"tdapi_workqueue_coalesced {self.wq.coalesced_count()}",
-            "# TYPE tdapi_reconcile_actions gauge",
-            f"tdapi_reconcile_actions {self.last_reconcile['actions']}",
-            "# TYPE tdapi_store_wal_records gauge",
-            f"tdapi_store_wal_records {self.store.wal_records}",
-            "# TYPE tdapi_store_wal_flushes counter",
-            "# flushed_records / flushes = avg group-commit batch size",
-            f"tdapi_store_wal_flushes {getattr(self.store, 'wal_flushes', 0)}",
-            "# TYPE tdapi_store_wal_flushed_records counter",
-            f"tdapi_store_wal_flushed_records "
-            f"{getattr(self.store, 'wal_flushed_records', 0)}",
-            "# TYPE tdapi_store_wal_flush_batch_max gauge",
-            f"tdapi_store_wal_flush_batch_max "
-            f"{getattr(self.store, 'wal_flush_batch_max', 0)}",
-            "# TYPE tdapi_chip_health_failures gauge",
-            f"tdapi_chip_health_failures "
-            f"{sum(c['failureScore'] for c in self.health.report()['chips'])}",
-            "# TYPE tdapi_backend_stop_kills counter",
-            "# stop() escalations: workload ignored SIGTERM for the whole "
-            "stop timeout and ate a SIGKILL",
-            f"tdapi_backend_stop_kills "
-            f"{getattr(getattr(self.backend, 'inner', self.backend), 'stop_kills', 0)}",
-        ]
-        # rolling-replace data movement (utils/copyfast.py): how many bytes
-        # layer/volume copies moved, through which ladder rung, and the
-        # last stop->start downtime window the pre-copy/delta path produced
-        cf = copyfast.METRICS.snapshot()
-        lines += [
-            "# TYPE tdapi_replace_copy_bytes counter",
-            f"tdapi_replace_copy_bytes {cf['copyBytes']}",
-            "# TYPE tdapi_replace_copy_seconds counter",
-            f"tdapi_replace_copy_seconds {cf['copySeconds']}",
-            "# TYPE tdapi_replace_copy_mode counter",
-            "# layer copies per resolved copy-ladder rung",
-        ]
-        for mode in sorted(cf["copiesByMode"]):
-            lines.append(f'tdapi_replace_copy_mode{{mode="{mode}"}} '
-                         f'{cf["copiesByMode"][mode]}')
-        lines += [
-            "# TYPE tdapi_replace_downtime_ms gauge",
-            "# last replace's stop->start window (the chips-idle time)",
-            f"tdapi_replace_downtime_ms {cf['lastDowntimeMs']}",
-            "# TYPE tdapi_copy_delta_files counter",
-            "# files re-copied by delta passes (the dirty sets)",
-            f"tdapi_copy_delta_files {cf['deltaFiles']}",
-        ]
-        # fractional multi-tenancy: per-chip share ledger + the serving-
-        # path regulators (time-slice admission, preemption). Per-chip
-        # lines only for chips that are actually share-split / regulated,
-        # so the exposition stays bounded on big slices.
-        total_q = SHARE_QUANTA * len(tpu["chips"])
-        alloc_q = sum(sum(c["shares"].values()) for c in tpu["chips"])
-        lines += [
-            "# TYPE tdapi_tpu_shares_allocated gauge",
-            "# fractional-grant quanta held, per share-split chip "
-            f"({SHARE_QUANTA} quanta = 1 chip)",
-        ]
-        for c in tpu["chips"]:
-            if c["shares"]:
-                lines.append(
-                    f'tdapi_tpu_shares_allocated{{chip="{c["index"]}"}} '
-                    f'{sum(c["shares"].values())}')
-        lines += [
-            "# TYPE tdapi_tpu_shares_allocated_total gauge",
-            f"tdapi_tpu_shares_allocated_total {alloc_q}",
-            "# TYPE tdapi_tpu_shares_allocatable gauge",
-            "# quanta still grantable to fractional requests "
-            "(excludes cordoned and whole-granted chips)",
-            f"tdapi_tpu_shares_allocatable {tpu.get('freeShares', 0)}",
-            "# TYPE tdapi_tpu_shares_utilization gauge",
-            f"tdapi_tpu_shares_utilization "
-            f"{round(alloc_q / total_q, 6) if total_q else 0}",
-        ]
-        regs = regulator.snapshot()
-        lines += [
-            "# TYPE tdapi_regulator_queue_depth gauge",
-            "# tenants parked waiting for their next decode chunk",
-            "# TYPE tdapi_regulator_preemptions_total counter",
-            "# best-effort chunks flagged to yield to a latency tenant",
-            "# TYPE tdapi_regulator_chunks_total counter",
-            "# TYPE tdapi_regulator_tenants gauge",
-        ]
-        for r in regs:
-            lbl = f'{{chip="{r["chip"]}"}}'
-            lines += [
-                f"tdapi_regulator_queue_depth{lbl} {r['queueDepth']}",
-                f"tdapi_regulator_preemptions_total{lbl} "
-                f"{r['preemptTotal']}",
-                f"tdapi_regulator_chunks_total{lbl} {r['chunksTotal']}",
-                f"tdapi_regulator_tenants{lbl} {len(r['tenants'])}",
-            ]
-        gate = self.gate.describe()
-        lines += [
-            "# TYPE tdapi_mutations_inflight gauge",
-            f"tdapi_mutations_inflight {gate['inflight']}",
-            "# TYPE tdapi_mutations_waiting gauge",
-            f"tdapi_mutations_waiting {gate['waiting']}",
-            "# TYPE tdapi_mutations_admitted_total counter",
-            f"tdapi_mutations_admitted_total {gate['admittedTotal']}",
-            "# TYPE tdapi_mutations_shed_total counter",
-            "# requests answered 429 before taking any grant",
-            f"tdapi_mutations_shed_total {gate['shedTotal']}",
-            "# TYPE tdapi_idempotency_records gauge",
-            f"tdapi_idempotency_records {self.idempotency.record_count()}",
-            "# TYPE tdapi_idempotency_replays_total counter",
-            "# duplicate keyed mutations answered from the result cache",
-            f"tdapi_idempotency_replays_total {self.idempotency.replays}",
-        ]
-        if isinstance(self.backend, GuardedBackend):
-            brk = self.backend.breaker.describe()
-            lines += [
-                "# TYPE tdapi_breaker_state gauge",
-                "# 0 = closed, 1 = half-open, 2 = open",
-                f"tdapi_breaker_state {breaker_gauge(brk['state'])}",
-                "# TYPE tdapi_breaker_consecutive_failures gauge",
-                f"tdapi_breaker_consecutive_failures "
-                f"{brk['consecutiveFailures']}",
-            ]
-        return RawResponse(("\n".join(lines) + "\n").encode(),
-                           "text/plain; version=0.0.4")
+        """Prometheus text exposition — the pull-metrics surface the
+        reference lacks (SURVEY §5.5: 'No Prometheus'). Rendered by the
+        obs/metrics.py registry (App-local inventories first, then the
+        process-global latency histograms), with label-value escaping and
+        the exposition-format content type."""
+        body = self.metrics.render() + obs_metrics.REGISTRY.render()
+        return RawResponse(body.encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
 
     _openapi_bytes: Optional[bytes] = None
 
@@ -1073,6 +1202,7 @@ class App:
                 log.exception("final store maintenance failed")
         self.backend.close()
         self.events.close()
+        self.traces.close()
         self.store.close()
 
     @property

@@ -23,6 +23,8 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .. import faults
+from ..obs import metrics as obs_metrics
+from ..obs import trace
 from .codes import ResCode
 
 log = logging.getLogger(__name__)
@@ -55,6 +57,21 @@ class Request:
     def query_flag(self, name: str) -> bool:
         return name in self.query
 
+    def header(self, name: str, default: str = "") -> str:
+        """Case-insensitive header lookup — dict(self.headers) in the
+        handler discarded the stdlib's case folding, and header names
+        (per RFC 9110, and W3C Trace Context explicitly) match in any
+        case. Exact-case hit first: it is the overwhelmingly common
+        wire form."""
+        v = self.headers.get(name)
+        if v is not None:
+            return v
+        lname = name.lower()
+        for k, hv in self.headers.items():
+            if k.lower() == lname:
+                return hv
+        return default
+
 
 class Response:
     def __init__(self, code: ResCode, data: Optional[dict] = None,
@@ -71,11 +88,15 @@ class Response:
         # the envelope
         self.http_status = http_status
         self.headers = dict(headers or {})
+        # stamped by the ingress pipeline on ERROR envelopes so a failed
+        # call is greppable server-side: GET /api/v1/traces/{traceId}
+        self.trace_id = ""
 
     def payload(self) -> bytes:
-        return json.dumps(
-            {"code": int(self.code), "msg": self.msg, "data": self.data},
-            default=str).encode("utf-8")
+        env = {"code": int(self.code), "msg": self.msg, "data": self.data}
+        if self.trace_id:
+            env["traceId"] = self.trace_id
+        return json.dumps(env, default=str).encode("utf-8")
 
 
 class RawResponse(Response):
@@ -89,6 +110,23 @@ class RawResponse(Response):
 
     def payload(self) -> bytes:
         return self._body
+
+
+class StreamingResponse(Response):
+    """Close-delimited streaming body (SSE: GET /api/v1/events?follow=1).
+
+    The handler returns immediately with a byte-chunk ITERATOR; the
+    connection thread writes chunks as the iterator produces them and the
+    socket close delimits the body (no Content-Length). The producing
+    generator owns pacing — it parks on EventLog.wait_since() and yields
+    heartbeats, so an idle follower costs one blocked thread and zero
+    polling."""
+
+    def __init__(self, chunks, content_type: str = "text/event-stream",
+                 headers: Optional[dict[str, str]] = None):
+        super().__init__(ResCode.Success, None, headers=headers)
+        self.chunks = chunks
+        self.content_type = content_type
 
 
 def ok(data: Optional[dict] = None) -> Response:
@@ -135,13 +173,13 @@ class Router:
     """(method, /path/with/:params) -> handler."""
 
     def __init__(self) -> None:
-        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        self._routes: list[tuple[str, re.Pattern, Handler, str]] = []
         self._patterns: list[tuple[str, str]] = []
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         regex = re.compile(
             "^" + re.sub(r":([a-zA-Z_]+)", r"(?P<\1>[^/]+)", pattern) + "$")
-        self._routes.append((method.upper(), regex, handler))
+        self._routes.append((method.upper(), regex, handler, pattern))
         self._patterns.append((method.upper(), pattern))
 
     def routes(self) -> list[tuple[str, str]]:
@@ -151,14 +189,21 @@ class Router:
         return list(self._patterns)
 
     def resolve(self, method: str, path: str):
+        handler, params, _ = self.resolve_full(method, path)
+        return handler, params
+
+    def resolve_full(self, method: str, path: str):
+        """(handler, params, route pattern). The PATTERN — not the raw
+        path — labels the request-latency histogram and names the ingress
+        span, so metric/trace cardinality is bounded by the route table."""
         path_matched = False
-        for m, regex, handler in self._routes:
+        for m, regex, handler, pattern in self._routes:
             match = regex.match(path)
             if match:
                 path_matched = True
                 if m == method.upper():
-                    return handler, match.groupdict()
-        return (None, {"_405": "1"}) if path_matched else (None, {})
+                    return handler, match.groupdict(), pattern
+        return (None, {"_405": "1"}, "") if path_matched else (None, {}, "")
 
 
 class _KeepAliveHTTPServer(ThreadingHTTPServer):
@@ -171,9 +216,12 @@ class _KeepAliveHTTPServer(ThreadingHTTPServer):
 
 class ApiServer:
     def __init__(self, router: Router, addr: str = "127.0.0.1:2378",
-                 api_key: Optional[str] = None, events=None):
+                 api_key: Optional[str] = None, events=None, traces=None):
         self.router = router
         self.events = events
+        # TraceCollector (obs/trace.py): when set, every request runs under
+        # an ingress root span honoring the client's W3C traceparent
+        self.traces = traces
         host, _, port = addr.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
@@ -189,6 +237,10 @@ class ApiServer:
         self._conns_lock = threading.Lock()
         self._inflight = 0
         self._draining = False
+        # sockets currently serving a StreamingResponse: stop() severs
+        # these FIRST (an SSE follower is in-flight by design and would
+        # otherwise eat the whole drain timeout)
+        self._streams: set = set()
 
     # ---- request pipeline ----
 
@@ -211,7 +263,7 @@ class ApiServer:
                 return 200, cors, Response(ResCode.Forbidden).payload()
 
         parsed = urlparse(raw_path)
-        handler, params = self.router.resolve(method, parsed.path)
+        handler, params, route = self.router.resolve_full(method, parsed.path)
         if handler is None:
             body_out = json.dumps({"code": 404 if "_405" not in params else 405,
                                    "msg": "route not found", "data": None}).encode()
@@ -219,30 +271,51 @@ class ApiServer:
 
         req = Request(method, parsed.path, parse_qs(parsed.query, keep_blank_values=True),
                       body, headers, params, client_addr=client_addr)
+        # W3C trace context: header names match case-insensitively (a
+        # proxy may re-case what the client sent)
+        traceparent = req.header("traceparent")
         t0 = time.perf_counter()
-        try:
-            resp = handler(req)
-        except json.JSONDecodeError:
-            resp = err(ResCode.InvalidParams)
-        except Exception:  # noqa: BLE001 — the envelope absorbs handler crashes
-            log.exception("unhandled error on %s %s [%s]", method, parsed.path,
-                          req.request_id)
-            resp = err(ResCode.ServerBusy)
+        trace_id = ""
+        with trace.root_span(self.traces, f"{method} {route}",
+                             traceparent=traceparent,
+                             target=params.get("name", "")) as sp:
+            try:
+                resp = handler(req)
+            except json.JSONDecodeError:
+                resp = err(ResCode.InvalidParams)
+            except Exception:  # noqa: BLE001 — the envelope absorbs handler crashes
+                log.exception("unhandled error on %s %s [%s]", method,
+                              parsed.path, req.request_id)
+                resp = err(ResCode.ServerBusy)
+            if sp is not None:
+                trace_id = sp.trace_id
+                sp.set(code=int(resp.code), requestId=req.request_id)
+        duration_ms = (time.perf_counter() - t0) * 1000
+        obs_metrics.REQUEST_LATENCY.observe(duration_ms, method=method,
+                                            route=route)
+        # error envelopes carry the trace id: `code != 200` is exactly the
+        # response an operator greps the trace for
+        if trace_id and int(resp.code) != 200 \
+                and not isinstance(resp, RawResponse):
+            resp.trace_id = trace_id
         if self.events is not None:
+            extra = {"traceId": trace_id} if trace_id else {}
             self.events.record(
                 op=f"{method} {parsed.path}",
                 target=params.get("name", ""),
                 code=int(resp.code),
-                duration_ms=(time.perf_counter() - t0) * 1000,
-                request_id=req.request_id)
+                duration_ms=duration_ms,
+                request_id=req.request_id, **extra)
         # duplicate-delivery injection: the handler EXECUTED; make the
         # client see a dead connection instead of the response
         if faults.should_drop_response(f"{method} {parsed.path}"):
             raise DroppedResponse()
-        if isinstance(resp, RawResponse):
+        if isinstance(resp, (RawResponse, StreamingResponse)):
             cors["Content-Type"] = resp.content_type
         if resp.headers:
             cors.update(resp.headers)
+        if isinstance(resp, StreamingResponse):
+            return resp.http_status, cors, resp
         return resp.http_status, cors, resp.payload()
 
     # ---- lifecycle ----
@@ -300,6 +373,9 @@ class ApiServer:
                         except OSError:
                             pass
                         return
+                    if isinstance(payload, StreamingResponse):
+                        self._stream(status, hdrs, payload)
+                        return
                     if server._draining:
                         hdrs = dict(hdrs)
                         hdrs["Connection"] = "close"
@@ -314,6 +390,34 @@ class ApiServer:
                 finally:
                     with server._conns_lock:
                         server._inflight -= 1
+
+            def _stream(self, status, hdrs, resp: StreamingResponse):
+                """Write a close-delimited streaming body. The producing
+                generator blocks between chunks; a client disconnect (or
+                stop() severing the socket) surfaces as an OSError on
+                write, which simply ends the stream."""
+                self.close_connection = True
+                self.send_response(status)
+                for k, v in hdrs.items():
+                    self.send_header(k, v)
+                self.send_header("Connection", "close")
+                self.send_header("Cache-Control", "no-store")
+                self.end_headers()
+                with server._conns_lock:
+                    server._streams.add(self.connection)
+                try:
+                    for chunk in resp.chunks:
+                        if chunk:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    with server._conns_lock:
+                        server._streams.discard(self.connection)
+                    close = getattr(resp.chunks, "close", None)
+                    if close is not None:
+                        close()
 
             do_GET = do_POST = do_PATCH = do_DELETE = do_OPTIONS = _dispatch
 
@@ -344,9 +448,30 @@ class ApiServer:
         if self._httpd is not None:
             self._draining = True
             self._httpd.shutdown()      # accept loop stops; workers keep going
+            # SSE followers are in-flight FOREVER by design: sever their
+            # sockets (the write loop ends on the OSError) instead of
+            # letting each one eat the whole drain timeout below, and wake
+            # their generators out of wait_since() so the dead socket is
+            # noticed now, not at the next heartbeat. Repeated every drain
+            # poll, not once: a follower whose generator read _draining
+            # just before we set it parks AFTER this first wake, and one
+            # that registered after the first snapshot was never severed.
+            def _sever_streams() -> None:
+                with self._conns_lock:
+                    streams = list(self._streams)
+                for conn in streams:
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                if self.events is not None:
+                    self.events.wake_all()
+
+            _sever_streams()
             deadline = time.monotonic() + max(0.0, drain_timeout)
             clear_streak = 0
             while time.monotonic() < deadline:
+                _sever_streams()
                 with self._conns_lock:
                     busy = self._inflight
                 if busy == 0:

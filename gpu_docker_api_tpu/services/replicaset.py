@@ -59,6 +59,7 @@ from ..dtos import (
 )
 from ..faults import crashpoint
 from ..intents import Intent, IntentJournal
+from ..obs import trace
 from ..schedulers import (
     SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
 )
@@ -190,6 +191,7 @@ class ReplicaSetService:
 
     # ------------------------------------------------------------------ run
 
+    @trace.traced("svc.run", "req.replicaSetName")
     def run_container(self, req: ContainerRun) -> dict:
         """POST /replicaSet (reference RunGpuContainer, replicaset.go:45-155)."""
         name = req.replicaSetName
@@ -354,6 +356,7 @@ class ReplicaSetService:
 
     # ---------------------------------------------------------------- patch
 
+    @trace.traced("svc.patch", "name")
     def patch_container(self, name: str, req: PatchRequest,
                         if_match: Optional[int] = None) -> dict:
         """PATCH /replicaSet/{name} (reference PatchContainer :267-363).
@@ -682,6 +685,7 @@ class ReplicaSetService:
 
     # ------------------------------------------------------------- rollback
 
+    @trace.traced("svc.rollback", "name")
     def rollback_container(self, name: str, version: int,
                            if_match: Optional[int] = None) -> dict:
         """PATCH /replicaSet/{name}/rollback (reference :365-446): forward-
@@ -729,6 +733,7 @@ class ReplicaSetService:
 
     # ---------------------------------------------------------------- drain
 
+    @trace.traced("svc.drain")
     def drain_cordoned(self) -> dict:
         """POST /tpus/drain: migrate every stored replicaSet holding a
         cordoned chip onto healthy chips through the rolling-replace path.
@@ -823,6 +828,7 @@ class ReplicaSetService:
 
     # ---------------------------------------------------- stop / restart etc
 
+    @trace.traced("svc.stop", "name")
     def stop_container(self, name: str,
                        if_match: Optional[int] = None) -> None:
         """PATCH /replicaSet/{name}/stop (reference :582-639): resources are
@@ -855,6 +861,7 @@ class ReplicaSetService:
                 raise
             intent.done(committed=True)
 
+    @trace.traced("svc.restart", "name")
     def restart_container(self, name: str,
                           if_match: Optional[int] = None) -> dict:
         """PATCH /replicaSet/{name}/restart (reference :736-864): a restart
@@ -903,10 +910,12 @@ class ReplicaSetService:
             intent.done(committed=True)
             return self._run_response(info)
 
+    @trace.traced("svc.pause", "name")
     def pause_container(self, name: str) -> None:
         info = self._stored_info(name)
         self.backend.pause(info.containerName)
 
+    @trace.traced("svc.continue", "name")
     def startup_container(self, name: str) -> None:
         """PATCH /replicaSet/{name}/continue (reference StartupContainer
         :717-732 — `docker restart`, pause's dual)."""
@@ -915,6 +924,7 @@ class ReplicaSetService:
 
     # -------------------------------------------------- exec / commit / info
 
+    @trace.traced("svc.execute", "name")
     def execute_container(self, name: str, cmd: list[str],
                           workdir: str = "") -> str:
         """POST /replicaSet/{name}/execute (reference :225-265)."""
@@ -924,6 +934,7 @@ class ReplicaSetService:
             raise RuntimeError(f"exec exit {code}: {output.strip()}")
         return output
 
+    @trace.traced("svc.commit", "name")
     def commit_container(self, name: str, new_image: str) -> str:
         info = self._stored_info(name)
         return self.backend.commit(info.containerName, new_image)
@@ -974,6 +985,7 @@ class ReplicaSetService:
 
     # --------------------------------------------------------------- delete
 
+    @trace.traced("svc.delete", "name")
     def delete_container(self, name: str,
                          if_match: Optional[int] = None) -> None:
         """DELETE /replicaSet/{name} (reference :157-223): remove container,

@@ -21,6 +21,7 @@ from ..backend.base import Backend
 from ..dtos import HistoryItem, StoredVolumeInfo
 from ..faults import crashpoint
 from ..intents import KIND_VOLUME, Intent, IntentJournal
+from ..obs import trace
 from ..store.client import StateClient
 from ..utils.copyfast import move_dir_contents
 from ..utils.file import to_bytes
@@ -57,6 +58,7 @@ class VolumeService:
 
     # ---- create ----
 
+    @trace.traced("svc.volume.create", "name")
     def create_volume(self, name: str, size: str, tier: str = "") -> dict:
         """POST /volumes (reference CreateVolume :26-96). tier selects the
         storage root (local-SSD default vs e.g. an NFS tier)."""
@@ -106,6 +108,7 @@ class VolumeService:
 
     # ---- patch (scale) ----
 
+    @trace.traced("svc.volume.scale", "name")
     def patch_volume_size(self, name: str, size: str,
                           if_match: Optional[int] = None) -> dict:
         """PATCH /volumes/{name}/size (reference PatchVolumeSize :98-170):
@@ -185,6 +188,7 @@ class VolumeService:
 
     # ---- delete / info / history ----
 
+    @trace.traced("svc.volume.delete", "name")
     def delete_volume(self, name: str, keep_history: bool = False,
                       if_match: Optional[int] = None) -> None:
         """DELETE /volumes/{name} (reference :174-199). keep_history mirrors

@@ -9,10 +9,13 @@ revision-walk of gRPC gets.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 from .. import xerrors
+from ..obs import metrics as obs_metrics
+from ..obs import trace
 from .mvcc import KeyValue, MVCCStore
 
 
@@ -65,7 +68,15 @@ class StateClient:
     # ---- basic ops (etcd/common.go parity) ----
 
     def put(self, resource: str, name: str, value: str) -> int:
-        return self.store.put(resource_key(resource, name), value)
+        """Synchronous durable write: the caller blocks until its record
+        is committed (group-commit wait included), so the span/histogram
+        here is the store latency a mutation actually pays."""
+        t0 = time.perf_counter()
+        with trace.span("store.put", target=f"{resource}/{name}"):
+            rev = self.store.put(resource_key(resource, name), value)
+        obs_metrics.STORE_PUT_LATENCY.observe(
+            (time.perf_counter() - t0) * 1e3)
+        return rev
 
     def get_value(self, resource: str, name: str) -> str:
         kv = self.store.get(resource_key(resource, name))
@@ -77,7 +88,8 @@ class StateClient:
         return self.store.get(resource_key(resource, name))
 
     def delete(self, resource: str, name: str) -> bool:
-        return self.store.delete(resource_key(resource, name))
+        with trace.span("store.delete", target=f"{resource}/{name}"):
+            return self.store.delete(resource_key(resource, name))
 
     def range(self, resource: str) -> list[KeyValue]:
         return self.store.range(f"{ResourcePrefix.Base}/{resource}/")

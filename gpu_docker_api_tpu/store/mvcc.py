@@ -25,6 +25,9 @@ import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
 # Group-commit batch window in milliseconds: when > 0, the flush leader
 # sleeps this long before flushing so more concurrent writers join the
 # batch. 0 (default) flushes as soon as a leader picks the batch up —
@@ -371,9 +374,17 @@ class MVCCStore:
                     target = self._seq  # everything appended so far
                     wal = self._wal
                     if wal is not None:
-                        wal.flush()
-                        if self._fsync:
-                            os.fsync(wal.fileno())
+                        # the leader's flush+fsync is the whole batch's
+                        # durability cost: histogram it, and span it on
+                        # the leader's own trace (followers' store.put
+                        # spans show the wait as their tail)
+                        t0 = time.perf_counter()
+                        with obs_trace.span("store.wal_flush"):
+                            wal.flush()
+                            if self._fsync:
+                                os.fsync(wal.fileno())
+                        obs_metrics.WAL_FLUSH_LATENCY.observe(
+                            (time.perf_counter() - t0) * 1e3)
                 except ValueError:
                     # handle swapped/closed mid-flush (maintain()/close()):
                     # both flush before closing, so target IS durable

@@ -42,6 +42,7 @@ counts; ``/metrics`` exposes them as ``tdapi_replace_copy_*`` gauges.
 from __future__ import annotations
 
 import errno
+import functools
 import logging
 import os
 import shutil
@@ -51,7 +52,27 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace
+
 log = logging.getLogger(__name__)
+
+
+def _traced(op: str):
+    """Span-wrap a copy entry point: the replace trace shows WHICH copy
+    stage (warm clone, delta pass, move) the time went to, with the
+    resolved ladder rung and byte counts as span attrs."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with trace.span(op) as sp:
+                out = fn(*args, **kwargs)
+                if sp is not None and isinstance(out, CopyStats):
+                    sp.set(bytes=out.bytes, files=out.files, mode=out.mode,
+                           deltaFiles=out.delta_files)
+                return out
+        return wrapper
+    return deco
 
 MODE_ENV = "TDAPI_COPY_MODE"
 WORKERS_ENV = "TDAPI_COPY_WORKERS"
@@ -132,6 +153,7 @@ class CopyMetrics:
     def observe_downtime(self, ms: float) -> None:
         with self._lock:
             self.last_downtime_ms = ms
+        obs_metrics.REPLACE_DOWNTIME.observe(ms)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -238,6 +260,7 @@ class _Ladder:
 
 # --------------------------------------------------------------- clone_tree
 
+@_traced("copy.clone")
 def clone_tree(src: str, dest: str, mode: str | None = None,
                workers: int | None = None) -> CopyStats:
     """Recursively copy ``src/*`` into ``dest`` (created if missing).
@@ -385,6 +408,7 @@ def _scan_src(src: str):
                 yield rel, "file", (st.st_size, st.st_mtime_ns)
 
 
+@_traced("copy.snapshot")
 def snapshot_tree(src: str, dest: str) -> TreeSnapshot:
     """Record src's per-file (size, mtime_ns) and dest's pre-existing
     entries. Taken BEFORE the warm copy so any write that races the copy
@@ -410,6 +434,7 @@ def snapshot_tree(src: str, dest: str) -> TreeSnapshot:
     return snap
 
 
+@_traced("copy.delta")
 def delta_sync(src: str, dest: str, snap: TreeSnapshot,
                mode: str | None = None,
                workers: int | None = None) -> CopyStats:
@@ -623,6 +648,7 @@ def _remove_entry(path: str) -> None:
 
 # ----------------------------------------------------- move_dir_contents
 
+@_traced("copy.move")
 def move_dir_contents(src: str, dest: str,
                       workers: int | None = None) -> CopyStats:
     """Move ``src/*`` into ``dest`` (volume scale / reconcile migration).

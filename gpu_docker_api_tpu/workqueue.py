@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Union
 
 from .faults import crashpoint
+from .obs import trace
 
 log = logging.getLogger(__name__)
 
@@ -87,6 +88,10 @@ class _Envelope:
     msg: object
     attempts: int = 0
     enqueued_at: float = field(default_factory=time.monotonic)
+    # trace context captured at submit(): the drainer resumes it, so a
+    # write-behind persist appears on the MUTATION's trace even though it
+    # runs seconds later on another thread (async span follow-through)
+    span: object = None
 
 
 class WorkQueue:
@@ -117,7 +122,7 @@ class WorkQueue:
         crashpoint("workqueue.before_submit")
         if self._closed.is_set():
             raise RuntimeError("work queue closed")
-        self._q.put(_Envelope(msg))
+        self._q.put(_Envelope(msg, span=trace.capture()))
 
     def pending(self) -> int:
         """Messages enqueued but not yet fully persisted (for /metrics)."""
@@ -154,7 +159,10 @@ class WorkQueue:
                 try:
                     while True:
                         try:
-                            self._dispatch(env.msg)
+                            with trace.resume(env.span, "workqueue.apply",
+                                              target=describe(env.msg),
+                                              coalesced=len(superseded)):
+                                self._dispatch(env.msg)
                             break
                         except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
                             env.attempts += 1

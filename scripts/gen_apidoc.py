@@ -142,6 +142,9 @@ def generate(spec: dict) -> str:
                            "description |")
                 out.append("|---|---|---|---|---|")
                 for p in params:
+                    while "$ref" in p:   # shared params (traceparent)
+                        sec, nm = p["$ref"].rsplit("/", 2)[-2:]
+                        p = spec["components"][sec][nm]
                     out.append(
                         f"| `{p['name']}` | {p['in']} "
                         f"| {_type_str(p.get('schema', {}))} "

@@ -155,6 +155,21 @@ CHIP_PARAM = {"name": "id", "in": "path", "required": True,
               "schema": {"type": "integer", "minimum": 0},
               "description": "Global chip index (see /resources/tpus)"}
 
+# Attached to EVERY operation (post-processing in build_spec): W3C Trace
+# Context ingress (obs/trace.py; the shipped client stamps one per call)
+TRACEPARENT_PARAM = {
+    "name": "traceparent", "in": "header", "required": False,
+    "schema": {"type": "string",
+               "pattern": "^[0-9a-f]{2}-[0-9a-f]{32}-[0-9a-f]{16}-"
+                          "[0-9a-f]{2}$"},
+    "description": "W3C Trace Context (level 1). When present, the "
+                   "request's ingress span joins the caller's trace id "
+                   "instead of minting a fresh one — a caller spanning "
+                   "several control planes can stitch the traces. "
+                   "Malformed values never fail the request; the trace "
+                   "just restarts here. The full span tree is served at "
+                   "GET /api/v1/traces/{traceId}."}
+
 
 def build_codes_desc() -> str:
     from gpu_docker_api_tpu.server.codes import ResCode
@@ -195,7 +210,12 @@ def build_spec() -> dict:
              "msg": s("Human-readable status"),
              "data": {"nullable": True,
                       "description": "Operation payload (endpoint-specific; "
-                                     "null on errors and bare acks)"}},
+                                     "null on errors and bare acks)"},
+             "traceId": s("W3C trace id of the request — present on ERROR "
+                          "envelopes (code != 200) when tracing is armed, "
+                          "so a failed call is greppable server-side: "
+                          "GET /api/v1/traces/{traceId} shows exactly "
+                          "where the mutation failed")},
             required=["code", "msg"],
             desc="Every endpoint answers HTTP 200 with this envelope "
                  "(server/http.py); failures ride the `code` field."),
@@ -402,8 +422,71 @@ def build_spec() -> dict:
             {"ts": {"type": "number", "description": "Unix seconds"},
              "op": s("Operation, e.g. 'replicaSet.run'"),
              "target": s(), "code": i("App code the op returned"),
-             "durationMs": {"type": "number"}, "requestId": s()},
+             "durationMs": {"type": "number"}, "requestId": s(),
+             "seq": i("Monotonic per-daemon sequence — the SSE event id; "
+                      "pass the last seen value as Last-Event-ID (or "
+                      "?lastEventId=) to resume a ?follow=1 stream from "
+                      "the ring"),
+             "traceId": s("Trace the event was recorded under (absent "
+                          "when no traced request was on the recording "
+                          "thread) — links this row to its span tree at "
+                          "GET /api/v1/traces/{traceId}")},
             desc="Operation event (events.py record)"),
+        "SpanEvent": obj(
+            {"name": s("Point-in-time marker: an intent step name, "
+                       "'retry', 'failed', or 'breaker.rejected'"),
+             "t": {"type": "number",
+                   "description": "Milliseconds since the span started"}},
+            desc="Point-in-time marker inside a span (obs/trace.py); "
+                 "extra keys carry marker-specific detail (retry attempt "
+                 "+ backoffMs, breaker state, step sync flag)",
+            additional=True),
+        "Span": obj(
+            {"traceId": s("32-hex W3C trace id"),
+             "spanId": s("16-hex span id"),
+             "parentId": s("Parent span id (null on the ingress root; an "
+                           "id OUTSIDE the trace's span set when the "
+                           "caller supplied a traceparent)",
+                           nullable=True),
+             "op": s("Stage name: '<METHOD> <route>' (ingress), 'svc.*', "
+                     "'intent.*', 'backend.*', 'sched.*', 'store.*', "
+                     "'copy.*', 'workqueue.apply', 'reconcile.*'"),
+             "target": s("ReplicaSet/volume name the stage acted on"),
+             "start": {"type": "number", "description": "Unix seconds"},
+             "durationMs": {"type": "number"},
+             "status": s("'ok', 'committed', or the exception class name "
+                         "the stage died with"),
+             "attrs": obj({}, additional=True,
+                          desc="Stage attributes (granted chips, copy "
+                               "bytes/mode, app code, ...)"),
+             "events": arr(ref("SpanEvent"))},
+            desc="One timed stage of a trace (obs/trace.py). In the "
+                 "`tree` view each span additionally carries `children`, "
+                 "sorted by start time."),
+        "TraceSummary": obj(
+            {"traceId": s(), "rootOp": s("The ingress root's op, e.g. "
+                                         "'POST /api/v1/replicaSet'"),
+             "target": s(), "start": {"type": "number"},
+             "durationMs": {"type": "number"},
+             "status": s(), "spanCount": i()},
+            desc="Finished-trace summary (GET /api/v1/traces rows, "
+                 "slowest first)"),
+        "Trace": obj(
+            {"traceId": s(), "rootOp": s(), "target": s(),
+             "durationMs": {"type": "number"}, "status": s(),
+             "spans": arr(ref("Span"), "Flat span list, finish order"),
+             "tree": arr(ref("Span"),
+                         "Spans nested by parentId (children sorted by "
+                         "start); reconciler resumes of a crashed "
+                         "mutation appear as additional roots on the "
+                         "same trace")},
+            desc="One full trace: every recorded span plus the assembled "
+                 "span tree"),
+        "TraceStats": obj(
+            {"retained": i("Traces currently held in the ring "
+                           "(keep-slowest retention)"),
+             "spansTotal": i(), "dropped": i("Traces FIFO-evicted")},
+            desc="Trace-collector self-observation (obs/trace.py)"),
         "ChipHealth": obj(
             {"index": i("Global chip index"), "device": s(),
              "failureScore": i("Consecutive failed probes (presence or "
@@ -606,14 +689,91 @@ def build_spec() -> dict:
             envelope(obj({"ports": ref("PortStatus")})),
             tags=["resource"])},
         f"{v1}/events": {"get": op(
-            "events", "Recent operation events (bounded ring)",
-            envelope(obj({"events": arr(ref("Event"))})),
+            "events", "Recent operation events (bounded ring), or — with "
+            "?follow=1 — a live Server-Sent Events stream",
+            {"200": {
+                "description":
+                    "Envelope with the ring snapshot — or, with "
+                    "?follow=1, a close-delimited text/event-stream: "
+                    "each event goes out as `id: <seq>` + `data: <Event "
+                    "JSON>`; `: heartbeat` comment frames mark idle "
+                    "intervals. Reconnect with Last-Event-ID (or "
+                    "?lastEventId=) to resume from the ring — a resume "
+                    "point older than the ring's tail yields what is "
+                    "retained, the gap visible as a seq jump. Subscribe "
+                    "instead of polling (client.follow_events()).",
+                "content": {
+                    "application/json": {"schema": {
+                        "allOf": [ref("Envelope"), {
+                            "type": "object", "properties": {
+                                "data": obj(
+                                    {"events": arr(ref("Event"))})}}]}},
+                    "text/event-stream": {
+                        "schema": {"type": "string"}}}}},
             params=[{"name": "limit", "in": "query", "required": False,
                      "schema": {"type": "integer", "minimum": 0}},
                     {"name": "target", "in": "query", "required": False,
                      "schema": {"type": "string"},
-                     "description": "Filter by event target name"}],
+                     "description": "Filter by event target name"},
+                    {"name": "follow", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description": "Set to 1 to stream new events as "
+                                    "Server-Sent Events instead of "
+                                    "answering a snapshot"},
+                    {"name": "heartbeat", "in": "query", "required": False,
+                     "schema": {"type": "number", "minimum": 0.05},
+                     "description": "Idle-heartbeat cadence in seconds "
+                                    "(follow=1 only; default 15)"},
+                    {"name": "lastEventId", "in": "query",
+                     "required": False,
+                     "schema": {"type": "integer", "minimum": 0},
+                     "description": "Resume point (follow=1 only): "
+                                    "stream ring events with seq greater "
+                                    "than this, then live ones"},
+                    {"name": "Last-Event-ID", "in": "header",
+                     "required": False,
+                     "schema": {"type": "integer", "minimum": 0},
+                     "description": "Header form of lastEventId (what an "
+                                    "EventSource reconnect sends)"}],
             tags=["meta"])},
+        f"{v1}/traces": {"get": op(
+            "traces", "Finished-trace summaries, slowest first "
+            "(keep-slowest retention: the ring pins its slowest traces "
+            "past FIFO eviction)",
+            envelope(obj({"traces": arr(ref("TraceSummary")),
+                          "stats": ref("TraceStats")})),
+            params=[{"name": "op", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description": "Root-op substring filter, e.g. "
+                                    "'PATCH' or '/replicaSet'"},
+                    {"name": "minDurationMs", "in": "query",
+                     "required": False,
+                     "schema": {"type": "number", "minimum": 0},
+                     "description": "Only traces at least this slow"},
+                    {"name": "limit", "in": "query", "required": False,
+                     "schema": {"type": "integer", "minimum": 0}}],
+            tags=["meta"],
+            desc="Every REST mutation yields a trace: ingress -> service "
+                 "-> intent steps -> scheduler grant -> backend ops "
+                 "(retries/breaker rejections as span events) -> store "
+                 "writes, async write-behind stages included. Events and "
+                 "error envelopes carry traceId, linking them here.")},
+        f"{v1}/traces/{{traceId}}": {"get": op(
+            "trace", "One full trace: flat span list + assembled span "
+            "tree",
+            envelope(obj({"trace": ref("Trace")})),
+            params=[{"name": "traceId", "in": "path", "required": True,
+                     "schema": {"type": "string",
+                                "pattern": "^[0-9a-f]{32}$"},
+                     "description": "From a traceparent this client "
+                                    "sent, an error envelope, an event "
+                                    "row, or the /traces listing"}],
+            tags=["meta"],
+            desc="App error 1000 when the id is unknown (evicted or "
+                 "never seen). A crash-recovered mutation's trace also "
+                 "carries the boot reconciler's replay spans — the "
+                 "intent journal preserves the original request's trace "
+                 "identity across the crash.")},
         f"{v1}/healthz": {"get": op(
             "healthz", "Substrate health: chip presence, reachability, "
             "flap detection, breaker state",
@@ -682,6 +842,13 @@ def build_spec() -> dict:
     # every mutating operation gets the exactly-once surface: the
     # Idempotency-Key header, the 429 shed response, and (for mutations of
     # a named, versioned resource) the If-Match precondition + 412
+    # every operation accepts a W3C traceparent (obs/trace.py ingress) —
+    # one shared components/parameters definition, $ref'd per op, so the
+    # 12-line header description isn't duplicated ~20 times in the spec
+    for path_item in paths.values():
+        for o in path_item.values():
+            o.setdefault("parameters", []).append(
+                {"$ref": "#/components/parameters/traceparent"})
     for path_item in paths.values():
         for method, o in path_item.items():
             if method not in ("post", "patch", "delete"):
@@ -697,7 +864,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.8.0",
+            "version": "0.9.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "
@@ -730,6 +897,7 @@ def build_spec() -> dict:
                 "bearer": {"type": "http", "scheme": "bearer",
                            "description": "Static APIKEY; no-op when the "
                                           "server runs without one"}},
+            "parameters": {"traceparent": dict(TRACEPARENT_PARAM)},
             "schemas": schemas,
         },
     }

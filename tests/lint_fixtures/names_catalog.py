@@ -1,0 +1,13 @@
+"""Fixture telemetry catalog for the untraced-op rule tests — the shape
+obs/names.py has in the real tree (the rule matches on the EVENT_OPS /
+METRIC_NAMES assignments, not on the filename)."""
+
+EVENT_OPS = frozenset({
+    "replace.copied",
+    "reconcile",
+})
+
+METRIC_NAMES = frozenset({
+    "tdapi_tpu_chips",
+    "tdapi_http_request_duration_ms",
+})

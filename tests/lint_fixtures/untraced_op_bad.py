@@ -1,0 +1,30 @@
+"""Seeded untraced-op violations: ad-hoc event op literals and
+unregistered tdapi_* metric families, in positional AND keyword form
+(5 violations expected)."""
+
+
+class Service:
+    def __init__(self, events, registry):
+        self.events = events
+        self._events = events
+        self.registry = registry
+
+    def mutate(self):
+        # unregistered op through the public handle
+        self.events.record("container.teleported", code=200)
+        # ... and through a private one (the workqueue idiom)
+        self._events.record("rogue.drop", target="x")
+        # keyword form must not bypass the gate (the http.py idiom)
+        self.events.record(op="rogue.keyword", code=200)
+        # registered op: fine
+        self.events.record("replace.copied", code=200)
+
+    def instruments(self):
+        # unregistered metric family
+        self.registry.gauge("tdapi_teleports_total", typ="counter")
+        # ... keyword form likewise
+        self.registry.counter(name="tdapi_rogue_kw_total")
+        # registered family: fine
+        self.registry.histogram("tdapi_http_request_duration_ms")
+        # non-tdapi name handed to an unrelated .counter() API: not ours
+        self.registry.counter("widget_spins")

@@ -1,0 +1,20 @@
+"""Clean twin of untraced_op_bad.py: every op/metric literal is in the
+fixture catalog (names_catalog.py); computed names are skipped by design
+(HTTP request events, breaker.{state})."""
+
+
+class Service:
+    def __init__(self, events, registry):
+        self.events = events
+        self.registry = registry
+
+    def mutate(self, method, path):
+        self.events.record("replace.copied", code=200)
+        self.events.record("reconcile", code=200)
+        # computed op: the rule skips non-literals by design — one event
+        # name per route would be unbounded
+        self.events.record(f"{method} {path}", code=200)
+
+    def instruments(self):
+        self.registry.gauge("tdapi_tpu_chips", labels=("state",))
+        self.registry.histogram("tdapi_http_request_duration_ms")

@@ -81,6 +81,27 @@ def test_silent_swallow_fires_and_clean_twin_silent():
     assert _lint(["silent_swallow_ok.py"], ["silent-swallow"]) == []
 
 
+def test_untraced_op_fires_and_clean_twin_silent():
+    vs = _lint(["untraced_op_bad.py", "names_catalog.py"], ["untraced-op"])
+    assert len(vs) == 5
+    msgs = " | ".join(v.message for v in vs)
+    assert "'container.teleported' is not registered" in msgs
+    assert "'rogue.drop' is not registered" in msgs
+    assert "'rogue.keyword' is not registered" in msgs
+    assert "'tdapi_teleports_total' is not registered" in msgs
+    assert "'tdapi_rogue_kw_total' is not registered" in msgs
+    # non-tdapi counter names on unrelated APIs are not ours to police
+    assert "widget_spins" not in msgs
+    assert _lint(["untraced_op_ok.py", "names_catalog.py"],
+                 ["untraced-op"]) == []
+
+
+def test_untraced_op_without_catalog_is_silent():
+    """A file set with no EVENT_OPS/METRIC_NAMES assignment (fixture runs
+    of OTHER rules) must not fail — there is no catalog to check against."""
+    assert _lint(["untraced_op_bad.py"], ["untraced-op"]) == []
+
+
 # ------------------------------------------------------------- pragmas
 
 def test_pragma_all_three_placements_honored_and_counted():
@@ -179,8 +200,25 @@ def test_repo_scope_covers_the_concurrent_core():
                  "gpu_docker_api_tpu/services/replicaset.py",
                  "gpu_docker_api_tpu/reconcile.py",
                  "gpu_docker_api_tpu/regulator.py",
-                 "gpu_docker_api_tpu/server/app.py"):
+                 "gpu_docker_api_tpu/server/app.py",
+                 "gpu_docker_api_tpu/obs/names.py",
+                 "gpu_docker_api_tpu/obs/trace.py"):
         assert must in rels
+
+
+def test_live_catalog_matches_emitters():
+    """The untraced-op rule reads the REAL obs/names.py when linting the
+    repo — a renamed event op or metric family that is still emitted
+    under the old name must fail the build. Spot-check that the catalog
+    carries both sides' anchor entries."""
+    from gpu_docker_api_tpu.obs import names
+    assert "replace.copied" in names.EVENT_OPS
+    assert "workqueue.drop" in names.EVENT_OPS
+    assert "tdapi_http_request_duration_ms" in names.METRIC_NAMES
+    assert "tdapi_tpu_chips" in names.METRIC_NAMES
+    # every catalogued family is a tdapi_* family — the rule's prefix
+    # filter must never skip a catalogued name
+    assert all(m.startswith("tdapi_") for m in names.METRIC_NAMES)
 
 
 def test_live_registry_matches_reconciler():

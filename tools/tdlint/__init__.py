@@ -132,6 +132,7 @@ DEFAULT_SCOPE = (
     "gpu_docker_api_tpu/regulator.py",
     "gpu_docker_api_tpu/workqueue.py",
     "gpu_docker_api_tpu/events.py",
+    "gpu_docker_api_tpu/obs/",
     "gpu_docker_api_tpu/version.py",
     "gpu_docker_api_tpu/xerrors.py",
 )

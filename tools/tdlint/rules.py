@@ -11,6 +11,9 @@
 | unmapped-xerror | every xerrors class maps to an app code; every code used is |
 |                 | documented in the generated OpenAPI                         |
 | silent-swallow  | no `except Exception` swallows a failure without log/event  |
+| untraced-op     | every events.record() op literal and every tdapi_* metric   |
+|                 | family name is registered in obs/names.py — telemetry names |
+|                 | are API, not scattered string literals                      |
 
 All checks are lexical (AST). That is deliberately conservative: code that
 needs a lock held by its CALLER (e.g. MVCCStore._apply_put) carries a
@@ -51,6 +54,12 @@ LOCK_ATTRS = frozenset({
 #: module-level lock names (regulator._LOCK)
 LOCK_NAMES = frozenset({"_LOCK"})
 
+#: contextmanager METHODS that acquire the owning lock for their body —
+#: `with <x>._granting(...):` is a guarded region exactly like
+#: `with <x>._lock:` (schedulers/tpu.py wraps the lock to observe grant
+#: latency after release, keeping histogram work out of the hot section)
+LOCK_WRAPPER_METHODS = frozenset({"_granting"})
+
 #: cross-object scheduler state: accessing these on anything but `self`
 #: must go through a locked snapshot accessor (owners()/shares_snapshot()/
 #: cordoned_snapshot()) — reading another object's raw dict races its
@@ -69,6 +78,9 @@ def _with_locks(node: ast.With) -> bool:
         if isinstance(e, ast.Attribute) and e.attr in LOCK_ATTRS:
             return True
         if isinstance(e, ast.Name) and e.id in LOCK_NAMES:
+            return True
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+                and e.func.attr in LOCK_WRAPPER_METHODS:
             return True
     return False
 
@@ -560,6 +572,113 @@ class SilentSwallow(Rule):
         return False
 
 
+# ------------------------------------------------------------- untraced-op
+
+#: registry-method and constructor names that declare a metric family
+METRIC_FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
+METRIC_CLASS_NAMES = frozenset({"Counter", "Gauge", "Histogram"})
+#: event-log receivers: `events.record(...)`, `self.events.record(...)`,
+#: `self._events.record(...)` (workqueue holds a private handle)
+EVENT_RECEIVERS = frozenset({"events", "_events"})
+
+
+class UntracedOp(Rule):
+    name = "untraced-op"
+    description = ("every events.record() op string literal and every "
+                   "tdapi_* metric family name must be registered in "
+                   "obs/names.py (EVENT_OPS / METRIC_NAMES) — dashboards "
+                   "and grep pipelines treat telemetry names as API, so an "
+                   "ad-hoc literal is an undocumented API surface")
+
+    def check_files(self, ctxs: list[FileCtx],
+                    scoped: bool = True) -> list[Violation]:
+        event_ops, metric_names = self._catalog(ctxs)
+        if event_ops is None and metric_names is None:
+            return []   # no catalog in this file set — nothing to check
+        out: list[Violation] = []
+        for ctx in ctxs:
+            if scoped and not self.applies(ctx.rel):
+                continue
+            if ctx.rel.endswith("obs/names.py"):
+                continue   # the catalog itself is not a call site
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # the name may arrive positionally or as a keyword —
+                # events.record(op=f"{m} {p}") is the http.py idiom, so
+                # the keyword form must not bypass the catalog gate
+                pos = node.args[0] if node.args else None
+                kws = {k.arg: k.value for k in node.keywords if k.arg}
+                arg = pos if pos is not None else \
+                    kws.get("op", kws.get("name"))
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue   # computed ops (f"{method} {path}",
+                               # f"breaker.{state}") are skipped by design
+                f = node.func
+                if (event_ops is not None and self._is_events_record(f)
+                        and arg.value not in event_ops):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"event op {arg.value!r} is not registered in the "
+                        f"telemetry catalog (obs/names.py EVENT_OPS) — "
+                        f"register it or reuse a registered op"))
+                if (metric_names is not None
+                        and arg.value.startswith("tdapi_")
+                        and self._is_metric_decl(f)
+                        and arg.value not in metric_names):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        f"metric family {arg.value!r} is not registered in "
+                        f"the telemetry catalog (obs/names.py METRIC_NAMES) "
+                        f"— register it or reuse a registered family"))
+        return out
+
+    @staticmethod
+    def _is_events_record(f: ast.AST) -> bool:
+        if not (isinstance(f, ast.Attribute) and f.attr == "record"):
+            return False
+        v = f.value
+        if isinstance(v, ast.Attribute):
+            return v.attr in EVENT_RECEIVERS
+        if isinstance(v, ast.Name):
+            return v.id in EVENT_RECEIVERS
+        return False
+
+    @staticmethod
+    def _is_metric_decl(f: ast.AST) -> bool:
+        if isinstance(f, ast.Attribute):
+            return (f.attr in METRIC_FACTORY_METHODS
+                    or f.attr in METRIC_CLASS_NAMES)
+        if isinstance(f, ast.Name):
+            return f.id in METRIC_CLASS_NAMES
+        return False
+
+    @staticmethod
+    def _catalog(ctxs: list[FileCtx]):
+        """(event_ops, metric_names) from whichever file in `ctxs` assigns
+        the EVENT_OPS / METRIC_NAMES set literals (obs/names.py in repo
+        runs; any catalog-bearing fixture in tests)."""
+        ops: Optional[set] = None
+        metrics: Optional[set] = None
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if t.id == "EVENT_OPS":
+                        vals = UnknownStep._str_elts(node.value)
+                        if vals is not None:
+                            ops = (ops or set()) | vals
+                    elif t.id == "METRIC_NAMES":
+                        vals = UnknownStep._str_elts(node.value)
+                        if vals is not None:
+                            metrics = (metrics or set()) | vals
+        return ops, metrics
+
+
 # ----------------------------------------------------------------- registry
 
 RULES: list[Rule] = [
@@ -569,6 +688,7 @@ RULES: list[Rule] = [
     IoUnderLock(),
     UnmappedXerror(),
     SilentSwallow(),
+    UntracedOp(),
 ]
 
 
