@@ -6,7 +6,8 @@ ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry verify-migrate verify-mt verify-races verify-obs bench \
+    verify-retry verify-migrate verify-mt verify-races verify-obs \
+    verify-gateway bench \
     serve serve-mock dryrun apidoc lint clean
 
 all: native
@@ -25,6 +26,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-mt      (fractional multi-tenancy sweep: -m mt)"
 	@echo "  make verify-races   (race stress sweep: -m races)"
 	@echo "  make verify-obs     (observability sweep: -m obs)"
+	@echo "  make verify-gateway (inference-gateway sweep: -m gateway)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -50,6 +52,9 @@ verify-races:           ## race stress sweep: concurrent mutation mixes + invari
 
 verify-obs:             ## observability sweep: trace trees over HTTP, Prometheus validity, SSE
 	$(PY) -m pytest tests/ -q -m obs
+
+verify-gateway:         ## inference-gateway sweep: router, autoscale, crash-mid-scale, e2e
+	$(PY) -m pytest tests/ -q -m gateway
 
 lint:                   ## compile baseline + tdlint concurrency-invariant rules + rule liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

@@ -77,6 +77,13 @@ CRASHPOINTS: dict[str, str] = {
     # op-specific preambles before the shared replace machinery
     "rollback.after_grant": "historical counts re-granted, replace not begun",
     "restart.after_grant": "fresh grants applied, replace not begun",
+    # gateway autoscale (gateway.py scale-up = a cloned run): the donor's
+    # warm layer is cloned into the new replica, which is not yet started
+    # and whose record is not yet persisted — a crash here must unwind the
+    # half-made replica like any aborted run, never leak its grants, and
+    # leave the gateway's other replicas serving
+    "gwscale.after_clone": "replica layer cloned from a warm donor, new "
+                           "replica not yet started",
     # stop = backend stop -> free grants -> persist resourcesReleased
     "stop.after_backend_stop": "container stopped, grants still held",
     "stop.after_restore": "grants freed, release not yet persisted",

@@ -39,6 +39,7 @@ INTENTS = "intents"
 
 KIND_CONTAINER = "container"
 KIND_VOLUME = "volume"
+KIND_GATEWAY = "gateway"
 
 
 @dataclass

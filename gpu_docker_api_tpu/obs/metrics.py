@@ -354,3 +354,19 @@ REGULATOR_CHUNK = REGISTRY.histogram(
 SPANS_TOTAL = REGISTRY.counter(
     "tdapi_trace_spans_total",
     "spans recorded by every trace collector in this process")
+
+GATEWAY_LATENCY = REGISTRY.histogram(
+    "tdapi_gateway_request_duration_ms",
+    "gateway data-plane latency: admission wait + replica forward + "
+    "relay, per gateway (gateway.py)",
+    labels=("gateway",),
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+             10000))
+
+GATEWAY_SCALE_READY = REGISTRY.histogram(
+    "tdapi_gateway_scale_ready_ms",
+    "autoscale trigger -> new replica READY (serving /healthz): the "
+    "CoW-clone + warm-pool path this distribution prices against the "
+    "~1.9s cold start",
+    labels=("gateway",),
+    buckets=(25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000))

@@ -49,6 +49,15 @@ EVENT_OPS = frozenset({
     "workqueue.drop",
     # co-tenancy regulator (regulator.py)
     "regulator.preempt",
+    # inference gateway: router + autoscaler control loop (gateway.py)
+    "gateway.create",
+    "gateway.delete",
+    "gateway.scale_up",
+    "gateway.scale_down",
+    "gateway.replica_ready",
+    "gateway.replica_down",
+    "gateway.shed",
+    "gateway.wake",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.
@@ -110,4 +119,13 @@ METRIC_NAMES = frozenset({
     "tdapi_traces_retained",
     "tdapi_trace_spans_total",
     "tdapi_events_stream_clients",
+    # inference gateway (gateway.py + server/app.py collect callback)
+    "tdapi_gateway_request_duration_ms",
+    "tdapi_gateway_scale_ready_ms",
+    "tdapi_gateway_replicas",
+    "tdapi_gateway_queue_depth",
+    "tdapi_gateway_inflight",
+    "tdapi_gateway_requests_total",
+    "tdapi_gateway_shed_total",
+    "tdapi_gateway_scale_events_total",
 })

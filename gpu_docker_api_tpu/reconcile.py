@@ -79,10 +79,15 @@ CONSULTED_STEPS = frozenset({"created", "copied", "migrated"})
 
 #: steps recorded for observability only (sync=False journal slimming);
 #: replay never branches on them, but they are registered so the linter
-#: can tell "known informational" from "forgot to teach the reconciler"
+#: can tell "known informational" from "forgot to teach the reconciler".
+#: "cloned" (a gateway scale-up's donor-layer CoW clone) and the
+#: gateway.scale markers are informational by the same argument as
+#: "precopied": cloned bytes live in the new container's layer and die
+#: with it on unwind, so replay branches on the stored record alone.
 INFORMATIONAL_STEPS = frozenset({
     "granted", "persisted", "precopied", "quiesced", "stopped_old",
     "started_new", "removed_old", "stopped", "restored", "removed",
+    "cloned", "replica_started", "replica_stopped",
 })
 
 KNOWN_STEPS = CONSULTED_STEPS | INFORMATIONAL_STEPS
@@ -245,6 +250,8 @@ class Reconciler:
             "volume.create": self._replay_volume_create,
             "volume.scale": self._replay_volume_scale,
             "volume.delete": self._replay_volume_delete,
+            "gateway.scale": self._replay_gateway_scale,
+            "gateway.delete": self._replay_gateway_delete,
         }.get(rec.op)
         if handler is None:
             # an op nobody here can replay means a NEWER (or corrupt)
@@ -410,6 +417,43 @@ class Reconciler:
     def _replay_delete(self, rec: IntentRecord, report: dict) -> None:
         self._purge_container_state(rec.target, report)
         report["opsCompleted"].append(f"delete-completed:{rec.target}")
+
+    # ------------------------------------------- intent replay: gateways
+
+    def _replay_gateway_scale(self, rec: IntentRecord, report: dict) -> None:
+        """A gateway scale died mid-flight. The replica's own `run` /
+        `stop` intent (journaled by the inner mutation) settles the
+        replica's containers and grants; this record settles the
+        REQUEST's outcome for the idempotency sweep: the scale completed
+        exactly when the replica's stored record reflects the requested
+        direction. The gateway's replica roster itself is derived from
+        stored container records at boot (gateway.py adopt-by-name), so
+        there is no roster state to repair here."""
+        replica = rec.meta.get("replica", "")
+        stored = self._stored(replica) if replica else None
+        if rec.meta.get("direction") == "down":
+            done = stored is None or stored.resourcesReleased
+        else:
+            # up completed only if the replica HOLDS capacity: a crashed
+            # warm re-admission leaves its pre-existing record with
+            # resourcesReleased=True, which must read as unwound (the
+            # scale added nothing; a keyed retry re-executes)
+            done = stored is not None and not stored.resourcesReleased
+        outcome = "completed" if done else "unwound"
+        report["opsCompleted"].append(
+            f"gateway.scale-{outcome}:{rec.target}")
+
+    def _replay_gateway_delete(self, rec: IntentRecord, report: dict) -> None:
+        """Finish a half-done gateway delete: purge every replica
+        replicaSet the roster scan still finds (idempotent — already-
+        deleted replicas purge to nothing) and drop the gateway record."""
+        from .gateway import GATEWAYS, replica_names_for
+        for rname in replica_names_for(self.client, rec.target):
+            self._purge_container_state(rname, report)
+        if self.client.get(GATEWAYS, rec.target) is not None:
+            self.client.delete(GATEWAYS, rec.target)
+        report["opsCompleted"].append(
+            f"gateway.delete-completed:{rec.target}")
 
     # -------------------------------------------- intent replay: volumes
 

@@ -222,14 +222,19 @@ class TpuScheduler(Scheduler):
         return sum(self.shares.get(chip, {}).values())
 
     def apply_shares(self, quanta: int, owner: str,
-                     prefer: Optional[int] = None) -> int:
+                     prefer: Optional[int] = None,
+                     avoid: Optional[set] = None) -> int:
         """Grant `quanta` shares (quanta/SHARE_QUANTA of a chip) on ONE
         chip; returns the chip index. Placement is bin-packing: the
         already-most-shared chip with capacity wins (fills partial chips
         before splitting a fresh one — whole-chip placements keep the
         most contiguous free space), `prefer` (the lift-in-place chip on
-        a patch) beating everything when it still fits. Never a cordoned
-        or whole-granted chip; the per-chip ledger can never exceed
+        a patch) beating everything when it still fits. `avoid` is a SOFT
+        anti-affinity set — chips hosting sibling replicas of the same
+        gateway: spread across chips when capacity allows (one chip's
+        regulator must not serialize all of a gateway's replicas), fall
+        back to packing when it doesn't. Never a cordoned or
+        whole-granted chip; the per-chip ledger can never exceed
         SHARE_QUANTA. Raises TpuOversubscribedError when no chip fits."""
         if not 0 < quanta < SHARE_QUANTA:
             raise ValueError(f"share quanta must be 1..{SHARE_QUANTA - 1}, "
@@ -245,6 +250,9 @@ class TpuScheduler(Scheduler):
                     f"that much free share capacity "
                     f"({len(self.shares)} share-split, "
                     f"{len(self.cordoned)} cordoned)")
+            if avoid:
+                spread = [i for i in cands if i not in avoid]
+                cands = spread or cands      # soft: packing beats failing
             if prefer in cands:
                 chip = prefer
             else:

@@ -35,6 +35,7 @@ from ..intents import IntentJournal
 from ..obs import metrics as obs_metrics
 from ..obs.metrics import Registry
 from ..obs.trace import TraceCollector
+from ..gateway import GatewayConfig, GatewayManager
 from ..reconcile import Reconciler
 from .. import regulator
 from ..schedulers import (
@@ -185,7 +186,11 @@ class MutationGate:
 class _WrappingRouter:
     """Registration facade used by App._router(): every mutating method
     (POST/PATCH/DELETE) is wrapped with the admission gate + idempotency
-    middleware at add() time, so no mutating route can forget it."""
+    middleware at add() time, so no mutating route can forget it.
+    raw=True opts a route out — ONLY for data-plane traffic (the gateway
+    generate route): serving requests are not control mutations, must not
+    consume mutation-gate slots, and apply their own admission policy
+    (gateway.py queue bound + deadline)."""
 
     MUTATING = ("POST", "PATCH", "DELETE")
 
@@ -193,8 +198,9 @@ class _WrappingRouter:
         self._router = router
         self._app = app
 
-    def add(self, method: str, pattern: str, handler) -> None:
-        if method.upper() in self.MUTATING:
+    def add(self, method: str, pattern: str, handler,
+            raw: bool = False) -> None:
+        if not raw and method.upper() in self.MUTATING:
             handler = self._app._mutating(handler)
         self._router.add(method, pattern, handler)
 
@@ -340,13 +346,26 @@ class App:
         # their preempt events onto this App's event log and export their
         # counters at /metrics
         regulator.set_events(self.events)
+        # inference gateways (gateway.py): rebuilt from their stored
+        # records AFTER the reconciler settled half-done scale mutations —
+        # replica rosters are re-derived from stored container records
+        self.gateways = GatewayManager(self.replicasets, self.client,
+                                       self.intents, events=self.events,
+                                       traces=self.traces)
+        self.gateways.boot()
         # SSE follower count (tdapi_events_stream_clients) — mutated from
         # stream generator threads under this lock
         self._stream_lock = threading.Lock()
         self._stream_clients = 0
         self.metrics = self._build_registry()
-        self.server = ApiServer(self._router(), addr=addr, api_key=api_key,
-                                events=self.events, traces=self.traces)
+        self.server = ApiServer(
+            self._router(), addr=addr, api_key=api_key,
+            events=self.events, traces=self.traces,
+            # the serving data plane must not write one event row per
+            # request: at load it evicts the whole control-plane ring
+            # (scale/shed events included) and taxes every decode
+            quiet_routes=frozenset(
+                {("POST", "/api/v1/gateways/:name/generate")}))
 
     # ------------------------------------------------------------- routes
 
@@ -372,6 +391,16 @@ class App:
         r.add("DELETE", f"{v1}/volumes/:name", self.h_vol_delete)
         r.add("GET", f"{v1}/volumes/:name", self.h_vol_info)
         r.add("GET", f"{v1}/volumes/:name/history", self.h_vol_history)
+        r.add("POST", f"{v1}/gateways", self.h_gw_create)
+        r.add("GET", f"{v1}/gateways", self.h_gw_list)
+        r.add("GET", f"{v1}/gateways/:name", self.h_gw_info)
+        r.add("PATCH", f"{v1}/gateways/:name/scale", self.h_gw_scale)
+        r.add("DELETE", f"{v1}/gateways/:name", self.h_gw_delete)
+        # DATA PLANE: serving traffic, not a control mutation — bypasses
+        # the mutation gate + idempotency middleware (raw); the gateway
+        # applies its own admission policy (queue bound, deadline, shed)
+        r.add("POST", f"{v1}/gateways/:name/generate", self.h_gw_generate,
+              raw=True)
         r.add("GET", f"{v1}/events", self.h_events)
         r.add("GET", f"{v1}/traces", self.h_traces)
         r.add("GET", f"{v1}/traces/:traceId", self.h_trace)
@@ -682,6 +711,121 @@ class App:
         except Exception:  # noqa: BLE001
             log.exception("delete failed [%s]", req.request_id)
             return err(ResCode.ContainerDeleteFailed)
+
+    # ---------------------------------------------------- gateway handlers
+
+    def h_gw_create(self, req: Request) -> Response:
+        try:
+            cfg = GatewayConfig.from_json(req.json())
+            cfg.validate()
+        except (ValueError, TypeError) as e:
+            return err(ResCode.InvalidParams, str(e))
+        try:
+            return ok({"gateway": self.gateways.create(cfg)})
+        except xerrors.GatewayExistedError:
+            return err(ResCode.GatewayExisted)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.CpuNotEnoughError:
+            return err(ResCode.ContainerCpuNotEnough)
+        except xerrors.PortNotEnoughError:
+            return err(ResCode.ContainerPortNotEnough)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
+        except Exception:  # noqa: BLE001
+            log.exception("gateway create failed [%s]", req.request_id)
+            return err(ResCode.GatewayCreateFailed)
+
+    def h_gw_list(self, req: Request) -> Response:
+        return ok({"gateways": self.gateways.list()})
+
+    def h_gw_info(self, req: Request) -> Response:
+        try:
+            return ok({"gateway": self.gateways.get(
+                req.params["name"]).describe()})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.GatewayGetInfoFailed)
+
+    def h_gw_scale(self, req: Request) -> Response:
+        try:
+            n = int(req.json().get("replicas", -1))
+        except (TypeError, ValueError):
+            return err(ResCode.InvalidParams)
+        if n < 0:
+            return err(ResCode.InvalidParams,
+                       "replicas must be an integer >= 0")
+        try:
+            return ok({"gateway": self.gateways.scale_to(
+                req.params["name"], n)})
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.GatewayGetInfoFailed)
+        except xerrors.TpuOversubscribedError:
+            return err(ResCode.ContainerTpuOversubscribed)
+        except xerrors.TpuNotEnoughError:
+            return err(ResCode.ContainerTpuNotEnough)
+        except xerrors.CpuNotEnoughError:
+            return err(ResCode.ContainerCpuNotEnough)
+        except xerrors.PortNotEnoughError:
+            return err(ResCode.ContainerPortNotEnough)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
+        except Exception:  # noqa: BLE001
+            log.exception("gateway scale failed [%s]", req.request_id)
+            return err(ResCode.GatewayScaleFailed)
+
+    def h_gw_delete(self, req: Request) -> Response:
+        try:
+            self.gateways.delete(req.params["name"])
+            return ok()
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.GatewayGetInfoFailed)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
+        except Exception:  # noqa: BLE001
+            log.exception("gateway delete failed [%s]", req.request_id)
+            return err(ResCode.GatewayDeleteFailed)
+
+    def h_gw_generate(self, req: Request) -> Response:
+        """The serving data plane: route one generate request through the
+        gateway's continuous-batching router. The replica's envelope is
+        relayed verbatim (RawResponse); ?stream=1 relays it as a
+        close-delimited streamed body (StreamingResponse) instead of
+        buffering."""
+        try:
+            gw = self.gateways.get(req.params["name"])
+        except xerrors.NotExistInStoreError:
+            return err(ResCode.GatewayGetInfoFailed)
+        # strict-priority admission class (the gateway twin of the
+        # regulator's latency class): an SLO-bound caller stamps it and
+        # bypasses the best-effort burst queue
+        priority = req.header("X-TDAPI-Priority").strip().lower()
+        try:
+            if req.query_flag("stream"):
+                _status, chunks = gw.forward(req.body, stream=True,
+                                             priority=priority)
+                return StreamingResponse(chunks,
+                                         content_type="application/json")
+            _status, payload = gw.forward(req.body, priority=priority)
+            return RawResponse(payload)
+        except xerrors.GatewayShedError:
+            self.events.record("gateway.shed", target=req.params["name"],
+                               code=int(ResCode.TooManyRequests),
+                               reason="queue_full",
+                               request_id=req.request_id)
+            return too_many("gateway queue full")
+        except xerrors.GatewayDeadlineError as e:
+            self.events.record("gateway.shed", target=req.params["name"],
+                               code=int(ResCode.GatewayTimeout),
+                               reason="deadline",
+                               request_id=req.request_id)
+            return Response(ResCode.GatewayTimeout, None, msg=str(e),
+                            http_status=504,
+                            headers={"Retry-After": "1"})
+        except Exception:  # noqa: BLE001
+            log.exception("gateway generate failed [%s]", req.request_id)
+            return err(ResCode.GatewayRequestFailed)
 
     # ----------------------------------------------------- volume handlers
 
@@ -1030,6 +1174,23 @@ class App:
                            "(keep-slowest retention, obs/trace.py)")
         g_followers = m.gauge("tdapi_events_stream_clients",
                               "live SSE followers of /api/v1/events")
+        # inference gateways (gateway.py)
+        g_gw_rep = m.gauge("tdapi_gateway_replicas",
+                           "replica count per gateway and state",
+                           labels=("gateway", "state"))
+        g_gw_q = m.gauge("tdapi_gateway_queue_depth",
+                         "requests parked in the gateway admission queue",
+                         labels=("gateway",))
+        g_gw_in = m.gauge("tdapi_gateway_inflight", labels=("gateway",))
+        g_gw_req = m.gauge("tdapi_gateway_requests_total",
+                           labels=("gateway",), typ="counter")
+        g_gw_shed = m.gauge(
+            "tdapi_gateway_shed_total",
+            "gateway requests refused (queue bound or deadline)",
+            labels=("gateway",), typ="counter")
+        g_gw_scale = m.gauge("tdapi_gateway_scale_events_total",
+                             labels=("gateway", "direction"),
+                             typ="counter")
 
         def collect() -> None:
             tpu = self.tpu.get_status()
@@ -1096,6 +1257,24 @@ class App:
                 g_brk.set(breaker_gauge(brk["state"]))
                 g_brk_f.set(brk["consecutiveFailures"])
             g_traces.set(self.traces.stats()["retained"])
+            for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
+                      g_gw_scale):
+                g.reset()
+            for gw in self.gateways.snapshot():
+                name = gw["name"]
+                by_state: dict[str, int] = {}
+                for r in gw["replicas"]:
+                    by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+                for state, count in by_state.items():
+                    g_gw_rep.set(count, gateway=name, state=state)
+                g_gw_q.set(gw["queueDepth"], gateway=name)
+                g_gw_in.set(gw["inflight"], gateway=name)
+                g_gw_req.set(gw["requestsTotal"], gateway=name)
+                g_gw_shed.set(gw["shedTotal"], gateway=name)
+                g_gw_scale.set(gw["scaleUps"], gateway=name,
+                               direction="up")
+                g_gw_scale.set(gw["scaleDowns"], gateway=name,
+                               direction="down")
             with self._stream_lock:
                 g_followers.set(self._stream_clients)
 
@@ -1182,6 +1361,7 @@ class App:
         """Graceful shutdown: drain queue, flush all state (reference Stop,
         main.go:139-154)."""
         self.server.stop()
+        self.gateways.stop_all()   # autoscaler loops, before services go
         self.health.stop()
         if self._maint_stop is not None:
             # join, don't just signal: an in-flight maintain() racing past

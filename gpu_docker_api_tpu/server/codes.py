@@ -54,6 +54,17 @@ class ResCode(enum.IntEnum):
     ContainerMemorySizeNotSupported = 1025
     ContainerTpuOversubscribed = 1026
 
+    # inference gateway (1030-1039). GatewayTimeout also changes the HTTP
+    # status (504): a data-plane deadline miss must be visible to load
+    # balancers without envelope parsing, like 503/412/429 above.
+    GatewayTimeout = 504
+    GatewayExisted = 1030
+    GatewayGetInfoFailed = 1031
+    GatewayCreateFailed = 1032
+    GatewayScaleFailed = 1033
+    GatewayDeleteFailed = 1034
+    GatewayRequestFailed = 1035
+
     VolumeCreateFailed = 1100
     VolumeNameCannotBeEmpty = 1101
     VolumeDeleteFailed = 1102
@@ -127,6 +138,18 @@ _MESSAGES: dict[ResCode, str] = {
     ResCode.ContainerTpuOversubscribed:
         "No chip has enough free share capacity for this fractional TPU "
         "request — retry after a co-tenant releases, or request fewer shares",
+
+    ResCode.GatewayTimeout:
+        "Gateway request deadline exceeded before a replica could serve "
+        "it — the autoscaler is adding capacity; retry",
+    ResCode.GatewayExisted: "Gateway already exists",
+    ResCode.GatewayGetInfoFailed:
+        "Failed to get gateway info, gateway not found",
+    ResCode.GatewayCreateFailed: "Failed to create gateway",
+    ResCode.GatewayScaleFailed: "Failed to scale gateway",
+    ResCode.GatewayDeleteFailed: "Failed to delete gateway",
+    ResCode.GatewayRequestFailed:
+        "Gateway could not serve the request (no replica answered)",
 
     ResCode.VolumeCreateFailed: "Failed to create volume",
     ResCode.VolumeNameCannotBeEmpty: "Volume name cannot be empty",

@@ -216,9 +216,18 @@ class _KeepAliveHTTPServer(ThreadingHTTPServer):
 
 class ApiServer:
     def __init__(self, router: Router, addr: str = "127.0.0.1:2378",
-                 api_key: Optional[str] = None, events=None, traces=None):
+                 api_key: Optional[str] = None, events=None, traces=None,
+                 quiet_routes: Optional[frozenset] = None):
         self.router = router
         self.events = events
+        # (METHOD, route pattern) pairs whose requests do NOT land an
+        # event-log row each: DATA-PLANE routes (gateway generate). At
+        # serving rates a per-request row floods the bounded ring —
+        # evicting the control-plane events an operator actually greps —
+        # and json-encoding the row is measurable against a single decode
+        # step. Latency still lands in the route-labeled histogram, and
+        # failures still trace.
+        self.quiet_routes = quiet_routes or frozenset()
         # TraceCollector (obs/trace.py): when set, every request runs under
         # an ingress root span honoring the client's W3C traceparent
         self.traces = traces
@@ -298,7 +307,8 @@ class ApiServer:
         if trace_id and int(resp.code) != 200 \
                 and not isinstance(resp, RawResponse):
             resp.trace_id = trace_id
-        if self.events is not None:
+        if self.events is not None \
+                and (method, route) not in self.quiet_routes:
             extra = {"traceId": trace_id} if trace_id else {}
             self.events.record(
                 op=f"{method} {parsed.path}",

@@ -192,8 +192,21 @@ class ReplicaSetService:
     # ------------------------------------------------------------------ run
 
     @trace.traced("svc.run", "req.replicaSetName")
-    def run_container(self, req: ContainerRun) -> dict:
-        """POST /replicaSet (reference RunGpuContainer, replicaset.go:45-155)."""
+    def run_container(self, req: ContainerRun, clone_from: str = "",
+                      share_avoid: Optional[set] = None,
+                      idem_partial: bool = False) -> dict:
+        """POST /replicaSet (reference RunGpuContainer, replicaset.go:45-155).
+
+        clone_from: donor CONTAINER whose writable layer is CoW-cloned
+        into the new container between create and start (gateway.py's
+        autoscale fast path: the donor already paid model load / compile;
+        the clone rides utils/copyfast's reflink ladder, so the new
+        replica starts warm in ~milliseconds instead of re-initializing).
+        Best-effort — a failed clone logs and cold-starts. share_avoid is
+        the fractional placement's soft anti-affinity set (chips hosting
+        sibling replicas). idem_partial marks this run as ONE piece of a
+        larger keyed request (a gateway scale), so its intent completing
+        never finalizes the request's idempotency record."""
         name = req.replicaSetName
         with self._mutex(name):
             if self.versions.exist(name) or self.backend.list_names(name + "-"):
@@ -210,14 +223,16 @@ class ReplicaSetService:
                 spec.memory_bytes = to_bytes(req.memory)
 
             whole, quanta = parse_tpu_count(req.tpuCount)
-            intent = self.intents.begin("run", name)
+            meta = {"idemPartial": True} if idem_partial else {}
+            intent = self.intents.begin("run", name, **meta)
             try:
                 if quanta:
                     # fractional grant: `quanta`/SHARE_QUANTA of one chip —
                     # the chip is shared with co-tenants; the serving-path
                     # regulator time-slices it by this weight
                     self._grant_tpus(spec,
-                                     [self.tpu.apply_shares(quanta, name)],
+                                     [self.tpu.apply_shares(
+                                         quanta, name, avoid=share_avoid)],
                                      shares=quanta)
                 elif whole > 0:
                     self._grant_tpus(spec, self.tpu.apply(whole, name))
@@ -228,7 +243,8 @@ class ReplicaSetService:
                             cpuset=spec.cpuset)
                 crashpoint("run.after_grant")
                 info = self._create_and_start(name, spec, req.containerPorts,
-                                              intent=intent, cp="run")
+                                              intent=intent, cp="run",
+                                              clone_from=clone_from)
             except Exception:
                 # resource rollback on any failure (reference :103-124);
                 # owner-checked so over-release is impossible. The unwind
@@ -286,11 +302,17 @@ class ReplicaSetService:
                           container_ports: list[str],
                           start: bool = True,
                           intent: Optional[Intent] = None,
-                          cp: str = "") -> StoredContainerInfo:
+                          cp: str = "",
+                          clone_from: str = "") -> StoredContainerInfo:
         """The runContainer core (reference replicaset_nomock.go:25-114):
-        version bump -> port grant -> create -> start -> persist. `cp`
-        namespaces the step-boundary crashpoints (run path only; the
-        replace path places its own around this call)."""
+        version bump -> port grant -> create -> [clone donor layer] ->
+        start -> persist. `cp` namespaces the step-boundary crashpoints
+        (run path only; the replace path places its own around this
+        call). clone_from CoW-clones a donor container's writable layer
+        into the fresh one before start (the gateway autoscale path) —
+        best-effort: the cloned bytes are a warm-start accelerant, not
+        state the control plane depends on, and they die with the
+        container on any unwind exactly like pre-copied replace bytes."""
         version = self.versions.bump(name)
         ctr_name = f"{name}-{version}"
         port_grant: list[int] = []
@@ -319,6 +341,20 @@ class ReplicaSetService:
                 intent.step("created", container=ctr_name, version=version)
             if cp:
                 crashpoint(f"{cp}.after_create")
+            if clone_from:
+                try:
+                    from ..backend.base import copy_container_layer
+                    stats = copy_container_layer(self.backend, clone_from,
+                                                 ctr_name)
+                except Exception:  # noqa: BLE001 — warm start is optional
+                    log.exception("cloning %s layer into %s; starting cold",
+                                  clone_from, ctr_name)
+                    stats = None
+                if intent is not None:
+                    intent.step("cloned", sync=False, source=clone_from,
+                                bytes=stats.bytes if stats else 0,
+                                mode=stats.mode if stats else "none")
+                crashpoint("gwscale.after_clone")
             if start:
                 self.backend.start(ctr_name)
                 if cp:
@@ -372,6 +408,11 @@ class ReplicaSetService:
             xerrors.PreconditionFailedError.check(name, old.version, if_match)
             new_spec = ContainerSpec.from_json(old.spec.to_json())
             changed = False
+            # whether THIS patch took a fresh share grant — the release
+            # decisions below must not infer it from spec (in)equality: a
+            # fresh grant can legitimately land on the same chip with the
+            # same quanta (see _rolling_replace)
+            took_fresh = {"shares": False}
             intent = self.intents.begin(
                 "replace", name, via="patch", oldVersion=old.version,
                 oldContainer=old.containerName,
@@ -379,7 +420,8 @@ class ReplicaSetService:
             try:
                 if req.tpuPatch is not None:
                     changed |= self._patch_tpu(name, new_spec, old,
-                                               req.tpuPatch.tpuCount)
+                                               req.tpuPatch.tpuCount,
+                                               took_fresh=took_fresh)
                 if req.cpuPatch is not None:
                     changed |= self._patch_cpu(name, new_spec, old,
                                                req.cpuPatch.cpuCount)
@@ -389,16 +431,20 @@ class ReplicaSetService:
                     changed |= self._patch_volume(new_spec, req.volumePatch)
                 if not changed:
                     raise xerrors.NoPatchRequiredError(name)
-                info = self._rolling_replace(name, old, new_spec, intent)
+                info = self._rolling_replace(
+                    name, old, new_spec, intent,
+                    fresh_shares=took_fresh["shares"])
             except Exception:
-                self._free_new_grants(name, new_spec, old.spec)
+                self._free_new_grants(name, new_spec, old.spec,
+                                      fresh_shares=took_fresh["shares"])
                 intent.done()
                 raise
             intent.done(committed=True)
             return self._run_response(info)
 
     def _patch_tpu(self, name: str, spec: ContainerSpec,
-                   old: StoredContainerInfo, count: float) -> bool:
+                   old: StoredContainerInfo, count: float,
+                   took_fresh: Optional[dict] = None) -> bool:
         """Re-grant chips when the count changes (reference patchGpu
         :448-495) — in place: a whole-chip old grant is offered for
         reuse, never released to the pool mid-patch. Fractional targets
@@ -406,7 +452,9 @@ class ReplicaSetService:
         an unchanged-chip resize stays put when capacity allows); the
         old holding is released only after the replace commits, and the
         ledger sums both during the window — capacity-checked, so the
-        transition can never oversubscribe a co-tenant."""
+        transition can never oversubscribe a co-tenant. took_fresh (when
+        given) records that a fresh share grant now exists — the release
+        paths key on it instead of comparing specs."""
         whole, quanta = parse_tpu_count(count)
         if count == self._spec_tpu_count(old.spec):
             return False
@@ -415,6 +463,8 @@ class ReplicaSetService:
                       if old.spec.tpu_shares and old.spec.tpu_chips else None)
             self._grant_tpus(spec, [self.tpu.apply_shares(
                 quanta, name, prefer=prefer)], shares=quanta)
+            if took_fresh is not None:
+                took_fresh["shares"] = True
             return True
         reuse = (list(old.spec.tpu_chips)
                  if not old.resourcesReleased and not old.spec.tpu_shares
@@ -453,18 +503,23 @@ class ReplicaSetService:
         return True
 
     def _free_new_grants(self, name: str, new_spec: ContainerSpec,
-                         old_spec: ContainerSpec) -> None:
+                         old_spec: ContainerSpec,
+                         fresh_shares: bool = False) -> None:
         """Failed mutation: free only the grants that are NEW in new_spec.
         The old container's grants were never released (in-place reuse), so
         there is nothing to re-mark — and owner checks make this safe even
         if this unwind itself races."""
         if new_spec.tpu_shares:
-            # a share grant is new only when it differs from the old
-            # holding — a spec merely COPIED from a fractional old (e.g. a
-            # failed memory patch) carries the same chip+quanta and took
-            # no fresh grant, so releasing it would free live capacity
-            if (new_spec.tpu_shares != old_spec.tpu_shares
-                    or new_spec.tpu_chips != old_spec.tpu_chips):
+            # a share grant is released only when the caller actually TOOK
+            # a fresh one (fresh_shares) — a spec merely COPIED from a
+            # fractional old (e.g. a failed memory patch) carries the same
+            # chip+quanta without a grant behind it, so releasing it would
+            # free live capacity. Spec comparison cannot tell the two
+            # apart: a fresh grant may legitimately land on the same chip
+            # with the same quanta (a drain racing an uncordon), and the
+            # ledger then holds old+new — restore_shares' exact-quanta
+            # release frees only the new half.
+            if fresh_shares and new_spec.tpu_chips:
                 self.tpu.restore_shares(new_spec.tpu_chips[0],
                                         new_spec.tpu_shares, name)
         else:
@@ -479,7 +534,8 @@ class ReplicaSetService:
     def _rolling_replace(self, name: str, old: StoredContainerInfo,
                          new_spec: ContainerSpec,
                          intent: Optional[Intent] = None,
-                         meta_out: Optional[dict] = None) -> StoredContainerInfo:
+                         meta_out: Optional[dict] = None,
+                         fresh_shares: bool = False) -> StoredContainerInfo:
         """create new version -> pre-copy writable layer (old still
         running) -> QUIESCE the workload (checkpoint-now, bounded) -> stop
         old (chip exclusivity) -> delta-copy dirtied files (now including
@@ -650,8 +706,13 @@ class ReplicaSetService:
                 # the new version carried the identical holding over
                 # untouched (e.g. a memory patch copied the spec; no fresh
                 # share grant exists, so a release here would free live
-                # capacity under the new container)
-                if (new_spec.tpu_shares != old.spec.tpu_shares
+                # capacity under the new container). fresh_shares is the
+                # caller's explicit word that a fresh grant DOES back the
+                # new spec — spec equality cannot stand in for it: a drain
+                # whose re-grant lands on the same chip with the same
+                # quanta (the cordon raced an uncordon) would read as
+                # "identical carryover" and leak the old holding forever.
+                if (fresh_shares or not new_spec.tpu_shares
                         or new_spec.tpu_chips != old.spec.tpu_chips):
                     self.tpu.restore_shares(old.spec.tpu_chips[0],
                                             old.spec.tpu_shares, name)
@@ -716,16 +777,21 @@ class ReplicaSetService:
                 "replace", name, via="rollback", oldVersion=old.version,
                 oldContainer=old.containerName, targetVersion=version,
                 oldReleased=old.resourcesReleased)
+            took_fresh = {"shares": False}
             try:
                 self._patch_tpu(name, target_spec, old,
-                                self._spec_tpu_count(hist.spec))
+                                self._spec_tpu_count(hist.spec),
+                                took_fresh=took_fresh)
                 self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
                 intent.step("granted", sync=False, tpuChips=target_spec.tpu_chips,
                             cpuset=target_spec.cpuset)
                 crashpoint("rollback.after_grant")
-                info = self._rolling_replace(name, old, target_spec, intent)
+                info = self._rolling_replace(
+                    name, old, target_spec, intent,
+                    fresh_shares=took_fresh["shares"])
             except Exception:
-                self._free_new_grants(name, target_spec, old.spec)
+                self._free_new_grants(name, target_spec, old.spec,
+                                      fresh_shares=took_fresh["shares"])
                 intent.done()
                 raise
             intent.done(committed=True)
@@ -783,32 +849,43 @@ class ReplicaSetService:
                     oldContainer=old.containerName,
                     oldReleased=old.resourcesReleased, idemPartial=True)
                 migration_meta: dict = {}
+                fresh = False
                 try:
                     if old.spec.tpu_shares:
                         # fractional co-tenant on a cordoned chip: fresh
                         # share grant (apply_shares excludes cordoned
                         # chips); its exact old quanta release when the
                         # replace commits — zero leaked shares per
-                        # migrated co-tenant
+                        # migrated co-tenant. The grant is fresh even if
+                        # it lands back on the SAME chip (this drain's
+                        # cordon snapshot may have raced an uncordon) —
+                        # fresh_shares tells the release paths so. Set
+                        # AFTER apply_shares: a failed grant must leave
+                        # fresh False, or the unwind would release the
+                        # live old holding the copied spec still names.
                         self._grant_tpus(new_spec, [self.tpu.apply_shares(
                             old.spec.tpu_shares, name)],
                             shares=old.spec.tpu_shares)
+                        fresh = True
                     else:
                         self._grant_tpus(new_spec, self.tpu.apply(
                             len(old.spec.tpu_chips), name,
                             reuse=list(old.spec.tpu_chips)))
                     intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
                     info = self._rolling_replace(name, old, new_spec, intent,
-                                                 meta_out=migration_meta)
+                                                 meta_out=migration_meta,
+                                                 fresh_shares=fresh)
                 except xerrors.BackendUnavailableError:
                     # breaker open: the WHOLE substrate is refusing — abort
                     # the drain (503 to the caller) instead of logging one
                     # doomed migration per replicaSet
-                    self._free_new_grants(name, new_spec, old.spec)
+                    self._free_new_grants(name, new_spec, old.spec,
+                                          fresh_shares=fresh)
                     intent.done()
                     raise
                 except Exception as e:  # noqa: BLE001 — drain the rest
-                    self._free_new_grants(name, new_spec, old.spec)
+                    self._free_new_grants(name, new_spec, old.spec,
+                                          fresh_shares=fresh)
                     intent.done()
                     log.exception("drain: migrating %s failed", name)
                     result["failed"][name] = str(e)
@@ -881,8 +958,15 @@ class ReplicaSetService:
                 if old.resourcesReleased:
                     # stopped: grants were returned at stop; re-apply counts
                     if old.spec.tpu_shares:
+                        # fresh_shares is set only once the grant EXISTS:
+                        # apply_shares raising (capacity gone since the
+                        # stop) must leave the unwind below with nothing
+                        # to free — keying it on the requested quanta made
+                        # the handler index an empty fresh_tpu (the stress
+                        # sweep's worker IndexError)
+                        fresh_tpu = [self.tpu.apply_shares(
+                            old.spec.tpu_shares, name)]
                         fresh_shares = old.spec.tpu_shares
-                        fresh_tpu = [self.tpu.apply_shares(fresh_shares, name)]
                         self._grant_tpus(new_spec, fresh_tpu,
                                          shares=fresh_shares)
                     elif old.spec.tpu_chips:
@@ -900,7 +984,7 @@ class ReplicaSetService:
                 info = self._rolling_replace(name, old, new_spec, intent)
             except Exception:
                 # free only what THIS restart freshly applied
-                if fresh_shares:
+                if fresh_shares and fresh_tpu:
                     self.tpu.restore_shares(fresh_tpu[0], fresh_shares, name)
                 else:
                     self.tpu.restore(fresh_tpu, name)

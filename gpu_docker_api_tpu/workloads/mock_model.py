@@ -1,0 +1,198 @@
+"""Mock model replica — the serving contract without the accelerator.
+
+Speaks exactly the serving workload's HTTP surface (workloads/serve.py:
+`GET /healthz` with the `batching` block, `POST /generate` with the
+token-level envelope, the `X-TDAPI-*` admission headers), but the "model"
+is a slot-bounded hold of --decode-ms per request instead of a jitted
+decode loop. Exists so the GATEWAY control loop — routing, admission,
+shedding, autoscale, clone-warm starts — can be exercised and priced
+end-to-end over real processes and real HTTP without paying `import jax`
+per replica (stdlib only: the warm pool absorbs the interpreter, and the
+bench's router-overhead number prices the gateway, not the kernels).
+
+Warm-start contract (the CoW-clone story, bench + e2e): startup costs
+--init-ms once — simulating model load + first compile — then writes
+--warm-mb of "weights" plus a `.model_ready` marker into the writable
+layer. A replica whose layer was CLONED from a warm donor (gateway
+scale-up) finds the marker and skips the init cost entirely: ready in
+milliseconds, the same economics as a real replica inheriting its
+donor's checkpoint/compile cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+READY_MARKER = ".model_ready"
+
+
+def launch_cmd(repo_root: str, *args: str) -> list:
+    """Container cmd that launches this module from `repo_root` on any
+    cwd (the process substrate chdirs into the container rootfs before
+    exec, so a bare `-m` lookup would miss the repo). The `-c` form is
+    warm-pool-eligible and needs no PYTHON* env (which would force a
+    cold spawn — backend/warmpool.py supports())."""
+    import sys
+    code = (f"import sys; sys.path.insert(0, {repo_root!r}); "
+            "from gpu_docker_api_tpu.workloads.mock_model import main; "
+            f"raise SystemExit(main({list(args)!r}))")
+    return [sys.executable, "-u", "-c", code]
+
+
+class _State:
+    def __init__(self, slots: int, decode_ms: float, admit_queue: int):
+        self.slots = slots
+        self.decode_ms = decode_ms
+        self.admit_queue = admit_queue
+        self.lock = threading.Lock()
+        self.slot_sem = threading.Semaphore(slots)
+        self.active = 0
+        self.queued = 0
+        self.served = 0
+        self.shed = 0
+
+
+def _handler_for(st: _State, model: str):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # headers and body flush as separate segments; Nagle would hold
+        # the second until the gateway ACKs — per-request tens of ms
+        disable_nagle_algorithm = True
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, msg: str, data, status: int = 200,
+                  extra: dict | None = None):
+            payload = json.dumps(
+                {"code": code, "msg": msg, "data": data}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            with st.lock:
+                self.send_header("X-TDAPI-Slots", str(st.slots))
+                self.send_header("X-TDAPI-Active", str(st.active))
+                self.send_header("X-TDAPI-Queued", str(st.queued))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                self._send(404, "route not found", None)
+                return
+            with st.lock:
+                batching = {
+                    "slots": st.slots, "active": st.active,
+                    "queued": st.queued, "alive": True,
+                    "served": st.served, "shed": st.shed,
+                }
+            self._send(200, "Success", {
+                "model": model, "params": 0,
+                "batching": batching,
+            })
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, "route not found", None)
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                tokens = body["tokens"]
+                max_new = int(body.get("max_new", 16))
+                if max_new < 1:
+                    raise ValueError("max_new must be >= 1")
+            except (KeyError, TypeError, ValueError) as e:
+                self._send(400, f"bad request: {e}", None)
+                return
+            # replica-side admission: shed past the queue bound so the
+            # gateway re-routes instead of stacking waiters here
+            with st.lock:
+                if st.queued >= st.admit_queue:
+                    st.shed += 1
+                    do_shed = True
+                else:
+                    st.queued += 1
+                    do_shed = False
+            if do_shed:
+                self._send(429, "replica queue full", None,
+                           extra={"Retry-After": "1",
+                                  "X-TDAPI-Shed": "1"})
+                return
+            st.slot_sem.acquire()
+            with st.lock:
+                st.queued -= 1
+                st.active += 1
+            try:
+                # the "decode": hold a slot for decode_ms * ceil(tokens)
+                time.sleep(st.decode_ms / 1e3)
+                out = [list(row) + list(range(max_new)) for row in tokens]
+            finally:
+                with st.lock:
+                    st.active -= 1
+                    st.served += 1
+                st.slot_sem.release()
+            self._send(200, "Success", {"tokens": out})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = $PORT from the process substrate, else 8000")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent in-flight requests (the batcher slots "
+                        "the gateway admits against)")
+    p.add_argument("--decode-ms", type=float, default=5.0,
+                   help="per-request slot hold time (the simulated decode)")
+    p.add_argument("--admit-queue", type=int, default=32,
+                   help="replica-side queue bound; past it /generate sheds "
+                        "429 so the gateway re-routes")
+    p.add_argument("--init-ms", type=float, default=0.0,
+                   help="one-time startup cost (simulated model load + "
+                        "compile) — SKIPPED when the writable layer "
+                        "already holds the warm marker (a CoW clone from "
+                        "a warm donor)")
+    p.add_argument("--warm-mb", type=int, default=0,
+                   help="'weights' bytes written at init (what the clone "
+                        "actually moves)")
+    args = p.parse_args(argv)
+    port = args.port or int(os.environ.get("PORT", "8000"))
+
+    warm = os.path.exists(READY_MARKER)
+    if not warm:
+        if args.init_ms > 0:
+            time.sleep(args.init_ms / 1e3)
+        if args.warm_mb > 0:
+            with open("model.weights", "wb") as f:
+                f.write(os.urandom(1024) * args.warm_mb * 1024)
+        with open(READY_MARKER, "w") as f:
+            f.write(json.dumps({"initMs": args.init_ms}))
+    print(f"mock model {'WARM (cloned layer)' if warm else 'cold init'} — "
+          f"{args.slots} slots, {args.decode_ms}ms decode", flush=True)
+
+    st = _State(args.slots, args.decode_ms, args.admit_queue)
+    httpd = ThreadingHTTPServer((args.host, port),
+                                _handler_for(st, "mock"))
+    print(f"mock model serving on {args.host}:{httpd.server_address[1]}",
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1251,19 +1251,36 @@ class _Server:
         return jax.device_get(out).tolist()
 
 
-def _handler_for(srv: _Server, model_name: str):
+def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # keep-alive envelope responses flush headers and body as two
+        # segments; a fronting gateway pays Nagle + delayed-ACK per
+        # request without this (same setting as the control plane's
+        # server/http.py)
+        disable_nagle_algorithm = True
 
         def log_message(self, *a):
             pass
 
-        def _send(self, code: int, msg: str, data):
+        def _send(self, code: int, msg: str, data,
+                  extra: "dict | None" = None):
             payload = json.dumps(
                 {"code": code, "msg": msg, "data": data}).encode()
             self.send_response(200)     # control-plane envelope style
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            # replica-side admission surface: a fronting gateway reads
+            # the batcher's slot/queue state off EVERY response instead
+            # of polling /healthz between requests (admit-on-slot-free)
+            b = srv.batcher
+            if b is not None:
+                self.send_header("X-TDAPI-Slots", str(len(b.slots)))
+                self.send_header("X-TDAPI-Active",
+                                 str(sum(s is not None for s in b.slots)))
+                self.send_header("X-TDAPI-Queued", str(b.queued))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
 
@@ -1313,6 +1330,16 @@ def _handler_for(srv: _Server, model_name: str):
         def do_POST(self):
             if self.path != "/generate":
                 self._send(404, "route not found", None)
+                return
+            # --admit-queue: shed BEFORE submitting once the batcher's
+            # wait line is past the bound — the 429 (+ X-TDAPI-Shed) tells
+            # a fronting gateway to route elsewhere / back off, instead of
+            # parking one more waiter on a saturated replica
+            b = srv.batcher
+            if (admit_queue > 0 and b is not None
+                    and b.queued >= admit_queue):
+                self._send(429, "replica queue full", None,
+                           extra={"Retry-After": "1", "X-TDAPI-Shed": "1"})
                 return
             try:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -1704,6 +1731,11 @@ def main(argv=None) -> int:
                         "cache over tp on the kv-head axis instead of "
                         "replicating it — per-rank cache HBM drops by "
                         "tp (requires n_kv_heads %% tp == 0)")
+    p.add_argument("--admit-queue", type=int, default=0,
+                   help="replica-side admission bound: /generate sheds "
+                        "429 (+ X-TDAPI-Shed) once the batcher's queue "
+                        "is this deep, so a fronting gateway re-routes "
+                        "instead of stacking waiters (0 = never shed)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0,
                    help="0 = the control plane's granted port ($PORT from "
@@ -1865,7 +1897,8 @@ def main(argv=None) -> int:
 
     name = f"{args.family}/{args.config}"
     httpd = ThreadingHTTPServer((args.host, args.port),
-                                _handler_for(srv, name))
+                                _handler_for(srv, name,
+                                             admit_queue=args.admit_queue))
     print(f"serving {name} ({srv.n_params:,} params) on "
           f"{args.host}:{httpd.server_address[1]}", flush=True)
     try:

@@ -58,6 +58,29 @@ class NoRollbackRequiredError(XError):
     sentinel = "no rollback required"
 
 
+# --- gateway errors (inference gateway, no reference counterpart) ---
+
+class GatewayExistedError(XError):
+    sentinel = "gateway already existed"
+
+
+class GatewayShedError(XError):
+    """The gateway's bounded admission queue is full: the request is
+    refused BEFORE it waits (early shedding, same philosophy as the
+    mutation gate) — routes map it to 429 + Retry-After."""
+
+    sentinel = "gateway admission queue full"
+
+
+class GatewayDeadlineError(XError):
+    """A gateway data-plane request overran its per-request deadline
+    before a replica could serve it (every ready replica stayed saturated
+    for the whole wait). Routes map it to HTTP 504; the autoscaler sees
+    the same pressure and scales up, so a retry lands on new capacity."""
+
+    sentinel = "gateway request deadline exceeded"
+
+
 # --- volume errors (reference internal/xerrors/volume.go) ---
 
 class VolumeExistedError(XError):

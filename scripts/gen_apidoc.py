@@ -98,6 +98,11 @@ def generate(spec: dict) -> str:
         "with `APIKEY`, send `Authorization: Bearer <key>` "
         "(403 envelope otherwise).",
         "",
+        "The `gateway` operations are the inference serving tier "
+        "(router + CoW-clone autoscaler) — the model, routing/shedding "
+        "policy, autoscale knobs and bench methodology live in "
+        "[serving.md](serving.md).",
+        "",
     ]
     # group operations by tag
     by_tag: dict[str, list] = {}

@@ -155,6 +155,16 @@ CHIP_PARAM = {"name": "id", "in": "path", "required": True,
               "schema": {"type": "integer", "minimum": 0},
               "description": "Global chip index (see /resources/tpus)"}
 
+GW_PARAM = {"name": "name", "in": "path", "required": True,
+            "schema": {"type": "string"},
+            "description": "Gateway name (no '-'; replicas are "
+                           "replicaSets named {name}r{idx})"}
+
+#: data-plane operations: NOT wrapped by the mutation gate / idempotency
+#: middleware server-side, so the exactly-once surface must not be
+#: documented on them (their 429 is the GATEWAY's own admission shed)
+DATA_PLANE_OPS = {"gatewayGenerate"}
+
 # Attached to EVERY operation (post-processing in build_spec): W3C Trace
 # Context ingress (obs/trace.py; the shipped client stamps one per call)
 TRACEPARENT_PARAM = {
@@ -550,6 +560,87 @@ def build_spec() -> dict:
                                 "drain proceeds")},
             desc="POST /tpus/drain payload (services/replicaset.py "
                  "drain_cordoned)"),
+        "GatewayCreate": obj(
+            {"name": s("Gateway name (required; no '-')"),
+             "image": s("Replica image (required)"),
+             "cmd": arr(s(), "Replica command — must serve the workload "
+                             "HTTP contract (POST /generate, GET "
+                             "/healthz with a `batching` block; "
+                             "workloads/serve.py or mock_model.py)"),
+             "env": arr(s()),
+             "tpuCount": {
+                 "type": "number", "minimum": 0, "multipleOf": 0.25,
+                 "description": "Per-replica chips; a fraction (0.25/0.5/"
+                                "0.75) multiplexes several models per "
+                                "chip through the share ledger + "
+                                "regulator, with one gateway's replicas "
+                                "spread across chips (soft "
+                                "anti-affinity)"},
+             "cpuCount": i(), "memory": s(),
+             "priority": s("Regulator class for fractional replicas: "
+                           "'' | latency | best_effort"),
+             "port": s("containerPort the replica serves on "
+                       "(default 8000; a host port is granted per "
+                       "replica)"),
+             "minReplicas": i("Floor; 0 enables scale-to-zero "
+                              "(default 1)"),
+             "maxReplicas": i("Ceiling the autoscaler may reach "
+                              "(default 4)"),
+             "sloMs": {"type": "number",
+                       "description": "p99 target the autoscaler "
+                                      "defends (default 1000)"},
+             "deadlineMs": {"type": "number",
+                            "description": "Per-request deadline at the "
+                                           "gateway (default 10000)"},
+             "maxQueue": i("Admission queue bound — past it requests "
+                           "shed 429 immediately (default 64)"),
+             "scaleUpQueue": i("Queued-per-ready-replica that triggers "
+                               "scale-up (default 4)"),
+             "scaleDownIdleS": {"type": "number",
+                                "description": "Idle seconds before "
+                                               "scaling down (default "
+                                               "60)"},
+             "slots": i("Assumed per-replica batcher slots until the "
+                        "replica's /healthz advertises them (default 4)"),
+             "readiness": s("http (poll replica /healthz; default) | "
+                            "running (trust substrate run state)")},
+            required=["name", "image"],
+            desc="POST /api/v1/gateways body (gateway.GatewayConfig)"),
+        "GatewayReplica": obj(
+            {"name": s("Replica replicaSet name ({gateway}r{idx})"),
+             "container": s("Current versioned container"),
+             "hostPort": i(), "state": s("starting | ready | stopping | "
+                                         "stopped | failed"),
+             "slots": i("Batcher slots the gateway admits against"),
+             "inflight": i(), "chips": arr(i()), "failures": i()}),
+        "GatewayStatus": obj(
+            {"name": s(), "config": ref("GatewayCreate"),
+             "replicas": arr(ref("GatewayReplica")),
+             "readyReplicas": i(), "queueDepth": i(), "inflight": i(),
+             "p99Ms": {"type": "number", "nullable": True,
+                       "description": "Rolling 30s p99 (the autoscaler's "
+                                      "SLO signal); null before traffic"},
+             "requestsTotal": i(), "shedTotal": i(),
+             "scaleUps": i(), "scaleDowns": i(),
+             "lastScaleReadyMs": {
+                 "type": "number", "nullable": True,
+                 "description": "Last scale trigger -> replica READY "
+                                "latency (the CoW-clone fast path vs "
+                                "~1.9s cold start)"}},
+            desc="Live gateway status (gateway.Gateway.describe)"),
+        "GatewayScale": obj({"replicas": i("Target live replicas "
+                                           "(0..maxReplicas)")},
+                            required=["replicas"]),
+        "GenerateRequest": obj(
+            {"tokens": arr(arr(i()), "Prompt token ids [batch, len]"),
+             "max_new": i("Tokens to generate (default 16)"),
+             "temperature": {"type": "number"},
+             "top_k": i(), "top_p": {"type": "number"}},
+            required=["tokens"],
+            desc="The serving workload's /generate body, relayed "
+                 "verbatim to a replica"),
+        "GenerateResponse": obj(
+            {"tokens": arr(arr(i()), "Generated streams [batch, len]")}),
         "ReconcileReport": obj(
             {"intentsReplayed": arr(s("kind:target:op")),
              "opsCompleted": arr(s()),
@@ -825,6 +916,79 @@ def build_spec() -> dict:
                      "schema": {"type": "string"},
                      "description": "Set to 1 to run a fresh pass"}],
             tags=["meta"])},
+        f"{v1}/gateways": {
+            "post": op(
+                "createGateway",
+                "Create an inference gateway (router + autoscaler) "
+                "fronting N model replicas",
+                envelope(obj({"gateway": ref("GatewayStatus")})),
+                body=ref("GatewayCreate"), tags=["gateway"],
+                desc="Starts minReplicas replicas immediately (each an "
+                     "ordinary replicaSet named {gateway}r{idx}, "
+                     "intent-journaled), then runs the autoscaler "
+                     "control loop: scale-up clones a warm replica's "
+                     "writable layer (CoW reflink ladder) so a new "
+                     "replica is serving well under the cold-start "
+                     "time; idle gateways scale down to minReplicas "
+                     "(0 = scale-to-zero; the first request wakes one "
+                     "replica back through the warm pool). Fractional "
+                     "tpuCount multiplexes several gateways' small "
+                     "models per chip via the share ledger + regulator. "
+                     "App errors: 1030 exists, 1013/1026 capacity."),
+            "get": op("listGateways", "All gateways with live status",
+                      envelope(obj({"gateways":
+                                    arr(ref("GatewayStatus"))})),
+                      tags=["gateway"])},
+        f"{v1}/gateways/{{name}}": {
+            "get": op("getGateway", "Live gateway status",
+                      envelope(obj({"gateway": ref("GatewayStatus")})),
+                      params=[GW_PARAM], tags=["gateway"]),
+            "delete": op("deleteGateway",
+                         "Stop the autoscaler, delete every replica, "
+                         "drop the gateway",
+                         envelope(None), params=[GW_PARAM],
+                         tags=["gateway"])},
+        f"{v1}/gateways/{{name}}/scale": {"patch": op(
+            "scaleGateway", "Manually scale to exactly N live replicas",
+            envelope(obj({"gateway": ref("GatewayStatus")})),
+            body=ref("GatewayScale"), params=[GW_PARAM],
+            tags=["gateway"],
+            desc="Bounded by the configured maxReplicas; the autoscaler "
+                 "keeps managing afterwards (an idle gateway scales "
+                 "back down). Scale mutations are intent-journaled.")},
+        f"{v1}/gateways/{{name}}/generate": {"post": op(
+            "gatewayGenerate",
+            "DATA PLANE: route one generate request through the "
+            "gateway's continuous-batching router",
+            envelope(ref("GenerateResponse"),
+                     {"tokens": [[1, 2, 3, 7, 9]]}),
+            body=ref("GenerateRequest"),
+            params=[GW_PARAM,
+                    {"name": "stream", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description":
+                         "Present: relay the replica's body as a "
+                         "close-delimited stream (StreamingResponse) "
+                         "instead of a buffered reply"},
+                    {"name": "X-TDAPI-Priority", "in": "header",
+                     "required": False,
+                     "schema": {"type": "string",
+                                "enum": ["", "high", "latency"]},
+                     "description":
+                         "Admission class: high/latency requests drain "
+                         "through a strict-priority FIFO ahead of "
+                         "best-effort traffic — an SLO-bound stream "
+                         "keeps its p99 through a burst (the gateway "
+                         "twin of the regulator's latency class)"}],
+            tags=["gateway"],
+            desc="Admitted when a ready replica has a free batcher slot "
+                 "(least-queued routing, FIFO admission); bypasses the "
+                 "mutation gate and idempotency middleware — serving "
+                 "traffic is not a control mutation. Sheds HTTP 429 + "
+                 "Retry-After when the gateway queue is full, HTTP 504 "
+                 "(envelope 504) when the per-request deadline passes "
+                 "before a slot frees; both feed the autoscaler. The "
+                 "replica's envelope is relayed verbatim.")},
         "/metrics": {"get": op(
             "metrics", "Prometheus text exposition",
             {"200": {"description": "text/plain; version=0.0.4",
@@ -853,6 +1017,19 @@ def build_spec() -> dict:
         for method, o in path_item.items():
             if method not in ("post", "patch", "delete"):
                 continue
+            if o["operationId"] in DATA_PLANE_OPS:
+                # the gateway's own shed/deadline responses, not the
+                # mutation gate's
+                o["responses"]["429"] = {
+                    "description": "Gateway admission queue full — shed "
+                                   "before waiting; retry after "
+                                   "Retry-After."}
+                o["responses"]["504"] = {
+                    "description": "Per-request deadline passed before a "
+                                   "replica slot freed (envelope code "
+                                   "504); the autoscaler is adding "
+                                   "capacity — retry."}
+                continue
             o.setdefault("parameters", []).append(dict(IDEM_PARAM))
             o["responses"]["429"] = dict(RESP_429)
             o["responses"]["409"] = dict(RESP_409)
@@ -864,7 +1041,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.9.0",
+            "version": "0.10.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

@@ -160,6 +160,30 @@ def post_run(app, stored):
     assert app.container_versions.items() == {}
 
 
+def setup_gwscale(app):
+    """A warm gateway donor replica: the scale-up clones its layer."""
+    run_demo(app, name="gwr0", tpus=0)
+    _mark(app, "gwr0-1")
+
+
+def scenario_gwscale(app):
+    """A gateway scale-up IS a cloned run (gateway.py _spawn): the
+    crashpoint fires after the donor's layer was cloned into the new
+    replica, before it started or persisted."""
+    app.replicasets.run_container(
+        ContainerRun(imageName="img", replicaSetName="gwr1", tpuCount=0),
+        clone_from="gwr0-1", idem_partial=True)
+
+
+def post_gwscale(app, stored):
+    # the half-made replica (cloned layer included) is fully unwound;
+    # the donor keeps serving with its layer intact
+    assert sorted(stored) == ["gwr0"]
+    assert app.backend.list_names() == ["gwr0-1"]
+    assert _has_mark(app, "gwr0-1")
+    assert app.container_versions.get("gwr1") is None
+
+
 def setup_replace(app):
     run_demo(app)
     _mark(app, "demo-1")
@@ -282,6 +306,7 @@ SCENARIOS = [
     ("volume.delete.", (setup_vol_delete, scenario_vol_delete,
                         post_vol_delete)),
     ("workqueue.", (None, scenario_run, post_run)),
+    ("gwscale.", (setup_gwscale, scenario_gwscale, post_gwscale)),
 ]
 
 

@@ -1,0 +1,520 @@
+"""Inference-gateway sweep (`gateway` marker; make verify-gateway).
+
+Three layers:
+
+- router/autoscaler units on an injected transport (no processes): the
+  admit-on-slot-free invariant, least-queued routing, queue-bound shed,
+  per-request deadline, autoscale decisions, fractional multiplexing
+  placement (anti-affinity within a gateway, packing across gateways);
+- crash-mid-scale: the gwscale.after_clone crashpoint kills the daemon
+  between the donor-layer clone and the replica start; the rebuild must
+  unwind the half-made replica, settle the `gateway.scale` intent, and
+  adopt the surviving roster;
+- the e2e acceptance over LIVE REST on the process substrate with real
+  mock-model replicas (workloads/mock_model.py): burst -> shed ->
+  autoscale event -> the CLONED replica serves warm -> scale-to-zero ->
+  warm re-admission on the wake request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gpu_docker_api_tpu import faults, xerrors
+from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.gateway import (
+    READY, STOPPED, Gateway, GatewayConfig, Replica, replica_names_for,
+)
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.topology import make_topology
+from gpu_docker_api_tpu.workloads.mock_model import launch_cmd
+
+pytestmark = pytest.mark.gateway
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def make_app(tmp_path, backend="mock", ports=(46000, 46100)):
+    return App(state_dir=str(tmp_path / "state"), backend=backend,
+               addr="127.0.0.1:0", port_range=ports,
+               topology=make_topology("v4-16"), api_key="", cpu_cores=8,
+               store_maint_records=0)
+
+
+def call(app, method, path, body=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://{app.address}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_ready(app, name, n=1, deadline=30):
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        _, out = call(app, "GET", f"/api/v1/gateways/{name}")
+        gw = out["data"]["gateway"]
+        if gw["readyReplicas"] >= n:
+            return gw
+        time.sleep(0.05)
+    raise AssertionError(f"{name}: {n} replicas not ready in {deadline}s: "
+                         f"{gw}")
+
+
+# ------------------------------------------------------- router units
+
+def _bare_gateway(transport, **cfg_kw) -> Gateway:
+    """A Gateway with no services behind it — router-path tests inject
+    replicas and a transport directly."""
+    kw = dict(name="g", image="img", deadlineMs=500, maxQueue=4)
+    kw.update(cfg_kw)
+    cfg = GatewayConfig(**kw)
+    return Gateway(cfg, services=None, intents=None, transport=transport)
+
+
+def _ready_replica(name, idx, port, slots=2) -> Replica:
+    r = Replica(name, idx)
+    r.state = READY
+    r.slots = slots
+    r.host_port = port
+    return r
+
+
+def test_router_least_queued_and_slot_cap():
+    """Admit-on-slot-free: per-replica in-flight never exceeds its slot
+    count, and new requests land on the least-loaded ready replica."""
+    seen = []
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        hold.wait(2)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=3000, maxQueue=32)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001, slots=2),
+                   "b": _ready_replica("b", 1, 1002, slots=2)}
+    done = []
+
+    def one():
+        done.append(gw.forward(b"{}"))
+
+    threads = [threading.Thread(target=one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    with gw._cond:
+        assert gw.replicas["a"].inflight == 2
+        assert gw.replicas["b"].inflight == 2
+    # a 5th request must PARK (no free slot), not exceed the cap
+    extra = threading.Thread(target=one)
+    extra.start()
+    time.sleep(0.2)
+    with gw._cond:
+        assert gw.replicas["a"].inflight == 2
+        assert gw.replicas["b"].inflight == 2
+        assert gw._queued == 1
+    hold.set()
+    for t in threads:
+        t.join(5)
+    extra.join(5)
+    assert len(done) == 5
+    assert sorted(seen[:4]) == [1001, 1001, 1002, 1002]  # least-queued split
+
+
+def test_router_queue_bound_sheds():
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        hold.wait(3)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=3000, maxQueue=2)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001, slots=1)}
+    threads = [threading.Thread(target=lambda: gw.forward(b"{}"))
+               for _ in range(3)]       # 1 in flight + 2 queued = full
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    with pytest.raises(xerrors.GatewayShedError):
+        gw.forward(b"{}")
+    assert gw.shed_total == 1
+    hold.set()
+    for t in threads:
+        t.join(5)
+
+
+def test_router_priority_class_barges_best_effort_queue():
+    """X-TDAPI-Priority high: the strict-priority FIFO serves a latency
+    request ahead of every parked best-effort one."""
+    order = []
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        order.append(bytes(body))
+        if body == b"first":
+            hold.wait(3)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=5000, maxQueue=16)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001, slots=1)}
+    threads = [threading.Thread(target=gw.forward, args=(b"first",))]
+    threads[0].start()
+    time.sleep(0.1)                     # slot occupied
+    for i in range(3):
+        t = threading.Thread(target=gw.forward,
+                             args=(b"low%d" % i,))
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)                # deterministic best-effort order
+    t = threading.Thread(target=gw.forward, args=(b"hi",),
+                         kwargs={"priority": "high"})
+    t.start()
+    threads.append(t)
+    time.sleep(0.15)
+    hold.set()
+    for t in threads:
+        t.join(5)
+    assert order[0] == b"first"
+    assert order[1] == b"hi", order      # barged the 3 parked lows
+    assert sorted(order[2:]) == [b"low0", b"low1", b"low2"]
+
+
+def test_router_deadline_sheds_504():
+    def transport(port, method, path, body, timeout):
+        time.sleep(0.05)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=120, maxQueue=8)
+    gw.replicas = {}                    # nothing will ever be ready
+    t0 = time.monotonic()
+    with pytest.raises(xerrors.GatewayDeadlineError):
+        gw.forward(b"{}")
+    assert 0.1 <= time.monotonic() - t0 < 1.0
+
+
+def test_router_retries_failed_replica_then_serves():
+    """A dead replica's connection error must not fail the request while
+    a healthy one exists — and repeated failures mark it FAILED."""
+    calls = []
+
+    def transport(port, method, path, body, timeout):
+        calls.append(port)
+        if port == 1001:
+            raise ConnectionRefusedError("replica gone")
+        return 200, b'{"code":200,"msg":"ok","data":{"ok":true}}'
+
+    gw = _bare_gateway(transport, deadlineMs=2000, maxQueue=8)
+    gw.replicas = {"dead": _ready_replica("dead", 0, 1001, slots=4),
+                   "live": _ready_replica("live", 1, 1002, slots=4)}
+    for _ in range(Gateway.MAX_FAILURES + 1):
+        status, payload = gw.forward(b"{}")
+        assert status == 200 and b'"ok"' in payload
+    assert gw.replicas["dead"].state == "failed"
+    assert 1002 in calls
+
+
+def test_config_validation():
+    for bad in (dict(name="", image="i"),
+                dict(name="a-b", image="i"),
+                dict(name="g", image=""),
+                dict(name="g", image="i", tpuCount=1.5),
+                dict(name="g", image="i", minReplicas=3, maxReplicas=2),
+                dict(name="g", image="i", readiness="psychic")):
+        with pytest.raises(ValueError):
+            GatewayConfig(**bad).validate()
+
+
+# --------------------------------------------- autoscaler + manager units
+
+def test_autoscaler_scales_up_on_queue_and_down_on_idle(tmp_path):
+    """Mock substrate, readiness=running: sustained queue pressure adds
+    a replica (journaled, donor-cloned); idle drains back to min."""
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        _, out = call(app, "POST", "/api/v1/gateways", {
+            "name": "gw", "image": "img", "cmd": ["serve"],
+            "minReplicas": 1, "maxReplicas": 3, "readiness": "running",
+            "scaleUpQueue": 2, "scaleDownIdleS": 0.8, "cooldownS": 0.1,
+            "deadlineMs": 4000, "maxQueue": 32})
+        assert out["code"] == 200, out
+        gw = app.gateways.get("gw")
+        hold = threading.Event()
+
+        def transport(port, method, path, body, timeout):
+            hold.wait(3)
+            return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+        gw._transport = transport
+        wait_ready(app, "gw", 1)
+        # park enough requests to exceed scaleUpQueue
+        threads = [threading.Thread(
+            target=lambda: call(app, "POST", "/api/v1/gateways/gw/generate",
+                                {"tokens": [[1]]}, timeout=10))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(gw.replicas) < 2:
+            time.sleep(0.05)
+        hold.set()
+        for t in threads:
+            t.join(10)
+        assert len(gw.replicas) >= 2, "queue pressure never scaled up"
+        g = wait_ready(app, "gw", 2)
+        assert g["scaleUps"] >= 2
+        # scale events are journaled + on the event log
+        _, ev = call(app, "GET", "/api/v1/events?limit=200")
+        ops = [e["op"] for e in ev["data"]["events"]]
+        assert "gateway.scale_up" in ops
+        # idle: back down to minReplicas (stop, not delete — layer kept)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            g = call(app, "GET", "/api/v1/gateways/gw")[1]["data"]["gateway"]
+            if g["readyReplicas"] == 1 and any(
+                    r["state"] == "stopped" for r in g["replicas"]):
+                break
+            time.sleep(0.1)
+        assert g["readyReplicas"] == 1, g
+        stored = {kv.key.rsplit("/", 1)[1]
+                  for kv in app.client.range("containers")}
+        assert {"gwr0", "gwr1"} <= stored      # stopped replica kept
+    finally:
+        app.stop()
+
+
+def test_fractional_multiplexing_placement(tmp_path):
+    """Two gateways of 0.25-chip replicas: one gateway's replicas SPREAD
+    over chips (anti-affinity), while both gateways PACK onto the same
+    chips (the share ledger's bin-packing) — several models per chip."""
+    app = make_app(tmp_path)
+    app.start()
+    try:
+        for name in ("alpha", "beta"):
+            _, out = call(app, "POST", "/api/v1/gateways", {
+                "name": name, "image": "img", "cmd": ["serve"],
+                "tpuCount": 0.25, "minReplicas": 2, "maxReplicas": 4,
+                "readiness": "running", "scaleDownIdleS": 3600})
+            assert out["code"] == 200, out
+        chips = {}
+        for name in ("alpha", "beta"):
+            g = call(app, "GET", f"/api/v1/gateways/{name}")[1]
+            chips[name] = [r["chips"][0]
+                           for r in g["data"]["gateway"]["replicas"]]
+        # within a gateway: distinct chips (spread)
+        assert len(set(chips["alpha"])) == 2, chips
+        assert len(set(chips["beta"])) == 2, chips
+        # across gateways: co-located (packing fills split chips first)
+        assert set(chips["alpha"]) == set(chips["beta"]), chips
+        snap = app.tpu.snapshot()
+        for chip in set(chips["alpha"]):
+            assert sum(snap["shares"][chip].values()) == 2
+    finally:
+        app.stop()
+
+
+def test_crash_mid_scale_reconciles(tmp_path):
+    """Kill the daemon at gwscale.after_clone (donor layer cloned, new
+    replica never started): the rebuild unwinds the half-made replica,
+    settles the gateway.scale intent, adopts the surviving roster, and a
+    fresh scale-up succeeds."""
+    app = make_app(tmp_path)
+    _, out = None, app.gateways.create(GatewayConfig(
+        name="gw", image="img", cmd=["serve"], minReplicas=1,
+        maxReplicas=3, readiness="running", scaleDownIdleS=3600))
+    gw = app.gateways.get("gw")
+    assert replica_names_for(app.client, "gw") == ["gwr0"]
+    # the clone path needs a READY donor (the probe turns gwr0 green)
+    deadline = time.time() + 10
+    while time.time() < deadline and gw.replicas["gwr0"].state != READY:
+        time.sleep(0.05)
+    assert gw.replicas["gwr0"].state == READY
+    faults.arm("gwscale.after_clone")
+    with pytest.raises(InjectedCrash):
+        gw.scale_up(reason="test")
+    faults.disarm_all()
+    # abandon like a daemon death (no graceful flush), rebuild on the
+    # surviving backend
+    app.gateways.stop_all()
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    rep = app2.last_reconcile
+    assert any(s.startswith("gateway.scale-unwound:gw")
+               for s in rep["opsCompleted"]), rep["opsCompleted"]
+    assert any(s.startswith("run-unwound:gwr1")
+               for s in rep["opsCompleted"]), rep["opsCompleted"]
+    # roster: only the survivor; the half-made replica left nothing
+    assert replica_names_for(app2.client, "gw") == ["gwr0"]
+    assert app2.container_versions.get("gwr1") is None
+    gw2 = app2.gateways.get("gw")
+    assert set(gw2.replicas) == {"gwr0"}
+    out = gw2.scale_up(reason="retry")
+    assert out["replica"] == "gwr1"
+    app2.stop()
+
+
+def test_gateway_delete_crash_replay(tmp_path):
+    """An interrupted gateway delete finishes at boot: remaining
+    replicas purged, gateway record dropped."""
+    app = make_app(tmp_path)
+    app.gateways.create(GatewayConfig(
+        name="gw", image="img", cmd=["serve"], minReplicas=2,
+        maxReplicas=3, readiness="running", scaleDownIdleS=3600))
+    # simulate a delete that died right after journaling its intent
+    app.gateways.stop_all()
+    app.intents.begin("gateway.delete", "gw", kind="gateway")
+    app.wq.close()
+    app.store.close()
+    app.events.close()
+    app2 = make_app(tmp_path, backend=app.backend)
+    assert replica_names_for(app2.client, "gw") == []
+    assert app2.client.get("gateways", "gw") is None
+    with pytest.raises(xerrors.NotExistInStoreError):
+        app2.gateways.get("gw")
+    app2.stop()
+
+
+def test_gateway_catalog_registration():
+    """Every gateway event op / metric family is in the obs/names.py
+    catalog (the tdlint untraced-op contract)."""
+    from gpu_docker_api_tpu.obs.names import EVENT_OPS, METRIC_NAMES
+    assert {"gateway.create", "gateway.delete", "gateway.scale_up",
+            "gateway.scale_down", "gateway.replica_ready",
+            "gateway.replica_down", "gateway.shed",
+            "gateway.wake"} <= EVENT_OPS
+    assert {"tdapi_gateway_request_duration_ms",
+            "tdapi_gateway_scale_ready_ms", "tdapi_gateway_replicas",
+            "tdapi_gateway_queue_depth",
+            "tdapi_gateway_requests_total",
+            "tdapi_gateway_shed_total"} <= METRIC_NAMES
+
+
+# ------------------------------------------------- e2e over live REST
+
+@pytest.mark.slow
+def test_e2e_burst_shed_autoscale_zero_wake(tmp_path):
+    """The acceptance walk on the process substrate with real mock-model
+    replicas over live REST: burst -> shed -> autoscale (cloned replica
+    serves WARM) -> scale-to-zero -> warm re-admission on a wake
+    request."""
+    app = make_app(tmp_path, backend="process", ports=(46200, 46300))
+    app.start()
+    try:
+        _, out = call(app, "POST", "/api/v1/gateways", {
+            "name": "mm", "image": "python",
+            "cmd": launch_cmd(REPO, "--slots", "4", "--decode-ms", "30",
+                              "--init-ms", "1200", "--warm-mb", "4"),
+            "minReplicas": 0, "maxReplicas": 3, "port": "8000",
+            "deadlineMs": 15000, "maxQueue": 24, "scaleUpQueue": 3,
+            "scaleDownIdleS": 2.5, "cooldownS": 0.25})
+        assert out["code"] == 200, out
+        # minReplicas=0: the gateway starts EMPTY; the first request is
+        # the wake trigger (cold this once — no layer exists yet)
+        t0 = time.time()
+        status, out = call(app, "POST", "/api/v1/gateways/mm/generate",
+                           {"tokens": [[5, 6]], "max_new": 3},
+                           timeout=20)
+        assert status == 200 and out["code"] == 200, out
+        assert out["data"]["tokens"] == [[5, 6, 0, 1, 2]]
+        cold_s = time.time() - t0
+        # sustained burst: 30ms x 4 slots -> force queue pressure
+        codes: list[int] = []
+        lock = threading.Lock()
+
+        def client(n):
+            for _ in range(n):
+                status, out = call(
+                    app, "POST", "/api/v1/gateways/mm/generate",
+                    {"tokens": [[1]], "max_new": 2}, timeout=30)
+                with lock:
+                    codes.append(out["code"])
+
+        threads = [threading.Thread(target=client, args=(6,))
+                   for _ in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        ok = sum(1 for c in codes if c == 200)
+        shed = sum(1 for c in codes if c in (429, 504))
+        assert ok > 0
+        assert ok + shed == len(codes), f"unexpected codes: {set(codes)}"
+        g = call(app, "GET", "/api/v1/gateways/mm")[1]["data"]["gateway"]
+        assert g["scaleUps"] >= 2, g          # wake + at least one clone
+        # the autoscale events are on the log, and the scaled replica was
+        # CLONED from the warm donor
+        _, ev = call(app, "GET", "/api/v1/events?limit=500")
+        scale_ups = [e for e in ev["data"]["events"]
+                     if e["op"] == "gateway.scale_up"]
+        assert any(e.get("cloned") for e in scale_ups), scale_ups
+        readys = [e for e in ev["data"]["events"]
+                  if e["op"] == "gateway.replica_ready"]
+        assert readys, "no replica_ready events"
+        # the cloned replicas started WARM: the donor's layer carried the
+        # ready marker, so --init-ms was skipped (the replica logs which
+        # path it took — semantic, not timing, so burst-load GIL noise
+        # can't flake it; bench.py prices the latency win under
+        # controlled load)
+        cloned_names = {e["replica"] for e in scale_ups
+                        if e.get("cloned")}
+        assert cloned_names
+        import glob as _glob
+        logs = {os.path.basename(p).rsplit("-", 1)[0]: open(p).read()
+                for p in _glob.glob(os.path.join(
+                    str(tmp_path), "state", "backend", "logs", "*.log"))}
+        for rname in cloned_names:
+            assert "WARM (cloned layer)" in logs.get(rname, ""), (
+                rname, list(logs))
+        # /metrics carries the gateway families
+        m = urllib.request.urlopen(
+            f"http://{app.address}/metrics").read().decode()
+        assert 'tdapi_gateway_replicas{gateway="mm"' in m
+        assert "tdapi_gateway_requests_total" in m
+        assert "tdapi_gateway_scale_ready_ms_bucket" in m
+        # idle -> scale to ZERO (minReplicas=0), grants released
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            g = call(app, "GET",
+                     "/api/v1/gateways/mm")[1]["data"]["gateway"]
+            if g["readyReplicas"] == 0 and all(
+                    r["state"] == "stopped" for r in g["replicas"]):
+                break
+            time.sleep(0.2)
+        assert g["readyReplicas"] == 0, g
+        app.wq.join()
+        assert all(o is None for o in app.ports.owners().values())
+        # WAKE: one request re-admits a stopped replica (kept layer =
+        # warm marker present, so no init cost; warm-pool interpreter)
+        t0 = time.time()
+        status, out = call(app, "POST", "/api/v1/gateways/mm/generate",
+                           {"tokens": [[9]], "max_new": 2}, timeout=20)
+        wake_s = time.time() - t0
+        assert status == 200 and out["code"] == 200, out
+        _, ev = call(app, "GET", "/api/v1/events?limit=500")
+        ops = [e["op"] for e in ev["data"]["events"]]
+        assert "gateway.wake" in ops
+        assert "gateway.scale_down" in ops
+        # warm re-admission beats the cold wake (no --init-ms replay)
+        assert wake_s < max(cold_s, 2.0) + 1.0, (wake_s, cold_s)
+    finally:
+        app.stop()

@@ -188,6 +188,63 @@ def test_concurrent_mutation_stress(world, seed):
     assert all(o is None for o in ports.owners().values())
 
 
+# ------------------------------- regression: stress-sweep flake findings
+
+def test_restart_stopped_fractional_under_exhaustion_raises_domain_error(
+        world):
+    """REGRESSION (stress-sweep worker IndexError, ~1/4 runs at PR 9
+    HEAD): restarting a STOPPED fractional replicaSet when share capacity
+    has since been exhausted made apply_shares raise — and the unwind
+    handler, keyed on the requested quanta instead of the taken grant,
+    indexed an empty fresh_tpu list. The domain error must propagate
+    clean, with nothing leaked."""
+    rs, _backend, tpu, _cpu, _ports, wq, _client = world
+    rs.run_container(ContainerRun(imageName="ubuntu:22.04",
+                                  replicaSetName="frac", tpuCount=0.25))
+    rs.stop_container("frac")       # releases the quanta
+    # eat ALL remaining capacity with whole-chip grants
+    hogs = tpu.apply(len(tpu.owners()), "hog")
+    with pytest.raises(xerrors.TpuNotEnoughError):   # incl. Oversubscribed
+        rs.restart_container("frac")
+    tpu.restore(hogs, "hog")
+    wq.join()
+    snap = tpu.snapshot()
+    assert snap["shares"] == {}
+    assert all(o is None for o in snap["status"].values())
+
+
+def test_drain_regrant_on_same_chip_releases_old_quanta(world):
+    """REGRESSION (stress-sweep share-ledger leak): a drain migration's
+    fresh share grant can land back on the SAME chip with the same quanta
+    when the cordon snapshot raced an uncordon — the old holding then
+    compared equal to the new spec and was treated as an identical
+    carryover, never released. The explicit fresh-grant flag releases the
+    old quanta exactly once; the ledger ends with only the new grant."""
+    rs, _backend, tpu, _cpu, _ports, wq, _client = world
+    out = rs.run_container(ContainerRun(imageName="ubuntu:22.04",
+                                        replicaSetName="mig",
+                                        tpuCount=0.25))
+    chip = out["tpuChips"][0]
+    assert tpu.shares_snapshot()[chip] == {"mig": 1}
+    # simulate the race window: the drain's entry snapshot says the chip
+    # is cordoned, but by re-grant time it is not — apply_shares picks the
+    # most-loaded chip, which is the SAME one
+    orig = tpu.cordoned_snapshot
+    tpu.cordoned_snapshot = lambda: {chip}
+    try:
+        result = rs.drain_cordoned()
+    finally:
+        tpu.cordoned_snapshot = orig
+    assert [d["name"] for d in result["drained"]] == ["mig"]
+    wq.join()
+    snap = tpu.snapshot()
+    total = sum(sum(o.values()) for o in snap["shares"].values())
+    assert total == 1, f"leaked share quanta: {snap['shares']}"
+    rs.delete_container("mig")
+    wq.join()
+    assert tpu.snapshot()["shares"] == {}
+
+
 # ----------------------------------------------- regression: health probe
 
 class _HangableBackend:

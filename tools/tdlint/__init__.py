@@ -126,6 +126,7 @@ DEFAULT_SCOPE = (
     "gpu_docker_api_tpu/backend/guard.py",
     "gpu_docker_api_tpu/backend/base.py",
     "gpu_docker_api_tpu/reconcile.py",
+    "gpu_docker_api_tpu/gateway.py",
     "gpu_docker_api_tpu/intents.py",
     "gpu_docker_api_tpu/idempotency.py",
     "gpu_docker_api_tpu/health.py",
