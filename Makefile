@@ -7,7 +7,7 @@ STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
     verify-retry verify-migrate verify-mt verify-races verify-obs \
-    verify-gateway bench \
+    verify-gateway verify-gang bench \
     serve serve-mock dryrun apidoc lint clean
 
 all: native
@@ -27,6 +27,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-races   (race stress sweep: -m races)"
 	@echo "  make verify-obs     (observability sweep: -m obs)"
 	@echo "  make verify-gateway (inference-gateway sweep: -m gateway)"
+	@echo "  make verify-gang    (elastic gang / reshard sweep: -m gang)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -55,6 +56,9 @@ verify-obs:             ## observability sweep: trace trees over HTTP, Prometheu
 
 verify-gateway:         ## inference-gateway sweep: router, autoscale, crash-mid-scale, e2e
 	$(PY) -m pytest tests/ -q -m gateway
+
+verify-gang:            ## elastic gang sweep: plan grants, reshard crashpoints, e2e 1->4->1
+	$(PY) -m pytest tests/ -q -m gang
 
 lint:                   ## compile baseline + tdlint concurrency-invariant rules + rule liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

@@ -1088,6 +1088,33 @@ def replace_bench() -> dict:
     return out
 
 
+def read_metric_recs(path) -> list:
+    """Step records from a live (fsync'd, possibly mid-append) workload
+    metrics.jsonl — the shared tail-reader for the migration/gang benches
+    (torn last lines skip; only records carrying a step count)."""
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "step" in r:
+                    out.append(r)
+    return out
+
+
+def wait_metric_recs(path, pred, timeout=300.0) -> list:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        recs = read_metric_recs(path)
+        if pred(recs):
+            return recs
+        time.sleep(0.25)
+    raise TimeoutError(f"metrics predicate not met at {path}")
+
+
 def migration_bench() -> dict:
     """Zero-loss training migration (the quiesce protocol,
     services/replicaset.py + backend quiesce contract): run a real (tiny,
@@ -1105,26 +1132,14 @@ def migration_bench() -> dict:
     from gpu_docker_api_tpu.topology import make_topology
 
     def read_steps(path):
-        out = []
-        if os.path.exists(path):
-            with open(path) as f:
-                for line in f:
-                    try:
-                        r = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if "step" in r:
-                        out.append((r["step"], r.get("time", 0.0)))
-        return out
+        return [(r["step"], r.get("time", 0.0))
+                for r in read_metric_recs(path)]
 
     def wait_steps(path, pred, timeout=300.0):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            recs = read_steps(path)
-            if pred(recs):
-                return recs
-            time.sleep(0.25)
-        raise TimeoutError(f"metrics predicate not met at {path}")
+        wait_metric_recs(
+            path, lambda rs: pred([(r["step"], r.get("time", 0.0))
+                                   for r in rs]), timeout)
+        return read_steps(path)
 
     def one_variant(tag: str, quiesce: bool) -> dict:
         state_dir = tempfile.mkdtemp(prefix=f"tdapi-migrate-{tag}-")
@@ -1205,6 +1220,129 @@ def migration_bench() -> dict:
     if base["gap_ms"] and q["gap_ms"]:
         out["gap_ratio"] = round(base["gap_ms"] / max(q["gap_ms"], 1e-9), 2)
     return out
+
+
+def gang_bench() -> dict:
+    """Elastic gang resharding (meshPlan grants + live reshard,
+    services/replicaset.py): run a real (tiny, CPU-forced — this prices
+    the control plane's reshard mechanics, not chip math) train_llama
+    replicaSet through the REST stack and drive the SURVEY's headline
+    cycle: 1 chip -> 4 chips (meshPlan dp=4) -> back to 1, mid-run.
+
+    Reports per reshard: steps_lost (replayed training steps — 0 is the
+    quiesce contract) and gap_ms (wall clock between the last step record
+    of the old generation and the first of the new — the re-mesh window:
+    process restart + import + compile + checkpoint restore under the new
+    sharding). Plus tokens/s under dp=4 vs single-chip (honest on this
+    CPU box: virtual devices share cores, so scaling ~1x is expected —
+    the number prices the mechanics, the SCALING claim belongs to real
+    chips). Headline: gang_steps_lost / gang_gap_ms / gang_tokens_scale."""
+    import shutil
+
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    def top_step(recs):
+        return max((r["step"] for r in recs), default=0)
+
+    def median_step_s(recs, dp: int):
+        # DELIMITED match on the leading axis of the MeshPlan repr
+        # ("MeshPlan(dp=4, fsdp=1, ..."): a bare "dp=1" substring would
+        # also match every record's "fsdp=1"
+        tag = f"(dp={dp},"
+        ts = sorted(r["step_time_s"] for r in recs
+                    if tag in str(r.get("plan", "")))
+        return ts[len(ts) // 2] if ts else None
+
+    state_dir = tempfile.mkdtemp(prefix="tdapi-gang-")
+    app = App(state_dir=state_dir, backend="process", addr="127.0.0.1:0",
+              topology=make_topology("v5p-8"), api_key="",
+              cpu_cores=max(os.cpu_count() or 1, 4))
+    app.start()
+    try:
+        port = app.server.port
+        vol = call(port, "POST", "/api/v1/volumes",
+                   {"name": "gangdata", "size": "2GB"})
+        mp = vol["mountpoint"]
+        env = [f"PYTHONPATH={REPO}",
+               "JAX_PLATFORMS=cpu", "JAX_PLATFORM_NAME=cpu",
+               # 4 virtual devices for the dp=4 generation; the planned
+               # mesh uses exactly plan.size of them per generation
+               "XLA_FLAGS=--xla_force_host_platform_device_count=4",
+               # warm shared compile cache intermittently heap-corrupts
+               # this jax build post-resume (see migration_bench)
+               "JAX_COMPILATION_CACHE_DIR=",
+               "TDAPI_QUIESCE=1"]
+        cmd = [sys.executable, "-m",
+               "gpu_docker_api_tpu.workloads.train_llama",
+               "--config", "tiny", "--steps", "600",
+               "--checkpoint-every", "10",
+               "--batch", "4", "--seq", "32",
+               "--workdir", "root/foo-tmp"]
+        call(port, "POST", "/api/v1/replicaSet", {
+            "imageName": "python", "replicaSetName": "gang",
+            "tpuCount": 1, "meshPlan": {"dp": 1}, "env": env, "cmd": cmd,
+            "binds": [{"src": mp, "dest": "/root/foo-tmp"}]})
+        metrics = os.path.join(mp, "metrics.jsonl")
+        wait_metric_recs(metrics, lambda rs: top_step(rs) >= 12)
+
+        def reshard(count, plan, settle_steps=8):
+            """PATCH, wait for the new generation to make progress, and
+            return (steps_lost, gap_ms) measured at the boundary."""
+            pre_recs = read_metric_recs(metrics)
+            pre_n, pre_top = len(pre_recs), top_step(pre_recs)
+            call(port, "PATCH", "/api/v1/replicaSet/gang",
+                 {"tpuPatch": {"tpuCount": count, "meshPlan": plan}})
+            recs = wait_metric_recs(
+                metrics,
+                lambda rs: top_step(rs) >= pre_top + settle_steps)
+            seq = [r["step"] for r in recs]
+            breaks = [i for i in range(max(pre_n, 1), len(seq))
+                      if seq[i] <= seq[i - 1]]
+            if breaks:
+                i = breaks[0]
+                lost = seq[i - 1] - (seq[i] - 1)
+            else:
+                # gapless: the boundary is the largest inter-record wall
+                # gap at-or-after pre_n (records kept landing between the
+                # pre-read and the stop, so pre_n itself may still be an
+                # old-generation index; the restart window — process +
+                # import + compile + restore — dwarfs a tiny-model step)
+                i = max(range(max(pre_n, 1), len(seq)),
+                        key=lambda j: recs[j]["time"] - recs[j - 1]["time"])
+                lost = 0
+            gap_ms = (recs[i]["time"] - recs[i - 1]["time"]) * 1e3
+            return lost, round(gap_ms, 1)
+
+        up_lost, up_gap = reshard(4, {"dp": 4})
+        recs = read_metric_recs(metrics)
+        dp1_step_s = median_step_s(recs, 1)
+        dp4_step_s = median_step_s(recs, 4)
+        down_lost, down_gap = reshard(1, {"dp": 1})
+
+        evts = [e for e in app.events.recent(limit=100)
+                if e["op"] == "reshard"]
+        call(port, "DELETE", "/api/v1/replicaSet/gang")
+        scale = (round(dp1_step_s / dp4_step_s, 2)
+                 if dp1_step_s and dp4_step_s else None)
+        return {
+            "cycle": "1 -> 4 (dp=4) -> 1, live REST, quiesce on",
+            "up": {"steps_lost": up_lost, "gap_ms": up_gap},
+            "down": {"steps_lost": down_lost, "gap_ms": down_gap},
+            "tokens": {
+                "dp1_step_s": dp1_step_s, "dp4_step_s": dp4_step_s,
+                # step wall-time ratio == tokens/s scaling (tokens/step
+                # constant); ~1x on shared-core virtual CPU devices
+                "dp4_vs_dp1_scale": scale},
+            "reshard_events": len(evts),
+            "quiesced": [bool(e.get("quiesced")) for e in evts],
+            "criteria": {
+                "zero_steps_lost": up_lost == 0 and down_lost == 0,
+                "both_reshards_evented": len(evts) == 2},
+        }
+    finally:
+        app.stop()
+        shutil.rmtree(state_dir, ignore_errors=True)
 
 
 def multitenancy_bench() -> dict:
@@ -1818,6 +1956,9 @@ def main() -> None:
     run_section(extra, "migration", migration_bench,
                 note="migration bench (tiny CPU-forced train_llama, "
                      "mid-run 1->4 patch, quiesce vs kill-and-replay)...")
+    run_section(extra, "gang", gang_bench,
+                note="gang bench (tiny CPU-forced train_llama, live "
+                     "1->4->1 meshPlan reshard cycle over REST)...")
     run_section(extra, "multitenancy", multitenancy_bench,
                 note="multitenancy bench (fractional co-tenants on one "
                      "chip through the regulator, dedicated vs shared)...")
@@ -1910,6 +2051,17 @@ def build_summary(p50, platform, vs, extra) -> dict:
             "migration_gap_ms": _dig("migration", "quiesce", "gap_ms"),
             "migration_baseline_steps_lost": _dig("migration", "baseline",
                                                   "steps_lost"),
+            "gang_steps_lost": (
+                None
+                if _dig("gang", "up", "steps_lost") is None
+                or _dig("gang", "down", "steps_lost") is None
+                else _dig("gang", "up", "steps_lost")
+                + _dig("gang", "down", "steps_lost")),
+            "gang_gap_ms": max(
+                _dig("gang", "up", "gap_ms", default=0) or 0,
+                _dig("gang", "down", "gap_ms", default=0) or 0) or None,
+            "gang_tokens_scale": _dig("gang", "tokens",
+                                      "dp4_vs_dp1_scale"),
             "mt_aggregate_speedup": _dig("multitenancy",
                                          "shared4_best_effort",
                                          "aggregate_speedup"),

@@ -337,11 +337,16 @@ class ApiClient:
         method = entry["method"]
         # reserved kwargs (header-borne; dashes can't be kwarg names):
         # if_match=N sends If-Match; idempotency_key overrides the
-        # auto-generated per-call key
+        # auto-generated per-call key; mesh_plan folds a gang MeshPlan
+        # into the body of runReplicaSet / patchReplicaSet
         extra: dict[str, str] = {}
         if_match = params.pop("if_match", None)
         if if_match is not None:
             extra["If-Match"] = str(if_match)
+        mesh_plan = params.pop("mesh_plan", None)
+        if mesh_plan is not None:
+            body = self._fold_mesh_plan(op_id, body, mesh_plan)
+        self._check_mesh_plan(op_id, body)
         idem_key = params.pop("idempotency_key", None)
         if method != "GET" and (idem_key or self.idempotency):
             extra["Idempotency-Key"] = str(idem_key or uuid.uuid4().hex)
@@ -406,6 +411,46 @@ class ApiClient:
         if "application/json" not in ok.get("content", {}):
             return raw                       # /metrics, /openapi.json
         return self._envelope(raw, op_id, fallback_tid=tid).get("data")
+
+    @staticmethod
+    def _fold_mesh_plan(op_id: str, body, mesh_plan: dict):
+        """Fold the mesh_plan= convenience kwarg into the op's body:
+        runReplicaSet carries it top-level, patchReplicaSet inside
+        tpuPatch. Any other operation has no meshPlan surface."""
+        if not isinstance(mesh_plan, dict):
+            raise SchemaError(f"{op_id}: mesh_plan must be a dict of axis "
+                              f"factors (dp/fsdp/pp/ep/tp/sp)")
+        body = dict(body or {})
+        if op_id == "runReplicaSet":
+            body["meshPlan"] = mesh_plan
+        elif op_id == "patchReplicaSet":
+            body["tpuPatch"] = dict(body.get("tpuPatch") or {})
+            body["tpuPatch"]["meshPlan"] = mesh_plan
+        else:
+            raise SchemaError(f"{op_id}: mesh_plan only applies to "
+                              f"runReplicaSet / patchReplicaSet")
+        return body
+
+    @staticmethod
+    def _check_mesh_plan(op_id: str, body) -> None:
+        """A meshPlan without its tpuCount is ALWAYS a mistake (the plan's
+        factors must multiply to the chip count) — fail here with a
+        pointed message instead of a generic server 1000."""
+        if not isinstance(body, dict):
+            return
+        if (op_id == "runReplicaSet" and body.get("meshPlan") is not None
+                and not body.get("tpuCount")):
+            raise SchemaError(
+                "runReplicaSet: meshPlan requires tpuCount (the plan's "
+                "axis factors must multiply to the chip count)")
+        tp = body.get("tpuPatch")
+        if (op_id == "patchReplicaSet" and isinstance(tp, dict)
+                and tp.get("meshPlan") is not None
+                and not tp.get("tpuCount")):
+            raise SchemaError(
+                "patchReplicaSet: tpuPatch.meshPlan requires "
+                "tpuPatch.tpuCount (the plan's axis factors must multiply "
+                "to the chip count)")
 
     @staticmethod
     def _envelope(raw, op_id: str, fallback_tid: str = "") -> dict:

@@ -55,6 +55,11 @@ class ContainerRun:
     cpuCount: int = 0
     memory: str = ""              # e.g. "8GB"; units KB/MB/GB/TB
     priority: str = ""            # "" | "latency" | "best_effort" (regulator class)
+    # gang parallelism plan: {dp, fsdp, pp, ep, tp, sp} axis factors whose
+    # product must equal tpuCount (meshplan.PlanSpec validates). None =
+    # no plan (the trivial single-chip shape) — every legacy request
+    # deserializes here.
+    meshPlan: Optional[dict] = None
     binds: list[Bind] = field(default_factory=list)
     env: list[str] = field(default_factory=list)
     cmd: list[str] = field(default_factory=list)
@@ -70,6 +75,7 @@ class ContainerRun:
             cpuCount=int(d.get("cpuCount", 0) or 0),
             memory=d.get("memory", "") or "",
             priority=d.get("priority", "") or "",
+            meshPlan=d.get("meshPlan"),
             binds=[Bind.from_json(b) for b in d.get("binds", []) if b],
             env=list(d.get("env", []) or []),
             cmd=list(d.get("cmd", []) or []),
@@ -80,6 +86,10 @@ class ContainerRun:
 @dataclass
 class TpuPatch:
     tpuCount: float = 0           # whole chips, or a 0.25-multiple share < 1
+    # gang reshard: new axis factors (product == tpuCount). None = no
+    # explicit plan — a count change then resets a gang set to the
+    # trivial plan, an unchanged count keeps the stored one.
+    meshPlan: Optional[dict] = None
 
 
 @dataclass
@@ -113,7 +123,8 @@ class PatchRequest:
         mp = d.get("memoryPatch")
         vp = d.get("volumePatch")
         return cls(
-            tpuPatch=TpuPatch(_num(tp.get("tpuCount", tp.get("gpuCount", 0)))) if tp else None,
+            tpuPatch=TpuPatch(_num(tp.get("tpuCount", tp.get("gpuCount", 0))),
+                              tp.get("meshPlan")) if tp else None,
             cpuPatch=CpuPatch(int(cp.get("cpuCount", 0) or 0)) if cp else None,
             memoryPatch=MemoryPatch(mp.get("memory", "") or "") if mp else None,
             volumePatch=VolumePatch(Bind.from_json(vp.get("oldBind")),
@@ -180,6 +191,12 @@ class ContainerSpec:
     priority: str = ""
     tpu_env: dict[str, str] = field(default_factory=dict)
     devices: list[str] = field(default_factory=list)        # /dev/accel* passthrough
+    # gang parallelism plan granted to this version: full axis-factor dict
+    # ({dp, fsdp, pp, ep, tp, sp}); {} = trivial/no plan (every
+    # pre-gang stored spec deserializes here). The scheduler granted an
+    # ICI-contiguous sub-mesh shaped for these factors, and the same dict
+    # rides into the container as TDAPI_MESH_PLAN (tpu_env).
+    mesh_plan: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return asdict(self)

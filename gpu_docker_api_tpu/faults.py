@@ -77,6 +77,14 @@ CRASHPOINTS: dict[str, str] = {
     # op-specific preambles before the shared replace machinery
     "rollback.after_grant": "historical counts re-granted, replace not begun",
     "restart.after_grant": "fresh grants applied, replace not begun",
+    # gang reshard (a patch/rollback that changes a MeshPlan'd set's shape)
+    "reshard.after_grant": "plan-shaped sub-mesh granted (old gang still "
+                           "running on its old chips), replace not begun",
+    "reshard.after_quiesce": "gang quiesce settled + reshard intent marker "
+                             "written, old gang not yet stopped — recovery "
+                             "rolls the persisted new version forward and "
+                             "the workload re-meshes from the same "
+                             "checkpoint",
     # gateway autoscale (gateway.py scale-up = a cloned run): the donor's
     # warm layer is cloned into the new replica, which is not yet started
     # and whose record is not yet persisted — a crash here must unwind the

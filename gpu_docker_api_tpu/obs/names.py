@@ -34,6 +34,8 @@ EVENT_OPS = frozenset({
     "health.cordon",
     # rolling replace data movement (services/replicaset.py)
     "replace.copied",
+    # gang reshard: a committed mesh-shape change (services/replicaset.py)
+    "reshard",
     # boot/runtime reconciler (reconcile.py)
     "reconcile",
     "reconcile.unknown_op",
@@ -85,6 +87,8 @@ METRIC_NAMES = frozenset({
     "tdapi_backend_stop_kills",
     "tdapi_breaker_state",
     "tdapi_breaker_consecutive_failures",
+    # gang resharding (services/replicaset.py reshards_total)
+    "tdapi_reshards_total",
     # replace fast path (utils/copyfast.py METRICS)
     "tdapi_replace_copy_bytes",
     "tdapi_replace_copy_seconds",

@@ -26,7 +26,9 @@ what its scheduled workloads (PyTorch+NCCL images) did for themselves.
 
 from __future__ import annotations
 
+import json
 import math
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -87,6 +89,39 @@ class MeshPlan:
                 f"tp({tp})*sp({sp})*pp({pp})*ep({ep}) must divide device "
                 f"count {n_devices}")
         return cls(dp=1, fsdp=rest, pp=pp, ep=ep, tp=tp, sp=sp)
+
+
+def plan_from_env(env: Optional[dict] = None) -> Optional[MeshPlan]:
+    """Parse the control plane's gang mesh contract (TDAPI_MESH_PLAN — a
+    JSON dict of axis factors, stamped by the scheduler next to
+    TPU_VISIBLE_CHIPS) into the MeshPlan the workload must build. Returns
+    None when the env carries no plan (single-chip / legacy launch). A
+    malformed value raises: the scheduler shaped the grant for THIS plan,
+    so silently falling back to an auto plan would put collectives on
+    links the placement never promised."""
+    e = os.environ if env is None else env
+    raw = e.get("TDAPI_MESH_PLAN", "")
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+    except json.JSONDecodeError as err:
+        raise ValueError(f"unparsable TDAPI_MESH_PLAN={raw!r}") from err
+    if not isinstance(d, dict):
+        raise ValueError(f"TDAPI_MESH_PLAN must be a JSON object, got {raw!r}")
+    unknown = sorted(set(d) - set(AXES))
+    if unknown:
+        raise ValueError(f"TDAPI_MESH_PLAN has unknown axis(es) {unknown}")
+    vals = {}
+    for a in AXES:
+        v = d.get(a, 1)
+        # strict: int(2.5) would silently build a smaller mesh than the
+        # scheduler granted — the exact mismatch this parse must refuse
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ValueError(
+                f"TDAPI_MESH_PLAN.{a} must be a positive integer, got {v!r}")
+        vals[a] = v
+    return MeshPlan(**vals)
 
 
 def make_mesh(plan: MeshPlan, devices: Optional[list] = None) -> Mesh:

@@ -84,10 +84,14 @@ CONSULTED_STEPS = frozenset({"created", "copied", "migrated"})
 #: gateway.scale markers are informational by the same argument as
 #: "precopied": cloned bytes live in the new container's layer and die
 #: with it on unwind, so replay branches on the stored record alone.
+#: "resharded" (a gang replace's mesh-shape change) is informational for
+#: the same reason "quiesced" is: the plan lives in the stored spec (and
+#: its env), so replay of the surrounding replace already lands the right
+#: shape — the marker documents the in-flight transition for operators.
 INFORMATIONAL_STEPS = frozenset({
-    "granted", "persisted", "precopied", "quiesced", "stopped_old",
-    "started_new", "removed_old", "stopped", "restored", "removed",
-    "cloned", "replica_started", "replica_stopped",
+    "granted", "persisted", "precopied", "quiesced", "resharded",
+    "stopped_old", "started_new", "removed_old", "stopped", "restored",
+    "removed", "cloned", "replica_started", "replica_stopped",
 })
 
 KNOWN_STEPS = CONSULTED_STEPS | INFORMATIONAL_STEPS

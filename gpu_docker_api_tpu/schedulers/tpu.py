@@ -26,10 +26,13 @@ import time
 from typing import Optional
 
 from .. import xerrors
+from ..meshplan import PlanSpec
 from ..obs import metrics as obs_metrics
 from ..obs import trace
 from ..store.client import StateClient
-from ..topology import TpuTopology, chips_per_host_for, discover_topology
+from ..topology import (
+    TpuTopology, chips_per_host_for, discover_topology, plan_fits_box,
+)
 from ..workqueue import WorkQueue
 from .base import FREE, Scheduler, _norm_owner, merge_stored_status
 
@@ -143,7 +146,8 @@ class TpuScheduler(Scheduler):
             (time.perf_counter() - t0) * 1e3, kind=kind)
 
     def apply(self, n: int, owner: str = "",
-              reuse: Optional[list[int]] = None) -> list[int]:
+              reuse: Optional[list[int]] = None,
+              plan: Optional[PlanSpec] = None) -> list[int]:
         """Grant n chips as an ICI-contiguous set; returns chip indices.
 
         owner: who holds the grant (restore is owner-checked).
@@ -153,9 +157,21 @@ class TpuScheduler(Scheduler):
         re-grant and the old container's teardown (chip exclusivity, SURVEY
         §7 hard part 2). Reused chips not in the new grant stay owned by
         `owner`; the caller restores them after the old container stops.
+        plan: a non-trivial MeshPlan makes this a GANG grant — only an
+        axis-aligned box whose geometry hosts the plan's axis factors
+        (topology.plan_fits_box: tp/sp innermost on contiguous links, pp
+        stages adjacent slabs) qualifies; there is no connected-set or
+        fragmented fallback, because the workload will reshape the grant
+        row-major into exactly this mesh and a fragmented grant would put
+        the chattiest collectives on multi-hop paths.
         """
         if n <= 0:
             return []
+        if plan is not None and plan.is_trivial:
+            plan = None
+        if plan is not None and plan.size != n:
+            raise ValueError(f"plan {plan.to_json()} sized {plan.size} "
+                             f"cannot shape a {n}-chip grant")
         with trace.span("sched.tpu.apply", target=owner, n=n) as sp, \
                 self._granting("tpu"):
             # cordoned chips are invisible to placement — not free, and not
@@ -175,7 +191,12 @@ class TpuScheduler(Scheduler):
                     f"want {n}, only {len(free)} of {len(self.status)} "
                     f"allocatable ({len(self.cordoned)} cordoned, "
                     f"{len(self.shares)} share-split)")
-            grant = self._find_box(n, free, prefer=reusable)
+            grant = self._find_box(n, free, prefer=reusable, plan=plan)
+            if grant is None and plan is not None:
+                raise xerrors.TpuNotEnoughError(
+                    f"no free ICI-contiguous sub-mesh fits meshPlan "
+                    f"{plan.to_json()} ({n} chips; "
+                    f"{len(free)} free of {len(self.status)})")
             if grant is None:
                 grant = self._find_connected(n, free, prefer=reusable)
             if grant is None:
@@ -348,49 +369,75 @@ class TpuScheduler(Scheduler):
     # ---- placement search ----
 
     def _find_box(self, n: int, free: set[int],
-                  prefer: Optional[set[int]] = None) -> Optional[list[int]]:
+                  prefer: Optional[set[int]] = None,
+                  plan: Optional[PlanSpec] = None) -> Optional[list[int]]:
         """Best free axis-aligned box of volume n: compact dims first, then
         max overlap with `prefer` (the lift-in-place chips on a patch —
         SURVEY §7 hard part 1: the new grant should CONTAIN the old one
         when an equally good box does), then the most packed placement
         (fewest free ICI neighbors outside the box — keeps the remaining
         free space contiguous). Uses the C++ core (native/topology_alloc.cc)
-        when available on non-torus meshes."""
+        when available on non-torus meshes.
+
+        With a plan, only boxes whose geometry hosts the plan's axis
+        factors qualify (topology.plan_fits_box), and among those the
+        placement whose tp*sp inner chunks split across the fewest hosts
+        wins the tie — "tp/sp inside a host where possible" is a score,
+        not a hard requirement, exactly like the whole-box worker span."""
         prefer = prefer or set()
-        native = self._native_find_box(n, free)
-        if native is not None:
-            if not native:
-                return None      # core searched the same space: no box exists
-            # the core doesn't score worker spans or reuse overlap — accept
-            # its pick only when neither axis could rank another box higher
-            # (full prefer containment can't be beaten on the overlap axis)
-            if (prefer <= set(native)
-                    and len(self.topology.workers_spanned(native)) == 1):
-                return native
+        if plan is None:
+            native = self._native_find_box(n, free)
+            if native is not None:
+                if not native:
+                    return None  # core searched the same space: no box exists
+                # the core doesn't score worker spans or reuse overlap —
+                # accept its pick only when neither axis could rank another
+                # box higher (full prefer containment can't be beaten on
+                # the overlap axis)
+                if (prefer <= set(native)
+                        and len(self.topology.workers_spanned(native)) == 1):
+                    return native
+        factors = plan.factors() if plan is not None else None
+        inner = (plan.tp * plan.sp) if plan is not None else 1
         best: Optional[list[int]] = None
         best_key: Optional[tuple] = None
-        for idx, box, ext, sa, span, origin in self._box_candidates(n):
+        for idx, box, ext, sa, span, origin, dims in self._box_candidates(n):
             # candidates are sorted by (span, sa) — once a fit exists, no
             # later candidate with a strictly worse rank prefix can win
             if best_key is not None and (span, sa) > best_key[:2]:
                 break
+            if factors is not None and not plan_fits_box(dims, factors):
+                continue
             if not box <= free:
                 continue
             # exterior free links = fragmentation damage; fewer is better
             ext_free = sum(1 for e in ext if e in free)
-            key = (span, sa, -len(box & prefer), ext_free,
+            key = (span, sa, self._inner_host_splits(idx, inner),
+                   -len(box & prefer), ext_free,
                    origin[2], origin[1], origin[0])
             if best_key is None or key < best_key:
                 best_key = key
                 best = idx
         return best
 
+    def _inner_host_splits(self, idx: list[int], inner: int) -> int:
+        """How many row-major inner (tp*sp) chunks of a candidate grant
+        span more than one TPU VM host. 0 for non-plan grants — the
+        term then never reorders the legacy ranking."""
+        if inner <= 1:
+            return 0
+        wof = self.topology.worker_of
+        return sum(
+            1 for i in range(0, len(idx), inner)
+            if len({wof(j) for j in idx[i:i + inner]}) > 1)
+
     def _box_candidates(self, n: int) -> list[tuple]:
         """Memoized per-n candidate boxes as
         (indices, index_frozenset, exterior_neighbor_indices, surface_area,
-        workers_spanned, origin) — everything about a candidate that does
-        not depend on the current free set. span ranks first: an intra-host
-        grant needs no cross-host process mesh (and one container, not K)."""
+        workers_spanned, origin, dims) — everything about a candidate that
+        does not depend on the current free set. span ranks first: an
+        intra-host grant needs no cross-host process mesh (and one
+        container, not K)."""
         cached = self._box_cache.get(n)
         if cached is None:
             topo = self.topology
@@ -402,13 +449,28 @@ class TpuScheduler(Scheduler):
                             for nb in topo.neighbors(topo.chip(i))
                             if nb.index not in box)
                 sa = dims[0] * dims[1] + dims[1] * dims[2] + dims[0] * dims[2]
-                cached.append((idx, box, ext,
-                               sa, len(topo.workers_spanned(idx)), origin))
+                cached.append((idx, box, ext, sa,
+                               len(topo.workers_spanned(idx)), origin, dims))
             # (span, sa)-ascending lets _find_box stop at the first rank
             # class that yields a fit
             cached.sort(key=lambda c: (c[4], c[3]))
             self._box_cache[n] = cached
         return cached
+
+    def plan_feasible(self, plan: PlanSpec) -> bool:
+        """Whether ANY sub-box of this topology could host `plan`
+        (geometry only — ignores occupancy). The admission check behind
+        the API's meshPlan validation: a plan that fails here can never
+        be granted on this slice, so the request is a client error (1000),
+        not a capacity 1012."""
+        if plan.is_trivial:
+            return True
+        n = plan.size
+        if n > len(self.status) or not self.topology.ici_connected:
+            return False
+        factors = plan.factors()
+        return any(plan_fits_box(dims, factors)
+                   for *_, dims in self._box_candidates(n))
 
     def _native_find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
         """C++ box search. Returns None when the core doesn't apply (torus,
@@ -548,9 +610,15 @@ class TpuScheduler(Scheduler):
                     "shares": {c: dict(o) for c, o in self.shares.items()},
                     "cordoned": set(self.cordoned)}
 
-    def env_for(self, grant: list[int]) -> dict[str, str]:
-        """TPU env plumbing for a grant (SURVEY §5.7)."""
-        return self.topology.visible_chips_env(grant)
+    def env_for(self, grant: list[int],
+                plan: Optional[PlanSpec] = None) -> dict[str, str]:
+        """TPU env plumbing for a grant (SURVEY §5.7). A plan (trivial
+        included — an explicitly requested dp=1 pins the workload to a
+        1-device mesh) additionally stamps TDAPI_MESH_PLAN, the gang mesh
+        contract; None stamps nothing (legacy/no-plan launches keep their
+        auto-mesh behavior)."""
+        plan_d = plan.to_json() if plan is not None else None
+        return self.topology.visible_chips_env(grant, plan=plan_d)
 
     def device_paths(self, grant: list[int]) -> list[str]:
         return [self.topology.chip(i).device_path for i in grant]

@@ -32,6 +32,7 @@ from ..events import EventLog
 from ..health import HealthMonitor
 from ..idempotency import IdempotencyCache
 from ..intents import IntentJournal
+from ..meshplan import PlanSpec
 from ..obs import metrics as obs_metrics
 from ..obs.metrics import Registry
 from ..obs.trace import TraceCollector
@@ -496,6 +497,28 @@ class App:
 
     # ------------------------------------------------- replicaSet handlers
 
+    def _validate_mesh_plan(self, plan_json, tpu_count) -> Optional[Response]:
+        """Admission validation for a request's meshPlan: well-formed axis
+        factors, product == tpuCount (strict at the wire — an explicit
+        plan that doesn't multiply out is a client mistake even when
+        trivial), and geometrically hostable on this slice's topology.
+        Returns the 1000 error Response, or None when valid/absent."""
+        if plan_json is None:
+            return None
+        try:
+            plan = PlanSpec.from_json(plan_json)
+            plan.validate_count(tpu_count)
+        except ValueError as e:
+            return err(ResCode.InvalidParams, str(e))
+        if not self.tpu.plan_feasible(plan):
+            return err(
+                ResCode.InvalidParams,
+                f"meshPlan {plan.to_json()} cannot map onto the "
+                f"{self.tpu.topology.accelerator_type} topology "
+                f"(shape {list(self.tpu.topology.shape)}): no sub-box "
+                f"hosts these axis factors ICI-contiguously")
+        return None
+
     def h_run(self, req: Request) -> Response:
         spec = ContainerRun.from_json(req.json())
         if not spec.imageName:
@@ -513,6 +536,9 @@ class App:
         if spec.priority not in regulator.PRIORITIES:
             return err(ResCode.InvalidParams,
                        f"priority must be one of {regulator.PRIORITIES[1:]}")
+        bad = self._validate_mesh_plan(spec.meshPlan, spec.tpuCount)
+        if bad is not None:
+            return bad
         if spec.cpuCount < 0:
             return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
         if spec.memory and not valid_size_unit(spec.memory):
@@ -547,6 +573,9 @@ class App:
                 parse_tpu_count(tp.tpuCount)
             except ValueError as e:
                 return err(ResCode.InvalidParams, str(e))
+            bad = self._validate_mesh_plan(tp.meshPlan, tp.tpuCount)
+            if bad is not None:
+                return bad
         cp = patch.cpuPatch
         if cp is not None and cp.cpuCount < 0:
             return err(ResCode.CpuCountMustBeGreaterThanOrEqualZero)
@@ -1116,6 +1145,11 @@ class App:
             "tdapi_backend_stop_kills",
             "stop() escalations: workload ignored SIGTERM for the whole "
             "stop timeout and ate a SIGKILL", typ="counter")
+        g_reshards = m.gauge(
+            "tdapi_reshards_total",
+            "gang mesh-shape changes committed through the rolling "
+            "replace (PATCH tpuCount/meshPlan on a MeshPlan'd set)",
+            typ="counter")
         # rolling-replace data movement (utils/copyfast.py)
         g_cp_bytes = m.gauge("tdapi_replace_copy_bytes", typ="counter")
         g_cp_secs = m.gauge("tdapi_replace_copy_seconds", typ="counter")
@@ -1218,6 +1252,7 @@ class App:
                              for c in self.health.report()["chips"]))
             g_kills.set(getattr(getattr(self.backend, "inner", self.backend),
                                 "stop_kills", 0))
+            g_reshards.set(self.replicasets.reshards_total)
             cf = copyfast.METRICS.snapshot()
             g_cp_bytes.set(cf["copyBytes"])
             g_cp_secs.set(cf["copySeconds"])

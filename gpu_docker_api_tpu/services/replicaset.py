@@ -59,6 +59,7 @@ from ..dtos import (
 )
 from ..faults import crashpoint
 from ..intents import Intent, IntentJournal
+from ..meshplan import PlanSpec, stored_plan
 from ..obs import trace
 from ..schedulers import (
     SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
@@ -160,6 +161,9 @@ class ReplicaSetService:
         # read hot on the heels of a mutation must not depend on the queue
         # having drained (the reference reads etcd here and wins by luck)
         self._latest: dict[str, StoredContainerInfo] = {}
+        # gang reshard counter (mesh-shape changes committed through the
+        # rolling replace) — exported as tdapi_reshards_total
+        self.reshards_total = 0
 
     @contextlib.contextmanager
     def _mutex(self, name: str):
@@ -223,6 +227,14 @@ class ReplicaSetService:
                 spec.memory_bytes = to_bytes(req.memory)
 
             whole, quanta = parse_tpu_count(req.tpuCount)
+            # gang plan: a non-trivial meshPlan makes this a plan-shaped
+            # grant. An EXPLICITLY trivial plan on a 1-chip run still
+            # stores + stamps (it pins the workload to a 1-device mesh —
+            # the dp=1 leg of a reshard cycle); absent stays legacy.
+            plan = PlanSpec.from_json(req.meshPlan)
+            if not plan.is_trivial:
+                plan.validate_count(req.tpuCount)
+            store = stored_plan(plan, req.meshPlan, whole)
             meta = {"idemPartial": True} if idem_partial else {}
             intent = self.intents.begin("run", name, **meta)
             try:
@@ -235,7 +247,9 @@ class ReplicaSetService:
                                          quanta, name, avoid=share_avoid)],
                                      shares=quanta)
                 elif whole > 0:
-                    self._grant_tpus(spec, self.tpu.apply(whole, name))
+                    self._grant_tpus(spec,
+                                     self.tpu.apply(whole, name, plan=plan),
+                                     plan=store)
                 if req.cpuCount > 0:
                     spec.cpuset = self.cpu.apply(req.cpuCount, name)
                     spec.cpu_count = req.cpuCount
@@ -274,10 +288,18 @@ class ReplicaSetService:
             spec.binds.append(bind)
 
     def _grant_tpus(self, spec: ContainerSpec, grant: list[int],
-                    shares: int = 0) -> None:
+                    shares: int = 0,
+                    plan: Optional[PlanSpec] = None) -> None:
         spec.tpu_chips = grant
         spec.tpu_shares = shares
-        spec.tpu_env = self.tpu.env_for(grant) if grant else {}
+        # the granted gang shape rides the spec (describe/history) AND the
+        # container env (TDAPI_MESH_PLAN via env_for). plan=None = no
+        # plan semantics: stores {} and stamps nothing, so legacy records
+        # and fractional grants stay unchanged — the CALLER resolves
+        # explicit-trivial (store + stamp, pinning a 1-device mesh) vs
+        # absent (legacy auto-mesh).
+        spec.mesh_plan = plan.to_json() if plan is not None else {}
+        spec.tpu_env = self.tpu.env_for(grant, plan=plan) if grant else {}
         spec.devices = self.tpu.device_paths(grant)
 
     def _release_tpus(self, spec: ContainerSpec, name: str) -> None:
@@ -421,7 +443,8 @@ class ReplicaSetService:
                 if req.tpuPatch is not None:
                     changed |= self._patch_tpu(name, new_spec, old,
                                                req.tpuPatch.tpuCount,
-                                               took_fresh=took_fresh)
+                                               took_fresh=took_fresh,
+                                               plan_json=req.tpuPatch.meshPlan)
                 if req.cpuPatch is not None:
                     changed |= self._patch_cpu(name, new_spec, old,
                                                req.cpuPatch.cpuCount)
@@ -444,19 +467,40 @@ class ReplicaSetService:
 
     def _patch_tpu(self, name: str, spec: ContainerSpec,
                    old: StoredContainerInfo, count: float,
-                   took_fresh: Optional[dict] = None) -> bool:
-        """Re-grant chips when the count changes (reference patchGpu
-        :448-495) — in place: a whole-chip old grant is offered for
-        reuse, never released to the pool mid-patch. Fractional targets
-        take a FRESH share grant (preferring the chip already held, so
-        an unchanged-chip resize stays put when capacity allows); the
-        old holding is released only after the replace commits, and the
-        ledger sums both during the window — capacity-checked, so the
-        transition can never oversubscribe a co-tenant. took_fresh (when
-        given) records that a fresh share grant now exists — the release
-        paths key on it instead of comparing specs."""
+                   took_fresh: Optional[dict] = None,
+                   plan_json: Optional[dict] = None) -> bool:
+        """Re-grant chips when the count OR the gang mesh plan changes
+        (reference patchGpu :448-495) — in place: a whole-chip old grant
+        is offered for reuse, never released to the pool mid-patch.
+        Fractional targets take a FRESH share grant (preferring the chip
+        already held, so an unchanged-chip resize stays put when capacity
+        allows); the old holding is released only after the replace
+        commits, and the ledger sums both during the window —
+        capacity-checked, so the transition can never oversubscribe a
+        co-tenant. took_fresh (when given) records that a fresh share
+        grant now exists — the release paths key on it instead of
+        comparing specs.
+
+        plan_json: the patch's meshPlan. None = unspecified — an
+        unchanged count keeps the stored plan, a count change resets a
+        gang set to the trivial plan (the new chip count invalidates the
+        old factors). An explicit dict (rollback passes the historical
+        spec's, {} included) always wins. A plan or chip-set change on a
+        gang set is a RESHARD: the grant is plan-shaped
+        (reshard.after_grant is the crash boundary) and the replace that
+        follows re-meshes the workload."""
         whole, quanta = parse_tpu_count(count)
-        if count == self._spec_tpu_count(old.spec):
+        old_count = self._spec_tpu_count(old.spec)
+        old_plan = PlanSpec.from_spec(old.spec.mesh_plan)
+        if plan_json is not None:
+            plan = PlanSpec.from_json(plan_json)
+            if not plan.is_trivial:
+                plan.validate_count(count)
+        elif count == old_count:
+            plan = old_plan
+        else:
+            plan = PlanSpec()
+        if count == old_count and plan == old_plan:
             return False
         if quanta:
             prefer = (old.spec.tpu_chips[0]
@@ -469,8 +513,12 @@ class ReplicaSetService:
         reuse = (list(old.spec.tpu_chips)
                  if not old.resourcesReleased and not old.spec.tpu_shares
                  else [])
-        self._grant_tpus(spec, self.tpu.apply(whole, name, reuse=reuse)
-                         if whole > 0 else [])
+        self._grant_tpus(spec, self.tpu.apply(whole, name, reuse=reuse,
+                                              plan=plan)
+                         if whole > 0 else [],
+                         plan=stored_plan(plan, plan_json, whole))
+        if not plan.is_trivial or not old_plan.is_trivial:
+            crashpoint("reshard.after_grant")
         return True
 
     def _patch_cpu(self, name: str, spec: ContainerSpec,
@@ -573,6 +621,16 @@ class ReplicaSetService:
         from ..utils import copyfast
         old_holds = not old.resourcesReleased
         old_ports = list(old.spec.port_bindings.values())
+        # gang reshard: a mesh-shape or chip-set change on a replicaSet
+        # that carries (or carried) a non-trivial MeshPlan. The replace
+        # machinery is identical — quiesce-checkpoint, stop, delta, start
+        # — but the restarted workload re-meshes under the NEW plan, so
+        # the transition gets its own crash boundary, intent marker, and
+        # event (the SURVEY's dp=1 -> 4 -> 1 scenario).
+        reshard = bool(
+            (old.spec.mesh_plan or new_spec.mesh_plan)
+            and (old.spec.mesh_plan != new_spec.mesh_plan
+                 or sorted(old.spec.tpu_chips) != sorted(new_spec.tpu_chips)))
         container_ports = list(new_spec.port_bindings.keys())
         new_spec.port_bindings = {}
         info = self._create_and_start(name, new_spec, container_ports,
@@ -627,6 +685,17 @@ class ReplicaSetService:
                 intent.step("quiesced", sync=False, ok=quiesced,
                             step=quiesce_step)
             crashpoint("replace.after_quiesce")
+            if reshard:
+                # informational like "quiesced": replay branches on the
+                # stored record alone — the marker documents WHAT shape
+                # change was in flight for the operator reading the journal
+                if intent is not None:
+                    intent.step("resharded", sync=False,
+                                fromPlan=old.spec.mesh_plan or {},
+                                toPlan=new_spec.mesh_plan or {},
+                                fromChips=sorted(old.spec.tpu_chips),
+                                toChips=sorted(new_spec.tpu_chips))
+                crashpoint("reshard.after_quiesce")
             t_window = time.perf_counter()
             if old_state.exists and (old_state.running or old_state.paused):
                 self.backend.stop(old.containerName)
@@ -689,6 +758,16 @@ class ReplicaSetService:
                     (pre_stats.seconds if pre_stats else 0.0)
                     + (copy_stats.seconds if copy_stats else 0.0), 6),
                 downtimeMs=round(downtime_ms, 3))
+        if reshard:
+            self.reshards_total += 1
+            if self.events is not None:
+                self.events.record(
+                    "reshard", target=name,
+                    fromPlan=old.spec.mesh_plan or {},
+                    toPlan=new_spec.mesh_plan or {},
+                    fromChips=sorted(old.spec.tpu_chips),
+                    toChips=sorted(new_spec.tpu_chips),
+                    quiesced=quiesced, quiesceStep=quiesce_step)
         self._record_merge(name, info.containerName)
         # delete-old-for-update (reference :660-679): drop it, free the old
         # version's resources that the new version did not take over — only
@@ -779,9 +858,14 @@ class ReplicaSetService:
                 oldReleased=old.resourcesReleased)
             took_fresh = {"shares": False}
             try:
+                # the historical plan is part of the rolled-back-to config:
+                # pass it EXPLICITLY ({} for a pre-gang version) so a
+                # rollback across a reshard restores the old mesh shape,
+                # not just the old chip count
                 self._patch_tpu(name, target_spec, old,
                                 self._spec_tpu_count(hist.spec),
-                                took_fresh=took_fresh)
+                                took_fresh=took_fresh,
+                                plan_json=hist.spec.mesh_plan or {})
                 self._patch_cpu(name, target_spec, old, hist.spec.cpu_count)
                 intent.step("granted", sync=False, tpuChips=target_spec.tpu_chips,
                             cpuset=target_spec.cpuset)
@@ -868,9 +952,15 @@ class ReplicaSetService:
                             shares=old.spec.tpu_shares)
                         fresh = True
                     else:
+                        # a gang set migrates as a gang: the re-grant is
+                        # plan-shaped (apply excludes cordoned chips from
+                        # pool and reuse alike); plan-less stays plan-less
+                        dr_plan = (PlanSpec.from_spec(old.spec.mesh_plan)
+                                   if old.spec.mesh_plan else None)
                         self._grant_tpus(new_spec, self.tpu.apply(
                             len(old.spec.tpu_chips), name,
-                            reuse=list(old.spec.tpu_chips)))
+                            reuse=list(old.spec.tpu_chips), plan=dr_plan),
+                            plan=dr_plan)
                     intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
                     info = self._rolling_replace(name, old, new_spec, intent,
                                                  meta_out=migration_meta,
@@ -970,8 +1060,14 @@ class ReplicaSetService:
                         self._grant_tpus(new_spec, fresh_tpu,
                                          shares=fresh_shares)
                     elif old.spec.tpu_chips:
-                        fresh_tpu = self.tpu.apply(len(old.spec.tpu_chips), name)
-                        self._grant_tpus(new_spec, fresh_tpu)
+                        # gang spec: the fresh grant must be plan-shaped
+                        # too (and keep stamping TDAPI_MESH_PLAN); a
+                        # plan-less spec stays plan-less
+                        rs_plan = (PlanSpec.from_spec(old.spec.mesh_plan)
+                                   if old.spec.mesh_plan else None)
+                        fresh_tpu = self.tpu.apply(len(old.spec.tpu_chips),
+                                                   name, plan=rs_plan)
+                        self._grant_tpus(new_spec, fresh_tpu, plan=rs_plan)
                     if old.spec.cpu_count:
                         fresh_cpu = self.cpu.apply(old.spec.cpu_count, name)
                         new_spec.cpuset = fresh_cpu
@@ -1041,6 +1137,7 @@ class ReplicaSetService:
             "running": running,
             "paused": paused,
             "resourcesReleased": info.resourcesReleased,
+            "meshPlan": PlanSpec.from_spec(info.spec.mesh_plan).to_json(),
             "spec": info.spec.to_json(),
         }
         if degraded:
@@ -1052,7 +1149,8 @@ class ReplicaSetService:
         chips = info.spec.tpu_chips
         if chips and len(topo.workers_spanned(chips)) > 1:
             out["multihost"] = {
-                str(w): env for w, env in topo.multihost_env(chips).items()}
+                str(w): env for w, env in topo.multihost_env(
+                    chips, plan=info.spec.mesh_plan or None).items()}
         return out
 
     def get_container_history(self, name: str) -> list[dict]:
@@ -1141,6 +1239,9 @@ class ReplicaSetService:
             # (0 = whole-chip grant) and the regulator priority class
             "tpuShares": info.spec.tpu_shares,
             "priority": info.spec.priority,
+            # the granted gang shape as a FULL axis dict (trivial for
+            # non-gang sets) — what a client resharding via PATCH reads
+            "meshPlan": PlanSpec.from_spec(info.spec.mesh_plan).to_json(),
             "cpuset": info.spec.cpuset,
             "portBindings": info.spec.port_bindings,
         }

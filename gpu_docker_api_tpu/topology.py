@@ -18,6 +18,7 @@ worker (SURVEY §5.8).
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 from dataclasses import dataclass, field
@@ -211,7 +212,8 @@ class TpuTopology:
         return mins, dims, full  # type: ignore[return-value]
 
     def multihost_env(self, indices: list[int], base_port: int = 8476,
-                      host_names: Optional[list[str]] = None
+                      host_names: Optional[list[str]] = None,
+                      plan: Optional[dict] = None
                       ) -> dict[int, dict[str, str]]:
         """Per-worker env for a grant spanning TPU VM workers: what each
         worker's container needs so the libtpu processes form ONE slice
@@ -283,14 +285,23 @@ class TpuTopology:
                     f"{per_dims[0]},{per_dims[1]},{per_dims[2]}")
                 env["TPU_PROCESS_BOUNDS"] = (
                     f"{pbounds[0]},{pbounds[1]},{pbounds[2]}")
+            if plan:
+                # the gang contract: every worker builds the SAME mesh
+                # shape the scheduler granted (parallel/mesh.plan_from_env)
+                env["TDAPI_MESH_PLAN"] = json.dumps(plan, sort_keys=True)
             envs[w] = env
         return envs
 
     # ---- env plumbing for the scheduled workload ----
 
-    def visible_chips_env(self, indices: list[int]) -> dict[str, str]:
+    def visible_chips_env(self, indices: list[int],
+                          plan: Optional[dict] = None) -> dict[str, str]:
         """Env a container/process needs so JAX sees exactly these chips as a
         well-formed mesh: TPU_VISIBLE_CHIPS + per-process bounds (SURVEY §5.7).
+        `plan` (a full {dp..sp} axis-factor dict) additionally stamps
+        TDAPI_MESH_PLAN — the gang contract parallel/mesh.plan_from_env
+        consumes so the workload builds exactly the mesh whose geometry
+        the grant was shaped for.
         """
         idx = sorted(indices)
         env = {
@@ -311,6 +322,8 @@ class TpuTopology:
             if full:
                 env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{bounds[0]},{bounds[1]},{bounds[2]}"
                 env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        if plan:
+            env["TDAPI_MESH_PLAN"] = json.dumps(plan, sort_keys=True)
         return env
 
     def serialize(self) -> dict:
@@ -324,6 +337,54 @@ class TpuTopology:
             "chipsPerHost": self.chips_per_host,
             "iciConnected": self.ici_connected,
         }
+
+
+def chunk_contiguous(dims: Coord, k: int) -> bool:
+    """True when row-major chunks of size k (aligned at multiples of k)
+    are each an ICI-connected sub-box of a box with extents `dims`.
+
+    Row-major order fills x fastest: a chunk is a run within one row
+    (k divides the x extent), a stack of whole rows (k a row-multiple
+    dividing into whole y runs), or a stack of whole planes. This is the
+    "folded" contiguity condition — exactly when a mesh axis of extent
+    n/k laid over those chunks keeps every chunk physically compact."""
+    a, b, c = dims
+    if k <= 1 or k == a * b * c:
+        return True
+    if k <= a:
+        return a % k == 0
+    if k % a == 0:
+        kk = k // a
+        if kk <= b:
+            return b % kk == 0
+        if kk % b == 0:
+            return c % (kk // b) == 0
+    return False
+
+
+def plan_fits_box(dims: Coord, factors: tuple) -> bool:
+    """True when a box with extents `dims` can host a MeshPlan whose axis
+    factors are `factors` (outermost first, i.e. (dp, fsdp, pp, ep, tp,
+    sp)) such that EVERY mesh axis maps to ICI-contiguous sub-boxes under
+    row-major chip order.
+
+    The device mesh is factors reshaped row-major over the box's
+    row-major chip order (parallel/mesh.make_mesh), so axis groups are
+    aligned chunks of the flat order: requiring every suffix product
+    (sp, tp*sp, ep*tp*sp, ...) to be folded-contiguous guarantees the
+    innermost (chattiest) axes ride adjacent ICI links and each pp stage
+    is a compact slab adjacent to its ring neighbors."""
+    n = 1
+    for f in factors:
+        n *= f
+    if n != dims[0] * dims[1] * dims[2]:
+        return False
+    k = 1
+    for f in reversed(factors):
+        k *= f
+        if not chunk_contiguous(dims, k):
+            return False
+    return True
 
 
 def chips_per_host_for(generation: str) -> int:

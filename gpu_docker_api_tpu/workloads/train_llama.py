@@ -74,7 +74,7 @@ def main(argv=None) -> int:
     import jax
 
     from ..models import named_config
-    from ..parallel.mesh import MeshPlan, best_tp_for
+    from ..parallel.mesh import MeshPlan, best_tp_for, plan_from_env
     from ..train import (
         QuiesceSignal, Trainer, TrainConfig, clear_quiesce_marker,
         read_quiesce_marker, restore_checkpoint, save_checkpoint,
@@ -96,10 +96,29 @@ def main(argv=None) -> int:
     except KeyError as e:
         p.error(str(e))
 
-    n_dev = jax.device_count()
-    fixed = args.sp * args.pp * args.ep
-    tp = args.tp or best_tp_for(n_dev // fixed if n_dev % fixed == 0 else 1)
-    plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp, pp=args.pp, ep=args.ep)
+    # gang contract: when the control plane granted a plan-shaped sub-mesh
+    # it stamped TDAPI_MESH_PLAN next to TPU_VISIBLE_CHIPS — build EXACTLY
+    # that mesh (a reshard restarts this process with a new plan + chip
+    # set, and resumes the checkpoint under the new sharding). CLI axis
+    # flags only apply to un-planned launches.
+    devices = None
+    plan = plan_from_env()
+    if plan is not None:
+        n_dev = plan.size
+        if jax.device_count() < n_dev:
+            raise SystemExit(
+                f"TDAPI_MESH_PLAN needs {n_dev} devices, "
+                f"jax sees {jax.device_count()}")
+        # CPU-forced runs (tests/bench) over-provision virtual devices;
+        # the mesh uses exactly the planned count
+        devices = jax.devices()[:n_dev]
+    else:
+        n_dev = jax.device_count()
+        fixed = args.sp * args.pp * args.ep
+        tp = args.tp or best_tp_for(n_dev // fixed if n_dev % fixed == 0
+                                    else 1)
+        plan = MeshPlan.auto(n_dev, tp=tp, sp=args.sp, pp=args.pp,
+                             ep=args.ep)
     trainer = Trainer.create(
         config, plan, tc=TrainConfig(n_microbatches=args.microbatches,
                                      virtual_stages=args.virtual_stages,
@@ -107,7 +126,8 @@ def main(argv=None) -> int:
                                      warmup_steps=args.warmup_steps,
                                      decay_steps=args.decay_steps,
                                      min_lr_ratio=args.min_lr_ratio,
-                                     accum_steps=args.accum_steps))
+                                     accum_steps=args.accum_steps),
+        devices=devices)
 
     # resume-first: restore against the ABSTRACT state template (no device
     # materialization); pay for a fresh sharded init only when there is no

@@ -201,6 +201,8 @@ def build_spec() -> dict:
     run_resp_example = {
         "name": "train-1", "version": 1, "tpuChips": [0, 1, 2, 3],
         "tpuShares": 0, "priority": "",
+        "meshPlan": {"dp": 1, "fsdp": 2, "pp": 1, "ep": 1, "tp": 2,
+                     "sp": 1},
         "cpuset": "0-7", "portBindings": {"8000": 40001},
     }
     spec_example = {
@@ -234,10 +236,34 @@ def build_spec() -> dict:
              "dest": s("Mount point inside the container")},
             desc="Volume/host-dir mount (dtos.Bind; wire format of the "
                  "reference models/container.go Bind)"),
+        "MeshPlan": obj(
+            {a: {"type": "integer", "minimum": 1, "default": 1,
+                 "description": d}
+             for a, d in [
+                 ("dp", "pure data parallelism (outermost axis)"),
+                 ("fsdp", "fully-sharded data parallelism (ZeRO-3)"),
+                 ("pp", "pipeline stages (granted as adjacent sub-mesh "
+                        "slabs along one axis)"),
+                 ("ep", "expert parallelism (MoE)"),
+                 ("tp", "tensor (megatron) parallelism — innermost with "
+                        "sp: placed on contiguous ICI links, inside one "
+                        "host where possible"),
+                 ("sp", "sequence/context parallelism (ring/Ulysses)")]},
+            additional=False,
+            desc="Gang parallelism plan: chips per mesh axis, outermost "
+                 "(dp) to innermost (sp). The product MUST equal the "
+                 "request's tpuCount (app error 1000 otherwise, also when "
+                 "no sub-box of the slice topology can host the factors "
+                 "ICI-contiguously). The scheduler grants an "
+                 "ICI-contiguous sub-mesh shaped for these factors and "
+                 "stamps TDAPI_MESH_PLAN into the container env so the "
+                 "workload builds exactly this mesh "
+                 "(docs/gang.md)."),
         "ContainerRun": obj(
             {"imageName": s("Image to run (required)"),
              "replicaSetName": s("Base name (required; no '-'; versions "
                                  "are named {name}-{v})"),
+             "meshPlan": ref("MeshPlan"),
              "tpuCount": {
                  "type": "number", "minimum": 0, "multipleOf": 0.25,
                  "description":
@@ -275,8 +301,21 @@ def build_spec() -> dict:
                                       "exactly 0.25/0.5/0.75 (counts "
                                       "above 1 must be whole; else app "
                                       "error 1000)"},
+                         "meshPlan": ref("MeshPlan"),
                          "gpuCount": {"type": "number", "minimum": 0,
-                                      "description": "Legacy alias"}}),
+                                      "description": "Legacy alias"}},
+                        desc="TPU re-grant. On a gang replicaSet a "
+                             "tpuCount/meshPlan change is a RESHARD: the "
+                             "workload is quiesce-checkpointed at an "
+                             "exact step, a new plan-shaped sub-mesh is "
+                             "granted, and the restarted version resumes "
+                             "the checkpoint under the new mesh (zero "
+                             "lost steps when the workload honors the "
+                             "quiesce contract; plain stop-and-replay "
+                             "fallback otherwise). meshPlan requires "
+                             "tpuCount; omitting meshPlan on a count "
+                             "change resets a gang set to the trivial "
+                             "plan."),
         "CpuPatch": obj({"cpuCount": i(minimum=0)}),
         "MemoryPatch": obj({"memory": s("e.g. '32GB'")}),
         "VolumePatch": obj({"oldBind": ref("Bind"),
@@ -322,7 +361,11 @@ def build_spec() -> dict:
                            "'best_effort')"),
              "tpu_env": obj({}, additional=s(),
                             desc="TPU env injected into the container "
-                                 "(TPU_VISIBLE_CHIPS etc.)"),
+                                 "(TPU_VISIBLE_CHIPS etc.; gang grants "
+                                 "add TDAPI_MESH_PLAN)"),
+             "mesh_plan": obj({}, additional=i(),
+                              desc="Granted gang plan as axis factors; "
+                                   "{} = trivial/no plan"),
              "devices": arr(s(), "/dev/accel* passthrough")},
             desc="Substrate-facing creation spec (dtos.ContainerSpec; the "
                  "reference stores docker Config+HostConfig here)"),
@@ -345,10 +388,12 @@ def build_spec() -> dict:
              "tpuShares": i("Share quanta (of 4) held on tpuChips[0]; "
                             "0 = whole-chip grant"),
              "priority": s("Regulator class for fractional co-tenancy"),
+             "meshPlan": ref("MeshPlan"),
              "cpuset": s(),
              "portBindings": obj({}, additional=i())},
             desc="run/patch/rollback/restart payload "
-                 "(services/replicaset.py _run_response)"),
+                 "(services/replicaset.py _run_response). meshPlan is the "
+                 "granted gang shape (all-1s for non-gang sets)."),
         "ExecuteResponse": obj({"output": s("Captured stdout+stderr")}),
         "CommitResponse": obj({"imageId": s(), "imageName": s()}),
         "ContainerInfo": obj(
@@ -361,6 +406,7 @@ def build_spec() -> dict:
              "resourcesReleased": b(),
              "degraded": b("Present/true when the answer came from the "
                            "store alone (substrate circuit open)"),
+             "meshPlan": ref("MeshPlan"),
              "spec": ref("ContainerSpec"),
              "multihost": obj(
                  {}, additional=obj({}, additional=s()),
@@ -696,7 +742,11 @@ def build_spec() -> dict:
                 desc="Creates version {name}-{v+1}; the writable layer is "
                      "copied; the old container stops BEFORE the new one "
                      "starts (TPU chips are exclusive). A tpuPatch "
-                     "prefers sub-meshes containing the current grant."),
+                     "prefers sub-meshes containing the current grant. On "
+                     "a gang replicaSet a tpuCount/meshPlan change is a "
+                     "live RESHARD (quiesce-checkpoint -> plan-shaped "
+                     "re-grant -> resume under the new mesh; docs/"
+                     "gang.md)."),
             "delete": op("deleteReplicaSet",
                          "Stop, release grants, delete all versions",
                          envelope(None), params=[NAME_PARAM],
@@ -1041,7 +1091,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.10.0",
+            "version": "0.11.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

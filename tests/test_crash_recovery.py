@@ -203,6 +203,50 @@ def post_replace(app, stored):
     assert _has_mark(app, "demo-2")
 
 
+def setup_reshard(app):
+    """A running 2-chip gang (tp=2 MeshPlan)."""
+    app.replicasets.run_container(ContainerRun(
+        imageName="img", replicaSetName="gang", tpuCount=2,
+        meshPlan={"tp": 2}))
+
+
+def scenario_reshard(app):
+    """The SURVEY scenario's scale-out: a gang patched to a 4-chip
+    dp=2 x tp=2 plan (reshard.* crashpoints fire inside it)."""
+    app.replicasets.patch_container("gang", PatchRequest(
+        tpuPatch=TpuPatch(tpuCount=4, meshPlan={"dp": 2, "tp": 2})))
+
+
+def post_reshard_grant(app, stored):
+    # reshard.after_grant sits BEFORE the new version exists: the grant is
+    # unwound, the old gang is intact on its original chips and plan
+    info = stored["gang"]
+    assert info.version == 1
+    assert len(info.spec.tpu_chips) == 2
+    assert info.spec.mesh_plan == {"dp": 1, "fsdp": 1, "pp": 1, "ep": 1,
+                                   "tp": 2, "sp": 1}
+    assert app.backend.inspect("gang-1").running
+    owned = [i for i, o in app.tpu.status.items() if o == "gang"]
+    assert sorted(owned) == sorted(info.spec.tpu_chips)
+    # and the retry SUCCEEDS: the unwound grant left capacity consistent
+    scenario_reshard(app)
+    out = app.replicasets.get_container_info("gang")
+    assert len(out["spec"]["tpu_chips"]) == 4
+    assert out["meshPlan"]["dp"] == 2 and out["meshPlan"]["tp"] == 2
+
+
+def post_reshard_quiesce(app, stored):
+    # reshard.after_quiesce sits AFTER the new version persisted: the
+    # reconciler rolls FORWARD — the 4-chip gang is live under its new plan
+    info = stored["gang"]
+    assert info.version == 2
+    assert len(info.spec.tpu_chips) == 4
+    assert info.spec.mesh_plan["dp"] == 2 and info.spec.mesh_plan["tp"] == 2
+    assert app.backend.inspect("gang-2").running
+    assert info.spec.tpu_env["TDAPI_MESH_PLAN"] == (
+        '{"dp": 2, "ep": 1, "fsdp": 1, "pp": 1, "sp": 1, "tp": 2}')
+
+
 def setup_rollback(app):
     run_demo(app)
     _mark(app, "demo-1")
@@ -298,6 +342,13 @@ SCENARIOS = [
     ("run.", (None, scenario_run, post_run)),
     ("replace.", (setup_replace, scenario_replace, post_replace)),
     ("rollback.", (setup_rollback, scenario_rollback, None)),
+    # the two reshard crashpoints straddle the new version's persist, so
+    # their recovery outcomes differ (unwind vs roll-forward) — each gets
+    # its own scenario row
+    ("reshard.after_grant", (setup_reshard, scenario_reshard,
+                             post_reshard_grant)),
+    ("reshard.after_quiesce", (setup_reshard, scenario_reshard,
+                               post_reshard_quiesce)),
     ("restart.", (setup_restart, scenario_restart, None)),
     ("stop.", (setup_stop, scenario_stop, post_stop)),
     ("delete.", (setup_delete, scenario_delete, post_delete)),
