@@ -7,7 +7,7 @@ STATE ?= ./tpu-docker-api-state
 
 .PHONY: all native test test-fast verify-crash verify-faults verify-perf \
     verify-retry verify-migrate verify-mt verify-races verify-obs \
-    verify-gateway verify-gang bench \
+    verify-gateway verify-gang verify-workers bench \
     serve serve-mock dryrun apidoc lint clean
 
 all: native
@@ -28,6 +28,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-obs     (observability sweep: -m obs)"
 	@echo "  make verify-gateway (inference-gateway sweep: -m gateway)"
 	@echo "  make verify-gang    (elastic gang / reshard sweep: -m gang)"
+	@echo "  make verify-workers (multi-process data-plane sweep: -m workers)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -60,7 +61,10 @@ verify-gateway:         ## inference-gateway sweep: router, autoscale, crash-mid
 verify-gang:            ## elastic gang sweep: plan grants, reshard crashpoints, e2e 1->4->1
 	$(PY) -m pytest tests/ -q -m gang
 
-lint:                   ## compile baseline + tdlint concurrency-invariant rules + rule liveness
+verify-workers: native  ## multi-process data-plane sweep: policy parity, kill/reconcile, drain
+	$(PY) -m pytest tests/ -q -m workers
+
+lint: native            ## compile baseline + tdlint concurrency-invariant rules + rule liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py
 	$(PY) -m tools.tdlint
 	$(PY) -m pytest tests/test_tdlint.py -q

@@ -21,20 +21,58 @@ _lock = threading.Lock()
 _cache: dict[str, Optional[ctypes.CDLL]] = {}
 
 
+#: each lib's own source (staleness is judged per-lib: make only relinks
+#: the targets whose source changed, so comparing against the newest of
+#: ALL sources would leave untouched libs looking stale forever)
+_LIB_SOURCES = {"mvccstore": "mvcc_store.cc",
+                "topoalloc": "topology_alloc.cc",
+                "shmatomics": "shm_atomics.cc"}
+
+
+def _source_mtime(name: str) -> float:
+    src = os.path.join(_REPO, "native", _LIB_SOURCES.get(name, ""))
+    try:
+        return os.path.getmtime(src)
+    except OSError:
+        return 0
+
+
+def _newest_source_mtime() -> float:
+    return max((_source_mtime(n) for n in _LIB_SOURCES), default=0)
+
+
+#: one symbol per lib that only the CURRENT C ABI exports — the load-time
+#: canary that keeps a stale build from binding the argtypes below to an
+#: older ABI (a segfault, not a clean error). Bump these when the ABI
+#: changes incompatibly.
+_ABI_CANARY = {"mvccstore": "mvcc_get_fast",
+               "topoalloc": "topo_find_box",
+               "shmatomics": "shm_futex_wait"}
+
+
 def load(name: str) -> Optional[ctypes.CDLL]:
-    """name: "mvccstore" | "topoalloc". Returns the CDLL or None."""
+    """name: "mvccstore" | "topoalloc" | "shmatomics". Returns the CDLL
+    or None."""
     with _lock:
         if name in _cache:
             return _cache[name]
         path = os.path.join(_BUILD, f"lib{name}.so")
-        if not os.path.exists(path):
+        # rebuild on absence OR staleness (source newer than the .so).
+        # When the rebuild can't run (no compiler), the existing .so is
+        # still LOADED — a fresh clone's checkout mtimes are arbitrary
+        # and the committed prebuilt binary is presumed to match its
+        # committed source; the ABI canary below catches a genuinely
+        # stale build either way.
+        if (not os.path.exists(path)
+                or os.path.getmtime(path) < _source_mtime(name)):
             _try_build()
         lib = None
         if os.path.exists(path):
             try:
                 lib = ctypes.CDLL(path)
+                getattr(lib, _ABI_CANARY[name])
                 _declare(name, lib)
-            except OSError:
+            except (OSError, AttributeError, KeyError):
                 lib = None
         _cache[name] = lib
         return lib
@@ -46,12 +84,8 @@ def _try_build() -> None:
     # a persistent failure marker stops every fresh process from re-running a
     # doomed compile (pytest collection imports this on each invocation)
     marker = os.path.join(_BUILD, ".build_failed")
-    sources = [os.path.join(_REPO, "native", f)
-               for f in ("mvcc_store.cc", "topology_alloc.cc", "Makefile")]
     if os.path.exists(marker):
-        newest_src = max((os.path.getmtime(s) for s in sources
-                          if os.path.exists(s)), default=0)
-        if os.path.getmtime(marker) >= newest_src:
+        if os.path.getmtime(marker) >= _newest_source_mtime():
             return
     try:
         proc = subprocess.run(["make", "-C", os.path.join(_REPO, "native")],
@@ -70,18 +104,24 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
     c = ctypes
     if name == "mvccstore":
         lib.mvcc_open.restype = c.c_void_p
-        lib.mvcc_open.argtypes = [c.c_char_p]
+        lib.mvcc_open.argtypes = [c.c_char_p, c.c_int]
         lib.mvcc_close.argtypes = [c.c_void_p]
         lib.mvcc_put.restype = c.c_int64
         lib.mvcc_put.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+        lib.mvcc_put_many.restype = c.c_int64
+        lib.mvcc_put_many.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
         lib.mvcc_delete.restype = c.c_int
         lib.mvcc_delete.argtypes = [c.c_void_p, c.c_char_p]
-        lib.mvcc_get.restype = c.c_void_p       # char* we must free
-        lib.mvcc_get.argtypes = [c.c_void_p, c.c_char_p]
+        # fast read path: raw bytes through the handle's mmap'd transfer
+        # buffer (NOT freed by the caller; serialized by the wrapper)
+        lib.mvcc_get_fast.restype = c.c_void_p
+        lib.mvcc_get_fast.argtypes = [c.c_void_p, c.c_char_p,
+                                      c.POINTER(c.c_int64)]
+        lib.mvcc_range_fast.restype = c.c_void_p
+        lib.mvcc_range_fast.argtypes = [c.c_void_p, c.c_char_p,
+                                        c.POINTER(c.c_int64)]
         lib.mvcc_get_at.restype = c.c_void_p
         lib.mvcc_get_at.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
-        lib.mvcc_range.restype = c.c_void_p
-        lib.mvcc_range.argtypes = [c.c_void_p, c.c_char_p]
         lib.mvcc_history.restype = c.c_void_p
         lib.mvcc_history.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         lib.mvcc_compact.restype = c.c_int64
@@ -92,6 +132,12 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.mvcc_maintain.argtypes = [c.c_void_p, c.c_char_p]
         lib.mvcc_wal_records.restype = c.c_int64
         lib.mvcc_wal_records.argtypes = [c.c_void_p]
+        lib.mvcc_wal_flushes.restype = c.c_int64
+        lib.mvcc_wal_flushes.argtypes = [c.c_void_p]
+        lib.mvcc_wal_flushed_records.restype = c.c_int64
+        lib.mvcc_wal_flushed_records.argtypes = [c.c_void_p]
+        lib.mvcc_wal_flush_batch_max.restype = c.c_int64
+        lib.mvcc_wal_flush_batch_max.argtypes = [c.c_void_p]
         lib.mvcc_revision.restype = c.c_int64
         lib.mvcc_revision.argtypes = [c.c_void_p]
         lib.mvcc_free.argtypes = [c.c_void_p]
@@ -100,3 +146,16 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.topo_find_box.argtypes = [
             c.c_int, c.c_int, c.c_int,
             c.POINTER(c.c_int8), c.c_int, c.POINTER(c.c_int32)]
+    elif name == "shmatomics":
+        lib.shm_load.restype = c.c_int64
+        lib.shm_load.argtypes = [c.c_void_p]
+        lib.shm_store.restype = None
+        lib.shm_store.argtypes = [c.c_void_p, c.c_int64]
+        lib.shm_add.restype = c.c_int64      # returns the NEW value
+        lib.shm_add.argtypes = [c.c_void_p, c.c_int64]
+        lib.shm_cas.restype = c.c_int
+        lib.shm_cas.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+        lib.shm_futex_wait.restype = c.c_int
+        lib.shm_futex_wait.argtypes = [c.c_void_p, c.c_uint32, c.c_int64]
+        lib.shm_futex_wake.restype = c.c_int
+        lib.shm_futex_wake.argtypes = [c.c_void_p, c.c_int]

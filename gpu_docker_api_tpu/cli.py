@@ -77,6 +77,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-auto-cordon", action="store_true",
                    help="report unhealthy chips on /healthz but never "
                         "cordon them automatically")
+    p.add_argument("--gw-workers", type=int, default=None, metavar="N",
+                   help="multi-process serving data plane: N worker "
+                        "processes share the gateway generate port via "
+                        "SO_REUSEPORT with router state in shared memory "
+                        "(default: TDAPI_GW_WORKERS env, else 0 = "
+                        "in-process)")
+    p.add_argument("--gw-data-port", type=int, default=None, metavar="PORT",
+                   help="explicit data-plane port for --gw-workers "
+                        "(default: TDAPI_GW_DATA_PORT env, else pick a "
+                        "free one; see /api/v1/healthz workers.port)")
     return p
 
 
@@ -110,7 +120,9 @@ def main(argv=None) -> int:
               supervise=not args.no_supervise,
               guard_backend=not args.no_guard,
               health_interval=args.health_interval,
-              auto_cordon=not args.no_auto_cordon)
+              auto_cordon=not args.no_auto_cordon,
+              gw_workers=args.gw_workers,
+              gw_data_port=args.gw_data_port)
     app.start()
 
     status = app.tpu.get_status()

@@ -214,7 +214,8 @@ class Gateway:
     TICK_S = 0.05
 
     def __init__(self, cfg: GatewayConfig, services, intents, events=None,
-                 traces=None, transport: Optional[Callable] = None):
+                 traces=None, transport: Optional[Callable] = None,
+                 on_change: Optional[Callable] = None):
         self.cfg = cfg
         self._svc = services
         self._intents = intents
@@ -222,6 +223,11 @@ class Gateway:
         self.traces = traces
         # injectable for unit tests / the perf floor; None = real HTTP
         self._transport = transport
+        # router-state change hook: the multi-process worker tier
+        # (server/workers.py) republishes the shared-memory roster twin
+        # when replicas turn ready/stopped/failed or config changes. The
+        # callback must be cheap and non-blocking (it sets an event).
+        self.on_change = on_change
         self._cond = threading.Condition()
         # one scale operation at a time per gateway: the autoscaler
         # thread, a manual PATCH scale, and create's min-replica top-up
@@ -260,6 +266,50 @@ class Gateway:
     def _record(self, op: str, **kw) -> None:
         if self.events is not None:
             self.events.record(op, target=self.cfg.name, **kw)
+
+    def _changed(self) -> None:
+        """Fire the router-state change hook (never under _cond — the
+        worker tier's poke only sets an event, but keep the contract
+        lock-free anyway)."""
+        if self.on_change is not None:
+            try:
+                self.on_change()
+            except Exception:  # noqa: BLE001 — a broken publisher hook must not fail the transition that fired it
+                log.exception("gateway %s on_change hook", self.cfg.name)
+
+    def router_state(self) -> dict:
+        """The router's STATE, split from its policy: everything the
+        admission path needs to route — config bounds and the live
+        replica roster — as plain data. The worker tier publishes this
+        into the shared-memory segment; the policy (admit-on-slot-free,
+        least-queued, priority FIFO, shed) runs against it in every
+        worker process without touching this object."""
+        with self._cond:
+            reps = sorted(self.replicas.values(), key=lambda r: r.idx)
+            return {
+                "name": self.cfg.name,
+                "maxQueue": int(self.cfg.maxQueue),
+                "deadlineMs": float(self.cfg.deadlineMs),
+                "replicas": [{"port": int(r.host_port),
+                              "slots": int(r.slots),
+                              "ready": r.state is READY}
+                             for r in reps],
+            }
+
+    def note_external_demand(self) -> None:
+        """Scale-to-zero wake for traffic that never touches forward():
+        the worker tier observed data-plane requests while no replica is
+        live, so arm the wake trigger the autoscaler acts on."""
+        wake = False
+        with self._cond:
+            alive = any(r.state in (READY, STARTING)
+                        for r in self.replicas.values())
+            if not alive and not self._wake_pending:
+                self._wake_pending = time.monotonic()
+                self._last_request = time.monotonic()
+                wake = True
+        if wake:
+            self._record("gateway.wake")
 
     def _call(self, port: int, method: str, path: str, body: bytes,
               timeout: float) -> tuple[int, bytes]:
@@ -526,6 +576,7 @@ class Gateway:
         if down:
             self._record("gateway.replica_down", replica=r.name,
                          code=500, failures=r.failures)
+            self._changed()
 
     # --------------------------------------------------- the autoscaler
 
@@ -633,6 +684,7 @@ class Gateway:
                     self._cond.notify_all()
                 self.last_scale_ready_ms = ready_ms
                 self.ready_hist.append(ready_ms)
+                self._changed()
                 obs_metrics.GATEWAY_SCALE_READY.observe(
                     ready_ms, gateway=self.cfg.name)
                 self._record("gateway.replica_ready", replica=r.name,
@@ -647,6 +699,7 @@ class Gateway:
                 if timed_out:
                     self._record("gateway.replica_down", replica=r.name,
                                  code=500, reason="ready_timeout")
+                    self._changed()
 
     def _probe(self, r: Replica) -> tuple[bool, int]:
         """(ready?, advertised slots). readiness="running" trusts the
@@ -724,6 +777,7 @@ class Gateway:
         self._record("gateway.scale_up", replica=out["replica"],
                      reason=reason, cloned=out.get("cloned", False),
                      warm=out.get("warm", False))
+        self._changed()
         # stamp the trigger so the readiness probe prices request->ready
         with self._cond:
             r = self.replicas.get(out["replica"])
@@ -825,6 +879,7 @@ class Gateway:
             self.scale_downs += 1
             self._last_scale = time.monotonic()
         self._record("gateway.scale_down", replica=rname, reason=reason)
+        self._changed()
 
     # ------------------------------------------------------------ status
 
@@ -866,6 +921,21 @@ class GatewayManager:
         self._transport = transport
         self._lock = threading.Lock()
         self._gateways: dict[str, Gateway] = {}
+        # the worker tier's republish hook (set by App after the tier is
+        # built); every gateway's on_change funnels through here
+        self.on_change: Optional[Callable] = None
+
+    def _roster_changed(self) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb()
+
+    def router_states(self) -> list[dict]:
+        """Router state (config + replica roster) of every gateway — the
+        payload the worker tier publishes into shared memory."""
+        with self._lock:
+            gws = list(self._gateways.values())
+        return [g.router_state() for g in gws]
 
     # ------------------------------------------------------------ access
 
@@ -897,7 +967,8 @@ class GatewayManager:
         # forever). The store write happens outside the lock — the
         # reservation already excludes racers — and unwinds on failure.
         gw = Gateway(cfg, self._svc, self._intents, events=self.events,
-                     traces=self.traces, transport=self._transport)
+                     traces=self.traces, transport=self._transport,
+                     on_change=self._roster_changed)
         with self._lock:
             if (cfg.name in self._gateways
                     or self._client.get(GATEWAYS, cfg.name) is not None):
@@ -930,6 +1001,8 @@ class GatewayManager:
                                    code=500, error="partial")
             raise
         gw.start()
+        self._roster_changed()   # a zero-replica gateway must still be
+        # routable by the worker tier (its queue bound + wake trigger)
         if self.events is not None:
             self.events.record("gateway.create", target=cfg.name,
                                minReplicas=cfg.minReplicas,
@@ -979,6 +1052,7 @@ class GatewayManager:
         intent.done(committed=True)
         with self._lock:
             self._gateways.pop(name, None)
+        self._roster_changed()
         if self.events is not None:
             self.events.record("gateway.delete", target=name)
 
@@ -998,7 +1072,8 @@ class GatewayManager:
                 log.exception("unreadable gateway record %s", name)
                 continue
             gw = Gateway(cfg, self._svc, self._intents, events=self.events,
-                         traces=self.traces, transport=self._transport)
+                         traces=self.traces, transport=self._transport,
+                         on_change=self._roster_changed)
             pat = re.compile(re.escape(name) + _REPLICA_RE)
             for rname in replica_names_for(self._client, name):
                 idx = int(pat.fullmatch(rname).group(1))
@@ -1021,6 +1096,7 @@ class GatewayManager:
             with self._lock:
                 self._gateways[name] = gw
             gw.start()
+        self._roster_changed()
 
     def stop_all(self) -> None:
         with self._lock:

@@ -60,6 +60,8 @@ EVENT_OPS = frozenset({
     "gateway.replica_down",
     "gateway.shed",
     "gateway.wake",
+    # multi-process data-plane worker tier (server/workers.py)
+    "gateway.worker_respawn",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.

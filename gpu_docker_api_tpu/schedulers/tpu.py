@@ -385,7 +385,13 @@ class TpuScheduler(Scheduler):
         wins the tie — "tp/sp inside a host where possible" is a score,
         not a hard requirement, exactly like the whole-box worker span."""
         prefer = prefer or set()
-        if plan is None:
+        # the native core is gated BEHIND the memo: it doesn't score
+        # worker spans, so when no candidate box of this size is
+        # single-worker (cands sort (span, sa)-ascending — check the
+        # head) its pick would always be discarded below and the call
+        # would be a pure pessimization on top of the python scan
+        if plan is None and (cands := self._box_candidates(n)) \
+                and cands[0][4] == 1:
             native = self._native_find_box(n, free)
             if native is not None:
                 if not native:
@@ -485,9 +491,12 @@ class TpuScheduler(Scheduler):
         import ctypes
         sx, sy, sz = self.topology.shape
         total = sx * sy * sz
-        status = (ctypes.c_int8 * total)()
-        for i in range(total):
-            status[i] = 0 if i in free else 1
+        # bulk-fill through a bytearray: the per-index ctypes __setitem__
+        # loop was the dominant cost of the whole native call
+        raw = bytearray(b"\x01" * total)
+        for i in free:
+            raw[i] = 0
+        status = (ctypes.c_int8 * total).from_buffer(raw)
         out = (ctypes.c_int32 * n)()
         ok = lib.topo_find_box(sx, sy, sz, status, n, out)
         return [int(out[i]) for i in range(n)] if ok else []

@@ -226,7 +226,9 @@ class App:
                  mutation_queue_depth: Optional[int] = None,
                  per_client_mutations: Optional[int] = None,
                  mutation_wait_timeout: float = 10.0,
-                 idem_ttl: Optional[float] = None):
+                 idem_ttl: Optional[float] = None,
+                 gw_workers: Optional[int] = None,
+                 gw_data_port: Optional[int] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
 
@@ -354,6 +356,28 @@ class App:
                                        self.intents, events=self.events,
                                        traces=self.traces)
         self.gateways.boot()
+        # multi-process SO_REUSEPORT data plane (server/workers.py): N
+        # worker processes share the gateway data-plane port, each
+        # parsing/routing/admitting end-to-end against the shared-memory
+        # router state; 0/unset (or no native shm-atomics core) keeps the
+        # in-process single-daemon data plane
+        from . import workers as gw_workers_mod
+        self.workers = None
+        n_workers = _env_int(gw_workers_mod.GW_WORKERS_ENV, gw_workers, 0)
+        if n_workers > 0:
+            if gw_workers_mod.available():
+                self.workers = gw_workers_mod.WorkerTier(
+                    self.gateways, n=n_workers,
+                    port=_env_int(gw_workers_mod.GW_DATA_PORT_ENV,
+                                  gw_data_port, 0),
+                    events=self.events,
+                    api_key=(api_key if api_key is not None
+                             else os.environ.get("APIKEY", "")))
+            else:
+                log.warning("TDAPI_GW_WORKERS=%d but the worker tier is "
+                            "unavailable (native shm-atomics core not "
+                            "built?) — serving stays in-process",
+                            n_workers)
         # SSE follower count (tdapi_events_stream_clients) — mutated from
         # stream generator threads under this lock
         self._stream_lock = threading.Lock()
@@ -1074,6 +1098,8 @@ class App:
             "breaker": breaker,
             "workqueue": {"pending": self.wq.pending(),
                           "dropped": self.wq.dropped_count()},
+            "workers": (self.workers.describe()
+                        if self.workers is not None else None),
             "reconcileActions": self.last_reconcile["actions"],
         })
 
@@ -1356,6 +1382,8 @@ class App:
 
     def start(self) -> None:
         self.server.start()
+        if self.workers is not None:
+            self.workers.start()
         self._start_store_maintenance()
         self.health.start()   # no-op when health_interval <= 0
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
@@ -1396,6 +1424,8 @@ class App:
         """Graceful shutdown: drain queue, flush all state (reference Stop,
         main.go:139-154)."""
         self.server.stop()
+        if self.workers is not None:
+            self.workers.stop()    # drain the data-plane tier first
         self.gateways.stop_all()   # autoscaler loops, before services go
         self.health.stop()
         if self._maint_stop is not None:

@@ -1,0 +1,914 @@
+"""Multi-process SO_REUSEPORT data plane: escape the GIL on serving.
+
+PR 10's bench named the ceiling: concurrent gateway throughput "measures
+stdlib-HTTP-parser GIL, not the router". One interpreter parses every
+request, so the serving tier caps at one core no matter how many replicas
+sit behind it. This module moves the DATA PLANE — parse, route-match,
+admit, forward — into N worker processes that each bind the same port
+with `SO_REUSEPORT` (the kernel load-balances accepted connections across
+the listening sockets), while every control-plane mutation stays on the
+single daemon.
+
+The split that makes this possible is **router policy vs router state**
+(the same split ROADMAP item 3's federation tier needs):
+
+- STATE lives in a `multiprocessing.shared_memory` segment: a seqlock-
+  protected roster twin (gateway config + per-replica port/slots/ready,
+  published by the daemon — `Gateway.router_state()`) plus lock-free
+  atomic counters (per-replica inflight, per-gateway queue depth,
+  request/shed totals) updated through the native shm-atomics core
+  (native/shm_atomics.cc — CPython has no cross-process atomic RMW).
+- POLICY (admit-on-slot-free, least-queued pick, strict-priority FIFO,
+  queue-bound shed, per-request deadline) runs in `WorkerRouter`,
+  identical in outcome to the in-process `Gateway` router: slot caps are
+  enforced by atomic claim (`fetch_add` then undo on overshoot), the
+  queue bound by a global atomic depth, priority barge by per-process
+  hi/lo FIFOs, and "a slot freed somewhere" becomes a prompt cross-
+  process wakeup via a futex on a per-gateway release-sequence word.
+
+Crash safety: each worker also keeps per-(worker, gateway, replica)
+CLAIM counters (incremented only after the global claim succeeds, so a
+death between the two under-admits briefly instead of ever double-
+admitting). The parent's watchdog detects a dead worker, subtracts its
+claims from the global counters (reconcile), and respawns it; the dead
+process's listening socket closed with it, so the kernel stops routing
+new connections there immediately.
+
+Requires Linux + the native shm-atomics core; `available()` gates the
+tier and everything degrades to the in-process single-daemon data plane
+when it is off (`TDAPI_GW_WORKERS` unset/0, or the core unbuilt).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+from multiprocessing import get_context, shared_memory
+from typing import Callable, Optional
+
+from .._native import load
+from .codes import ResCode
+from .http import (
+    ApiServer, RawResponse, Request, Response, Router, StreamingResponse,
+    err, ok, too_many,
+)
+
+log = logging.getLogger(__name__)
+
+#: env knob: number of data-plane worker processes (0/unset = tier off)
+GW_WORKERS_ENV = "TDAPI_GW_WORKERS"
+#: env knob: explicit data-plane port (0 = pick a free one)
+GW_DATA_PORT_ENV = "TDAPI_GW_DATA_PORT"
+
+# ---- segment geometry (all fields 8-byte words unless noted) ----------------
+
+MAX_GATEWAYS = 16
+MAX_REPLICAS = 16
+MAX_WORKERS = 8
+NAME_LEN = 48
+
+MAGIC = 0x7464_6170_6977_6b31          # "tdapiwk1"
+
+# header words: magic, version, epoch(seqlock), n_gateways, n_workers,
+# data_port, shutdown
+HDR_WORDS = 8
+HDR_OFF_EPOCH = 16
+HDR_OFF_NGW = 24
+HDR_OFF_SHUTDOWN = 48
+
+# config region (seqlock-protected, plain bytes): per gateway
+#   name[NAME_LEN] | maxQueue | deadline_ms | n_replicas |
+#   per replica: port | slots | ready
+GW_CONF_WORDS = 3
+REP_CONF_WORDS = 3
+GW_CONF_SZ = NAME_LEN + 8 * (GW_CONF_WORDS + MAX_REPLICAS * REP_CONF_WORDS)
+CONF_OFF = HDR_WORDS * 8
+CONF_SZ = MAX_GATEWAYS * GW_CONF_SZ
+
+# counter region (atomics, NEVER seqlock-protected): per gateway
+#   gen | queued | relseq | requests_total | shed_total | wake_hint |
+#   per replica: inflight | errors
+GW_CNT_WORDS = 6
+REP_CNT_WORDS = 2
+GW_CNT_SZ = 8 * (GW_CNT_WORDS + MAX_REPLICAS * REP_CNT_WORDS)
+CNT_OFF = CONF_OFF + CONF_SZ
+CNT_SZ = MAX_GATEWAYS * GW_CNT_SZ
+
+# worker region: per worker
+#   heartbeat_ns | pid | per gateway: queued_held | per (gw, rep): claims
+WK_FIXED_WORDS = 2
+WK_SZ = 8 * (WK_FIXED_WORDS + MAX_GATEWAYS * (1 + MAX_REPLICAS))
+WK_OFF = CNT_OFF + CNT_SZ
+
+SEGMENT_SZ = WK_OFF + MAX_WORKERS * WK_SZ
+
+
+def _gw_conf_off(g: int) -> int:
+    return CONF_OFF + g * GW_CONF_SZ
+
+
+def _gw_cnt_off(g: int) -> int:
+    return CNT_OFF + g * GW_CNT_SZ
+
+
+def _rep_cnt_off(g: int, r: int) -> int:
+    return _gw_cnt_off(g) + 8 * (GW_CNT_WORDS + r * REP_CNT_WORDS)
+
+
+def _wk_off(w: int) -> int:
+    return WK_OFF + w * WK_SZ
+
+
+def _wk_queued_off(w: int, g: int) -> int:
+    return _wk_off(w) + 8 * WK_FIXED_WORDS + 8 * g
+
+
+def _wk_claim_off(w: int, g: int, r: int) -> int:
+    return (_wk_off(w) + 8 * WK_FIXED_WORDS + 8 * MAX_GATEWAYS
+            + 8 * (g * MAX_REPLICAS + r))
+
+
+def available() -> bool:
+    """The worker tier needs Linux (SO_REUSEPORT + futex) and the native
+    shm-atomics core."""
+    return (hasattr(socket, "SO_REUSEPORT")
+            and load("shmatomics") is not None)
+
+
+class SharedRouterState:
+    """Owner/attacher of the shared segment: seqlock roster publishing on
+    the daemon side, consistent roster reads + atomic counter ops on the
+    worker side. Both sides address the SAME bytes; the atomics go
+    through native/shm_atomics.cc so cross-process RMW is real."""
+
+    def __init__(self, name: Optional[str] = None, create: bool = False):
+        self.lib = load("shmatomics")
+        if self.lib is None:
+            raise RuntimeError("shm-atomics core unavailable")
+        if create:
+            self.shm = shared_memory.SharedMemory(create=True,
+                                                  size=SEGMENT_SZ)
+            self.shm.buf[:SEGMENT_SZ] = b"\0" * SEGMENT_SZ
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.created = create
+        # base address for the atomics: keep the from_buffer anchor alive
+        # for the segment's lifetime (it pins the exported buffer)
+        self._anchor = ctypes.c_char.from_buffer(self.shm.buf)
+        self.base = ctypes.addressof(self._anchor)
+        if create:
+            struct.pack_into("<qq", self.shm.buf, 0, MAGIC, 1)
+
+    @property
+    def name(self) -> str:
+        return self.shm.name
+
+    # ---- raw atomic ops --------------------------------------------------
+
+    def load(self, off: int) -> int:
+        return self.lib.shm_load(self.base + off)
+
+    def store(self, off: int, v: int) -> None:
+        self.lib.shm_store(self.base + off, v)
+
+    def add(self, off: int, d: int) -> int:
+        return self.lib.shm_add(self.base + off, d)
+
+    def dec_floor0(self, off: int) -> None:
+        """CAS-decrement that never goes below zero: a release racing a
+        publisher-side counter reset must not drive the counter negative
+        (which would leak phantom capacity)."""
+        lib, addr = self.lib, self.base + off
+        while True:
+            v = lib.shm_load(addr)
+            if v <= 0:
+                return
+            if lib.shm_cas(addr, v, v - 1):
+                return
+
+    def futex_wait(self, off: int, expected: int, timeout_s: float) -> None:
+        self.lib.shm_futex_wait(self.base + off,
+                                expected & 0xFFFFFFFF,
+                                max(0, int(timeout_s * 1000)))
+
+    def futex_wake_all(self, off: int) -> None:
+        self.lib.shm_futex_wake(self.base + off, 2 ** 30)
+
+    # ---- daemon side: seqlock publish ------------------------------------
+
+    def publish(self, states: list[dict]) -> None:
+        """Write the roster twin under the seqlock: epoch goes odd,
+        config bytes land, epoch goes even — readers retry on any
+        movement, so they only ever parse a consistent roster. Counter
+        cells are NOT part of the protected region; a gateway keeps its
+        slot (and counters) across publishes, and a slot reassigned to a
+        different gateway bumps its generation word so stale releases
+        skip themselves."""
+        states = states[:MAX_GATEWAYS]
+        buf = self.shm.buf
+        # stable slot assignment: keep existing names in place
+        current: dict[str, int] = {}
+        for g in range(MAX_GATEWAYS):
+            raw = bytes(buf[_gw_conf_off(g):_gw_conf_off(g) + NAME_LEN])
+            n = raw.split(b"\0", 1)[0]
+            if n:
+                current[n.decode("utf-8", "replace")] = g
+        assigned: dict[int, dict] = {}
+        free = [g for g in range(MAX_GATEWAYS)
+                if g not in current.values()]
+        for st in states:
+            slot = current.get(st["name"])
+            if slot is None:
+                if not free:
+                    log.warning("worker tier: more than %d gateways; "
+                                "%s stays daemon-routed", MAX_GATEWAYS,
+                                st["name"])
+                    continue
+                slot = free.pop(0)
+            assigned[slot] = st
+        epoch = self.load(HDR_OFF_EPOCH)
+        self.store(HDR_OFF_EPOCH, epoch + 1)          # odd: write in progress
+        try:
+            for g in range(MAX_GATEWAYS):
+                off = _gw_conf_off(g)
+                st = assigned.get(g)
+                if st is None:
+                    buf[off:off + NAME_LEN] = b"\0" * NAME_LEN
+                    continue
+                name = st["name"].encode()[:NAME_LEN - 1]
+                raw = bytes(buf[off:off + NAME_LEN]).split(b"\0", 1)[0]
+                if raw != name:
+                    # slot changes identity: bump the gen word (in-flight
+                    # releases see the mismatch and skip themselves) and
+                    # ZERO the old tenant's counters + every worker's
+                    # claim cells — without this the new gateway inherits
+                    # phantom inflight that can never drain (its replicas
+                    # would look permanently busy). A claim racing this
+                    # re-checks gen after its fetch_add and undoes
+                    # floor-clamped, so the transient is at most ±1 and
+                    # self-corrects.
+                    self.add(_gw_cnt_off(g), 1)       # gen word
+                    self.store(_gw_cnt_off(g) + 8, 0)     # queued
+                    self.store(_gw_cnt_off(g) + 24, 0)    # requests_total
+                    self.store(_gw_cnt_off(g) + 32, 0)    # shed_total
+                    self.store(_gw_cnt_off(g) + 40, 0)    # wake_hint
+                    for r in range(MAX_REPLICAS):
+                        self.store(_rep_cnt_off(g, r), 0)
+                        self.store(_rep_cnt_off(g, r) + 8, 0)
+                    for w in range(MAX_WORKERS):
+                        self.store(_wk_queued_off(w, g), 0)
+                        for r in range(MAX_REPLICAS):
+                            self.store(_wk_claim_off(w, g, r), 0)
+                buf[off:off + NAME_LEN] = name + b"\0" * (NAME_LEN
+                                                          - len(name))
+                reps = st["replicas"][:MAX_REPLICAS]
+                struct.pack_into("<qqq", buf, off + NAME_LEN,
+                                 int(st["maxQueue"]),
+                                 int(st["deadlineMs"]), len(reps))
+                roff = off + NAME_LEN + 8 * GW_CONF_WORDS
+                for r in reps:
+                    struct.pack_into("<qqq", buf, roff, int(r["port"]),
+                                     int(r["slots"]),
+                                     1 if r["ready"] else 0)
+                    roff += 8 * REP_CONF_WORDS
+        finally:
+            self.store(HDR_OFF_EPOCH, epoch + 2)      # even: consistent
+        self.store(HDR_OFF_NGW, len(assigned))
+
+    # ---- worker side: consistent roster read -----------------------------
+
+    def read_roster(self) -> tuple[int, dict]:
+        """(epoch, {name: gateway-dict}) — seqlock retry until stable."""
+        buf = self.shm.buf
+        while True:
+            e1 = self.load(HDR_OFF_EPOCH)
+            if e1 & 1:
+                time.sleep(0.0002)
+                continue
+            raw = bytes(buf[CONF_OFF:CONF_OFF + CONF_SZ])
+            if self.load(HDR_OFF_EPOCH) == e1:
+                break
+        roster: dict[str, dict] = {}
+        for g in range(MAX_GATEWAYS):
+            off = g * GW_CONF_SZ
+            name = raw[off:off + NAME_LEN].split(b"\0", 1)[0]
+            if not name:
+                continue
+            max_queue, deadline_ms, n_reps = struct.unpack_from(
+                "<qqq", raw, off + NAME_LEN)
+            reps = []
+            roff = off + NAME_LEN + 8 * GW_CONF_WORDS
+            for r in range(min(n_reps, MAX_REPLICAS)):
+                port, slots, ready = struct.unpack_from("<qqq", raw, roff)
+                reps.append({"idx": r, "port": port, "slots": slots,
+                             "ready": bool(ready)})
+                roff += 8 * REP_CONF_WORDS
+            roster[name.decode("utf-8", "replace")] = {
+                "slot": g, "maxQueue": max_queue,
+                "deadlineMs": deadline_ms, "replicas": reps,
+                "gen": self.load(_gw_cnt_off(g)),
+            }
+        return e1, roster
+
+    # ---- counters --------------------------------------------------------
+
+    def gateway_counters(self, g: int) -> dict:
+        return {"queued": self.load(_gw_cnt_off(g) + 8),
+                "requestsTotal": self.load(_gw_cnt_off(g) + 24),
+                "shedTotal": self.load(_gw_cnt_off(g) + 32),
+                "wakeHint": self.load(_gw_cnt_off(g) + 40),
+                "inflight": [self.load(_rep_cnt_off(g, r))
+                             for r in range(MAX_REPLICAS)]}
+
+    def reconcile_worker(self, w: int) -> int:
+        """Subtract a dead worker's held claims + queue tickets from the
+        global counters, zero its cells, and wake parked claimants (the
+        freed slots are real capacity). Returns reclaimed claim count.
+        Claims are incremented only AFTER the global fetch_add succeeded,
+        so subtracting them can never free capacity that was not actually
+        claimed — the zero-double-admit invariant."""
+        reclaimed = 0
+        for g in range(MAX_GATEWAYS):
+            qoff = _wk_queued_off(w, g)
+            q = self.load(qoff)
+            if q > 0:
+                for _ in range(q):
+                    self.dec_floor0(_gw_cnt_off(g) + 8)
+                self.store(qoff, 0)
+            freed = 0
+            for r in range(MAX_REPLICAS):
+                coff = _wk_claim_off(w, g, r)
+                c = self.load(coff)
+                if c > 0:
+                    freed += c
+                    for _ in range(c):
+                        self.dec_floor0(_rep_cnt_off(g, r))
+                    self.store(coff, 0)
+            reclaimed += freed
+            if q > 0 or freed:
+                self.add(_gw_cnt_off(g) + 16, 1)      # relseq
+                self.futex_wake_all(_gw_cnt_off(g) + 16)
+        return reclaimed
+
+    def close(self, unlink: bool = False) -> None:
+        # the ctypes anchor pins the exported buffer; drop it first
+        del self._anchor
+        self.shm.close()
+        if unlink and self.created:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class _LocalLine:
+    """Per-process admission lines for one gateway slot: the strict-
+    priority hi/lo FIFOs (identical to Gateway._claim's), guarded by a
+    process-local lock. Cross-process wakeups ride the futex."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.hi: list = []
+        self.lo: list = []
+
+
+class _Claim:
+    __slots__ = ("gslot", "rep", "gen", "port")
+
+    def __init__(self, gslot: int, rep: int, gen: int, port: int):
+        self.gslot = gslot
+        self.rep = rep
+        self.gen = gen
+        self.port = port
+
+
+class WorkerRouter:
+    """The router POLICY over shared state: one instance per worker
+    process (and per test harness — it is plain Python over a
+    SharedRouterState, so the policy-parity suite drives it in-process).
+
+    Outcomes match the in-process Gateway router: admit-on-slot-free via
+    atomic claim against the replica's advertised slots, least-queued
+    pick, strict-priority FIFO per process with hi barging lo, global
+    queue bound -> 429, deadline -> 504, transport failure -> retry
+    another replica until the deadline."""
+
+    def __init__(self, state: SharedRouterState, worker_idx: int,
+                 transport: Optional[Callable] = None):
+        self.state = state
+        self.widx = worker_idx
+        self._transport = transport
+        self._roster_epoch = -1
+        self._roster: dict[str, dict] = {}
+        self._roster_lock = threading.Lock()
+        self._lines: dict[int, _LocalLine] = {}
+        self._local = threading.local()
+
+    # ---- roster cache ----------------------------------------------------
+
+    def _gateway(self, name: str) -> Optional[dict]:
+        epoch = self.state.load(HDR_OFF_EPOCH)
+        if epoch != self._roster_epoch:
+            with self._roster_lock:
+                if epoch != self._roster_epoch:
+                    e, roster = self.state.read_roster()
+                    self._roster = roster
+                    self._roster_epoch = e
+        return self._roster.get(name)
+
+    def _line(self, gslot: int) -> _LocalLine:
+        line = self._lines.get(gslot)
+        if line is None:
+            line = self._lines.setdefault(gslot, _LocalLine())
+        return line
+
+    # ---- claim / release -------------------------------------------------
+
+    def _try_claim(self, gw: dict,
+                   avoid: frozenset = frozenset()) -> Optional[_Claim]:
+        """Least-queued atomic claim: order ready replicas by global
+        inflight, fetch_add the best, undo on overshoot. The claim cell
+        (this worker's ledger for crash reconcile) is incremented only
+        after the global claim stuck. `avoid` holds replicas that already
+        failed THIS request's forward — replica failure marking is
+        control-plane state the daemon owns, so the worker only steers
+        the current request away (identical outcome: a dead replica's
+        error never fails the request while a healthy one exists)."""
+        st = self.state
+        g = gw["slot"]
+        ready = [(st.load(_rep_cnt_off(g, r["idx"])), r)
+                 for r in gw["replicas"]
+                 if r["ready"] and r["port"] and r["idx"] not in avoid]
+        ready.sort(key=lambda t: t[0])
+        for _, r in ready:
+            off = _rep_cnt_off(g, r["idx"])
+            if st.add(off, 1) <= r["slots"]:
+                if st.load(_gw_cnt_off(g)) != gw["gen"]:
+                    # the slot was reassigned mid-claim: undo against
+                    # whatever lives there now (floor-clamped)
+                    st.dec_floor0(off)
+                    continue
+                st.add(_wk_claim_off(self.widx, g, r["idx"]), 1)
+                return _Claim(g, r["idx"], gw["gen"], r["port"])
+            st.dec_floor0(off)
+        return None
+
+    def _release(self, c: _Claim) -> None:
+        st = self.state
+        if st.load(_gw_cnt_off(c.gslot)) == c.gen:
+            st.dec_floor0(_wk_claim_off(self.widx, c.gslot, c.rep))
+            st.dec_floor0(_rep_cnt_off(c.gslot, c.rep))
+        relseq = _gw_cnt_off(c.gslot) + 16
+        st.add(relseq, 1)
+        st.futex_wake_all(relseq)
+
+    def _claim(self, name: str, gw: dict, deadline: float, high: bool,
+               avoid: frozenset = frozenset()) -> _Claim:
+        """Block until a slot claim succeeds; shed on queue bound or
+        deadline — Gateway._claim's contract over shared state."""
+        from .. import xerrors  # local import: workers must stay light
+        st = self.state
+        g = gw["slot"]
+        line = self._line(g)
+        with line.lock:
+            if not line.hi and (high or not line.lo):
+                c = self._try_claim(gw, avoid)
+                if c is not None:
+                    return c
+            qoff = _gw_cnt_off(g) + 8
+            if st.load(qoff) >= gw["maxQueue"]:
+                st.add(_gw_cnt_off(g) + 32, 1)        # shed_total
+                raise xerrors.GatewayShedError(
+                    f"{name}: admission queue full ({gw['maxQueue']})")
+            st.add(qoff, 1)
+            st.add(_wk_queued_off(self.widx, g), 1)
+            ticket = object()
+            mine = line.hi if high else line.lo
+            mine.append(ticket)
+        relseq = _gw_cnt_off(g) + 16
+        try:
+            while True:
+                with line.lock:
+                    at_head = mine and mine[0] is ticket and (
+                        high or not line.hi)
+                    if at_head:
+                        c = self._try_claim(gw, avoid)
+                        if c is not None:
+                            return c
+                    seen = st.load(relseq)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    st.add(_gw_cnt_off(g) + 32, 1)    # shed_total
+                    raise xerrors.GatewayDeadlineError(
+                        f"{name}: no replica slot freed within the "
+                        f"{gw['deadlineMs']:.0f}ms deadline")
+                # cross-process park: any release bumps relseq and wakes
+                # the futex; cap the wait so a roster change (new ready
+                # replica) is noticed promptly too
+                st.futex_wait(relseq, seen, min(left, 0.05))
+                fresh = self._gateway(name)
+                if fresh is not None:
+                    gw = fresh
+        finally:
+            with line.lock:
+                try:
+                    mine.remove(ticket)
+                except ValueError:
+                    pass
+            st.dec_floor0(qoff)
+            st.dec_floor0(_wk_queued_off(self.widx, g))
+            # line movement: peers re-check their head position
+            st.add(relseq, 1)
+            st.futex_wake_all(relseq)
+
+    # ---- transport (pooled per thread+port, NODELAY) ---------------------
+
+    def _call(self, port: int, body: bytes, timeout: float):
+        if self._transport is not None:
+            return self._transport(port, "POST", "/generate", body, timeout)
+        import http.client
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        conn = pool.get(port)
+        try:
+            if conn is None:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=timeout)
+                conn.connect()
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                pool[port] = conn
+            else:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+            conn.request("POST", "/generate", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except Exception:
+            pool.pop(port, None)
+            if conn is not None:
+                try:
+                    conn.close()
+                # tdlint: disable=silent-swallow -- closing an already-failed socket; the original error re-raises
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+    # ---- the forward path ------------------------------------------------
+
+    def forward(self, name: str, body: bytes,
+                priority: str = "") -> tuple[int, bytes]:
+        from .. import xerrors
+        gw = self._gateway(name)
+        if gw is None:
+            raise KeyError(name)
+        st = self.state
+        g = gw["slot"]
+        st.add(_gw_cnt_off(g) + 24, 1)                # requests_total
+        if not any(r["ready"] for r in gw["replicas"]):
+            st.add(_gw_cnt_off(g) + 40, 1)            # wake hint
+        t0 = time.monotonic()
+        deadline = t0 + gw["deadlineMs"] / 1e3
+        high = priority in ("high", "latency")
+        avoid: set = set()
+        while True:
+            c = self._claim(name, gw, deadline, high=high,
+                            avoid=frozenset(avoid))
+            left = deadline - time.monotonic()
+            try:
+                status, payload = self._call(c.port, body,
+                                             timeout=max(left, 0.05))
+            except Exception as e:  # noqa: BLE001 — replica gone/slow
+                self._release(c)
+                st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)  # errors
+                if time.monotonic() >= deadline:
+                    raise xerrors.GatewayDeadlineError(
+                        f"{name}: replicas unreachable "
+                        f"({type(e).__name__})")
+                avoid.add(c.rep)
+                fresh = self._gateway(name)
+                if fresh is not None:
+                    gw = fresh
+                if len(avoid) >= sum(1 for r in gw["replicas"]
+                                     if r["ready"] and r["port"]):
+                    avoid.clear()    # every replica failed once: retry all
+                continue
+            self._release(c)
+            return status, payload
+
+    # ---- HTTP handlers (the worker's route table) ------------------------
+
+    def _forward_stream(self, name: str, body: bytes, priority: str):
+        """?stream=1: claim a slot, issue the replica request on a FRESH
+        connection (a half-relayed pooled socket could never be reused),
+        and return a chunk iterator that releases the claim on exit."""
+        from .. import xerrors
+        import http.client
+        gw = self._gateway(name)
+        if gw is None:
+            raise KeyError(name)
+        st = self.state
+        st.add(_gw_cnt_off(gw["slot"]) + 24, 1)       # requests_total
+        deadline = time.monotonic() + gw["deadlineMs"] / 1e3
+        high = priority in ("high", "latency")
+        avoid: set = set()
+        while True:
+            c = self._claim(name, gw, deadline, high=high,
+                            avoid=frozenset(avoid))
+            left = max(deadline - time.monotonic(), 0.05)
+            conn = http.client.HTTPConnection("127.0.0.1", c.port,
+                                              timeout=left)
+            try:
+                conn.request("POST", "/generate", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except Exception as e:  # noqa: BLE001 — replica gone/slow
+                conn.close()
+                self._release(c)
+                st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)
+                if time.monotonic() >= deadline:
+                    raise xerrors.GatewayDeadlineError(
+                        f"{name}: replicas unreachable "
+                        f"({type(e).__name__})")
+                avoid.add(c.rep)
+                fresh = self._gateway(name)
+                if fresh is not None:
+                    gw = fresh          # a replacement replica may exist
+                if len(avoid) >= sum(1 for r in gw["replicas"]
+                                     if r["ready"] and r["port"]):
+                    avoid.clear()
+                continue
+
+            def relay(c=c, conn=conn, resp=resp):
+                try:
+                    while True:
+                        chunk = resp.read(8192)
+                        if not chunk:
+                            return
+                        yield chunk
+                finally:
+                    conn.close()
+                    self._release(c)
+
+            return relay()
+
+    def h_generate(self, req: Request) -> Response:
+        from .. import xerrors
+        name = req.params["name"]
+        priority = req.header("X-TDAPI-Priority").strip().lower()
+        try:
+            if req.query_flag("stream"):
+                chunks = self._forward_stream(name, req.body,
+                                              priority=priority)
+                return StreamingResponse(chunks,
+                                         content_type="application/json")
+            _status, payload = self.forward(name, req.body,
+                                            priority=priority)
+            return RawResponse(payload)
+        except KeyError:
+            return err(ResCode.GatewayGetInfoFailed)
+        except xerrors.GatewayShedError:
+            return too_many("gateway queue full")
+        except xerrors.GatewayDeadlineError as e:
+            return Response(ResCode.GatewayTimeout, None, msg=str(e),
+                            http_status=504, headers={"Retry-After": "1"})
+        except Exception:  # noqa: BLE001 — the envelope absorbs it
+            log.exception("worker %d: generate %s failed", self.widx, name)
+            return err(ResCode.GatewayRequestFailed)
+
+    def h_healthz(self, req: Request) -> Response:
+        _, roster = self.state.read_roster()
+        return ok({"worker": self.widx, "pid": os.getpid(),
+                   "gateways": sorted(roster)})
+
+
+# ---- the worker process -----------------------------------------------------
+
+def _worker_main(host: str, port: int, shm_name: str, worker_idx: int,
+                 api_key: str = "") -> None:
+    """Child entry (spawn context): bind the data-plane port with
+    SO_REUSEPORT, serve generate end-to-end, heartbeat into the segment,
+    drain gracefully on SIGTERM."""
+    state = SharedRouterState(name=shm_name)
+    wr = WorkerRouter(state, worker_idx)
+    router = Router()
+    router.add("POST", "/api/v1/gateways/:name/generate", wr.h_generate)
+    router.add("GET", "/api/v1/healthz", wr.h_healthz)
+    router.add("GET", "/ping",
+               lambda req: ok({"status": "pong", "worker": worker_idx}))
+    srv = ApiServer(router, addr=f"{host}:{port}", api_key=api_key,
+                    reuse_port=True,
+                    quiet_routes=frozenset(
+                        {("POST", "/api/v1/gateways/:name/generate")}))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    srv.start()
+    state.store(_wk_off(worker_idx) + 8, os.getpid())
+    parent = os.getppid()
+    try:
+        while not stop.wait(0.05):
+            state.store(_wk_off(worker_idx), time.monotonic_ns())
+            if state.load(HDR_OFF_SHUTDOWN):
+                break
+            if os.getppid() != parent:
+                # the daemon died without cleanup (SIGKILL skips atexit):
+                # an orphaned worker would keep serving a STALE roster on
+                # the old data port forever — exit instead; the restarted
+                # daemon brings its own tier on a fresh segment
+                log.warning("worker %d: daemon gone — exiting",
+                            worker_idx)
+                break
+    finally:
+        try:
+            srv.stop(drain_timeout=5.0)     # in-flight requests complete
+        # tdlint: disable=silent-swallow -- last-gasp drain; the process exits either way
+        except Exception:  # noqa: BLE001
+            pass
+    os._exit(0)
+
+
+class WorkerTier:
+    """Parent-side lifecycle: owns the segment, publishes the roster,
+    spawns/respawns workers, reconciles a dead worker's counters, drains
+    on stop."""
+
+    #: watchdog cadence; also bounds publish latency after a poke
+    TICK_S = 0.05
+    #: periodic republish even without pokes (heals missed transitions)
+    REPUBLISH_S = 0.25
+    #: a worker whose heartbeat is older than this is declared hung
+    HEARTBEAT_STALE_S = 10.0
+
+    def __init__(self, gateways, n: int, host: str = "127.0.0.1",
+                 port: int = 0, events=None, api_key: str = ""):
+        if not available():
+            raise RuntimeError("worker tier unavailable "
+                               "(needs Linux + native shm-atomics core)")
+        self.gateways = gateways
+        self.n = max(1, min(int(n), MAX_WORKERS))
+        self.host = host
+        self.port = int(port)
+        self.events = events
+        self.api_key = api_key
+        self.state: Optional[SharedRouterState] = None
+        self.procs: list = [None] * self.n
+        self.respawns = 0
+        self.reclaimed_claims = 0
+        self._poke = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ctx = get_context("spawn")
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def _alloc_port(self) -> int:
+        """Reserve a concrete port number for the SO_REUSEPORT group (a
+        port-0 bind per worker would scatter them across N ports)."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind((self.host, self.port))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    def start(self) -> None:
+        self.state = SharedRouterState(create=True)
+        self.state.publish(self.gateways.router_states())
+        self.port = self._alloc_port()
+        struct.pack_into("<q", self.state.shm.buf, 40, self.port)
+        for i in range(self.n):
+            self._spawn(i)
+        # the manager's change hook funnels here: publish on next tick
+        self.gateways.on_change = self.poke
+        self._thread = threading.Thread(target=self._watchdog,
+                                        name="gw-workers", daemon=True)
+        self._thread.start()
+        log.info("worker tier: %d SO_REUSEPORT workers on %s:%d",
+                 self.n, self.host, self.port)
+
+    def _spawn(self, idx: int) -> None:
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(self.host, self.port, self.state.name, idx,
+                  self.api_key),
+            name=f"gw-worker-{idx}", daemon=True)
+        p.start()
+        self.procs[idx] = p
+
+    def poke(self) -> None:
+        self._poke.set()
+
+    # ---- watchdog --------------------------------------------------------
+
+    def _watchdog(self) -> None:
+        last_pub = 0.0
+        last_wake: dict[int, int] = {}
+        while not self._stop.wait(self.TICK_S):
+            try:
+                now = time.monotonic()
+                if (self._poke.is_set()
+                        or now - last_pub >= self.REPUBLISH_S):
+                    self._poke.clear()
+                    self.state.publish(self.gateways.router_states())
+                    last_pub = now
+                self._check_workers()
+                self._relay_wake_hints(last_wake)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("worker-tier watchdog tick")
+
+    def _check_workers(self) -> None:
+        for i, p in enumerate(self.procs):
+            if p is None or p.is_alive():
+                hb = self.state.load(_wk_off(i))
+                if (p is not None and hb
+                        and time.monotonic_ns() - hb
+                        > self.HEARTBEAT_STALE_S * 1e9):
+                    log.warning("worker %d heartbeat stale — killing", i)
+                    p.kill()
+                    p.join(timeout=1)
+                else:
+                    continue
+            # dead: reconcile its shared-memory footprint, then respawn —
+            # the kernel already stopped routing to its closed socket
+            reclaimed = self.state.reconcile_worker(i)
+            self.reclaimed_claims += reclaimed
+            if not self._stop.is_set():
+                self.respawns += 1
+                if self.events is not None:
+                    self.events.record("gateway.worker_respawn",
+                                       target=f"worker-{i}", code=500,
+                                       reclaimed=reclaimed)
+                self.state.store(_wk_off(i), 0)
+                self._spawn(i)
+
+    def _relay_wake_hints(self, last_wake: dict[int, int]) -> None:
+        """Workers can't run the autoscaler; they bump a wake-hint
+        counter when requests arrive with zero live replicas. Relay it to
+        the owning Gateway's wake trigger (scale-to-zero wake)."""
+        _, roster = self.state.read_roster()
+        for name, ent in roster.items():
+            slot = ent["slot"]
+            hint = self.state.load(_gw_cnt_off(slot) + 40)
+            if hint > last_wake.get(slot, 0):
+                last_wake[slot] = hint
+                try:
+                    self.gateways.get(name).note_external_demand()
+                # tdlint: disable=silent-swallow -- the gateway was deleted between roster read and relay
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ---- observability ---------------------------------------------------
+
+    def describe(self) -> dict:
+        out = {"count": self.n, "port": self.port,
+               "alive": sum(1 for p in self.procs
+                            if p is not None and p.is_alive()),
+               "respawns": self.respawns,
+               "reclaimedClaims": self.reclaimed_claims,
+               "gateways": {}}
+        if self.state is not None:
+            _, roster = self.state.read_roster()
+            for name, ent in roster.items():
+                c = self.state.gateway_counters(ent["slot"])
+                out["gateways"][name] = {
+                    "requestsTotal": c["requestsTotal"],
+                    "shedTotal": c["shedTotal"],
+                    "queued": c["queued"],
+                    "inflight": sum(c["inflight"]),
+                }
+        return out
+
+    # ---- stop ------------------------------------------------------------
+
+    def stop(self, drain_timeout: float = 8.0) -> None:
+        self._stop.set()
+        if self.gateways.on_change == self.poke:
+            self.gateways.on_change = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.state is not None:
+            self.state.store(HDR_OFF_SHUTDOWN, 1)
+        for p in self.procs:
+            if p is not None and p.is_alive():
+                p.terminate()               # SIGTERM: graceful drain
+        deadline = time.monotonic() + drain_timeout
+        for p in self.procs:
+            if p is not None:
+                p.join(timeout=max(0.1, deadline - time.monotonic()))
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=2)
+        if self.state is not None:
+            self.state.close(unlink=True)
+            self.state = None

@@ -104,6 +104,26 @@ class MVCCStore:
         self._commit(seq)
         return rev
 
+    def put_many(self, items) -> int:
+        """Apply a batch of (key, value) puts under ONE lock acquisition
+        and make them durable with ONE flush (+ fsync when enabled) —
+        the batched twin of put() the workqueue's coalescing drainer
+        calls. Returns the final revision (the current revision when the
+        batch is empty)."""
+        seq = 0
+        with self._lock:
+            for key, value in items:
+                self._rev += 1
+                self._apply_put(key, value, self._rev)
+                seq = self._wal_append(
+                    {"op": "put", "k": key, "v": value, "r": self._rev},
+                    inline_flush=False)
+            rev = self._rev
+            if seq and self._wal is not None and not self._fsync:
+                self._wal.flush()   # one flush for the whole batch
+        self._commit(seq)
+        return rev
+
     def delete(self, key: str) -> bool:
         """Tombstone the key. Re-creating it later restarts version at 1
         (etcd semantics). Returns False if the key doesn't exist."""
@@ -303,17 +323,18 @@ class MVCCStore:
 
     # ---- persistence ----
 
-    def _wal_append(self, rec: dict) -> int:
+    def _wal_append(self, rec: dict, inline_flush: bool = True) -> int:
         """Append under _lock; returns the record's commit sequence number
         (0 = no WAL, nothing to wait for). fsync mode appends BUFFERED and
         leaves the flush to the group-commit leader; non-fsync mode flushes
         inline — a page-cache flush costs microseconds, less than parking
-        the writer on the commit condition variable would."""
+        the writer on the commit condition variable would. put_many passes
+        inline_flush=False and flushes once for the whole batch."""
         if self._wal is None:
             return 0
         self._wal.write(
             (json.dumps(rec, separators=(",", ":")) + "\n").encode("utf-8"))
-        if not self._fsync:
+        if not self._fsync and inline_flush:
             self._wal.flush()
         self._wal_records += 1
         self._seq += 1

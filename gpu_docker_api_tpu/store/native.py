@@ -3,6 +3,13 @@
 Same API and WAL format as the pure-Python MVCCStore — the two are
 interchangeable engines behind StateClient. `open_store()` is the factory
 the app uses: native when the core is available, Python otherwise.
+
+The core honors `fsync` for real (batched leader/follower group commit,
+one fwrite + fsync per batch — the same design as store/mvcc.py), so the
+factory no longer demotes to the Python engine when durability is
+requested. The hot read path goes through `mvcc_get_fast`/
+`mvcc_range_fast`: raw value bytes via a per-handle mmap'd transfer
+buffer instead of a JSON round trip plus a malloc per call.
 """
 
 from __future__ import annotations
@@ -10,7 +17,9 @@ from __future__ import annotations
 import ctypes
 import json
 import os
-from typing import Optional, Union
+import struct
+import threading
+from typing import Iterable, Optional, Union
 
 from .._native import load
 from .mvcc import KeyValue, MVCCStore
@@ -24,13 +33,26 @@ class NativeMVCCStore:
     """Drop-in MVCCStore backed by the C++ core."""
 
     def __init__(self, wal_path: Optional[str] = None, fsync: bool = False):
-        del fsync  # the core fflushes per record
         self._lib = load("mvccstore")
         if self._lib is None:
             raise RuntimeError("native mvcc core unavailable")
         if wal_path:
             os.makedirs(os.path.dirname(os.path.abspath(wal_path)), exist_ok=True)
-        self._h = self._lib.mvcc_open((wal_path or "").encode())
+        self._fsync = bool(fsync)
+        self._h = self._lib.mvcc_open((wal_path or "").encode(),
+                                      1 if fsync else 0)
+        # the fast read path returns pointers into the handle's single
+        # transfer buffer — valid only until the next *_fast call, so the
+        # call + copy-out pair is serialized here (the GIL makes this
+        # nearly free; the C core's own mutex still guards its state).
+        # The meta arrays are preallocated for the same reason: they are
+        # only ever touched under this lock, and a per-call allocation is
+        # measurable at the FFI call rate the read path runs at.
+        self._read_lock = threading.Lock()
+        self._get_meta = (ctypes.c_int64 * 4)()
+        self._range_meta = (ctypes.c_int64 * 2)()
+        self._get_fast = self._lib.mvcc_get_fast
+        self._range_fast = self._lib.mvcc_range_fast
 
     # ---- helpers ----
 
@@ -60,13 +82,36 @@ class NativeMVCCStore:
     def put(self, key: str, value: str) -> int:
         return self._lib.mvcc_put(self._handle, key.encode(), value.encode())
 
+    def put_many(self, items: Iterable[tuple[str, str]]) -> int:
+        """Apply all puts under one native lock acquisition and one batch
+        commit (single fwrite + optional fsync) — the entry point the
+        workqueue's coalescing drainer batches into. Returns the final
+        revision (the store's current revision when `items` is empty)."""
+        parts = []
+        n = 0
+        for key, value in items:
+            k = key.encode()
+            v = value.encode()
+            parts.append(struct.pack("<II", len(k), len(v)))
+            parts.append(k)
+            parts.append(v)
+            n += 1
+        if n == 0:
+            return self.revision
+        return self._lib.mvcc_put_many(self._handle, b"".join(parts), n)
+
     def delete(self, key: str) -> bool:
         return bool(self._lib.mvcc_delete(self._handle, key.encode()))
 
     def get(self, key: str) -> Optional[KeyValue]:
-        raw = self._take(self._lib.mvcc_get(self._handle, key.encode()))
-        d = json.loads(raw) if raw else None
-        return self._kv(d) if d else None
+        meta = self._get_meta
+        with self._read_lock:
+            ptr = self._get_fast(self._handle, key.encode(), meta)
+            if meta[0] < 0 or not ptr:
+                return None
+            raw = ctypes.string_at(ptr, meta[0])
+            crev, mrev, ver = meta[1], meta[2], meta[3]
+        return KeyValue(key, raw.decode("utf-8"), crev, mrev, ver)
 
     def get_at_revision(self, key: str, revision: int) -> Optional[KeyValue]:
         ptr = self._lib.mvcc_get_at(self._handle, key.encode(), revision)
@@ -76,8 +121,25 @@ class NativeMVCCStore:
         return self._kv(d) if d else None
 
     def range(self, prefix: str) -> list[KeyValue]:
-        raw = self._take(self._lib.mvcc_range(self._handle, prefix.encode()))
-        return [self._kv(d) for d in json.loads(raw or "[]")]
+        meta = self._range_meta
+        with self._read_lock:
+            ptr = self._range_fast(self._handle, prefix.encode(), meta)
+            if not ptr or meta[1] <= 0:
+                return []
+            buf = ctypes.string_at(ptr, meta[1])
+            count = meta[0]
+        out = []
+        off = 0
+        for _ in range(count):
+            klen, vlen, crev, mrev, ver = struct.unpack_from("<IIqqq", buf,
+                                                             off)
+            off += 32
+            key = buf[off:off + klen].decode("utf-8")
+            off += klen
+            value = buf[off:off + vlen].decode("utf-8")
+            off += vlen
+            out.append(KeyValue(key, value, crev, mrev, ver))
+        return out
 
     def history(self, key: str, since_create: bool = True) -> list[KeyValue]:
         raw = self._take(self._lib.mvcc_history(
@@ -107,26 +169,19 @@ class NativeMVCCStore:
     def wal_records(self) -> int:
         return self._lib.mvcc_wal_records(self._handle)
 
-    # ---- group-commit counters (python-engine parity) ----
-    # The C++ core cleanly BYPASSES group commit: it fflushes each record
-    # inside its own mutex (microseconds to page cache, no fsync), so
-    # there is no per-record flush cost worth amortizing — the Python
-    # engine's group commit exists because TextIO flush + optional fsync
-    # per record is what hurt there. One record == one flush here, which
-    # is exactly what these counters report so /metrics stays uniform
-    # across engines.
+    # ---- group-commit counters (python-engine parity; /metrics) ----
 
     @property
     def wal_flushes(self) -> int:
-        return self.wal_records
+        return self._lib.mvcc_wal_flushes(self._handle)
 
     @property
     def wal_flushed_records(self) -> int:
-        return self.wal_records
+        return self._lib.mvcc_wal_flushed_records(self._handle)
 
     @property
     def wal_flush_batch_max(self) -> int:
-        return 1 if self.wal_records else 0
+        return self._lib.mvcc_wal_flush_batch_max(self._handle)
 
     def maintain(self, keep_history_prefixes: tuple[str, ...] = ()) -> dict:
         """Compact + WAL rewrite + handle swap, same contract as
@@ -168,10 +223,10 @@ def open_store(wal_path: Optional[str] = None,
     """engine: "auto" (native when available), "native", "python".
 
     fsync (default: the TDAPI_WAL_FSYNC env, off): fsync every commit.
-    Affordable because the python engine group-commits — N concurrent
-    writers share one fsync (store/mvcc.py). The native engine does not
-    fsync (its per-record fflush reaches the page cache only); "auto"
-    therefore prefers the python engine when fsync is requested."""
+    Affordable on BOTH engines because both group-commit — N concurrent
+    writers share one fsync (store/mvcc.py; native/mvcc_store.cc mirrors
+    the same leader/follower design). "auto" therefore prefers the native
+    engine whenever the core is available, fsync or not."""
     if fsync is None:
         fsync = os.environ.get("TDAPI_WAL_FSYNC", "") not in ("", "0")
     if engine == "python":
@@ -180,6 +235,6 @@ def open_store(wal_path: Optional[str] = None,
         return NativeMVCCStore(wal_path=wal_path, fsync=fsync)
     if engine != "auto":
         raise ValueError(f"unknown store engine {engine!r} (auto|native|python)")
-    if native_available() and not fsync:
-        return NativeMVCCStore(wal_path=wal_path)
+    if native_available():
+        return NativeMVCCStore(wal_path=wal_path, fsync=fsync)
     return MVCCStore(wal_path=wal_path, fsync=fsync)

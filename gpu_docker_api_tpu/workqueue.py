@@ -152,36 +152,106 @@ class WorkQueue:
                     batch.append(self._q.get_nowait())
                 except queue.Empty:
                     break
+            # runs of consecutive surviving puts dispatch as ONE batched
+            # store commit (client.put_many -> store.put_many: one lock,
+            # one flush, one optional fsync) instead of N round trips;
+            # barriers (DelKey/Call) still apply individually in order
+            run: list[tuple] = []
             for env, superseded in self._coalesce(batch):
-                # Retry inline, blocking the drainer: later writes to the same
-                # key must not overtake a failed earlier one, and join()/
-                # close() must see in-flight retries as unfinished work.
+                if isinstance(env.msg, PutKeyValue):
+                    run.append((env, superseded))
+                    continue
+                self._apply_put_run(run)
+                run = []
+                self._apply_one(env, superseded)
+            self._apply_put_run(run)
+
+    def _apply_put_run(self, entries: list[tuple]) -> None:
+        """Persist a run of coalesce-surviving puts as one batched store
+        commit. Retries the whole batch (ordering within the run is the
+        store's ordering); exhausted retries dead-letter every message
+        individually so replay_dropped() re-queues each."""
+        if not entries:
+            return
+        put_many = getattr(self._client, "put_many", None)
+        if len(entries) == 1 or put_many is None:
+            for env, superseded in entries:
+                self._apply_one(env, superseded)
+            return
+        attempts = 0
+        try:
+            while True:
                 try:
-                    while True:
-                        try:
-                            with trace.resume(env.span, "workqueue.apply",
-                                              target=describe(env.msg),
-                                              coalesced=len(superseded)):
-                                self._dispatch(env.msg)
-                            break
-                        except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
-                            env.attempts += 1
-                            if env.attempts > self._max_retries:
-                                log.error("workqueue: dropping %r after %d attempts: %s",
-                                          env.msg, env.attempts, e)
-                                self._record_drop(env.msg, env.attempts, e)
-                                break
-                            delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
-                            log.warning("workqueue: retry %d for %r in %.2fs: %s",
-                                        env.attempts, env.msg, delay, e)
-                            time.sleep(delay)
-                finally:
-                    # superseded envelopes complete WITH their survivor:
-                    # join() must not report done while the key's latest
-                    # value is still un-persisted
+                    with trace.resume(entries[0][0].span,
+                                      "workqueue.apply_batch",
+                                      target=f"put_many x{len(entries)}",
+                                      coalesced=sum(len(s) for _, s
+                                                    in entries)):
+                        put_many([(e.msg.resource, e.msg.name,
+                                   e.msg.resolve()) for e, _ in entries])
+                    # every OTHER mutation in the batch still gets its
+                    # persistence span (end-to-end mutation tracing must
+                    # not end at enqueue just because the write was
+                    # batched); the batch's cost is carried by the
+                    # apply_batch span above, these mark completion
+                    for env, superseded in entries[1:]:
+                        with trace.resume(env.span, "workqueue.apply",
+                                          target=describe(env.msg),
+                                          coalesced=len(superseded),
+                                          batched=True):
+                            pass
+                    break
+                except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
+                    attempts += 1
+                    if attempts > self._max_retries:
+                        log.error("workqueue: dropping %d-put batch after "
+                                  "%d attempts: %s", len(entries), attempts,
+                                  e)
+                        for env, _ in entries:
+                            self._record_drop(env.msg, attempts, e)
+                        break
+                    delay = min(self._base_backoff * (2 ** (attempts - 1)),
+                                2.0)
+                    log.warning("workqueue: retry %d for %d-put batch in "
+                                "%.2fs: %s", attempts, len(entries), delay,
+                                e)
+                    time.sleep(delay)
+        finally:
+            for _, superseded in entries:
+                self._q.task_done()
+                for _ in superseded:
                     self._q.task_done()
-                    for _ in superseded:
-                        self._q.task_done()
+
+    def _apply_one(self, env, superseded: list) -> None:
+        # Retry inline, blocking the drainer: later writes to the same
+        # key must not overtake a failed earlier one, and join()/
+        # close() must see in-flight retries as unfinished work.
+        try:
+            while True:
+                try:
+                    with trace.resume(env.span, "workqueue.apply",
+                                      target=describe(env.msg),
+                                      coalesced=len(superseded)):
+                        self._dispatch(env.msg)
+                    break
+                except Exception as e:  # noqa: BLE001 — persistence must not kill the drainer
+                    env.attempts += 1
+                    if env.attempts > self._max_retries:
+                        log.error("workqueue: dropping %r after %d attempts: %s",
+                                  env.msg, env.attempts, e)
+                        self._record_drop(env.msg, env.attempts, e)
+                        break
+                    delay = min(self._base_backoff * (2 ** (env.attempts - 1)), 2.0)
+                    log.warning("workqueue: retry %d for %r in %.2fs: %s",
+                                env.attempts, env.msg, delay, e)
+                    time.sleep(delay)
+        finally:
+            # superseded envelopes complete WITH their survivor:
+            # join() must not report done while the key's latest
+            # value is still un-persisted
+            self._q.task_done()
+            for _ in superseded:
+                self._q.task_done()
 
     def _coalesce(self, batch: list) -> list[tuple]:
         """[(survivor_envelope, [superseded_envelopes])], order-preserving.

@@ -9,17 +9,33 @@
 // {"op":"compact","r":N,"keep":[...]} / {"op":"rev","r":N}) so either
 // engine can open the other's state.
 //
-// Exposed as a C ABI for ctypes (no pybind11 in the image). All returned
-// strings are malloc'd JSON; the caller frees them with mvcc_free().
+// Durability mirrors the Python engine exactly: writers append records to
+// an in-memory pending buffer under the store mutex and block in Commit()
+// until a flush LEADER has written their sequence — one fwrite + fflush
+// (+ fsync when the handle was opened with fsync on) per batch, so N
+// racing writers share one flush instead of paying N (leader/follower
+// group commit, store/mvcc.py _commit). put()/put_many() return only
+// after the record is on disk.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image). The hot read
+// path (mvcc_get_fast / mvcc_range_fast) returns raw value bytes through
+// a per-handle mmap'd transfer buffer — no JSON round trip, no per-call
+// malloc; cold paths (get_at, history) return malloc'd JSON the caller
+// frees with mvcc_free().
 //
 // Reference parity note: the reference outsources this entire layer to an
 // external etcd server over gRPC (internal/etcd/). Embedding it natively
 // removes the network hop from every control-plane mutation — the store
 // becomes a library call.
 
+#include <sys/mman.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -197,7 +213,14 @@ Record parse_record(const std::string& line) {
 
 class Store {
  public:
-  explicit Store(const char* wal_path) {
+  // fsync_on: fsync the WAL on every commit (amortized by group commit —
+  // the Python engine's exact contract, store/mvcc.py _commit).
+  Store(const char* wal_path, bool fsync_on) : fsync_(fsync_on) {
+    const char* bw = std::getenv("TDAPI_WAL_BATCH_MS");
+    if (bw && *bw) {
+      double ms = std::strtod(bw, nullptr);
+      if (ms > 0) batch_window_us_ = static_cast<int64_t>(ms * 1000.0);
+    }
     if (wal_path && wal_path[0]) {
       wal_path_ = wal_path;
       Replay();
@@ -205,60 +228,162 @@ class Store {
     }
   }
 
-  ~Store() { Close(); }
+  ~Store() {
+    Close();
+    if (rb_) munmap(rb_, rb_cap_);
+  }
 
   void Close() {
-    std::lock_guard<std::mutex> g(mu_);
-    if (wal_) {
-      std::fflush(wal_);
-      std::fclose(wal_);
-      wal_ = nullptr;
+    int64_t target = 0;
+    {
+      std::lock_guard<std::mutex> wg(wal_mu_);
+      std::lock_guard<std::mutex> g(mu_);
+      target = seq_;
+      if (wal_) {
+        FlushPendingLocked();
+        std::fflush(wal_);
+        if (fsync_) ::fsync(fileno(wal_));
+        std::fclose(wal_);
+        wal_ = nullptr;
+      }
     }
+    MarkDurable(target);  // wake any commit waiters: the close flushed them
   }
 
   int64_t Put(const std::string& key, const std::string& value) {
-    std::lock_guard<std::mutex> g(mu_);
-    ++rev_;
-    ApplyPut(key, value, rev_);
-    if (wal_) {
+    int64_t rev, seq;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      rev = ++rev_;
+      ApplyPut(key, value, rev);
       std::string line = "{\"op\":\"put\",\"k\":";
       json_escape(key, &line);
       line += ",\"v\":";
       json_escape(value, &line);
-      line += ",\"r\":" + std::to_string(rev_) + "}\n";
-      std::fwrite(line.data(), 1, line.size(), wal_);
-      std::fflush(wal_);
-      ++wal_records_;
+      line += ",\"r\":" + std::to_string(rev) + "}\n";
+      seq = Append(line);
     }
-    return rev_;
+    Commit(seq);
+    return rev;
+  }
+
+  // records: n entries of [u32 klen][u32 vlen][key bytes][value bytes].
+  // All applied + appended under ONE lock acquisition and made durable by
+  // ONE batch flush (+fsync) — the workqueue drainer's coalesced batch
+  // costs one commit instead of n ctypes round trips and n flushes.
+  int64_t PutMany(const char* buf, int64_t n) {
+    int64_t rev = 0, seq = 0;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      const char* p = buf;
+      std::string batch;
+      for (int64_t i = 0; i < n; ++i) {
+        uint32_t klen, vlen;
+        std::memcpy(&klen, p, 4);
+        std::memcpy(&vlen, p + 4, 4);
+        p += 8;
+        std::string key(p, klen);
+        p += klen;
+        std::string value(p, vlen);
+        p += vlen;
+        rev = ++rev_;
+        ApplyPut(key, value, rev);
+        std::string line = "{\"op\":\"put\",\"k\":";
+        json_escape(key, &line);
+        line += ",\"v\":";
+        json_escape(value, &line);
+        line += ",\"r\":" + std::to_string(rev) + "}\n";
+        seq = Append(line);
+      }
+    }
+    Commit(seq);
+    return rev;
   }
 
   bool Delete(const std::string& key) {
-    std::lock_guard<std::mutex> g(mu_);
-    auto it = log_.find(key);
-    if (it == log_.end() || it->second.empty() || it->second.back().tombstone)
-      return false;
-    ++rev_;
-    ApplyDelete(key, rev_);
-    if (wal_) {
+    int64_t seq;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = log_.find(key);
+      if (it == log_.end() || it->second.empty() ||
+          it->second.back().tombstone)
+        return false;
+      ++rev_;
+      ApplyDelete(key, rev_);
       std::string line = "{\"op\":\"del\",\"k\":";
       json_escape(key, &line);
       line += ",\"r\":" + std::to_string(rev_) + "}\n";
-      std::fwrite(line.data(), 1, line.size(), wal_);
-      std::fflush(wal_);
-      ++wal_records_;
+      seq = Append(line);
     }
+    Commit(seq);
     return true;
   }
 
-  // Returns JSON {"key","value","create_revision","mod_revision","version"}
-  // or "null".
-  std::string Get(const std::string& key) {
+  // Raw read path: value bytes copied once into the handle's mmap'd
+  // transfer buffer — no JSON escape/parse and no per-call malloc between
+  // the revision log and the caller. meta: [0]=value length (-1 = key
+  // absent/tombstoned), [1]=create_revision, [2]=mod_revision,
+  // [3]=version. The returned pointer is valid until the next *_fast call
+  // on this handle (the Python wrapper serializes them under a lock).
+  const char* GetFast(const std::string& key, int64_t* meta) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = log_.find(key);
-    if (it == log_.end() || it->second.empty() || it->second.back().tombstone)
-      return "null";
-    return KvJson(key, it->second.back());
+    if (it == log_.end() || it->second.empty() ||
+        it->second.back().tombstone) {
+      meta[0] = -1;
+      return nullptr;
+    }
+    const Rev& r = it->second.back();
+    char* b = EnsureBuf(r.value.size());
+    if (!b) {
+      meta[0] = -1;
+      return nullptr;
+    }
+    std::memcpy(b, r.value.data(), r.value.size());
+    meta[0] = static_cast<int64_t>(r.value.size());
+    meta[1] = r.create;
+    meta[2] = r.mod;
+    meta[3] = r.version;
+    return b;
+  }
+
+  // Range over the mmap'd buffer: entries packed as [u32 klen][u32 vlen]
+  // [i64 create][i64 mod][i64 version][key][value]. meta: [0]=entry
+  // count, [1]=total bytes.
+  const char* RangeFast(const std::string& prefix, int64_t* meta) {
+    std::lock_guard<std::mutex> g(mu_);
+    size_t total = 0;
+    int64_t count = 0;
+    for (auto it = log_.lower_bound(prefix); it != log_.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      if (it->second.empty() || it->second.back().tombstone) continue;
+      total += 32 + it->first.size() + it->second.back().value.size();
+      ++count;
+    }
+    char* b = EnsureBuf(total);
+    if (!b) {
+      meta[0] = meta[1] = 0;
+      return nullptr;
+    }
+    char* p = b;
+    for (auto it = log_.lower_bound(prefix); it != log_.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      if (it->second.empty() || it->second.back().tombstone) continue;
+      const Rev& r = it->second.back();
+      uint32_t klen = static_cast<uint32_t>(it->first.size());
+      uint32_t vlen = static_cast<uint32_t>(r.value.size());
+      std::memcpy(p, &klen, 4);
+      std::memcpy(p + 4, &vlen, 4);
+      std::memcpy(p + 8, &r.create, 8);
+      std::memcpy(p + 16, &r.mod, 8);
+      std::memcpy(p + 24, &r.version, 8);
+      std::memcpy(p + 32, it->first.data(), klen);
+      std::memcpy(p + 32 + klen, r.value.data(), vlen);
+      p += 32 + klen + vlen;
+    }
+    meta[0] = count;
+    meta[1] = static_cast<int64_t>(total);
+    return b;
   }
 
   std::string GetAt(const std::string& key, int64_t revision, bool* err_compacted) {
@@ -276,21 +401,6 @@ class Store {
     }
     if (!best || best->tombstone) return "null";
     return KvJson(key, *best);
-  }
-
-  std::string Range(const std::string& prefix) {
-    std::lock_guard<std::mutex> g(mu_);
-    std::string out = "[";
-    bool first = true;
-    for (auto it = log_.lower_bound(prefix); it != log_.end(); ++it) {
-      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
-      if (it->second.empty() || it->second.back().tombstone) continue;
-      if (!first) out += ",";
-      first = false;
-      out += KvJson(it->first, it->second.back());
-    }
-    out += "]";
-    return out;
   }
 
   std::string History(const std::string& key, bool since_create) {
@@ -316,20 +426,13 @@ class Store {
   }
 
   int64_t Compact(int64_t revision, const std::vector<std::string>& keep) {
-    std::lock_guard<std::mutex> g(mu_);
-    int64_t dropped = CompactLocked(revision, keep);
-    if (wal_) {
-      std::string line = "{\"op\":\"compact\",\"r\":" + std::to_string(revision) +
-                         ",\"keep\":[";
-      for (size_t i = 0; i < keep.size(); ++i) {
-        if (i) line += ",";
-        json_escape(keep[i], &line);
-      }
-      line += "]}\n";
-      std::fwrite(line.data(), 1, line.size(), wal_);
-      std::fflush(wal_);
-      ++wal_records_;
+    int64_t dropped, seq;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      dropped = CompactLocked(revision, keep);
+      seq = Append(CompactLine(revision, keep));
     }
+    Commit(seq);
     return dropped;
   }
 
@@ -344,37 +447,46 @@ class Store {
   // the old handle after rename would write to the unlinked inode).
   // Returns dropped revision count, or -1 when the rewrite failed.
   int64_t Maintain(const std::vector<std::string>& keep) {
-    std::lock_guard<std::mutex> g(mu_);
     if (wal_path_.empty()) return 0;
-    int64_t dropped = CompactLocked(rev_, keep);
-    if (wal_) {
-      std::fflush(wal_);
-      std::fclose(wal_);
-      wal_ = nullptr;
-    }
-    int64_t records = 0;
-    if (!SnapshotLocked(wal_path_, &records)) {
-      wal_ = std::fopen(wal_path_.c_str(), "ab");  // keep appending regardless
-      return -1;
-    }
-    wal_ = std::fopen(wal_path_.c_str(), "ab");
-    if (!wal_) return -1;  // surface it: silent wal_=nullptr would drop
-                           // every subsequent write from persistence
-    wal_records_ = records;
-    // restore the compaction floor on future replays (the snapshot itself
-    // carries only puts) — a no-op prune that re-sets compacted_
-    if (wal_) {
-      std::string line = "{\"op\":\"compact\",\"r\":" +
-                         std::to_string(compacted_) + ",\"keep\":[";
-      for (size_t i = 0; i < keep.size(); ++i) {
-        if (i) line += ",";
-        json_escape(keep[i], &line);
+    int64_t dropped, target;
+    {
+      // wal_mu_ before mu_ — the one nesting order (the flush leader
+      // takes them the same way), so maintain excludes an in-flight
+      // batch write while it swaps the file out underneath
+      std::lock_guard<std::mutex> wg(wal_mu_);
+      std::lock_guard<std::mutex> g(mu_);
+      target = seq_;
+      dropped = CompactLocked(rev_, keep);
+      if (wal_) {
+        // pending records land on the OLD file first: if the rewrite
+        // fails we keep appending to it, and nothing applied in memory
+        // is missing from disk
+        FlushPendingLocked();
+        std::fflush(wal_);
+        std::fclose(wal_);
+        wal_ = nullptr;
       }
-      line += "]}\n";
+      int64_t records = 0;
+      if (!SnapshotLocked(wal_path_, &records)) {
+        wal_ = std::fopen(wal_path_.c_str(), "ab");  // keep appending
+        MarkDurable(target);
+        return -1;
+      }
+      wal_ = std::fopen(wal_path_.c_str(), "ab");
+      if (!wal_) {
+        MarkDurable(target);
+        return -1;  // surface it: silent wal_=nullptr would drop every
+                    // subsequent write from persistence
+      }
+      wal_records_ = records;
+      // restore the compaction floor on future replays (the snapshot
+      // itself carries only puts) — a no-op prune that re-sets compacted_
+      std::string line = CompactLine(compacted_, keep);
       std::fwrite(line.data(), 1, line.size(), wal_);
       std::fflush(wal_);
       ++wal_records_;
     }
+    MarkDurable(target);
     return dropped;
   }
 
@@ -388,7 +500,123 @@ class Store {
     return wal_records_;
   }
 
+  int64_t wal_flushes() {
+    std::lock_guard<std::mutex> g(commit_mu_);
+    return flushes_;
+  }
+
+  int64_t wal_flushed_records() {
+    std::lock_guard<std::mutex> g(commit_mu_);
+    return flushed_records_;
+  }
+
+  int64_t wal_flush_batch_max() {
+    std::lock_guard<std::mutex> g(commit_mu_);
+    return flush_batch_max_;
+  }
+
  private:
+  // ---- group commit ----
+  // Writers append records to pending_ under mu_ (memory only) and
+  // receive a sequence number; Commit(seq) blocks until a flush leader
+  // has written that sequence. The leader swaps the whole pending buffer
+  // out and pays ONE fwrite + fflush (+ fsync when enabled) for the
+  // batch — N racing writers share one flush, mirroring the Python
+  // engine's leader/follower design (store/mvcc.py _commit). The leader
+  // never holds mu_ during the file write, so writers keep batching up
+  // behind it while an fsync is on the wire.
+
+  // caller holds mu_; returns the record's commit sequence (0 = no WAL)
+  int64_t Append(const std::string& line) {
+    if (!wal_) return 0;
+    pending_ += line;
+    ++wal_records_;
+    return ++seq_;
+  }
+
+  // caller holds wal_mu_ AND mu_
+  void FlushPendingLocked() {
+    if (!pending_.empty() && wal_) {
+      std::fwrite(pending_.data(), 1, pending_.size(), wal_);
+      pending_.clear();
+    }
+  }
+
+  // caller holds commit_mu_
+  void MarkDurableLocked(int64_t target) {
+    if (target > durable_seq_) {
+      ++flushes_;
+      int64_t batch = target - durable_seq_;
+      flushed_records_ += batch;
+      if (batch > flush_batch_max_) flush_batch_max_ = batch;
+      durable_seq_ = target;
+    }
+    commit_cv_.notify_all();
+  }
+
+  void MarkDurable(int64_t target) {
+    std::lock_guard<std::mutex> g(commit_mu_);
+    MarkDurableLocked(target);
+  }
+
+  void Commit(int64_t seq) {
+    if (seq == 0) return;
+    std::unique_lock<std::mutex> lk(commit_mu_);
+    while (durable_seq_ < seq) {
+      if (flushing_) {
+        commit_cv_.wait(lk);
+        continue;
+      }
+      flushing_ = true;
+      lk.unlock();
+      if (batch_window_us_ > 0) ::usleep(static_cast<useconds_t>(batch_window_us_));
+      int64_t target = 0;
+      {
+        std::lock_guard<std::mutex> wg(wal_mu_);
+        std::string batch;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          target = seq_;
+          batch.swap(pending_);
+        }
+        if (!batch.empty() && wal_) {
+          std::fwrite(batch.data(), 1, batch.size(), wal_);
+          std::fflush(wal_);
+          if (fsync_) ::fsync(fileno(wal_));
+        }
+      }
+      lk.lock();
+      flushing_ = false;
+      MarkDurableLocked(target);
+    }
+  }
+
+  static std::string CompactLine(int64_t revision,
+                                 const std::vector<std::string>& keep) {
+    std::string line = "{\"op\":\"compact\",\"r\":" + std::to_string(revision) +
+                       ",\"keep\":[";
+    for (size_t i = 0; i < keep.size(); ++i) {
+      if (i) line += ",";
+      json_escape(keep[i], &line);
+    }
+    line += "]}\n";
+    return line;
+  }
+
+  // caller holds mu_. The transfer buffer is mmap'd (anonymous) so the
+  // read path never allocates per call; it only grows, doubling.
+  char* EnsureBuf(size_t need) {
+    if (need <= rb_cap_ && rb_) return rb_;
+    size_t cap = 1 << 16;
+    while (cap < need) cap <<= 1;
+    void* m = mmap(nullptr, cap, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (m == MAP_FAILED) return nullptr;
+    if (rb_) munmap(rb_, rb_cap_);
+    rb_ = static_cast<char*>(m);
+    rb_cap_ = cap;
+    return rb_;
+  }
   void ApplyPut(const std::string& key, const std::string& value, int64_t rev) {
     auto& revs = log_[key];
     Rev r;
@@ -519,6 +747,25 @@ class Store {
   int64_t wal_records_ = 0;
   std::string wal_path_;
   FILE* wal_ = nullptr;
+  bool fsync_ = false;
+  int64_t batch_window_us_ = 0;
+  // group-commit state: pending_/seq_ under mu_; the file itself under
+  // wal_mu_ (ordered wal_mu_ -> mu_); durable_seq_/flushing_/counters
+  // under commit_mu_ (a leaf — taken while holding the others only in
+  // MarkDurable, never the other way around)
+  std::string pending_;
+  int64_t seq_ = 0;
+  std::mutex wal_mu_;
+  std::mutex commit_mu_;
+  std::condition_variable commit_cv_;
+  int64_t durable_seq_ = 0;
+  bool flushing_ = false;
+  int64_t flushes_ = 0;
+  int64_t flushed_records_ = 0;
+  int64_t flush_batch_max_ = 0;
+  // mmap'd read-path transfer buffer (EnsureBuf)
+  char* rb_ = nullptr;
+  size_t rb_cap_ = 0;
 };
 
 char* dup_string(const std::string& s) {
@@ -531,7 +778,9 @@ char* dup_string(const std::string& s) {
 
 extern "C" {
 
-void* mvcc_open(const char* wal_path) { return new Store(wal_path); }
+void* mvcc_open(const char* wal_path, int fsync_on) {
+  return new Store(wal_path, fsync_on != 0);
+}
 
 void mvcc_close(void* h) { delete static_cast<Store*>(h); }
 
@@ -539,12 +788,25 @@ int64_t mvcc_put(void* h, const char* key, const char* value) {
   return static_cast<Store*>(h)->Put(key, value);
 }
 
+// buf: n entries of [u32 klen][u32 vlen][key][value]; one lock + one
+// batch commit for the lot. Returns the final revision.
+int64_t mvcc_put_many(void* h, const char* buf, int64_t n) {
+  return static_cast<Store*>(h)->PutMany(buf, n);
+}
+
 int mvcc_delete(void* h, const char* key) {
   return static_cast<Store*>(h)->Delete(key) ? 1 : 0;
 }
 
-char* mvcc_get(void* h, const char* key) {
-  return dup_string(static_cast<Store*>(h)->Get(key));
+// Raw get through the handle's mmap'd transfer buffer; see Store::GetFast
+// for the meta contract. NOT thread-safe against concurrent *_fast calls
+// on the same handle — the Python wrapper serializes them.
+const char* mvcc_get_fast(void* h, const char* key, int64_t* meta) {
+  return static_cast<Store*>(h)->GetFast(key, meta);
+}
+
+const char* mvcc_range_fast(void* h, const char* prefix, int64_t* meta) {
+  return static_cast<Store*>(h)->RangeFast(prefix, meta);
 }
 
 // Returns NULL when `revision` is below the compaction floor.
@@ -553,10 +815,6 @@ char* mvcc_get_at(void* h, const char* key, int64_t revision) {
   std::string out = static_cast<Store*>(h)->GetAt(key, revision, &compacted);
   if (compacted) return nullptr;
   return dup_string(out);
-}
-
-char* mvcc_range(void* h, const char* prefix) {
-  return dup_string(static_cast<Store*>(h)->Range(prefix));
 }
 
 char* mvcc_history(void* h, const char* key, int since_create) {
@@ -593,6 +851,18 @@ int64_t mvcc_maintain(void* h, const char* keep_prefixes) {
 
 int64_t mvcc_wal_records(void* h) {
   return static_cast<Store*>(h)->wal_records();
+}
+
+int64_t mvcc_wal_flushes(void* h) {
+  return static_cast<Store*>(h)->wal_flushes();
+}
+
+int64_t mvcc_wal_flushed_records(void* h) {
+  return static_cast<Store*>(h)->wal_flushed_records();
+}
+
+int64_t mvcc_wal_flush_batch_max(void* h) {
+  return static_cast<Store*>(h)->wal_flush_batch_max();
 }
 
 int64_t mvcc_revision(void* h) { return static_cast<Store*>(h)->revision(); }

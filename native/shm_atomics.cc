@@ -1,0 +1,79 @@
+// Cross-process atomics + futex for the SO_REUSEPORT worker tier.
+//
+// The multi-process data plane (gpu_docker_api_tpu/server/workers.py)
+// keeps the gateway router's shared state — per-replica inflight/slot
+// claims, queue depth, roster epoch — in a multiprocessing.shared_memory
+// segment. CPython has no cross-process atomic RMW, so the hot-path
+// operations live here: every function takes a raw address inside the
+// mapped segment (the Python side computes base + offset) and runs a
+// single __atomic builtin on it. SEQ_CST throughout — the data plane does
+// a handful of these per request; correctness over nanoseconds.
+//
+// The futex pair turns "a slot freed somewhere" into a prompt
+// cross-process wakeup: releasers bump a per-gateway release-sequence
+// word and wake it; parked claimants wait on the word's low 32 bits
+// (futexes are 32-bit) instead of polling. Linux-only, like
+// SO_REUSEPORT itself.
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <ctime>
+
+extern "C" {
+
+int64_t shm_load(void* p) {
+  return __atomic_load_n(static_cast<int64_t*>(p), __ATOMIC_SEQ_CST);
+}
+
+void shm_store(void* p, int64_t v) {
+  __atomic_store_n(static_cast<int64_t*>(p), v, __ATOMIC_SEQ_CST);
+}
+
+// returns the NEW value
+int64_t shm_add(void* p, int64_t delta) {
+  return __atomic_add_fetch(static_cast<int64_t*>(p), delta,
+                            __ATOMIC_SEQ_CST);
+}
+
+// returns 1 when the swap happened
+int shm_cas(void* p, int64_t expected, int64_t desired) {
+  return __atomic_compare_exchange_n(static_cast<int64_t*>(p), &expected,
+                                     desired, false, __ATOMIC_SEQ_CST,
+                                     __ATOMIC_SEQ_CST)
+             ? 1
+             : 0;
+}
+
+// Wait until the word's low 32 bits differ from `expected` or timeout_ms
+// elapses. Returns 0 on wake, 1 on timeout, 2 on value-already-changed,
+// -1 on error. The word lives in shared memory, so FUTEX_WAIT (not
+// _PRIVATE) is required.
+int shm_futex_wait(void* p, uint32_t expected, int64_t timeout_ms) {
+  struct timespec ts;
+  struct timespec* tsp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = (timeout_ms % 1000) * 1000000L;
+    tsp = &ts;
+  }
+  long rc = syscall(SYS_futex, static_cast<uint32_t*>(p), FUTEX_WAIT,
+                    expected, tsp, nullptr, 0);
+  if (rc == 0) return 0;
+  if (errno == ETIMEDOUT) return 1;
+  if (errno == EAGAIN) return 2;  // value moved before we parked
+  if (errno == EINTR) return 0;
+  return -1;
+}
+
+int shm_futex_wake(void* p, int n) {
+  return static_cast<int>(
+      syscall(SYS_futex, static_cast<uint32_t*>(p), FUTEX_WAKE, n, nullptr,
+              nullptr, 0));
+}
+
+}  // extern "C"

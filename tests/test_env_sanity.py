@@ -47,7 +47,9 @@ def test_native_store_lib_loadable():
     from gpu_docker_api_tpu._native import load
     lib = load("mvccstore")
     if lib is not None:  # missing lib is allowed (pure-python fallback)
-        for sym in ("mvcc_open", "mvcc_put", "mvcc_get", "mvcc_maintain"):
+        for sym in ("mvcc_open", "mvcc_put", "mvcc_put_many",
+                    "mvcc_get_fast", "mvcc_range_fast", "mvcc_maintain",
+                    "mvcc_wal_flushes"):
             assert hasattr(lib, sym), f"stale native build: no {sym}"
 
 

@@ -280,6 +280,188 @@ def test_obs_overhead_ceiling(app):
         f"{[round(x, 1) for x in disarmed]}/s)")
 
 
+FLOOR_NATIVE_PUT_MANY_PER_SEC = 20000   # native batched+fsync runs ~20x this
+FLOOR_NATIVE_SPEEDUP_BATCHED = 1.2      # bench records ~3-5x; criterion 1.5
+
+
+def test_native_batched_fsync_puts_beat_python(tmp_path):
+    """The store_native_speedup criterion's tier-1 shadow: batched puts
+    with fsync ON through the native core must beat the python engine
+    (both group-commit, so the delta is the per-record python-side cost
+    the core eliminates). Floors are generous — the target regression is
+    the core quietly losing its batch commit (per-record flush/fsync
+    again), which costs 5-20x. Skips when the core isn't built."""
+    from gpu_docker_api_tpu.store import native_available, open_store
+
+    if not native_available():
+        pytest.skip("native core not built")
+
+    def run(engine):
+        s = open_store(wal_path=str(tmp_path / f"bm-{engine}.wal"),
+                       engine=engine, fsync=True)
+        best = 0.0
+        try:
+            for _ in range(2):               # best-of-2 (noisy CI box)
+                t0 = time.perf_counter()
+                for b in range(4):
+                    s.put_many([(f"/bm/k{i % 50}", f"v{b}-{i}")
+                                for i in range(250)])
+                best = max(best, 1000 / (time.perf_counter() - t0))
+        finally:
+            s.close()
+        return best
+
+    native = run("native")
+    python = run("python")
+    assert native >= FLOOR_NATIVE_PUT_MANY_PER_SEC, (
+        f"native batched fsync puts collapsed: {native:.0f} ops/sec < "
+        f"floor {FLOOR_NATIVE_PUT_MANY_PER_SEC} (did the core lose its "
+        f"group commit?)")
+    assert native >= python * FLOOR_NATIVE_SPEEDUP_BATCHED, (
+        f"native no longer beats python on batched durable puts: "
+        f"{native:.0f} vs {python:.0f} ops/sec (criterion 1.5x; floor "
+        f"{FLOOR_NATIVE_SPEEDUP_BATCHED}x)")
+
+
+def test_native_box_search_not_a_pessimization():
+    """topology_alloc.cc's keep-it verdict, pinned: at v4-128 scale the
+    memo-gated native box search must not be slower than the pure-python
+    candidate scan it accelerates (generous 1.5x margin — the target
+    failure is the gate breaking so every call pays native marshalling
+    AND the python scan, or the core itself regressing). Skips when the
+    core isn't built."""
+    import random
+    from unittest import mock
+
+    from gpu_docker_api_tpu._native import load
+    from gpu_docker_api_tpu.schedulers.tpu import TpuScheduler
+    from gpu_docker_api_tpu.topology import TpuTopology
+
+    if load("topoalloc") is None:
+        pytest.skip("native core not built")
+    # single-worker mesh: the native path applies to every size
+    topo = TpuTopology("v4-128", "v4", (4, 4, 4), chips_per_host=64)
+    sched = TpuScheduler(None, topology=topo)
+    rng = random.Random(11)
+    for i in rng.sample(range(64), 24):
+        sched.status[i] = "x"
+    free = {i for i, o in sched.status.items() if o is None}
+    sizes = (1, 2, 4, 8)
+    for n in sizes:
+        sched._box_candidates(n)             # warm the memo for both arms
+
+    def sweep():
+        for n in sizes:
+            sched._find_box(n, free)
+
+    t_native = t_python = float("inf")
+    for _ in range(3):                       # interleaved best-of (noise)
+        t0 = time.perf_counter()
+        for _ in range(30):
+            sweep()
+        t_native = min(t_native, time.perf_counter() - t0)
+        with mock.patch.object(sched, "_native_find_box",
+                               return_value=None):
+            t0 = time.perf_counter()
+            for _ in range(30):
+                sweep()
+            t_python = min(t_python, time.perf_counter() - t0)
+    assert t_native <= t_python * 1.5, (
+        f"native-assisted box search is a pessimization: {t_native:.4f}s "
+        f"vs python-only {t_python:.4f}s at v4-128 — is the memo gate "
+        f"broken?")
+
+
+FLOOR_WORKER_TIER_RPS = 150   # one worker + stub replica runs ~10-30x this
+
+
+def test_worker_tier_throughput_floor():
+    """The multi-process data plane end-to-end (real SO_REUSEPORT worker
+    process, shared-memory claims, stub replica): a generous floor that
+    catches the tier re-serializing (e.g. per-request roster reads going
+    seqlock-retry-bound, per-request connection setup, futex storms).
+    Skips when the tier is unavailable."""
+    import http.server
+    import socketserver
+
+    try:
+        from gpu_docker_api_tpu.server import workers
+    except ImportError:
+        pytest.skip("worker tier module unavailable")
+    if not workers.available():
+        pytest.skip("worker tier unavailable")
+
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # without NODELAY the stub's header/body segments wait out the
+        # worker's delayed ACK (~40ms) and the floor measures Nagle
+        disable_nagle_algorithm = True
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            self.rfile.read(n)
+            body = b'{"code":200,"msg":"ok","data":{}}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    rep = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+    rep.daemon_threads = True
+    threading.Thread(target=rep.serve_forever, daemon=True).start()
+    rport = rep.server_address[1]
+
+    class Mgr:
+        on_change = None
+
+        def router_states(self):
+            return [{"name": "g", "maxQueue": 64, "deadlineMs": 10000,
+                     "replicas": [{"port": rport, "slots": 16,
+                                   "ready": True}]}]
+
+        def get(self, name):
+            raise KeyError(name)
+
+    tier = workers.WorkerTier(Mgr(), n=1)
+    tier.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", tier.port,
+                                          timeout=10)
+        deadline = time.time() + 15
+        while time.time() < deadline:       # worker boot
+            try:
+                conn.request("POST", "/api/v1/gateways/g/generate", b"{}",
+                             {"Content-Type": "application/json"})
+                if json.loads(conn.getresponse().read()).get(
+                        "code") == 200:
+                    break
+            except OSError:
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", tier.port,
+                                                  timeout=10)
+                time.sleep(0.05)
+        n = 150
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                conn.request("POST", "/api/v1/gateways/g/generate", b"{}",
+                             {"Content-Type": "application/json"})
+                out = json.loads(conn.getresponse().read())
+                assert out.get("code") == 200, out
+            best = max(best, n / (time.perf_counter() - t0))
+        conn.close()
+        assert best >= FLOOR_WORKER_TIER_RPS, (
+            f"worker-tier data plane collapsed: {best:.0f} rps < floor "
+            f"{FLOOR_WORKER_TIER_RPS}")
+    finally:
+        tier.stop()
+        rep.shutdown()
+
+
 FLOOR_ROUTER_FWD_PER_SEC = 5000       # uncontended forwards run ~10-15x this
 FLOOR_ROUTER_CONTENDED_PER_SEC = 500  # 4-thread GIL-bound runs ~10x this
 

@@ -1,0 +1,541 @@
+"""Multi-process SO_REUSEPORT data-plane sweep (`workers` marker).
+
+Three layers:
+
+- POLICY PARITY: the worker router (server/workers.py WorkerRouter) is
+  the in-process Gateway router's policy re-run over shared-memory state;
+  the same scenarios the `gateway` suite pins on Gateway — slot caps,
+  least-queued split, queue-bound shed, priority barge, deadline — are
+  driven against WorkerRouter with an injected transport and must yield
+  identical outcomes;
+- E2E over real SO_REUSEPORT worker processes: kernel-balanced accepts,
+  shed codes on the wire (429 + Retry-After, 504), the App wiring
+  (TDAPI_GW_WORKERS -> tier, /healthz workers block, graceful stop);
+- CRASH: SIGKILL a worker mid-request — the kernel stops routing to its
+  closed socket, the watchdog respawns it, and the shared-memory claim
+  reconcile returns the dead worker's slots with zero double-admits (the
+  replica-side concurrent-request high-water mark never exceeds slots).
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import xerrors
+
+workers = pytest.importorskip("gpu_docker_api_tpu.server.workers")
+
+pytestmark = [
+    pytest.mark.workers,
+    pytest.mark.skipif(not workers.available(),
+                       reason="worker tier unavailable "
+                              "(no Linux SO_REUSEPORT / native core)"),
+]
+
+
+# ---------------------------------------------------------------- harness
+
+@pytest.fixture()
+def state():
+    st = workers.SharedRouterState(create=True)
+    yield st
+    st.close(unlink=True)
+
+
+def publish(st, replicas, max_queue=4, deadline_ms=3000, name="g"):
+    st.publish([{"name": name, "maxQueue": max_queue,
+                 "deadlineMs": deadline_ms, "replicas": replicas}])
+
+
+def rep(port, slots=2, ready=True):
+    return {"port": port, "slots": slots, "ready": ready}
+
+
+class StubReplica:
+    """Minimal replica-contract HTTP server with hold/concurrency probes:
+    the policy assertions need to see in-replica concurrency, which is
+    exactly what the slot cap bounds."""
+
+    def __init__(self):
+        outer = self
+        self.hold = threading.Event()
+        self.hold.set()                      # set = answer immediately
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.peak = 0
+        self.served = 0
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True   # keep-alive + small bodies
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(n)
+                with outer.lock:
+                    outer.inflight += 1
+                    outer.peak = max(outer.peak, outer.inflight)
+                try:
+                    outer.hold.wait(10)
+                    body = b'{"code":200,"msg":"ok","data":{}}'
+                    try:
+                        self.send_response(200)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except OSError:
+                        pass      # client (a killed worker) went away
+                finally:
+                    with outer.lock:
+                        outer.inflight -= 1
+                        outer.served += 1
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+class FakeManager:
+    """Just enough GatewayManager for a WorkerTier: router_states() is
+    the publish payload; get() backs the wake-hint relay."""
+
+    def __init__(self, states):
+        self.states = states
+        self.on_change = None
+        self.waked = []
+
+    def router_states(self):
+        return list(self.states)
+
+    def get(self, name):
+        class _G:
+            def note_external_demand(inner):
+                self.waked.append(name)
+        return _G()
+
+
+def data_call(port, name="g", body=b"{}", headers=None, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", f"/api/v1/gateways/{name}/generate", body,
+                     {"Content-Type": "application/json", **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.getheaders(), json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------- policy parity (in-process)
+
+def test_worker_router_slot_cap_and_least_queued(state):
+    """Identical outcome to the gateway suite's slot-cap case: per-replica
+    inflight never exceeds advertised slots, load splits least-queued."""
+    seen = []
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        hold.wait(2)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    publish(state, [rep(1001, slots=2), rep(1002, slots=2)], max_queue=32)
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    done = []
+    threads = [threading.Thread(target=lambda: done.append(
+        r.forward("g", b"{}"))) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    c = state.gateway_counters(0)
+    assert c["inflight"][:2] == [2, 2]
+    extra = threading.Thread(target=lambda: done.append(
+        r.forward("g", b"{}")))
+    extra.start()
+    time.sleep(0.2)
+    c = state.gateway_counters(0)
+    assert c["inflight"][:2] == [2, 2]       # the 5th PARKED, cap held
+    assert c["queued"] == 1
+    hold.set()
+    for t in threads + [extra]:
+        t.join(5)
+    assert len(done) == 5
+    assert sorted(seen[:4]) == [1001, 1001, 1002, 1002]
+
+
+def test_worker_router_queue_bound_sheds(state):
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        hold.wait(3)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    publish(state, [rep(1001, slots=1)], max_queue=2)
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    threads = [threading.Thread(target=lambda: r.forward("g", b"{}"))
+               for _ in range(3)]           # 1 in flight + 2 queued = full
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    with pytest.raises(xerrors.GatewayShedError):
+        r.forward("g", b"{}")
+    assert state.gateway_counters(0)["shedTotal"] == 1
+    hold.set()
+    for t in threads:
+        t.join(5)
+
+
+def test_worker_router_priority_barges(state):
+    """X-TDAPI-Priority high admits ahead of every parked best-effort
+    request — the strict-priority FIFO contract, same as in-process."""
+    order = []
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        order.append(bytes(body))
+        if body == b"first":
+            hold.wait(3)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    publish(state, [rep(1001, slots=1)], max_queue=16, deadline_ms=5000)
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    threads = [threading.Thread(target=r.forward, args=("g", b"first"))]
+    threads[0].start()
+    time.sleep(0.1)
+    for i in range(3):
+        t = threading.Thread(target=r.forward, args=("g", b"low%d" % i))
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)
+    t = threading.Thread(target=r.forward, args=("g", b"hi"),
+                         kwargs={"priority": "high"})
+    t.start()
+    threads.append(t)
+    time.sleep(0.15)
+    hold.set()
+    for t in threads:
+        t.join(5)
+    assert order[0] == b"first"
+    assert order[1] == b"hi", order
+    assert sorted(order[2:]) == [b"low0", b"low1", b"low2"]
+
+
+def test_worker_router_deadline_504(state):
+    publish(state, [], max_queue=8, deadline_ms=150)
+    r = workers.WorkerRouter(state, 0,
+                             transport=lambda *a: (200, b"{}"))
+    t0 = time.monotonic()
+    with pytest.raises(xerrors.GatewayDeadlineError):
+        r.forward("g", b"{}")
+    assert 0.1 <= time.monotonic() - t0 < 1.5
+
+
+def test_worker_router_retries_dead_replica(state):
+    calls = []
+
+    def transport(port, method, path, body, timeout):
+        calls.append(port)
+        if port == 1001:
+            raise ConnectionRefusedError("replica gone")
+        return 200, b'{"code":200,"msg":"ok","data":{"ok":true}}'
+
+    publish(state, [rep(1001, slots=4), rep(1002, slots=4)],
+            deadline_ms=2000)
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    status, payload = r.forward("g", b"{}")
+    assert status == 200 and b'"ok"' in payload
+    assert 1002 in calls
+    # the error landed on the shared error counter (daemon-visible)
+    g = 0
+    assert state.load(workers._rep_cnt_off(g, 0) + 8) >= 1
+
+
+def test_slot_reassignment_resets_counters(state):
+    """A gateway deleted mid-request whose segment slot is reused by a
+    NEW gateway must not bequeath phantom inflight: the publisher bumps
+    the gen word AND zeroes the slot's counters + claim cells, and the
+    old claim's release skips itself on the gen mismatch."""
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        hold.wait(5)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    publish(state, [rep(1001, slots=2)], name="old")
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    t = threading.Thread(target=lambda: r.forward("old", b"{}"))
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            state.gateway_counters(0)["inflight"][0] == 0:
+        time.sleep(0.01)
+    assert state.gateway_counters(0)["inflight"][0] == 1
+    # delete "old" (its slot's name clears), then create "new" — the
+    # publisher reuses the freed slot 0, which must change identity
+    state.publish([])
+    gen0 = state.load(workers._gw_cnt_off(0))
+    publish(state, [rep(2002, slots=4)], name="new")
+    assert state.load(workers._gw_cnt_off(0)) == gen0 + 1
+    c = state.gateway_counters(0)
+    assert sum(c["inflight"]) == 0 and c["queued"] == 0
+    assert state.load(workers._wk_claim_off(0, 0, 0)) == 0
+    # the old claim releases against the new tenant: gen mismatch ->
+    # skipped, nothing goes negative or phantom
+    hold.set()
+    t.join(5)
+    c = state.gateway_counters(0)
+    assert sum(c["inflight"]) == 0 and c["queued"] == 0
+
+
+def test_seqlock_readers_never_see_torn_roster(state):
+    """Concurrent publishes vs readers: every read parses as ONE of the
+    published rosters, never a mix (the seqlock contract)."""
+    a = [{"name": "alpha", "maxQueue": 4, "deadlineMs": 1000,
+          "replicas": [rep(1, 1), rep(2, 2)]}]
+    b = [{"name": "alpha", "maxQueue": 9, "deadlineMs": 9000,
+          "replicas": [rep(9, 9)]}]
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            _, roster = state.read_roster()
+            gw = roster.get("alpha")
+            if gw is None:
+                continue
+            shape = (gw["maxQueue"], gw["deadlineMs"],
+                     tuple((r["port"], r["slots"]) for r in gw["replicas"]))
+            if shape not in ((4, 1000, ((1, 1), (2, 2))),
+                             (9, 9000, ((9, 9),))):
+                bad.append(shape)
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        state.publish(a if i % 2 == 0 else b)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not bad, bad[:3]
+
+
+# ------------------------------------------------- e2e over SO_REUSEPORT
+
+@pytest.fixture()
+def stub():
+    s = StubReplica()
+    yield s
+    s.close()
+
+
+def test_tier_e2e_kernel_balanced_and_shed_codes(stub):
+    """Two real worker processes on one port: requests serve through
+    either, queue-full sheds HTTP 429 + Retry-After on the wire, and the
+    worker /healthz answers."""
+    mgr = FakeManager([{"name": "g", "maxQueue": 1, "deadlineMs": 3000,
+                        "replicas": [rep(stub.port, slots=2)]}])
+    tier = workers.WorkerTier(mgr, n=2)
+    tier.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                status, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                time.sleep(0.05)
+        assert out["code"] == 200, out
+        for _ in range(10):
+            _, _, out = data_call(tier.port)
+            assert out["code"] == 200
+        # saturate: hold the replica, fill both slots + the 1-queue
+        stub.hold.clear()
+        parked = [threading.Thread(target=data_call, args=(tier.port,))
+                  for _ in range(3)]
+        for t in parked:
+            t.start()
+        time.sleep(0.4)
+        status, headers, out = data_call(tier.port)
+        assert out["code"] == 429 and status == 429
+        assert any(k.lower() == "retry-after" for k, _ in headers)
+        stub.hold.set()
+        for t in parked:
+            t.join(10)
+        assert stub.peak <= 2, f"slot cap violated: peak {stub.peak}"
+        # worker healthz
+        conn = http.client.HTTPConnection("127.0.0.1", tier.port,
+                                          timeout=5)
+        conn.request("GET", "/api/v1/healthz")
+        hz = json.loads(conn.getresponse().read())
+        conn.close()
+        assert hz["data"]["gateways"] == ["g"]
+    finally:
+        tier.stop()
+
+
+def test_tier_worker_kill_mid_request_reconciles(stub):
+    """SIGKILL the ONLY worker while it holds the replica's single slot:
+    the watchdog respawns it and reconciles the orphaned claim, so the
+    slot is usable again — and the replica never saw over-cap admits."""
+    mgr = FakeManager([{"name": "g", "maxQueue": 8, "deadlineMs": 4000,
+                        "replicas": [rep(stub.port, slots=1)]}])
+    tier = workers.WorkerTier(mgr, n=1)
+    tier.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                time.sleep(0.05)
+        assert out["code"] == 200
+        # a request that will HOLD the slot, then SIGKILL its worker
+        stub.hold.clear()
+        t = threading.Thread(target=lambda: data_call(tier.port,
+                                                      timeout=3))
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and stub.inflight == 0:
+            time.sleep(0.02)
+        assert stub.inflight == 1
+        assert state_inflight(tier) == 1
+        tier.procs[0].kill()
+        t.join(10)
+        stub.hold.set()
+        # respawn + reconcile: claim subtracted, slot free again
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if tier.respawns >= 1 and state_inflight(tier) == 0:
+                break
+            time.sleep(0.05)
+        assert tier.respawns >= 1
+        assert state_inflight(tier) == 0, "orphaned claim never reconciled"
+        assert tier.reclaimed_claims >= 1
+        # the respawned worker serves with the FULL slot again
+        deadline = time.time() + 10
+        out = {}
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                time.sleep(0.05)
+        assert out.get("code") == 200, out
+        assert stub.peak <= 1, f"double admit: replica saw {stub.peak}"
+    finally:
+        tier.stop()
+
+
+def state_inflight(tier) -> int:
+    return sum(tier.state.gateway_counters(0)["inflight"])
+
+
+def test_tier_graceful_drain_completes_inflight(stub):
+    """stop() SIGTERMs workers, which drain: a request in flight when the
+    tier stops still gets its 200."""
+    mgr = FakeManager([{"name": "g", "maxQueue": 8, "deadlineMs": 8000,
+                        "replicas": [rep(stub.port, slots=2)]}])
+    tier = workers.WorkerTier(mgr, n=1)
+    tier.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            _, _, out = data_call(tier.port)
+            if out.get("code") == 200:
+                break
+        except OSError:
+            time.sleep(0.05)
+    assert out["code"] == 200
+    stub.hold.clear()
+    results = []
+
+    def slow():
+        try:
+            results.append(data_call(tier.port, timeout=15)[2]["code"])
+        except Exception as e:  # noqa: BLE001
+            results.append(repr(e))
+
+    t = threading.Thread(target=slow)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and stub.inflight == 0:
+        time.sleep(0.02)
+    releaser = threading.Timer(0.5, stub.hold.set)
+    releaser.start()
+    tier.stop(drain_timeout=10)
+    t.join(15)
+    assert results == [200], results
+
+
+# ----------------------------------------------------- App-level wiring
+
+def test_app_wires_worker_tier_and_wakes(tmp_path, stub):
+    """TDAPI_GW_WORKERS via App arg: the tier starts with the App,
+    /healthz reports it, the data port serves a REAL gateway's roster
+    (replica port patched onto the stub), and App.stop() drains it."""
+    from gpu_docker_api_tpu.gateway import READY, GatewayConfig
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    app = App(state_dir=str(tmp_path / "state"), backend="mock",
+              addr="127.0.0.1:0", port_range=(47000, 47100),
+              topology=make_topology("v5p-8"), api_key="", cpu_cores=8,
+              store_maint_records=0, gw_workers=2)
+    app.start()
+    try:
+        assert app.workers is not None
+        app.gateways.create(GatewayConfig(
+            name="gw", image="img", cmd=["serve"],
+            minReplicas=1, maxReplicas=2, readiness="running",
+            scaleDownIdleS=3600, deadlineMs=4000, maxQueue=16))
+        gw = app.gateways.get("gw")
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                r.state is READY for r in gw.replicas.values()):
+            time.sleep(0.05)
+        # the mock substrate's replica isn't a real server: point the
+        # roster at the stub and republish
+        with gw._cond:
+            for r in gw.replicas.values():
+                r.host_port = stub.port
+        app.workers.poke()
+        deadline = time.time() + 10
+        out = {}
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(app.workers.port, name="gw")
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        assert out.get("code") == 200, out
+        # healthz reports the tier, with data-plane counters
+        conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                          timeout=10)
+        conn.request("GET", "/api/v1/healthz")
+        hz = json.loads(conn.getresponse().read())["data"]
+        conn.close()
+        assert hz["workers"]["count"] == 2
+        assert hz["workers"]["port"] == app.workers.port
+        assert hz["workers"]["gateways"]["gw"]["requestsTotal"] >= 1
+    finally:
+        app.stop()
+    assert app.workers.state is None        # segment closed + unlinked
