@@ -5,15 +5,18 @@ PY ?= python3
 ADDR ?= 0.0.0.0:2378
 STATE ?= ./tpu-docker-api-state
 
-.PHONY: all native test test-fast verify-crash verify-faults verify-perf \
-    verify-retry verify-migrate verify-mt verify-races verify-obs \
-    verify-gateway verify-gang verify-workers bench \
-    serve serve-mock dryrun apidoc lint clean
+.PHONY: all native native-san test test-fast verify-crash verify-faults \
+    verify-perf verify-retry verify-migrate verify-mt verify-races \
+    verify-obs verify-gateway verify-gang verify-workers verify-tdcheck \
+    bench serve serve-mock dryrun apidoc lint clean
 
 all: native
 
 native:                 ## build the C++ cores (MVCC store, topology search)
 	$(MAKE) -C native
+
+native-san:             ## ASan+UBSan / TSan cores + stress driver -> native/build/san/
+	$(MAKE) -C native san
 
 test: native            ## full suite on the virtual 8-device CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -29,6 +32,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-gateway (inference-gateway sweep: -m gateway)"
 	@echo "  make verify-gang    (elastic gang / reshard sweep: -m gang)"
 	@echo "  make verify-workers (multi-process data-plane sweep: -m workers)"
+	@echo "  make verify-tdcheck (cross-process protocol model-check: -m tdcheck)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -64,10 +68,14 @@ verify-gang:            ## elastic gang sweep: plan grants, reshard crashpoints,
 verify-workers: native  ## multi-process data-plane sweep: policy parity, kill/reconcile, drain
 	$(PY) -m pytest tests/ -q -m workers
 
-lint: native            ## compile baseline + tdlint concurrency-invariant rules + rule liveness
+verify-tdcheck: native  ## cross-process protocol model-check: interleaving + kill sweep, mutant liveness
+	$(PY) -m pytest tests/ -q -m tdcheck
+
+lint: native            ## compile baseline + tdlint rules (stale pragmas fail) + rule/checker liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py
-	$(PY) -m tools.tdlint
+	$(PY) -m tools.tdlint --stale-strict
 	$(PY) -m pytest tests/test_tdlint.py -q
+	$(PY) -m tools.tdcheck --prove-mutants --schedules 4000
 
 test-fast: native       ## skip the slow model/e2e tests
 	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \

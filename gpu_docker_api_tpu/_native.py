@@ -4,6 +4,17 @@ The .so files live under native/build/. When absent and a compiler exists,
 they're built on first use (`make -C native`); failures degrade silently to
 the pure-Python implementations — native code is an accelerator here, never
 a hard dependency.
+
+`TDAPI_NATIVE_BUILD_DIR` points the loader at an alternate build dir —
+the sanitizer builds in native/build/san/{asan,tsan} (`make native-san`).
+With the override set, no auto-build or staleness rebuild runs (the
+sanitizer dirs are built explicitly and must never be silently replaced
+by -O2 objects); without it, the default -O2 path is untouched, so the
+perf floors keep measuring the optimized cores. ASan note: loading an
+ASan-instrumented .so into a stock python needs the ASan runtime first
+(`LD_PRELOAD=$(gcc -print-file-name=libasan.so)`); TSan DSOs cannot load
+into an uninstrumented interpreter at all — the TSan coverage vehicle is
+the statically-linked stress driver.
 """
 
 from __future__ import annotations
@@ -16,7 +27,9 @@ import threading
 from typing import Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_BUILD = os.path.join(_REPO, "native", "build")
+_BUILD_OVERRIDE = os.environ.get("TDAPI_NATIVE_BUILD_DIR", "")
+_BUILD = (os.path.abspath(_BUILD_OVERRIDE) if _BUILD_OVERRIDE
+          else os.path.join(_REPO, "native", "build"))
 _lock = threading.Lock()
 _cache: dict[str, Optional[ctypes.CDLL]] = {}
 
@@ -63,8 +76,9 @@ def load(name: str) -> Optional[ctypes.CDLL]:
         # and the committed prebuilt binary is presumed to match its
         # committed source; the ABI canary below catches a genuinely
         # stale build either way.
-        if (not os.path.exists(path)
-                or os.path.getmtime(path) < _source_mtime(name)):
+        if (not _BUILD_OVERRIDE
+                and (not os.path.exists(path)
+                     or os.path.getmtime(path) < _source_mtime(name))):
             _try_build()
         lib = None
         if os.path.exists(path):

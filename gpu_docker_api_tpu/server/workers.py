@@ -135,6 +135,15 @@ def _wk_claim_off(w: int, g: int, r: int) -> int:
             + 8 * (g * MAX_REPLICAS + r))
 
 
+#: test seam: tdcheck's interleaving explorer (tools/tdcheck) installs a
+#: callable here to get schedulable yield points INSIDE the seqlock
+#: publish window — between the odd-epoch store and the closing even
+#: store — so torn-write interleavings are reachable under its
+#: cooperative scheduler. Called with the gateway slot being written.
+#: None (the default) costs one attribute load per publish slot.
+_publish_yield: Optional[Callable[[int], None]] = None
+
+
 def available() -> bool:
     """The worker tier needs Linux (SO_REUSEPORT + futex) and the native
     shm-atomics core."""
@@ -234,7 +243,16 @@ class SharedRouterState:
                 slot = free.pop(0)
             assigned[slot] = st
         epoch = self.load(HDR_OFF_EPOCH)
-        self.store(HDR_OFF_EPOCH, epoch + 1)          # odd: write in progress
+        # A publisher killed inside the window parks the epoch odd. The
+        # heal republish re-enters from that state, and `epoch + 1` would
+        # flip it EVEN while the config bytes are mid-write (readers
+        # parse a torn roster) then park it odd again at the close
+        # (readers wedge until the next heal makes it worse, forever
+        # alternating). Found by tdcheck's seqlock kill sweep: normalize
+        # to odd-while-writing whatever parity the crash left behind.
+        odd = epoch + 1 if epoch % 2 == 0 else epoch
+        self.store(HDR_OFF_EPOCH, odd)                # odd: write in progress
+        yield_seam = _publish_yield
         try:
             for g in range(MAX_GATEWAYS):
                 off = _gw_conf_off(g)
@@ -242,6 +260,8 @@ class SharedRouterState:
                 if st is None:
                     buf[off:off + NAME_LEN] = b"\0" * NAME_LEN
                     continue
+                if yield_seam is not None:
+                    yield_seam(g)
                 name = st["name"].encode()[:NAME_LEN - 1]
                 raw = bytes(buf[off:off + NAME_LEN]).split(b"\0", 1)[0]
                 if raw != name:
@@ -274,12 +294,14 @@ class SharedRouterState:
                                  int(st["deadlineMs"]), len(reps))
                 roff = off + NAME_LEN + 8 * GW_CONF_WORDS
                 for r in reps:
+                    if yield_seam is not None:
+                        yield_seam(g)
                     struct.pack_into("<qqq", buf, roff, int(r["port"]),
                                      int(r["slots"]),
                                      1 if r["ready"] else 0)
                     roff += 8 * REP_CONF_WORDS
         finally:
-            self.store(HDR_OFF_EPOCH, epoch + 2)      # even: consistent
+            self.store(HDR_OFF_EPOCH, odd + 1)        # even: consistent
         self.store(HDR_OFF_NGW, len(assigned))
 
     # ---- worker side: consistent roster read -----------------------------

@@ -1,0 +1,226 @@
+// Multithreaded stress driver for the native cores, built ONLY under
+// sanitizers (native/Makefile `san` target; the repo root's `make
+// native-san`). Two suites:
+//
+//   store — N threads hammer the MVCC store's leader/follower group
+//   commit (put / put_many / delete / history / get_at, periodic
+//   compact + maintain), then the WAL is replayed into a fresh handle
+//   and the revision accounting is checked exactly. Under TSan this is
+//   the mu_/wal_mu_/commit_mu_ choreography the comments in
+//   mvcc_store.cc assert in prose; under ASan/UBSan it sweeps the JSON
+//   escape/parse, the mmap'd transfer buffer growth, and replay.
+//
+//   shm — N threads run the worker tier's claim protocol (fetch_add,
+//   undo-on-overshoot, floor-clamped CAS release) plus futex park/wake
+//   against one shared block, asserting the slot cap is never exceeded
+//   and every counter returns to zero. The atomics are the extern "C"
+//   functions from shm_atomics.cc, linked into this binary so TSan sees
+//   both sides of every race.
+//
+// Exit 0 = clean. Any invariant failure prints and exits 1; sanitizer
+// findings abort with their own reports (that's the point).
+//
+// Usage: stress [store|shm|all] [threads] [iters] [wal_path]
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// mvcc_store.cc C ABI (subset the stress needs)
+void* mvcc_open(const char* wal_path, int fsync_on);
+void mvcc_close(void* h);
+int64_t mvcc_put(void* h, const char* key, const char* value);
+int64_t mvcc_put_many(void* h, const char* buf, int64_t n);
+int mvcc_delete(void* h, const char* key);
+char* mvcc_get_at(void* h, const char* key, int64_t revision);
+char* mvcc_history(void* h, const char* key, int since_create);
+int64_t mvcc_compact(void* h, int64_t revision, const char* keep_prefixes);
+int64_t mvcc_maintain(void* h, const char* keep_prefixes);
+int64_t mvcc_revision(void* h);
+int64_t mvcc_wal_flushes(void* h);
+void mvcc_free(char* p);
+// shm_atomics.cc
+int64_t shm_load(void* p);
+void shm_store(void* p, int64_t v);
+int64_t shm_add(void* p, int64_t delta);
+int shm_cas(void* p, int64_t expected, int64_t desired);
+int shm_futex_wait(void* p, uint32_t expected, int64_t timeout_ms);
+int shm_futex_wake(void* p, int n);
+}
+
+namespace {
+
+int g_failures = 0;
+
+void check(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "stress: FAIL: %s\n", what);
+    ++g_failures;
+  }
+}
+
+// ------------------------------------------------------------- store
+
+void store_thread(void* h, int tid, int iters,
+                  std::atomic<int64_t>* revs_made) {
+  std::string key, value;
+  for (int i = 0; i < iters; ++i) {
+    key = "/stress/t" + std::to_string(tid) + "/k" + std::to_string(i % 7);
+    value = "v-" + std::to_string(tid) + "-" + std::to_string(i) +
+            std::string(1 + (i % 64), 'x') + "\"quoted\n\t";
+    mvcc_put(h, key.c_str(), value.c_str());
+    revs_made->fetch_add(1, std::memory_order_relaxed);
+    if (i % 5 == 0) {
+      // put_many: 3 records through one lock + one batch commit
+      std::string buf;
+      for (int j = 0; j < 3; ++j) {
+        std::string k = "/stress/batch/t" + std::to_string(tid) + "-" +
+                        std::to_string(j);
+        std::string v = "b" + std::to_string(i);
+        uint32_t kl = static_cast<uint32_t>(k.size());
+        uint32_t vl = static_cast<uint32_t>(v.size());
+        buf.append(reinterpret_cast<const char*>(&kl), 4);
+        buf.append(reinterpret_cast<const char*>(&vl), 4);
+        buf += k;
+        buf += v;
+      }
+      mvcc_put_many(h, buf.data(), 3);
+      revs_made->fetch_add(3, std::memory_order_relaxed);
+    }
+    if (i % 11 == 3) {
+      if (mvcc_delete(h, key.c_str()))
+        revs_made->fetch_add(1, std::memory_order_relaxed);
+    }
+    if (i % 9 == 2) {
+      char* out = mvcc_history(h, key.c_str(), 1);
+      mvcc_free(out);
+      out = mvcc_get_at(h, key.c_str(), mvcc_revision(h));
+      if (out) mvcc_free(out);
+    }
+  }
+}
+
+void run_store(int threads, int iters, const char* wal_path) {
+  std::remove(wal_path);
+  void* h = mvcc_open(wal_path, 1 /* fsync: the durable configuration */);
+  std::atomic<int64_t> revs_made{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < threads; ++t)
+    ts.emplace_back(store_thread, h, t, iters, &revs_made);
+  // concurrent maintenance: compaction + WAL rewrite race the writers
+  std::thread maint([&] {
+    for (int i = 0; i < 4; ++i) {
+      ::usleep(2000);
+      mvcc_compact(h, mvcc_revision(h) / 2, "/stress/batch\0\0");
+      mvcc_maintain(h, "/stress/batch\0\0");
+    }
+  });
+  for (auto& t : ts) t.join();
+  maint.join();
+  check(mvcc_revision(h) == revs_made.load(),
+        "store: final revision != successful mutations");
+  check(mvcc_wal_flushes(h) > 0, "store: group commit never flushed");
+  int64_t committed = mvcc_revision(h);
+  mvcc_close(h);
+  // replay: every committed revision survives reopen
+  void* h2 = mvcc_open(wal_path, 0);
+  check(mvcc_revision(h2) == committed,
+        "store: replayed revision != committed revision");
+  char* out = mvcc_history(h2, "/stress/t0/k0", 0);
+  check(out != nullptr && out[0] == '[', "store: replay history broken");
+  mvcc_free(out);
+  mvcc_close(h2);
+  std::remove(wal_path);
+  std::fprintf(stderr, "stress: store ok (%lld revisions)\n",
+               static_cast<long long>(committed));
+}
+
+// --------------------------------------------------------------- shm
+
+// one cache-line-ish block: [0] inflight counter, [8] release sequence
+// (futex word), [16] true in-critical-section count, [24] peak
+struct ShmBlock {
+  alignas(64) int64_t words[8] = {0};
+};
+
+constexpr int64_t kSlots = 3;
+
+void dec_floor0(void* p) {
+  while (true) {
+    int64_t v = shm_load(p);
+    if (v <= 0) return;
+    if (shm_cas(p, v, v - 1)) return;
+  }
+}
+
+void shm_thread(ShmBlock* blk, int iters) {
+  void* inflight = &blk->words[0];
+  void* relseq = &blk->words[1];
+  void* held = &blk->words[2];
+  void* peak = &blk->words[3];
+  for (int i = 0; i < iters; ++i) {
+    // the worker tier's claim protocol: fetch_add, undo on overshoot
+    if (shm_add(inflight, 1) <= kSlots) {
+      int64_t h = shm_add(held, 1);
+      // peak high-water via CAS (racy max is fine — only grows)
+      while (true) {
+        int64_t p = shm_load(peak);
+        if (h <= p || shm_cas(peak, p, h)) break;
+      }
+      if (h > kSlots) {
+        std::fprintf(stderr, "stress: FAIL: shm: %lld concurrent "
+                     "claims > %lld slots\n", static_cast<long long>(h),
+                     static_cast<long long>(kSlots));
+        ++g_failures;
+      }
+      shm_add(held, -1);
+      dec_floor0(inflight);
+      shm_add(relseq, 1);
+      shm_futex_wake(relseq, 1 << 30);
+    } else {
+      dec_floor0(inflight);
+      uint32_t seen = static_cast<uint32_t>(shm_load(relseq));
+      shm_futex_wait(relseq, seen, 1);
+    }
+  }
+}
+
+void run_shm(int threads, int iters) {
+  ShmBlock blk;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < threads; ++t)
+    ts.emplace_back(shm_thread, &blk, iters);
+  for (auto& t : ts) t.join();
+  check(shm_load(&blk.words[0]) == 0, "shm: inflight did not drain to 0");
+  check(shm_load(&blk.words[2]) == 0, "shm: held did not drain to 0");
+  check(shm_load(&blk.words[3]) >= 1 && shm_load(&blk.words[3]) <= kSlots,
+        "shm: peak concurrency outside [1, slots]");
+  std::fprintf(stderr, "stress: shm ok (peak %lld/%lld)\n",
+               static_cast<long long>(shm_load(&blk.words[3])),
+               static_cast<long long>(kSlots));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string suite = argc > 1 ? argv[1] : "all";
+  int threads = argc > 2 ? std::atoi(argv[2]) : 4;
+  int iters = argc > 3 ? std::atoi(argv[3]) : 400;
+  const char* wal = argc > 4 ? argv[4] : "/tmp/tdapi_stress.wal";
+  if (suite == "store" || suite == "all") run_store(threads, iters, wal);
+  if (suite == "shm" || suite == "all") run_shm(threads, iters);
+  if (g_failures) {
+    std::fprintf(stderr, "stress: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  std::fprintf(stderr, "stress: all clean\n");
+  return 0;
+}

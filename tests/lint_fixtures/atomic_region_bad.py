@@ -1,0 +1,19 @@
+"""Seeded violations for the atomic-region rule: counter-region words
+written through the raw buffer path (pack_into / slice assignment) the
+seqlock config writes use — a plain racy store over live fetch_adds."""
+
+import struct
+
+CNT_OFF = 512
+
+
+def _gw_cnt_off(g):
+    return CNT_OFF + g * 64
+
+
+class State:
+    def publish(self, buf):
+        struct.pack_into("<q", buf, _gw_cnt_off(0) + 8, 0)   # raw pack
+        off = _gw_cnt_off(1)
+        buf[off:off + 8] = b"\0" * 8                         # aliased slice
+        self.shm.buf[CNT_OFF:CNT_OFF + 8] = b"\0" * 8        # region const

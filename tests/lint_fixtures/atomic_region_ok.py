@@ -1,0 +1,24 @@
+"""Clean twin: config-region raw writes (that's what the seqlock
+protects) and counter-region access through the atomic ops only."""
+
+import struct
+
+CONF_OFF = 64
+CNT_OFF = 512
+
+
+def _gw_conf_off(g):
+    return CONF_OFF + g * 456
+
+
+def _gw_cnt_off(g):
+    return CNT_OFF + g * 64
+
+
+class State:
+    def publish(self, buf, name):
+        off = _gw_conf_off(0)
+        buf[off:off + 48] = name.ljust(48, b"\0")        # config region
+        struct.pack_into("<q", buf, off + 48, 4)         # config words
+        self.store(_gw_cnt_off(0), 0)                    # atomic op: fine
+        self.add(_gw_cnt_off(0) + 8, 1)                  # atomic op: fine

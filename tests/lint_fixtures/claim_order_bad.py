@@ -1,0 +1,25 @@
+"""Seeded violations for the claim-order rule: the per-worker claims
+ledger written on the WRONG side of the global counter op — a SIGKILL
+between the two makes reconcile free capacity that was never claimed."""
+
+
+def _rep_cnt_off(g, r):
+    return 512 + g * 64 + r * 16
+
+
+def _wk_claim_off(w, g, r):
+    return 4096 + w * 256 + g * 16 + r * 8
+
+
+class BrokenRouter:
+    def try_claim(self, st, g, r, slots):
+        st.add(_wk_claim_off(0, g, r), 1)          # ledger BEFORE global
+        if st.add(_rep_cnt_off(g, r), 1) <= slots:
+            return True
+        st.dec_floor0(_rep_cnt_off(g, r))
+        st.dec_floor0(_wk_claim_off(0, g, r))      # undo AFTER global
+        return False
+
+    def release(self, st, g, r):
+        st.dec_floor0(_rep_cnt_off(g, r))          # global freed first
+        st.dec_floor0(_wk_claim_off(0, g, r))      # ledger undone last

@@ -1,0 +1,29 @@
+"""Clean twin: the documented ordering — global fetch_add before the
+ledger increment, ledger undo before the global release, and bulk
+zeroing that accounts both sides."""
+
+
+def _rep_cnt_off(g, r):
+    return 512 + g * 64 + r * 16
+
+
+def _wk_claim_off(w, g, r):
+    return 4096 + w * 256 + g * 16 + r * 8
+
+
+class Router:
+    def try_claim(self, st, g, r, slots):
+        off = _rep_cnt_off(g, r)
+        if st.add(off, 1) <= slots:                # global claim first
+            st.add(_wk_claim_off(0, g, r), 1)      # then the ledger
+            return True
+        st.dec_floor0(off)                         # overshoot undo
+        return False
+
+    def release(self, st, g, r):
+        st.dec_floor0(_wk_claim_off(0, g, r))      # ledger undone first
+        st.dec_floor0(_rep_cnt_off(g, r))          # then the global free
+
+    def reconcile(self, st, g, r):
+        st.dec_floor0(_rep_cnt_off(g, r))
+        st.store(_wk_claim_off(0, g, r), 0)        # zero, both accounted

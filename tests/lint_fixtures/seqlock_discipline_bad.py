@@ -1,0 +1,21 @@
+"""Seeded violations for the seqlock-discipline rule: blocking work
+(store write, sleep, logging) inside the seqlock publish window — the
+try-block whose finally closes the epoch."""
+
+import time
+
+HDR_OFF_EPOCH = 16
+
+
+class State:
+    def publish(self, states):
+        epoch = self.load(HDR_OFF_EPOCH)
+        odd = epoch + 1 if epoch % 2 == 0 else epoch
+        self.store(HDR_OFF_EPOCH, odd)
+        try:
+            for st in states:
+                self.client.put("/roster", st)     # store write in window
+                time.sleep(0.01)                   # sleep in window
+                log.warning("published %s", st)    # logging in window
+        finally:
+            self.store(HDR_OFF_EPOCH, odd + 1)

@@ -96,6 +96,67 @@ def test_untraced_op_fires_and_clean_twin_silent():
                  ["untraced-op"]) == []
 
 
+def test_seqlock_discipline_fires_and_clean_twin_silent():
+    vs = _lint(["seqlock_discipline_bad.py"], ["seqlock-discipline"])
+    assert len(vs) == 3
+    msgs = " | ".join(v.message for v in vs)
+    assert "store write '.client.put()' inside the seqlock publish" in msgs
+    assert "time.sleep() inside the seqlock publish window" in msgs
+    assert "logging call 'log.warning()'" in msgs
+    assert _lint(["seqlock_discipline_ok.py"],
+                 ["seqlock-discipline"]) == []
+
+
+def test_claim_order_fires_and_clean_twin_silent():
+    vs = _lint(["claim_order_bad.py"], ["claim-order"])
+    assert len(vs) == 3
+    msgs = " | ".join(v.message for v in vs)
+    assert "no earlier global fetch_add" in msgs
+    assert "no later global release" in msgs
+    assert _lint(["claim_order_ok.py"], ["claim-order"]) == []
+
+
+def test_atomic_region_fires_and_clean_twin_silent():
+    vs = _lint(["atomic_region_bad.py"], ["atomic-region"])
+    assert len(vs) == 3
+    msgs = " | ".join(v.message for v in vs)
+    assert "struct.pack_into targeting a counter-region offset" in msgs
+    assert "raw buffer slice assignment into the counter region" in msgs
+    assert _lint(["atomic_region_ok.py"], ["atomic-region"]) == []
+
+
+def test_shm_rules_scoped_to_workers_only():
+    """The three shm rules reason about server/workers.py's segment
+    discipline; other scoped files must not be walked by them (their
+    helper names could collide)."""
+    from tools.tdlint.rules import AtomicRegion, ClaimOrder, \
+        SeqlockDiscipline
+    for rule in (SeqlockDiscipline(), ClaimOrder(), AtomicRegion()):
+        assert rule.applies("gpu_docker_api_tpu/server/workers.py")
+        assert not rule.applies("gpu_docker_api_tpu/gateway.py")
+        assert not rule.applies("gpu_docker_api_tpu/store/mvcc.py")
+
+
+def test_claim_order_ignores_non_inflight_cells():
+    """`_rep_cnt_off(...) + 8` is the errors cell, not the inflight
+    claim — arithmetic on a helper must not be classified as the global
+    claim op (a false 'earlier fetch_add' would mask real reversals)."""
+    import textwrap
+    import tempfile
+    src = textwrap.dedent("""\
+        def forward(self, st, g, r):
+            st.add(_rep_cnt_off(g, r) + 8, 1)      # errors cell only
+            st.add(_wk_claim_off(0, g, r), 1)      # ledger with NO claim
+    """)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "errors_cell.py")
+        with open(p, "w") as f:
+            f.write(src)
+        vs = lint_paths([p], d, rules=["claim-order"])["violations"]
+    assert len(vs) == 1
+    assert "no earlier global fetch_add" in vs[0].message
+
+
 def test_untraced_op_without_catalog_is_silent():
     """A file set with no EVENT_OPS/METRIC_NAMES assignment (fixture runs
     of OTHER rules) must not fail — there is no catalog to check against."""
@@ -172,6 +233,20 @@ def test_pragma_does_not_suppress_other_rules(tmp_path):
         "        pass\n")
     rep = lint_paths([str(f)], str(tmp_path), rules=["silent-swallow"])
     assert len(rep["violations"]) == 1
+
+
+def test_stale_strict_cli_fails_on_stale_pragma(tmp_path):
+    """`make lint` runs --stale-strict: a pragma whose rule no longer
+    fires must FAIL the build, not warn — the stated contract it
+    documents no longer matches the code."""
+    from tools.tdlint.__main__ import main as tdlint_main
+    pkg = tmp_path / "gpu_docker_api_tpu"
+    pkg.mkdir()
+    (pkg / "health.py").write_text(
+        "# tdlint: disable=unlocked-state -- contract long gone\n"
+        "X = 1\n")
+    assert tdlint_main(["--root", str(tmp_path)]) == 0
+    assert tdlint_main(["--root", str(tmp_path), "--stale-strict"]) == 1
 
 
 # ------------------------------------------------------------ repo gate

@@ -332,6 +332,54 @@ def test_seqlock_readers_never_see_torn_roster(state):
     assert not bad, bad[:3]
 
 
+def test_writer_killed_mid_publish_heals_on_republish(state):
+    """A publisher SIGKILLed inside the seqlock window parks the epoch
+    odd. Readers must bound their retry (paced by the backoff sleep,
+    refusing the in-progress window rather than parsing through it) and
+    the daemon's 250ms heal republish must recover them — i.e. publish
+    is re-enterable from a crashed-odd epoch. Pinned by tdcheck's
+    seqlock kill sweep (tools/tdcheck); this is the deterministic
+    single-schedule twin."""
+    publish(state, [rep(1001, slots=1)])
+    epoch = state.load(workers.HDR_OFF_EPOCH)
+    assert epoch % 2 == 0
+    # park the epoch odd, exactly as a kill inside the window would
+    state.store(workers.HDR_OFF_EPOCH, epoch + 1)
+    sleeps = []
+    orig_sleep = workers.time.sleep
+
+    def counting_sleep(s):
+        sleeps.append(s)
+        orig_sleep(s)
+
+    workers.time.sleep = counting_sleep
+    got = []
+    t = threading.Thread(target=lambda: got.append(state.read_roster()))
+    t.start()
+    try:
+        # orig_sleep: workers.time IS the global time module, so the
+        # test's own pacing must not feed the counter it asserts on
+        orig_sleep(0.25)
+        # the reader is retrying, paced — not parsing the torn window,
+        # not busy-spinning
+        assert not got, "reader parsed a roster through an odd epoch"
+        assert sleeps, "reader busy-spins instead of pacing its retry"
+        # the heal: republish onto the crashed-odd epoch
+        publish(state, [rep(2002, slots=4)], max_queue=7)
+        t.join(5)
+        assert got, "reader wedged after the heal republish"
+    finally:
+        workers.time.sleep = orig_sleep
+        t.join(1)
+    _, roster = got[0]
+    gw = roster["g"]
+    # the recovered read is CONSISTENT: entirely the healed roster
+    assert gw["maxQueue"] == 7
+    assert gw["replicas"][0]["port"] == 2002
+    # and the heal left the epoch even — the next reader needs no retry
+    assert state.load(workers.HDR_OFF_EPOCH) % 2 == 0
+
+
 # ------------------------------------------------- e2e over SO_REUSEPORT
 
 @pytest.fixture()

@@ -1,0 +1,56 @@
+"""tdcheck — deterministic interleaving explorer for the cross-process
+protocols.
+
+PR 13 moved the data plane's hottest state out of the GIL and into a
+hand-rolled cross-process protocol: a seqlock roster twin, lock-free
+atomic claim counters with undo-on-overshoot, futex wakeups, and a C++
+leader/follower WAL group commit. None of PR 8's correctness suite sees
+any of it — tdlint reasons about `threading` locks, lockwatch patches
+in-process lock factories. tdcheck is the missing layer: a cooperative
+scheduler runs N logical processes over the REAL protocol code (the
+yield-point seam instruments `SharedRouterState`'s shm ops and the
+seqlock publish window, the same factory-patching trick lockwatch uses
+for locks), systematically enumerates schedules, and injects a SIGKILL
+at every yield point. Each invariant checker is proven LIVE on a
+seeded-broken mutant twin, like tdlint's rule fixtures.
+
+Checked protocols (tools/tdcheck/models.py):
+
+1. **seqlock publish/read** — a reader never acts on a torn roster, and
+   a writer crash mid-publish (epoch parked odd) is healed by the 250ms
+   republish rather than wedging readers forever.
+2. **claim/undo/reconcile** — no schedule ever admits past a replica's
+   advertised slots, and `reconcile_worker` after a SIGKILL restores
+   exact counter accounting (the "ledger incremented only after the
+   global claim" ordering, previously asserted only in prose).
+3. **WAL group commit** — `Commit(seq)` returning implies the record's
+   batch was flushed, across leader handoff and crash-at-any-step.
+   Checked on a pure-Python twin of the C++ state machine
+   (native/mvcc_store.cc), cross-validated against the real core by the
+   subprocess kill sweep in tests/test_tdcheck.py.
+
+Exploration (tools/tdcheck/sched.py) is CHESS-style iterative context
+bounding: the base schedule runs each process to completion; exhaustive
+mode enumerates every placement of up to `preemptions` forced switches
+plus up to `kills` crash injections (exhaustive for small bounds —
+the 2-writer/1-reader seqlock and 2-worker claim models are swept
+completely); beyond the bounds, randomized mode draws schedules from a
+seeded RNG, and every failure report carries the exact schedule so
+`--replay` reproduces it deterministically.
+
+Run: `python -m tools.tdcheck` (all models, quick budget; `make
+verify-tdcheck` wraps the pytest sweep). Exit 0 = every invariant held
+on every explored schedule.
+"""
+
+from __future__ import annotations
+
+from .sched import (  # noqa: F401  (re-exports: the package API)
+    ExhaustiveStrategy, InvariantViolation, RandomStrategy, ReplayStrategy,
+    RunResult, Scheduler, explore,
+)
+
+__all__ = [
+    "Scheduler", "RunResult", "InvariantViolation", "explore",
+    "ExhaustiveStrategy", "RandomStrategy", "ReplayStrategy",
+]

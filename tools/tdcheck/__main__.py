@@ -1,0 +1,130 @@
+"""CLI: `python -m tools.tdcheck [--model a,b] [--mode exhaustive|random]
+[--schedules N] [--seed N] [--preemptions N] [--replay SCHED]`.
+
+Default: every model, exhaustive within the context bounds. Exit 0 =
+every invariant held on every explored schedule; exit 1 prints the
+violation with its replayable schedule. `--prove-mutants` instead runs
+each checker against its seeded-broken twin and FAILS if any checker
+stays silent (the liveness gate `make lint` relies on).
+
+A worker-tier-incapable host (no Linux SO_REUSEPORT / native shm core)
+can still check the WAL twin; the shm-backed models report skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tdcheck")
+    ap.add_argument("--model", default="seqlock,claim,wal",
+                    help="comma-separated subset of: seqlock, claim, wal")
+    ap.add_argument("--mode", default="exhaustive",
+                    choices=["exhaustive", "random"])
+    ap.add_argument("--schedules", type=int, default=2000,
+                    help="schedule cap (exhaustive) / draw count (random)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--preemptions", type=int, default=2,
+                    help="context bound: forced switches per schedule")
+    ap.add_argument("--replay", default="",
+                    help="replay one schedule (the failure report's "
+                    "'k:p,k:p,...' string) against --model")
+    ap.add_argument("--variant", default="",
+                    help="which sweep pass the schedule came from "
+                    "(seqlock: torn|heal; claim: no-kill|kill) — the "
+                    "failure report's reproduce line includes it; "
+                    "defaults to the kill pass")
+    ap.add_argument("--prove-mutants", action="store_true",
+                    help="run each checker against its seeded-broken "
+                    "twin; fail unless every checker fires")
+    args = ap.parse_args(argv)
+
+    from gpu_docker_api_tpu.server import workers
+
+    from .models import MUTANTS, SWEEPS
+    from .sched import InvariantViolation, ReplayStrategy, parse_schedule
+
+    names = [m.strip() for m in args.model.split(",") if m.strip()]
+    unknown = [m for m in names if m not in SWEEPS]
+    if unknown:
+        print(f"tdcheck: unknown model(s) {unknown} "
+              f"(known: {sorted(SWEEPS)})", file=sys.stderr)
+        return 2
+    shm_ok = workers.available()
+
+    if args.replay:
+        if len(names) != 1:
+            print("tdcheck: --replay needs exactly one --model",
+                  file=sys.stderr)
+            return 2
+        from .models import (
+            ClaimModel, SeqlockModel, WalModel, run_model,
+        )
+        schedule = parse_schedule(args.replay)
+        strat = ReplayStrategy(schedule)
+        m = names[0]
+        try:
+            # each variant reconstructs the exact model shape + bounds
+            # its sweep pass ran — a mismatched process set would
+            # desynchronize the replay
+            if m == "seqlock":
+                if args.variant == "torn":
+                    run_model(lambda s: SeqlockModel(s, heal=False),
+                              strat, kills=0,
+                              preemptions=args.preemptions)
+                else:
+                    run_model(lambda s: SeqlockModel(s, heal=True),
+                              strat, kills=1, preemptions=0)
+            elif m == "claim":
+                if args.variant == "no-kill":
+                    run_model(lambda s: ClaimModel(s, daemon=False),
+                              strat, kills=0,
+                              preemptions=args.preemptions)
+                else:
+                    run_model(lambda s: ClaimModel(s), strat, kills=1,
+                              preemptions=0)
+            else:
+                run_model(lambda s: WalModel(s), strat, kills=1,
+                          crash_all=True, preemptions=args.preemptions)
+        except InvariantViolation as v:
+            print(v.format())
+            return 1
+        print("tdcheck: replay completed, invariants held")
+        return 0
+
+    kw = dict(mode=args.mode, max_schedules=args.schedules,
+              seed=args.seed, preemptions=args.preemptions)
+    rc = 0
+    for m in names:
+        if m in ("seqlock", "claim") and not shm_ok:
+            print(f"tdcheck: {m}: SKIPPED (no Linux SO_REUSEPORT / "
+                  f"native shm-atomics core)")
+            continue
+        if args.prove_mutants:
+            try:
+                MUTANTS[m](**kw)
+            except InvariantViolation as v:
+                print(f"tdcheck: {m}: checker LIVE — fired on its "
+                      f"seeded mutant ({v.message.splitlines()[0]})")
+            else:
+                print(f"tdcheck: {m}: checker DEAD — the seeded mutant "
+                      f"survived the sweep", file=sys.stderr)
+                rc = 1
+            continue
+        try:
+            stats = SWEEPS[m](**kw)
+        except InvariantViolation as v:
+            print(v.format(), file=sys.stderr)
+            rc = 1
+            continue
+        print(f"tdcheck: {m}: {stats['schedules']} schedule(s) "
+              f"[{args.mode}], {stats['killed_runs']} with injected "
+              f"kill(s), all invariants held "
+              f"(digest {stats['digest'][:12]})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

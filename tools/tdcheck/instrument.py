@@ -1,0 +1,132 @@
+"""The yield-point seam over the real protocol code.
+
+Lockwatch's trick, re-aimed: instead of patching `threading` lock
+factories, `InstrumentedState` subclasses the REAL
+`server.workers.SharedRouterState` and wraps every shared-memory
+operation (atomic load/store/add/cas-dec, futex wait/wake) with a
+scheduler yield point BEFORE the op executes — so the explorer can
+preempt, or kill, a logical process between any two shared accesses,
+exactly where a real cross-process race or SIGKILL would land.
+`install_seams` additionally hooks the in-window publish seam
+(`workers._publish_yield`) and defuses `time.sleep` inside the module
+(a parked reader must yield to the scheduler, not stall the whole
+single-baton world).
+
+Granularity notes (deliberate):
+- `dec_floor0`'s internal load/CAS retry loop executes as ONE yield op.
+  The loop is self-contained lock-free code whose correctness does not
+  depend on mid-loop interleaving with the protocols under test; op
+  granularity keeps the schedule tree small enough to sweep.
+- `futex_wait` yields and returns "timed out" immediately. Spurious
+  wakeups are within the futex contract, so every caller already
+  re-checks its condition in a loop — under the explorer that loop IS
+  the park/retry behaviour, with the scheduler deciding who runs.
+
+Every op is reported to the model hook with the scheduler's current
+process attribution, which is how the claim model knows precisely
+whether a kill landed inside the fetch_add→ledger window.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Optional
+
+from gpu_docker_api_tpu.server import workers
+
+from .sched import Scheduler
+
+#: op log entry: (proc_name, op, offset, value_or_result)
+OpNote = tuple[Optional[str], str, int, int]
+
+
+class InstrumentedState(workers.SharedRouterState):
+    """A real SharedRouterState (real segment, real native atomics)
+    whose every shm op is a scheduler yield point."""
+
+    def __init__(self, sched: Scheduler,
+                 note: Optional[Callable[[OpNote], None]] = None):
+        super().__init__(create=True)
+        self._sched = sched
+        self._note = note
+
+    def _yield(self, op: str, off: int) -> None:
+        self._sched.yield_point((op, off))
+
+    def _log(self, op: str, off: int, val: int) -> None:
+        if self._note is not None:
+            self._note((self._sched.current, op, off, val))
+
+    # ---- instrumented ops ------------------------------------------------
+
+    def load(self, off: int) -> int:
+        self._yield("load", off)
+        v = super().load(off)
+        self._log("load", off, v)
+        return v
+
+    def store(self, off: int, v: int) -> None:
+        self._yield("store", off)
+        super().store(off, v)
+        self._log("store", off, v)
+
+    def add(self, off: int, d: int) -> int:
+        self._yield("add", off)
+        v = super().add(off, d)
+        self._log("add", off, v)
+        return v
+
+    def dec_floor0(self, off: int) -> None:
+        self._yield("dec", off)
+        super().dec_floor0(off)
+        self._log("dec", off, 0)
+
+    def futex_wait(self, off: int, expected: int, timeout_s: float) -> None:
+        # park = yield; the caller's retry loop re-checks under the
+        # scheduler's control, so no real blocking ever happens
+        self._yield("futex_wait", off)
+        self._log("futex_wait", off, expected)
+
+    def futex_wake_all(self, off: int) -> None:
+        self._yield("futex_wake", off)
+        super().futex_wake_all(off)
+        self._log("futex_wake", off, 0)
+
+
+class BrokenSeqlockState(InstrumentedState):
+    """Seeded mutant: drops the odd-epoch store that opens the publish
+    window, so config bytes land under an even (read-admissible) epoch —
+    the classic forgotten-seqlock bug. The torn-roster checker must
+    catch this (its liveness proof)."""
+
+    def store(self, off: int, v: int) -> None:
+        if off == workers.HDR_OFF_EPOCH and v % 2 == 1:
+            self._yield("store", off)   # keep the schedule shape
+            self._log("store-dropped", off, v)
+            return
+        super().store(off, v)
+
+
+@contextlib.contextmanager
+def install_seams(sched: Scheduler):
+    """Arm the module-level seams for one exploration run: the publish
+    in-window yield hook and a scheduler-cooperative time.sleep."""
+    prev_hook = workers._publish_yield
+    prev_sleep = workers.time.sleep
+
+    def coop_sleep(s: float) -> None:
+        # `workers.time` is the global time module: only modeled threads
+        # may be descheduled instead of sleeping — anything else in the
+        # process (pytest timers, watchdogs) keeps the real sleep
+        if sched.current is None:
+            prev_sleep(s)
+        else:
+            sched.yield_point(("sleep", 0))
+
+    workers._publish_yield = lambda g: sched.yield_point(("pub", g))
+    workers.time.sleep = coop_sleep
+    try:
+        yield
+    finally:
+        workers._publish_yield = prev_hook
+        workers.time.sleep = prev_sleep

@@ -1,0 +1,671 @@
+"""The three checked protocols, their invariants, and their seeded-broken
+mutant twins.
+
+Each model wires REAL protocol code (or, for the C++ group commit, a
+line-for-line Python twin of native/mvcc_store.cc's state machine) into
+the cooperative scheduler and states its invariants as code. Every
+checker is proven LIVE by a mutant twin that reintroduces the bug class
+the checker exists to catch — a checker that cannot fail its mutant is
+decoration, exactly like a tdlint rule without its bad fixture.
+
+Invariant catalog (docs/correctness.md carries the prose version):
+
+seqlock (real `SharedRouterState.publish` / `read_roster`):
+  S1  a reader never parses a torn roster — every observed roster is
+      bytewise one of the published ones.
+  S2  a writer SIGKILLed inside the publish window (epoch parked odd)
+      does not wedge readers forever: the daemon's heal republish
+      recovers them (exercised against the real publish, which must be
+      re-enterable from a crashed-odd epoch).
+
+claim/undo/reconcile (real `WorkerRouter._try_claim` / `_release` /
+`SharedRouterState.reconcile_worker`):
+  C1  no schedule admits past a replica's advertised slots (live
+      concurrently-held claims <= slots, at every admission).
+  C2  after any SIGKILL + reconcile, the global inflight counter equals
+      the live outstanding claims plus, per killed worker, a surplus
+      that is EXACTLY the worker's (global ops) - (ledger ops) imbalance
+      at the kill point — i.e. reconcile's arithmetic is exact, and the
+      only reachable discrepancy is the documented one-op window where
+      the counter reads HIGH (brief under-admit). It must never read
+      LOW: a negative imbalance means the ledger ran ahead of the
+      global fetch_add and reconcile would free capacity that was never
+      claimed — the double-admit direction the "ledger only after
+      global claim" ordering exists to prevent.
+
+WAL group commit (Python twin of native/mvcc_store.cc Append/Commit):
+  W1  Commit(seq) returning implies the record's batch was flushed: a
+      crash at ANY yield point never loses an acked record, across
+      leader handoff (a follower acked by another leader's flush).
+  W2  the flushed stream is strictly ordered and duplicate-free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional
+
+from gpu_docker_api_tpu.server import workers
+
+from .instrument import BrokenSeqlockState, InstrumentedState, install_seams
+from .sched import (
+    InvariantViolation, RunResult, Scheduler, Strategy, explore,
+)
+
+# ---------------------------------------------------------------- harness
+
+
+def run_model(factory: Callable[[Scheduler], "Model"], strategy: Strategy,
+              *, max_steps: int = 400, preemptions: int = 2,
+              kills: int = 0, crash_all: bool = False,
+              fair_cap: int = 8, starve_cap: int = 16) -> RunResult:
+    """One schedule: build the model, run it, check its invariants.
+    Raises InvariantViolation (with the replayable schedule) on any
+    failure; returns the RunResult otherwise."""
+    sched = Scheduler(strategy, max_steps=max_steps,
+                      preemptions=preemptions, kills=kills,
+                      crash_all=crash_all, fair_cap=fair_cap,
+                      starve_cap=starve_cap)
+    model = factory(sched)
+    sched.end_hook = model.finish
+    try:
+        with install_seams(sched):
+            result = sched.run()
+        err = result.error
+        if isinstance(err, InvariantViolation):
+            raise InvariantViolation(err.model, err.message,
+                                     schedule=result.schedule)
+        if err is not None:
+            raise InvariantViolation(
+                model.name, f"modeled code raised {err!r}",
+                schedule=result.schedule) from err
+        model.check(result)
+        return result
+    finally:
+        model.close()
+
+
+class Model:
+    name = "model"
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+
+    def violation(self, message: str) -> InvariantViolation:
+        return InvariantViolation(self.name, message,
+                                  schedule=self.sched.trace)
+
+    def finish(self, result: RunResult) -> None:
+        """Frozen-state checks — runs BEFORE teardown unwind."""
+
+    def check(self, result: RunResult) -> None:
+        """Result-shape checks — runs after teardown."""
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------- seqlock
+
+ROSTER_A = [{"name": "alpha", "maxQueue": 4, "deadlineMs": 1000,
+             "replicas": [{"port": 1, "slots": 1, "ready": True},
+                          {"port": 2, "slots": 2, "ready": True}]}]
+ROSTER_B = [{"name": "alpha", "maxQueue": 9, "deadlineMs": 9000,
+             "replicas": [{"port": 9, "slots": 9, "ready": True}]}]
+
+
+def _shape(gw: Optional[dict]) -> Optional[tuple]:
+    if gw is None:
+        return None
+    return (gw["maxQueue"], gw["deadlineMs"],
+            tuple((r["port"], r["slots"]) for r in gw["replicas"]))
+
+
+SHAPE_A = _shape({"maxQueue": 4, "deadlineMs": 1000,
+                  "replicas": [{"port": 1, "slots": 1},
+                               {"port": 2, "slots": 2}]})
+SHAPE_B = _shape({"maxQueue": 9, "deadlineMs": 9000,
+                  "replicas": [{"port": 9, "slots": 9}]})
+
+
+class PublisherGate:
+    """The seqlock's single-writer contract, as model harness: in the
+    real tier every publish runs on ONE daemon watchdog thread, so two
+    publishes never interleave (tdcheck demonstrated that concurrent
+    publishers DO tear the roster — the protocol's documented contract,
+    now machine-checked rather than assumed). A KILLED holder models a
+    crashed daemon: its successor (the heal republish, or a federation
+    peer taking over the segment lease) reclaims the gate and publishes
+    over whatever epoch parity the corpse left behind — which is exactly
+    the crashed-odd re-entry path `publish` must handle."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.owner: Optional[str] = None
+
+    def acquire(self) -> None:
+        while True:
+            self.sched.yield_point(("gate", 0))
+            own = self.owner
+            if own is None or self.sched.procs[own].killed:
+                self.owner = self.sched.current
+                return
+
+    def release(self) -> None:
+        self.owner = None
+
+
+class SeqlockModel(Model):
+    """2 writers / 1 reader over the REAL publish/read_roster, plus (in
+    the kill sweep) the daemon's heal republish as a fourth process.
+    Writers serialize through the PublisherGate (the single-writer
+    contract); the reader and kill injection interleave freely."""
+
+    name = "seqlock"
+
+    def __init__(self, sched: Scheduler, heal: bool = False,
+                 state_cls: type = InstrumentedState):
+        super().__init__(sched)
+        self.heal = heal
+        self.st = state_cls(sched)
+        # setup runs inline on the controller thread (yield points are
+        # no-ops there): slot 0 is already named, so modeled publishes
+        # never take the slot-identity-change branch and its ~170 cell
+        # zeroes — the seqlock window itself is what's under test
+        self.st.publish(ROSTER_A)
+        self.gate = PublisherGate(sched)
+        self.observed: list[tuple] = []
+        sched.spawn("w0", self._writer_fn(ROSTER_A))
+        sched.spawn("w1", self._writer_fn(ROSTER_B))
+        sched.spawn("reader", self._reader, killable=False)
+        if heal:
+            sched.spawn("heal", self._healer, killable=False)
+
+    def _writer_fn(self, roster: list[dict]) -> Callable[[], None]:
+        def fn() -> None:
+            self.gate.acquire()
+            self.st.publish(roster)
+            self.gate.release()
+        return fn
+
+    def _reader(self) -> None:
+        _, roster = self.st.read_roster()
+        self.observed.append(_shape(roster.get("alpha")))
+
+    def _healer(self) -> None:
+        # the daemon's 250ms heal republish: fires once the writers have
+        # settled (done or killed), like the watchdog tick after a crash
+        procs = self.sched.procs
+        while not all(procs[w].done or procs[w].killed
+                      for w in ("w0", "w1")):
+            self.sched.yield_point(("heal-wait", 0))
+        if any(procs[w].killed for w in ("w0", "w1")):
+            self.gate.acquire()
+            self.st.publish(ROSTER_B)
+            self.gate.release()
+
+    def check(self, result: RunResult) -> None:
+        for shape in self.observed:
+            if shape not in (SHAPE_A, SHAPE_B):
+                raise self.violation(
+                    f"S1 torn roster parsed by a reader: {shape!r} is "
+                    f"neither published roster")
+        if result.wedged:
+            raise self.violation(
+                "S2 reader wedged: the heal republish did not recover "
+                "readers from a crashed publish"
+                if self.heal else
+                "run exceeded its step budget (no heal process in this "
+                "variant — check bounds)")
+
+    def close(self) -> None:
+        self.st.close(unlink=True)
+
+
+# ------------------------------------------------------- claim/reconcile
+
+CLAIM_ROSTER = [{"name": "g", "maxQueue": 8, "deadlineMs": 60000,
+                 "replicas": [{"port": 7001, "slots": 1, "ready": True}]}]
+
+
+class ClaimModel(Model):
+    """2 workers × 2 claim/hold/release iterations against ONE advertised
+    slot, with the daemon's watchdog reconciling any killed worker
+    mid-run. Uses the real WorkerRouter claim path (or a seeded-broken
+    mutant of it)."""
+
+    name = "claim"
+
+    ITERS = 2
+    SLOTS = 1
+
+    def __init__(self, sched: Scheduler,
+                 router_cls: type = workers.WorkerRouter,
+                 daemon: bool = True):
+        super().__init__(sched)
+        self.st = InstrumentedState(sched, note=self._note)
+        self.st.publish(CLAIM_ROSTER)     # inline setup: no yields
+        self.rep_off = workers._rep_cnt_off(0, 0)
+        self.wk_offs = {workers._wk_claim_off(w, 0, 0): w
+                        for w in range(2)}
+        self.ops: dict[str, list[tuple[str, int]]] = {}
+        self.outstanding: dict[str, int] = {}   # proc -> held claims
+        self.reconciled: set[int] = set()
+        self.names = {"k0": 0, "k1": 1}
+        for name, widx in self.names.items():
+            router = router_cls(self.st, widx)
+            gw = router._gateway("g")       # inline prewarm
+            sched.spawn(name, self._worker_fn(name, router, gw))
+        if daemon:
+            # the watchdog only matters once a worker can die — the
+            # no-kill sweep leaves it out to keep the tree small
+            sched.spawn("daemon", self._daemon, killable=False)
+
+    # ---- op attribution (the claim-window oracle) ------------------------
+
+    def _note(self, note) -> None:
+        proc, op, off, _val = note
+        if proc is None or op not in ("add", "dec"):
+            return
+        if off == self.rep_off or off in self.wk_offs:
+            self.ops.setdefault(proc, []).append((op, off))
+
+    def _imbalance(self, proc: str) -> int:
+        """(global ops) - (ledger ops) net for one worker's op log: how
+        far the global counter over-counts this worker relative to its
+        reconcile-visible ledger. >0 = counter reads high after
+        reconcile (safe, brief under-admit); <0 = ledger ran AHEAD of
+        the global claim — the double-admit direction."""
+        g = led = 0
+        for op, off in self.ops.get(proc, ()):
+            d = 1 if op == "add" else -1
+            if off == self.rep_off:
+                g += d
+            else:
+                led += d
+        return g - led
+
+    # ---- processes -------------------------------------------------------
+
+    def _worker_fn(self, name: str, router, gw) -> Callable[[], None]:
+        def fn() -> None:
+            for _ in range(self.ITERS):
+                c = router._try_claim(gw)
+                if c is None:
+                    self.sched.yield_point(("retry", 0))
+                    continue
+                live = sum(n for p, n in self.outstanding.items()
+                           if not self.sched.procs[p].killed)
+                if live + 1 > self.SLOTS:
+                    raise self.violation(
+                        f"C1 double admit: {name} claimed slot while "
+                        f"{live} live claim(s) already held "
+                        f"(slots={self.SLOTS})")
+                self.outstanding[name] = self.outstanding.get(name, 0) + 1
+                self.sched.yield_point(("hold", 0))
+                self.outstanding[name] -= 1
+                router._release(c)
+        return fn
+
+    def _daemon(self) -> None:
+        procs = self.sched.procs
+        while not all(procs[n].done or procs[n].killed
+                      for n in self.names):
+            self.sched.yield_point(("watchdog", 0))
+            self._reconcile_dead()
+        self._reconcile_dead()
+
+    def _reconcile_dead(self) -> None:
+        for name, widx in self.names.items():
+            if self.sched.procs[name].killed and widx not in self.reconciled:
+                self.reconciled.add(widx)
+                self.st.reconcile_worker(widx)
+                self._check_accounting(f"after reconcile of {name}")
+
+    # ---- invariants ------------------------------------------------------
+
+    def _check_accounting(self, when: str) -> None:
+        live = sum(n for p, n in self.outstanding.items()
+                   if not self.sched.procs[p].killed)
+        surplus = 0
+        for name, widx in self.names.items():
+            if self.sched.procs[name].killed and widx in self.reconciled:
+                imb = self._imbalance(name)
+                if imb < 0:
+                    raise self.violation(
+                        f"C2 {when}: {name}'s claim ledger ran AHEAD of "
+                        f"its global fetch_add (imbalance {imb}) — "
+                        f"reconcile freed capacity that was never "
+                        f"claimed (double-admit direction)")
+                surplus += imb
+        counter = self.st.lib.shm_load(self.st.base + self.rep_off)
+        if counter != live + surplus:
+            raise self.violation(
+                f"C2 {when}: inflight counter {counter} != live "
+                f"outstanding {live} + characterized kill-window "
+                f"surplus {surplus} — reconcile accounting is not exact")
+
+    def finish(self, result: RunResult) -> None:
+        # frozen state: reconcile any worker the daemon didn't get to
+        # (killed on the last step), then the exactness check
+        self._reconcile_dead()
+        self._check_accounting("at end of schedule")
+        for widx in self.reconciled:
+            led = self.st.lib.shm_load(
+                self.st.base + workers._wk_claim_off(widx, 0, 0))
+            if led != 0:
+                raise self.violation(
+                    f"C2 reconciled worker {widx}'s ledger cell is "
+                    f"{led}, not zeroed")
+
+    def check(self, result: RunResult) -> None:
+        if result.wedged:
+            raise self.violation("claim run exceeded its step budget")
+
+    def close(self) -> None:
+        self.st.close(unlink=True)
+
+
+class BrokenClaimRouter(workers.WorkerRouter):
+    """Seeded mutant: increments the per-worker claims ledger BEFORE the
+    global fetch_add — the exact ordering bug the prose in workers.py
+    warns about. A kill between the two makes reconcile subtract a claim
+    that never landed globally, freeing someone else's held slot."""
+
+    def _try_claim(self, gw, avoid=frozenset()):
+        st = self.state
+        g = gw["slot"]
+        ready = [(st.load(workers._rep_cnt_off(g, r["idx"])), r)
+                 for r in gw["replicas"]
+                 if r["ready"] and r["port"] and r["idx"] not in avoid]
+        ready.sort(key=lambda t: t[0])
+        for _, r in ready:
+            off = workers._rep_cnt_off(g, r["idx"])
+            wk = workers._wk_claim_off(self.widx, g, r["idx"])
+            st.add(wk, 1)                       # BUG: ledger first
+            if st.add(off, 1) <= r["slots"]:
+                if st.load(workers._gw_cnt_off(g)) != gw["gen"]:
+                    st.dec_floor0(off)
+                    st.dec_floor0(wk)
+                    continue
+                return workers._Claim(g, r["idx"], gw["gen"], r["port"])
+            st.dec_floor0(off)
+            st.dec_floor0(wk)
+        return None
+
+
+# -------------------------------------------------------- WAL group commit
+
+class CoopLock:
+    """A mutex in the cooperative world: acquire spins on a yield point
+    (the scheduler decides who wins), release is immediate. Only used by
+    the WAL twin — crashes there are whole-process (crash_all), so a
+    dead owner can never strand a waiter."""
+
+    __slots__ = ("sched", "tag", "owner")
+
+    def __init__(self, sched: Scheduler, tag: str):
+        self.sched = sched
+        self.tag = tag
+        self.owner: Optional[str] = None
+
+    def acquire(self) -> None:
+        while True:
+            self.sched.yield_point(("lock", self.tag))
+            if self.owner is None:
+                self.owner = self.sched.current or "<main>"
+                return
+
+    def release(self) -> None:
+        self.owner = None
+
+    def __enter__(self) -> "CoopLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class WalTwin:
+    """Pure-Python twin of native/mvcc_store.cc's leader/follower group
+    commit: Append under mu_, Commit blocks until a flush leader has
+    written the record's sequence; the leader swaps the pending buffer
+    out under mu_, writes it under wal_mu_ WITHOUT holding mu_, then
+    marks durable_seq_ under commit_mu_. The cv wait is modeled as
+    release-yield-reacquire (spurious wakes are within the contract).
+    Cross-validated against the real core by the subprocess kill sweep
+    in tests/test_tdcheck.py."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.mu = CoopLock(sched, "mu")
+        self.wal_mu = CoopLock(sched, "wal_mu")
+        self.commit_mu = CoopLock(sched, "commit_mu")
+        self.pending: list[int] = []     # appended, not yet written
+        self.filebuf: list[int] = []     # written, not yet fsynced
+        self.disk: list[int] = []        # durable
+        self.seq = 0
+        self.durable = 0
+        self.flushing = False
+        self.flushes = 0
+
+    def put(self) -> int:
+        with self.mu:
+            self.seq += 1
+            s = self.seq
+            self.pending.append(s)
+        self.commit(s)
+        return s
+
+    def commit(self, s: int) -> None:
+        self.commit_mu.acquire()
+        try:
+            while self.durable < s:
+                if self.flushing:
+                    # follower: park until the leader marks durable
+                    self.commit_mu.release()
+                    self.sched.yield_point(("cv-wait", 0))
+                    self.commit_mu.acquire()
+                    continue
+                self.flushing = True
+                self.commit_mu.release()
+                target = self._flush()
+                self.commit_mu.acquire()
+                self.flushing = False
+                if target > self.durable:
+                    self.durable = target
+                    self.flushes += 1
+        finally:
+            self.commit_mu.release()
+
+    def _flush(self) -> int:
+        with self.wal_mu:
+            with self.mu:
+                target = self.seq        # the batch's durable horizon,
+                batch = self.pending     # captured AT the swap
+                self.pending = []
+            self._write(batch)
+        return target
+
+    def _write(self, batch: list[int]) -> None:
+        for s in batch:
+            self.sched.yield_point(("fwrite", s))
+            self.filebuf.append(s)       # fwrite: in the stdio buffer
+        if batch:
+            self.sched.yield_point(("fsync", 0))
+            self.disk.extend(self.filebuf)   # fflush+fsync: durable
+            self.filebuf.clear()
+
+
+class BrokenWalTwin(WalTwin):
+    """Seeded mutant: the leader reads the durable target AFTER the file
+    write — records appended while the flush was on the wire are marked
+    durable without ever being written, so their Commit returns and a
+    crash loses an acked record."""
+
+    def _flush(self) -> int:
+        with self.wal_mu:
+            with self.mu:
+                batch = self.pending
+                self.pending = []
+            self._write(batch)
+            with self.mu:
+                target = self.seq        # BUG: post-write horizon
+        return target
+
+
+class WalModel(Model):
+    name = "wal"
+
+    WRITERS = 2
+    PUTS = 2
+
+    def __init__(self, sched: Scheduler, twin_cls: type = WalTwin):
+        super().__init__(sched)
+        self.twin = twin_cls(sched)
+        self.acked: list[int] = []
+        for i in range(self.WRITERS):
+            sched.spawn(f"p{i}", self._writer_fn())
+
+    def _writer_fn(self) -> Callable[[], None]:
+        def fn() -> None:
+            for _ in range(self.PUTS):
+                s = self.twin.put()
+                # no yield between commit-return and the ack record: the
+                # ack IS the return, same step
+                self.acked.append(s)
+        return fn
+
+    def finish(self, result: RunResult) -> None:
+        disk = self.twin.disk
+        on_disk = set(disk)
+        for s in self.acked:
+            if s not in on_disk:
+                raise self.violation(
+                    f"W1 Commit({s}) returned but the record is not in "
+                    f"the flushed stream {disk} — an acked record would "
+                    f"be lost by this crash")
+        if sorted(on_disk) != disk or len(on_disk) != len(disk):
+            raise self.violation(
+                f"W2 flushed stream is not strictly ordered and "
+                f"duplicate-free: {disk}")
+        if result.completed and not result.crashed:
+            want = list(range(1, self.twin.seq + 1))
+            if disk != want:
+                raise self.violation(
+                    f"W1 clean completion but flushed stream {disk} != "
+                    f"{want}")
+
+    def check(self, result: RunResult) -> None:
+        if result.wedged:
+            raise self.violation("wal run exceeded its step budget")
+
+
+# ---------------------------------------------------------------- sweeps
+
+def _annotating(variant: str, run_once):
+    """Stamp any escaping InvariantViolation with the pass's variant so
+    its reproduce line reconstructs the SAME model shape."""
+    def wrapped(strategy: Strategy) -> RunResult:
+        try:
+            return run_once(strategy)
+        except InvariantViolation as v:
+            v.variant = variant
+            raise
+    return wrapped
+
+
+def _tally(stats: dict, res: RunResult) -> None:
+    stats["schedules"] += 1
+    stats["killed_runs"] += bool(res.killed)
+    stats["_digest"].update(repr(res.schedule).encode())
+
+
+def _seal(stats: dict) -> dict:
+    stats["digest"] = stats.pop("_digest").hexdigest()
+    return stats
+
+
+def _new_stats(model: str) -> dict:
+    return {"model": model, "schedules": 0, "killed_runs": 0,
+            "_digest": hashlib.sha256()}
+
+
+def sweep_seqlock(mode: str = "exhaustive", max_schedules: int = 4000,
+                  seed: int = 0, preemptions: int = 2,
+                  state_cls: type = InstrumentedState) -> dict:
+    """Two passes: the torn-read sweep (no kills, full preemption bound)
+    and the kill+heal sweep (1 injected writer SIGKILL + the daemon's
+    republish). The kill pass runs at preemption bound 0: the kill
+    placement is itself the enumerated disturbance — every yield point
+    of every writer gets a crash — and the fairness cap still forces
+    reader/healer interleaving through the recovery, which keeps the
+    pass's tree fully sweepable."""
+    stats = _new_stats("seqlock")
+
+    def torn(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: SeqlockModel(s, heal=False,
+                                                state_cls=state_cls),
+                         strategy, preemptions=preemptions, kills=0)
+
+    def heal(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: SeqlockModel(s, heal=True,
+                                                state_cls=state_cls),
+                         strategy, preemptions=0, kills=1)
+
+    for run_once in (_annotating("torn", torn), _annotating("heal", heal)):
+        for res in explore(run_once, mode=mode,
+                           max_schedules=max_schedules, seed=seed):
+            _tally(stats, res)
+    return _seal(stats)
+
+
+def sweep_claim(mode: str = "exhaustive", max_schedules: int = 4000,
+                seed: int = 0, preemptions: int = 2,
+                router_cls: type = workers.WorkerRouter) -> dict:
+    stats = _new_stats("claim")
+
+    def no_kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: ClaimModel(s, router_cls=router_cls,
+                                              daemon=False),
+                         strategy, preemptions=preemptions, kills=0)
+
+    def kill(strategy: Strategy) -> RunResult:
+        # preemption bound 0 for the same reason as the seqlock kill
+        # pass: the enumerated disturbance is the kill point itself
+        return run_model(lambda s: ClaimModel(s, router_cls=router_cls),
+                         strategy, preemptions=0, kills=1)
+
+    for run_once in (_annotating("no-kill", no_kill),
+                     _annotating("kill", kill)):
+        for res in explore(run_once, mode=mode,
+                           max_schedules=max_schedules, seed=seed):
+            _tally(stats, res)
+    return _seal(stats)
+
+
+def sweep_wal(mode: str = "exhaustive", max_schedules: int = 4000,
+              seed: int = 0, preemptions: int = 2,
+              twin_cls: type = WalTwin) -> dict:
+    stats = _new_stats("wal")
+
+    def run_once(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: WalModel(s, twin_cls=twin_cls),
+                         strategy, preemptions=preemptions, kills=1,
+                         crash_all=True)
+
+    for res in explore(run_once, mode=mode,
+                       max_schedules=max_schedules, seed=seed):
+        _tally(stats, res)
+    return _seal(stats)
+
+
+SWEEPS = {"seqlock": sweep_seqlock, "claim": sweep_claim, "wal": sweep_wal}
+
+MUTANTS = {
+    "seqlock": lambda **kw: sweep_seqlock(state_cls=BrokenSeqlockState,
+                                          **kw),
+    "claim": lambda **kw: sweep_claim(router_cls=BrokenClaimRouter, **kw),
+    "wal": lambda **kw: sweep_wal(twin_cls=BrokenWalTwin, **kw),
+}

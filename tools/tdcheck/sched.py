@@ -1,0 +1,443 @@
+"""The cooperative scheduler + schedule-space exploration.
+
+Model checking real code needs real call stacks, so each logical process
+is a Python thread — but only ONE ever runs at a time: the controller
+hands a baton to the chosen process, which runs until its next yield
+point (every instrumented shm op, seqlock seam hit, or cooperative-lock
+step) and parks. Determinism follows: the modeled code takes no other
+scheduling input, so a schedule (the sequence of controller choices) is
+a complete replay key.
+
+Crash semantics are SIGKILL's, not an exception's: a killed process is
+simply never scheduled again — its thread stays parked at the yield
+point, its shared-memory footprint frozen exactly as a killed worker
+process would leave it. `finally:` blocks must NOT run (a real SIGKILL
+skips them); they are only unwound at teardown, AFTER the run's
+invariants have been checked against the frozen state, so the cleanup
+they perform lands on state nobody will read again.
+
+Exploration is CHESS-style iterative context bounding (Musuvathi &
+Qadeer): the base schedule runs the current process until it finishes,
+and the exhaustive driver enumerates every placement of up to
+`preemptions` voluntary switches and up to `kills` injected crashes.
+Small protocol models (a handful of processes, tens of yield points)
+are swept completely; a fairness cap bounds spin loops (a process that
+has run `fair_cap` consecutive steps is descheduled for one step for
+free) so retry loops cannot eat the whole budget.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+#: a schedule choice: ("run", proc) | ("kill", proc) | ("crash", "*")
+Choice = tuple[str, str]
+
+
+class _Abort(BaseException):
+    """Teardown unwind signal. BaseException so the modeled code's
+    `except Exception` handlers never swallow it."""
+
+
+class InvariantViolation(AssertionError):
+    """An invariant checker fired. Carries everything needed to replay
+    the failing schedule deterministically: the schedule, and the model
+    VARIANT it ran under (a torn-pass schedule replayed against the
+    kill-variant model would desynchronize on the extra process)."""
+
+    def __init__(self, model: str, message: str,
+                 schedule: Optional[list[Choice]] = None,
+                 seed: Optional[int] = None,
+                 variant: Optional[str] = None):
+        self.model = model
+        self.message = message
+        self.schedule = list(schedule or [])
+        self.seed = seed
+        self.variant = variant
+        super().__init__(message)
+
+    def __str__(self) -> str:   # dynamic: variant is annotated post-raise
+        return self.format()
+
+    def format(self) -> str:
+        out = f"[{self.model}] {self.message}"
+        if self.schedule:
+            sched = ",".join(f"{k}:{p}" for k, p in self.schedule)
+            var = f" --variant {self.variant}" if self.variant else ""
+            out += f"\n  replay schedule: {sched}"
+            out += (f"\n  reproduce: python -m tools.tdcheck "
+                    f"--model {self.model}{var} --replay '{sched}'")
+        if self.seed is not None:
+            out += f"\n  seed: {self.seed}"
+        return out
+
+
+@dataclass
+class RunResult:
+    """One schedule's outcome."""
+    schedule: list[Choice]
+    steps: int
+    completed: bool            # every live process ran to the end
+    wedged: bool               # hit max_steps with processes still live
+    killed: list[str] = field(default_factory=list)
+    crashed: bool = False      # global crash injected (WAL model)
+    error: Optional[BaseException] = None   # modeled-code exception
+
+
+class _Proc:
+    __slots__ = ("name", "fn", "thread", "go", "paused", "done", "killed",
+                 "abort", "error", "tag", "killable", "started",
+                 "last_run")
+
+    def __init__(self, name: str, fn: Callable[[], None], killable: bool):
+        self.name = name
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()
+        self.paused = threading.Event()
+        self.done = False
+        self.killed = False
+        self.abort = False
+        self.error: Optional[BaseException] = None
+        self.tag = ("start",)
+        self.killable = killable
+        self.started = False
+        self.last_run = 0
+
+
+class Scheduler:
+    """One schedule's execution engine. Build, spawn(), run(), teardown().
+
+    `kills` bounds injected per-process crashes; `crash_all=True` offers
+    a whole-process-group crash instead (the WAL model: every thread of
+    the C++ store dies together). `preemptions` bounds forced switches
+    away from a still-runnable process — the context bound that keeps
+    exhaustive exploration tractable.
+    """
+
+    #: join timeout for modeled threads — generous; modeled code never
+    #: blocks outside a yield point by construction
+    JOIN_S = 20.0
+
+    def __init__(self, strategy: "Strategy", max_steps: int = 400,
+                 preemptions: int = 2, kills: int = 0,
+                 crash_all: bool = False, fair_cap: int = 16,
+                 starve_cap: int = 24):
+        self.strategy = strategy
+        self.max_steps = max_steps
+        self.preempt_budget = preemptions
+        self.kill_budget = kills
+        self.crash_all = crash_all
+        self.fair_cap = fair_cap
+        self.starve_cap = starve_cap
+        self.procs: dict[str, _Proc] = {}
+        self.trace: list[Choice] = []
+        self.steps = 0
+        self.crashed = False
+        self._by_tid: dict[int, _Proc] = {}
+        self._teardown = False
+        self._last: Optional[_Proc] = None
+        self._consec = 0
+        self.step_hook: Optional[Callable[[], None]] = None
+        #: called with the RunResult after the loop but BEFORE teardown:
+        #: killed processes are still frozen at their yield points, so
+        #: frozen-state invariant checks see exactly what a post-SIGKILL
+        #: reconciler would (teardown unwinds `finally:` blocks, which
+        #: would "clean up" the very state under test)
+        self.end_hook: Optional[Callable[[RunResult], None]] = None
+
+    # ---- process-side API ------------------------------------------------
+
+    def yield_point(self, tag: tuple = ()) -> None:
+        """Called by instrumented ops from modeled threads. Parks the
+        thread and hands the baton back to the controller. A no-op on
+        unregistered threads (model setup runs inline on the controller)
+        and during teardown unwind."""
+        p = self._by_tid.get(threading.get_ident())
+        if p is None or self._teardown:
+            return
+        p.tag = tag
+        p.paused.set()
+        p.go.wait()
+        p.go.clear()
+        if p.abort:
+            p.abort = False
+            raise _Abort()
+
+    @property
+    def current(self) -> Optional[str]:
+        """Name of the process whose thread is asking (attribution for
+        model op logs)."""
+        p = self._by_tid.get(threading.get_ident())
+        return p.name if p is not None else None
+
+    # ---- controller-side API ---------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], None],
+              killable: bool = True) -> None:
+        self.procs[name] = _Proc(name, fn, killable)
+
+    def _body(self, p: _Proc) -> None:
+        # ident is only assigned once the thread runs — register here,
+        # before the first baton wait, so yield_point can attribute ops
+        self._by_tid[threading.get_ident()] = p
+        p.go.wait()
+        p.go.clear()
+        try:
+            if not p.abort:
+                p.fn()
+        except _Abort:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced on RunResult
+            p.error = e
+        finally:
+            p.done = True
+            p.paused.set()
+
+    def _step(self, p: _Proc) -> None:
+        p.paused.clear()
+        p.go.set()
+        if not p.paused.wait(self.JOIN_S):
+            raise RuntimeError(
+                f"tdcheck: process {p.name!r} did not reach a yield point "
+                f"within {self.JOIN_S}s — modeled code blocked outside "
+                f"the instrumented seam?")
+
+    def _options(self) -> list[Choice]:
+        runnable = [p for p in self.procs.values()
+                    if not p.done and not p.killed]
+        # weak fairness: only fair schedules are enumerated. A runnable
+        # process starved past starve_cap steps is force-run — otherwise
+        # the DFS "finds" livelocks no real scheduler produces (two spin
+        # loops taking turns forever while the lock holder never runs)
+        starved = [p for p in runnable
+                   if self.steps - p.last_run > self.starve_cap]
+        if starved:
+            starved.sort(key=lambda p: p.last_run)
+            return [("run", starved[0].name)]
+        last = self._last
+        last_runnable = (last is not None and not last.done
+                         and not last.killed)
+        opts: list[Choice] = []
+        if last_runnable and self._consec < self.fair_cap:
+            opts.append(("run", last.name))
+        others = [p for p in runnable if p is not last]
+        # switching away from a runnable process costs a preemption;
+        # switching after it finished/was killed (or hit the fairness
+        # cap) is free
+        free_switch = (not last_runnable or self._consec >= self.fair_cap)
+        if free_switch or self.preempt_budget > 0:
+            opts.extend(("run", p.name) for p in others)
+        if self.kill_budget > 0:
+            if self.crash_all:
+                # whole-process-group crash (the WAL model: every thread
+                # of the store's process dies together) — per-process
+                # kills would model a thread dying alone, which SIGKILL
+                # cannot do
+                if runnable:
+                    opts.append(("crash", "*"))
+            elif last_runnable and last.killable:
+                # kill is offered only for the process that JUST ran —
+                # at the yield point it is parked on. Killing a parked
+                # process any number of steps later leaves its own state
+                # identical, so those schedules are duplicates; this
+                # prunes them and enumerates crash points along each
+                # process's own execution, one per yield point
+                opts.append(("kill", last.name))
+        if not opts and runnable:
+            # fairness cap descheduled the only runnable process with no
+            # one to switch to: let it keep running
+            opts.append(("run", runnable[0].name))
+        return opts
+
+    def run(self) -> RunResult:
+        for p in self.procs.values():
+            p.thread = threading.Thread(target=self._body, args=(p,),
+                                        name=f"tdcheck-{p.name}",
+                                        daemon=True)
+            p.thread.start()
+            p.started = True
+        try:
+            result = self._loop()
+            if self.end_hook is not None and result.error is None:
+                self.end_hook(result)
+            return result
+        finally:
+            self.teardown()
+
+    def _loop(self) -> RunResult:
+        while True:
+            runnable = [p for p in self.procs.values()
+                        if not p.done and not p.killed]
+            err = next((p.error for p in self.procs.values()
+                        if p.error is not None), None)
+            if err is not None or not runnable or self.crashed:
+                return RunResult(
+                    schedule=self.trace, steps=self.steps,
+                    completed=all(p.done and not p.killed
+                                  for p in self.procs.values()),
+                    wedged=False, crashed=self.crashed,
+                    killed=[p.name for p in self.procs.values()
+                            if p.killed],
+                    error=err)
+            if self.steps >= self.max_steps:
+                return RunResult(
+                    schedule=self.trace, steps=self.steps, completed=False,
+                    wedged=True, crashed=False,
+                    killed=[p.name for p in self.procs.values()
+                            if p.killed])
+            opts = self._options()
+            choice = self.strategy.choose(self.steps, opts)
+            self.trace.append(choice)
+            kind, who = choice
+            if kind == "crash":
+                self.kill_budget -= 1
+                for p in self.procs.values():
+                    if not p.done:
+                        p.killed = True
+                self.crashed = True
+                self.steps += 1
+                continue
+            p = self.procs[who]
+            if kind == "kill":
+                self.kill_budget -= 1
+                p.killed = True
+                self.steps += 1
+                if self._last is p:
+                    self._last = None
+                continue
+            if self._last is not None and p is not self._last:
+                # a switch only costs preemption budget when CONTINUING
+                # was among the offered options (fairness-forced and
+                # after-block switches are free)
+                if ("run", self._last.name) in opts:
+                    self.preempt_budget -= 1
+                self._consec = 0
+            self._consec = self._consec + 1 if p is self._last else 1
+            self._last = p
+            p.last_run = self.steps
+            self._step(p)
+            self.steps += 1
+            if self.step_hook is not None:
+                self.step_hook()
+
+    def teardown(self) -> None:
+        """Release every parked thread (killed, descheduled, or
+        budget-stranded): unwind with _Abort so `finally:` cleanup runs
+        against the now-discarded state, then join."""
+        self._teardown = True
+        for p in self.procs.values():
+            if p.started and not p.done:
+                p.abort = True
+                p.go.set()
+        for p in self.procs.values():
+            if p.thread is not None:
+                p.thread.join(timeout=self.JOIN_S)
+
+# ---------------------------------------------------------------- strategies
+
+class Strategy:
+    def choose(self, step: int, options: list[Choice]) -> Choice:
+        raise NotImplementedError
+
+
+class ExhaustiveStrategy(Strategy):
+    """Follow a forced prefix of option indices, then always pick option
+    0; record the option count at every step so the driver can branch."""
+
+    def __init__(self, prefix: tuple[int, ...] = ()):
+        self.prefix = prefix
+        self.taken: list[int] = []
+        self.counts: list[int] = []
+
+    def choose(self, step: int, options: list[Choice]) -> Choice:
+        i = len(self.taken)
+        idx = self.prefix[i] if i < len(self.prefix) else 0
+        if idx >= len(options):
+            # the prefix outran this path's options (a shorter run than
+            # the sibling it branched from) — clamp; the driver dedups
+            idx = 0
+        self.taken.append(idx)
+        self.counts.append(len(options))
+        return options[idx]
+
+
+class RandomStrategy(Strategy):
+    """Seeded uniform choice — deterministic given the seed."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rng = random.Random(seed)
+
+    def choose(self, step: int, options: list[Choice]) -> Choice:
+        return options[self.rng.randrange(len(options))]
+
+
+class ReplayStrategy(Strategy):
+    """Replay a recorded schedule by VALUE (robust to option reordering);
+    past the recorded suffix, fall back to option 0."""
+
+    def __init__(self, schedule: list[Choice]):
+        self.schedule = list(schedule)
+        self._i = 0
+
+    def choose(self, step: int, options: list[Choice]) -> Choice:
+        if self._i < len(self.schedule):
+            want = self.schedule[self._i]
+            self._i += 1
+            if want in options:
+                return want
+        return options[0]
+
+
+# ---------------------------------------------------------------- the driver
+
+def explore(run_once: Callable[[Strategy], RunResult],
+            mode: str = "exhaustive", max_schedules: int = 4000,
+            seed: int = 0) -> Iterator[RunResult]:
+    """Yield RunResults over the schedule space.
+
+    exhaustive: stateless DFS — re-run from scratch for every branch of
+    the choice tree (same-prefix runs replay identically because the
+    models are deterministic). Terminates when the frontier empties
+    (full sweep within the Scheduler's bounds) or max_schedules is hit.
+
+    random: max_schedules draws from a seeded RNG; schedule i uses seed
+    `seed + i` so any single failing draw is reproducible alone.
+    """
+    if mode == "random":
+        for i in range(max_schedules):
+            try:
+                yield run_once(RandomStrategy(seed + i))
+            except InvariantViolation as v:
+                if v.seed is None:
+                    v.seed = seed + i   # this draw alone reproduces it
+                raise
+        return
+    frontier: list[tuple[int, ...]] = [()]
+    ran = 0
+    while frontier and ran < max_schedules:
+        prefix = frontier.pop()
+        strat = ExhaustiveStrategy(prefix)
+        result = run_once(strat)
+        ran += 1
+        taken = tuple(strat.taken)
+        for i in range(len(prefix), len(strat.counts)):
+            for alt in range(1, strat.counts[i]):
+                frontier.append(taken[:i] + (alt,))
+        yield result
+
+
+def parse_schedule(text: str) -> list[Choice]:
+    """Inverse of the failure report's `k:p,k:p,...` schedule string."""
+    out: list[Choice] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, who = part.partition(":")
+        out.append((kind, who))
+    return out

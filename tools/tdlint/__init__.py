@@ -15,8 +15,9 @@ honors and counts:
 
 placed on the offending line, the line above it, or a function's `def` line
 (which suppresses the rule for the whole function). A pragma that suppresses
-nothing is reported as stale (warning, not an error), so dead annotations
-don't accumulate.
+nothing is reported as stale, and `--stale-strict` (used by `make lint`)
+turns that into a failure — a dead annotation documents a contract the
+code no longer has.
 
 Run: `python -m tools.tdlint` (from the repo root; `make lint` wraps it).
 Exit status 0 = clean, 1 = violations.

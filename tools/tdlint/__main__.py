@@ -1,8 +1,12 @@
-"""CLI: `python -m tools.tdlint [--root DIR] [--rules a,b] [files...]`.
+"""CLI: `python -m tools.tdlint [--root DIR] [--rules a,b]
+[--stale-strict] [files...]`.
 
 With no file arguments, lints the control-plane scope (tools.tdlint
 DEFAULT_SCOPE) of the repo at --root (default: cwd). With files, lints
 exactly those (the seeded-violation fixture path). Exit 1 on violations.
+`--stale-strict` also fails on stale pragmas (a pragma that suppresses
+nothing is a dead annotation whose stated contract no longer holds) —
+only meaningful on full-rule runs; `make lint` uses it.
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default="",
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--stale-strict", action="store_true",
+                    help="exit nonzero when any pragma is stale")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -39,14 +45,21 @@ def main(argv=None) -> int:
     n = len(report["violations"])
     pragmas = report.get("pragmas")
     summary = f"tdlint: {n} violation(s) in {report['files']} file(s)"
+    stale = []
     if pragmas is not None:
         summary += (f"; {pragmas['total']} pragma(s), "
                     f"{pragmas['used']} honored")
-        for rel, line, rls in pragmas["stale"]:
+        stale = pragmas["stale"]
+        for rel, line, rls in stale:
             print(f"{rel}:{line}: [pragma] stale pragma "
                   f"(suppresses nothing): {','.join(rls)}")
     print(summary)
-    return 1 if n else 0
+    if n:
+        return 1
+    if args.stale_strict and stale:
+        print(f"tdlint: --stale-strict: {len(stale)} stale pragma(s)")
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -14,6 +14,15 @@
 | untraced-op     | every events.record() op literal and every tdapi_* metric   |
 |                 | family name is registered in obs/names.py — telemetry names |
 |                 | are API, not scattered string literals                      |
+| seqlock-        | nothing that can block (backend op, WAL-backed store write, |
+|  discipline     | sleep, open, fsync, futex wait, logging) runs inside the    |
+|                 | seqlock publish window — readers spin for its whole length  |
+| claim-order     | per-worker claim-ledger writes follow the global fetch_add  |
+|                 | (and ledger undo precedes the global release) — the order   |
+|                 | that makes a worker SIGKILL under-admit, never double-admit |
+| atomic-region   | counter-region words are only ever touched through the      |
+|                 | atomic ops, never raw buffer writes via the seqlock-        |
+|                 | protected config path                                       |
 
 All checks are lexical (AST). That is deliberately conservative: code that
 needs a lock held by its CALLER (e.g. MVCCStore._apply_put) carries a
@@ -679,6 +688,250 @@ class UntracedOp(Rule):
         return ops, metrics
 
 
+# ------------------------------------------------- shm-protocol rules
+#
+# PR 13's cross-process protocols (server/workers.py) put router state
+# beyond both the GIL and every in-process lock tdlint's older rules
+# reason about. These three rules encode the shm segment's discipline
+# lexically, the same way unlocked-state encodes the lock discipline;
+# tdcheck (tools/tdcheck) is the dynamic half of the same defense.
+
+#: offset-helper names addressing the lock-free COUNTER region — cells
+#: that must only ever be touched through the atomic ops
+COUNTER_OFF_HELPERS = frozenset({
+    "_gw_cnt_off", "_rep_cnt_off", "_wk_claim_off", "_wk_queued_off",
+    "_wk_off",
+})
+COUNTER_OFF_NAMES = frozenset({"CNT_OFF", "WK_OFF"})
+#: the seqlock epoch word's offset constant (publish-window anchor)
+EPOCH_NAME = "HDR_OFF_EPOCH"
+
+
+def _exact_helper_call(node: ast.AST,
+                       aliases: dict[str, str]) -> Optional[str]:
+    """The offset-helper a call expression (or a one-step variable alias
+    of one) resolves to, if any. Deliberately EXACT: `_rep_cnt_off(g, r)
+    + 8` (the errors cell) is arithmetic on a helper, not the inflight
+    cell itself, and is not matched."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in COUNTER_OFF_HELPERS):
+        return node.func.id
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    return None
+
+
+def _offset_aliases(fn: ast.AST) -> dict[str, str]:
+    """name -> helper for simple `x = _helper(...)` assignments in fn."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in COUNTER_OFF_HELPERS):
+            aliases[node.targets[0].id] = node.value.func.id
+    return aliases
+
+
+def _mentions_counter_offset(node: ast.AST,
+                             aliases: dict[str, str]) -> bool:
+    """Whether ANY part of an offset expression reaches into the counter
+    region (helpers, region constants, or aliases of either)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and (
+                sub.id in COUNTER_OFF_NAMES or sub.id in aliases):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id in COUNTER_OFF_HELPERS):
+            return True
+    return False
+
+
+class SeqlockDiscipline(Rule):
+    name = "seqlock-discipline"
+    description = ("blocking work (backend op, store write, sleep, open, "
+                   "fsync, futex wait, logging) inside the seqlock "
+                   "publish window — every reader spins for the window's "
+                   "whole duration, and a crash inside it parks the "
+                   "epoch odd")
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith("server/workers.py")
+
+    @staticmethod
+    def _is_epoch_store(node: ast.AST) -> bool:
+        """`<x>.store(HDR_OFF_EPOCH, ...)` — the window's closing store."""
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "store" and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == EPOCH_NAME)
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                # the publish window is the try-block whose FINALLY
+                # closes the epoch (stores to HDR_OFF_EPOCH)
+                if not isinstance(node, ast.Try) or not node.finalbody:
+                    continue
+                closes = any(self._is_epoch_store(sub)
+                             for stmt in node.finalbody
+                             for sub in ast.walk(stmt))
+                if not closes:
+                    continue
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        what = self._blocking_in_window(sub)
+                        if what:
+                            out.append(Violation(
+                                ctx.rel, sub.lineno, self.name,
+                                f"{what} inside the seqlock publish "
+                                f"window — readers spin (and a crash "
+                                f"here parks the epoch odd) for its "
+                                f"whole duration"))
+        return out
+
+    @staticmethod
+    def _blocking_in_window(node: ast.Call) -> Optional[str]:
+        what = IoUnderLock._blocking_call(node)
+        if what:
+            return what
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("futex_wait", "wait"):
+                return f"blocking '.{f.attr}()'"
+            if (isinstance(f.value, ast.Name) and f.value.id == "log"):
+                return f"logging call 'log.{f.attr}()'"
+        return None
+
+
+class ClaimOrder(Rule):
+    name = "claim-order"
+    description = ("per-worker claim-ledger writes must FOLLOW the global "
+                   "fetch_add (and ledger undo must precede the global "
+                   "release): the order that makes a worker SIGKILL "
+                   "between the two under-admit briefly instead of ever "
+                   "double-admitting")
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith("server/workers.py")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            aliases = _offset_aliases(fn)
+            ops: list[tuple[int, str, str]] = []   # (line, cell, op)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.args):
+                    continue
+                meth = node.func.attr
+                if meth == "add":
+                    op = "add"
+                elif meth == "dec_floor0":
+                    op = "dec"
+                elif (meth == "store" and len(node.args) >= 2
+                      and isinstance(node.args[1], ast.Constant)
+                      and node.args[1].value == 0):
+                    op = "zero"
+                else:
+                    continue
+                helper = _exact_helper_call(node.args[0], aliases)
+                if helper == "_wk_claim_off":
+                    ops.append((node.lineno, "ledger", op))
+                elif helper == "_rep_cnt_off":
+                    ops.append((node.lineno, "global", op))
+            for line, cell, op in ops:
+                if cell != "ledger":
+                    continue
+                if op == "add" and not any(
+                        c == "global" and o == "add" and ln < line
+                        for ln, c, o in ops):
+                    out.append(Violation(
+                        ctx.rel, line, self.name,
+                        "claims-ledger increment with no earlier global "
+                        "fetch_add in this function — a SIGKILL between "
+                        "the two would make reconcile free capacity that "
+                        "was never claimed (double-admit)"))
+                elif op == "dec" and not any(
+                        c == "global" and o in ("dec", "zero") and ln > line
+                        for ln, c, o in ops):
+                    out.append(Violation(
+                        ctx.rel, line, self.name,
+                        "claims-ledger undo with no later global release "
+                        "in this function — the undo must come FIRST so "
+                        "a SIGKILL between the two under-admits instead "
+                        "of double-freeing at reconcile"))
+                elif op == "zero" and not any(
+                        c == "global" and o in ("dec", "zero")
+                        for ln, c, o in ops):
+                    out.append(Violation(
+                        ctx.rel, line, self.name,
+                        "claims-ledger cell zeroed without the matching "
+                        "global counter accounting in this function"))
+        return out
+
+
+class AtomicRegion(Rule):
+    name = "atomic-region"
+    description = ("counter-region words written through a raw buffer "
+                   "path (pack_into / slice assignment) instead of the "
+                   "atomic ops — a seqlock-path write to a counter word "
+                   "is a plain racy store that can wipe concurrent "
+                   "fetch_adds")
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith("server/workers.py")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
+        out: list[Violation] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            aliases = _offset_aliases(fn)
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "pack_into"
+                        and len(node.args) >= 3
+                        and _mentions_counter_offset(node.args[2],
+                                                     aliases)):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.name,
+                        "struct.pack_into targeting a counter-region "
+                        "offset — counter words are atomic-ops-only "
+                        "(a raw store races concurrent fetch_adds)"))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Subscript)
+                                and self._is_buf(t.value)
+                                and _mentions_counter_offset(t.slice,
+                                                             aliases)):
+                            out.append(Violation(
+                                ctx.rel, t.lineno, self.name,
+                                "raw buffer slice assignment into the "
+                                "counter region — counter words are "
+                                "atomic-ops-only"))
+        return out
+
+    @staticmethod
+    def _is_buf(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in ("buf", "view")
+        if isinstance(node, ast.Attribute):
+            return node.attr == "buf"
+        return False
+
+
 # ----------------------------------------------------------------- registry
 
 RULES: list[Rule] = [
@@ -689,6 +942,9 @@ RULES: list[Rule] = [
     UnmappedXerror(),
     SilentSwallow(),
     UntracedOp(),
+    SeqlockDiscipline(),
+    ClaimOrder(),
+    AtomicRegion(),
 ]
 
 
