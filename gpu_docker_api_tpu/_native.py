@@ -60,15 +60,34 @@ def _newest_source_mtime() -> float:
 #: changes incompatibly.
 _ABI_CANARY = {"mvccstore": "mvcc_get_fast",
                "topoalloc": "topo_find_box",
-               "shmatomics": "shm_futex_wait"}
+               "shmatomics": "shm_hist_observe"}
 
 
 def load(name: str) -> Optional[ctypes.CDLL]:
     """name: "mvccstore" | "topoalloc" | "shmatomics". Returns the CDLL
     or None."""
+    return _load(name, nogil=False)
+
+
+def load_nogil(name: str) -> Optional[ctypes.CDLL]:
+    """Same library via ctypes.PyDLL: calls do NOT release the GIL.
+
+    For sub-microsecond atomic ops (the shm metric shards' fetch-adds)
+    a CDLL call's GIL release/reacquire is the dominant cost — and on a
+    busy multi-threaded server every release is a scheduler yield point
+    that can hand the thread's whole 5ms switch interval away. PyDLL
+    keeps the GIL held across the call, which is only correct because
+    these ops never block. NEVER route a blocking call (futex_wait,
+    store flush) through this handle — it would freeze every thread in
+    the process for the wait's duration."""
+    return _load(name, nogil=True)
+
+
+def _load(name: str, nogil: bool) -> Optional[ctypes.CDLL]:
     with _lock:
-        if name in _cache:
-            return _cache[name]
+        key = f"{name}:nogil" if nogil else name
+        if key in _cache:
+            return _cache[key]
         path = os.path.join(_BUILD, f"lib{name}.so")
         # rebuild on absence OR staleness (source newer than the .so).
         # When the rebuild can't run (no compiler), the existing .so is
@@ -83,12 +102,12 @@ def load(name: str) -> Optional[ctypes.CDLL]:
         lib = None
         if os.path.exists(path):
             try:
-                lib = ctypes.CDLL(path)
+                lib = (ctypes.PyDLL if nogil else ctypes.CDLL)(path)
                 getattr(lib, _ABI_CANARY[name])
                 _declare(name, lib)
             except (OSError, AttributeError, KeyError):
                 lib = None
-        _cache[name] = lib
+        _cache[key] = lib
         return lib
 
 
@@ -169,6 +188,9 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.shm_add.argtypes = [c.c_void_p, c.c_int64]
         lib.shm_cas.restype = c.c_int
         lib.shm_cas.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+        lib.shm_hist_observe.restype = None
+        lib.shm_hist_observe.argtypes = [c.c_void_p, c.c_int64,
+                                         c.c_int64, c.c_int64]
         lib.shm_futex_wait.restype = c.c_int
         lib.shm_futex_wait.argtypes = [c.c_void_p, c.c_uint32, c.c_int64]
         lib.shm_futex_wake.restype = c.c_int

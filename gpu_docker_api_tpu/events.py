@@ -48,6 +48,11 @@ class EventLog:
         self._w: Optional[RotatingWriter] = None
         self._last_flush = 0.0
         self._seq = 0
+        # optional per-event mirror (obs/recorder.py FlightRecorder
+        # note_event): the daemon's flight recorder sees every event the
+        # moment it lands, so its SIGTERM/atexit flush carries the final
+        # control-plane moments. Called OUTSIDE the log's lock.
+        self.mirror = None
         if state_dir:
             self._w = RotatingWriter(f"{state_dir}/events.jsonl")
 
@@ -80,6 +85,13 @@ class EventLog:
                     self._w.flush()
                     self._last_flush = now
             self._cond.notify_all()
+        mirror = self.mirror
+        if mirror is not None:
+            try:
+                mirror(evt)
+            # tdlint: disable=silent-swallow -- best-effort flight-recorder mirror; the event itself already landed in the ring and jsonl
+            except Exception:  # noqa: BLE001
+                pass
 
     def recent(self, limit: int = 200, target: str = "") -> list[dict]:
         with self._lock:

@@ -62,6 +62,9 @@ EVENT_OPS = frozenset({
     "gateway.wake",
     # multi-process data-plane worker tier (server/workers.py)
     "gateway.worker_respawn",
+    # watchdog-reaped dead worker: flight-recorder segment + claim-
+    # reconcile delta bundle (server/workers.py _capture_postmortem)
+    "gateway.worker_postmortem",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.
@@ -134,4 +137,15 @@ METRIC_NAMES = frozenset({
     "tdapi_gateway_requests_total",
     "tdapi_gateway_shed_total",
     "tdapi_gateway_scale_events_total",
+    # cross-process telemetry plane: shared-memory metric shards of the
+    # multi-process worker tier (obs/shm_metrics.py, summed at scrape by
+    # the server/app.py collect callback). Declared in BOTH serving
+    # modes (family parity); per-worker attribution of the data plane.
+    "tdapi_gw_workers_alive",
+    "tdapi_gw_worker_respawns_total",
+    "tdapi_gw_worker_requests_total",
+    "tdapi_gw_worker_shed_total",
+    "tdapi_gw_worker_deadline_total",
+    "tdapi_gw_worker_retries_total",
+    "tdapi_gw_worker_queue_wait_ms",
 })

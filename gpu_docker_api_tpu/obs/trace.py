@@ -439,9 +439,41 @@ class TraceCollector:
             if len(t.spans) < self.max_spans_per_trace:
                 t.spans.append(sj)
             if span._root:
-                row = self._finalize(t, span, sj)
+                row = self._finalize(t, span.op, span.target, span.start,
+                                     span.duration_ms, span.outcome)
         if row is not None:
             self._write_row(row)
+        _metrics.SPANS_TOTAL.inc()
+
+    def ingest_row(self, row: dict) -> None:
+        """Adopt an externally-produced span dict — the worker span-spool
+        merge (obs/spool.py SpoolTailer). ``"root": true`` rows finalize
+        their trace exactly like a local root finish, so keep-slowest
+        retention and the traces.jsonl record treat worker-served
+        data-plane requests like any other trace."""
+        row = dict(row)
+        is_root = bool(row.pop("root", False))
+        trace_id = row.get("traceId")
+        if not trace_id:
+            return
+        out = None
+        with self._lock:
+            self.spans_total += 1
+            t = self._traces.get(trace_id)
+            if t is None:
+                t = _Trace(trace_id)
+                self._traces[trace_id] = t
+                self._order.append(trace_id)
+            if len(t.spans) < self.max_spans_per_trace:
+                t.spans.append(row)
+            if is_root:
+                out = self._finalize(
+                    t, row.get("op", ""), row.get("target", ""),
+                    row.get("start", 0.0),
+                    float(row.get("durationMs", 0.0)),
+                    row.get("status", "ok"))
+        if out is not None:
+            self._write_row(out)
         _metrics.SPANS_TOTAL.inc()
 
     def _write_row(self, row: dict) -> None:
@@ -459,19 +491,20 @@ class TraceCollector:
                 self._writer.flush()
                 self._last_flush = now
 
-    def _finalize(self, t: _Trace, root: Span,
-                  root_json: dict) -> Optional[dict]:
+    def _finalize(self, t: _Trace, op: str, target: str, start: float,
+                  duration_ms: float, outcome: str) -> Optional[dict]:
         """Root finished: stamp the trace summary, apply retention, and
         return the jsonl row for the caller to persist off-lock (span
         list SNAPSHOTTED here — spans landing later mutate t.spans under
         the lock). A trace can finalize more than once (runtime reconcile
-        joining an old trace id) — later roots update the summary, one
-        line per finalization, newest last."""
-        t.root_op = root.op
-        t.target = root.target or t.target
-        t.start = root.start
-        t.duration_ms = round(root.duration_ms, 3)
-        t.outcome = root.outcome
+        joining an old trace id; a worker root merging after a daemon
+        one) — later roots update the summary, one line per finalization,
+        newest last."""
+        t.root_op = op
+        t.target = target or t.target
+        t.start = start
+        t.duration_ms = round(duration_ms, 3)
+        t.outcome = outcome
         t.done = True
         row = None
         if self._writer is not None:

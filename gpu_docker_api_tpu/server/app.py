@@ -35,6 +35,7 @@ from ..intents import IntentJournal
 from ..meshplan import PlanSpec
 from ..obs import metrics as obs_metrics
 from ..obs.metrics import Registry
+from ..obs.recorder import FlightRecorder
 from ..obs.trace import TraceCollector
 from ..gateway import GatewayConfig, GatewayManager
 from ..reconcile import Reconciler
@@ -363,6 +364,13 @@ class App:
         # in-process single-daemon data plane
         from . import workers as gw_workers_mod
         self.workers = None
+        # per-process flight recorder (obs/recorder.py): every event row
+        # mirrors into a cheap bounded ring, flushed to the state dir on
+        # graceful stop (the cli's SIGTERM handler drives App.stop) — the
+        # daemon's own postmortem segment, the in-process twin of the
+        # workers' shm rings
+        self.recorder = FlightRecorder()
+        self.events.mirror = self.recorder.note_event
         n_workers = _env_int(gw_workers_mod.GW_WORKERS_ENV, gw_workers, 0)
         if n_workers > 0:
             if gw_workers_mod.available():
@@ -371,8 +379,17 @@ class App:
                     port=_env_int(gw_workers_mod.GW_DATA_PORT_ENV,
                                   gw_data_port, 0),
                     events=self.events,
+                    traces=self.traces,
+                    spool_dir=os.path.join(state_dir, "spans"),
                     api_key=(api_key if api_key is not None
                              else os.environ.get("APIKEY", "")))
+                # worker-served requests merge into the SAME latency
+                # family the in-process path observes into: the family is
+                # truthful whichever tier served the request (metric-
+                # family parity). Cleared in stop() — the instrument is
+                # module-global and this App's tier must not outlive it.
+                obs_metrics.GATEWAY_LATENCY.set_extern(
+                    self.workers.latency_extern)
             else:
                 log.warning("TDAPI_GW_WORKERS=%d but the worker tier is "
                             "unavailable (native shm-atomics core not "
@@ -1251,6 +1268,40 @@ class App:
         g_gw_scale = m.gauge("tdapi_gateway_scale_events_total",
                              labels=("gateway", "direction"),
                              typ="counter")
+        # multi-process data-plane worker tier (server/workers.py +
+        # obs/shm_metrics.py). Declared UNCONDITIONALLY: family presence
+        # must not depend on TDAPI_GW_WORKERS, or dashboards built in one
+        # mode break in the other (the metric-family parity contract —
+        # same names/labels whichever tier serves; the values are simply
+        # zero/empty when the tier is off)
+        g_wk_alive = m.gauge("tdapi_gw_workers_alive",
+                             "live SO_REUSEPORT data-plane workers")
+        g_wk_respawn = m.gauge(
+            "tdapi_gw_worker_respawns_total",
+            "dead workers reaped and respawned by the watchdog",
+            typ="counter")
+        g_wk_req = m.gauge("tdapi_gw_worker_requests_total",
+                           "data-plane requests served, per worker "
+                           "process and gateway",
+                           labels=("worker", "gateway"), typ="counter")
+        g_wk_shed = m.gauge("tdapi_gw_worker_shed_total",
+                            "queue-bound 429 sheds, per worker process",
+                            labels=("worker", "gateway"), typ="counter")
+        g_wk_dead = m.gauge("tdapi_gw_worker_deadline_total",
+                            "deadline 504 kills, per worker process",
+                            labels=("worker", "gateway"), typ="counter")
+        g_wk_retry = m.gauge(
+            "tdapi_gw_worker_retries_total",
+            "replica transport failures retried on another replica, per "
+            "worker process", labels=("worker", "gateway"), typ="counter")
+        h_wk_qw = m.histogram(
+            "tdapi_gw_worker_queue_wait_ms",
+            "admission queue wait in the worker tier (claim start -> "
+            "slot claimed), summed across workers per gateway",
+            labels=("gateway",),
+            buckets=obs_metrics.LATENCY_BUCKETS_MS)
+        if self.workers is not None:
+            h_wk_qw.set_extern(self.workers.queue_wait_extern)
 
         def collect() -> None:
             tpu = self.tpu.get_status()
@@ -1319,8 +1370,16 @@ class App:
                 g_brk_f.set(brk["consecutiveFailures"])
             g_traces.set(self.traces.stats()["retained"])
             for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
-                      g_gw_scale):
+                      g_gw_scale, g_wk_req, g_wk_shed, g_wk_dead,
+                      g_wk_retry):
                 g.reset()
+            # worker-tier counts fold into the SAME gateway families the
+            # in-process router feeds (metric-family parity: a dashboard
+            # sum over tdapi_gateway_requests_total is the whole data
+            # plane, whichever tier served it)
+            tier = self.workers
+            tier_desc = tier.describe() if tier is not None else None
+            tier_gw = (tier_desc or {}).get("gateways", {})
             for gw in self.gateways.snapshot():
                 name = gw["name"]
                 by_state: dict[str, int] = {}
@@ -1328,14 +1387,37 @@ class App:
                     by_state[r["state"]] = by_state.get(r["state"], 0) + 1
                 for state, count in by_state.items():
                     g_gw_rep.set(count, gateway=name, state=state)
-                g_gw_q.set(gw["queueDepth"], gateway=name)
-                g_gw_in.set(gw["inflight"], gateway=name)
-                g_gw_req.set(gw["requestsTotal"], gateway=name)
-                g_gw_shed.set(gw["shedTotal"], gateway=name)
+                wk = tier_gw.get(name, {})
+                g_gw_q.set(gw["queueDepth"] + wk.get("queued", 0),
+                           gateway=name)
+                g_gw_in.set(gw["inflight"] + wk.get("inflight", 0),
+                            gateway=name)
+                g_gw_req.set(gw["requestsTotal"]
+                             + wk.get("requestsTotal", 0), gateway=name)
+                g_gw_shed.set(gw["shedTotal"] + wk.get("shedTotal", 0),
+                              gateway=name)
                 g_gw_scale.set(gw["scaleUps"], gateway=name,
                                direction="up")
                 g_gw_scale.set(gw["scaleDowns"], gateway=name,
                                direction="down")
+            if tier_desc is not None:
+                g_wk_alive.set(tier_desc["alive"])
+                g_wk_respawn.set(tier_desc["respawns"])
+                for name, rows in tier.per_worker_counts().items():
+                    for w, row in enumerate(rows):
+                        if not any(row.values()):
+                            continue    # bounded exposition: quiet cells
+                        g_wk_req.set(row["requests"], worker=w,
+                                     gateway=name)
+                        g_wk_shed.set(row["shed"], worker=w,
+                                      gateway=name)
+                        g_wk_dead.set(row["deadline"], worker=w,
+                                      gateway=name)
+                        g_wk_retry.set(row["retries"], worker=w,
+                                       gateway=name)
+            else:
+                g_wk_alive.set(0)
+                g_wk_respawn.set(0)
             with self._stream_lock:
                 g_followers.set(self._stream_clients)
 
@@ -1425,6 +1507,12 @@ class App:
         main.go:139-154)."""
         self.server.stop()
         if self.workers is not None:
+            # the module-global latency family must not keep scraping a
+            # dead tier's unlinked segment (and a later App's tier will
+            # install its own hook)
+            if (obs_metrics.GATEWAY_LATENCY._extern
+                    == self.workers.latency_extern):
+                obs_metrics.GATEWAY_LATENCY.set_extern(None)
             self.workers.stop()    # drain the data-plane tier first
         self.gateways.stop_all()   # autoscaler loops, before services go
         self.health.stop()
@@ -1449,6 +1537,12 @@ class App:
         self.events.close()
         self.traces.close()
         self.store.close()
+        # last: the daemon's own postmortem segment (SIGTERM reaches
+        # here through the cli handler; a SIGKILL'd daemon leaves the
+        # previous flush — telemetry, not state)
+        self.recorder.note("stop")
+        self.recorder.flush_to(os.path.join(self.state_dir,
+                                            "recorder-daemon.json"))
 
     @property
     def address(self) -> str:

@@ -41,7 +41,9 @@ when it is off (`TDAPI_GW_WORKERS` unset/0, or the core unbuilt).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import glob
 import logging
 import os
 import signal
@@ -50,10 +52,15 @@ import struct
 import threading
 import time
 
+from collections import deque
 from multiprocessing import get_context, shared_memory
 from typing import Callable, Optional
 
 from .._native import load
+from ..obs import shm_metrics
+from ..obs import trace
+from ..obs.recorder import FlightRecorder
+from ..obs.spool import SpanSpool, SpoolTailer
 from .codes import ResCode
 from .http import (
     ApiServer, RawResponse, Request, Response, Router, StreamingResponse,
@@ -73,6 +80,12 @@ MAX_GATEWAYS = 16
 MAX_REPLICAS = 16
 MAX_WORKERS = 8
 NAME_LEN = 48
+
+# the metric-shard segment (obs/shm_metrics.py) is addressed by the same
+# (worker, gateway-slot) coordinates as this segment — the geometries
+# must agree or shard writes land in another gateway's cells
+assert shm_metrics.SH_MAX_SHARDS >= MAX_WORKERS
+assert shm_metrics.SH_MAX_GATEWAYS == MAX_GATEWAYS
 
 MAGIC = 0x7464_6170_6977_6b31          # "tdapiwk1"
 
@@ -212,14 +225,17 @@ class SharedRouterState:
 
     # ---- daemon side: seqlock publish ------------------------------------
 
-    def publish(self, states: list[dict]) -> None:
+    def publish(self, states: list[dict]) -> list[int]:
         """Write the roster twin under the seqlock: epoch goes odd,
         config bytes land, epoch goes even — readers retry on any
         movement, so they only ever parse a consistent roster. Counter
         cells are NOT part of the protected region; a gateway keeps its
         slot (and counters) across publishes, and a slot reassigned to a
         different gateway bumps its generation word so stale releases
-        skip themselves."""
+        skip themselves. Returns the slots whose IDENTITY changed this
+        publish, so the caller can reset per-slot state that lives
+        outside this segment (the metric shards) — outside the window,
+        per seqlock discipline."""
         states = states[:MAX_GATEWAYS]
         buf = self.shm.buf
         # stable slot assignment: keep existing names in place
@@ -253,6 +269,7 @@ class SharedRouterState:
         odd = epoch + 1 if epoch % 2 == 0 else epoch
         self.store(HDR_OFF_EPOCH, odd)                # odd: write in progress
         yield_seam = _publish_yield
+        reassigned: list[int] = []
         try:
             for g in range(MAX_GATEWAYS):
                 off = _gw_conf_off(g)
@@ -274,6 +291,7 @@ class SharedRouterState:
                     # re-checks gen after its fetch_add and undoes
                     # floor-clamped, so the transient is at most ±1 and
                     # self-corrects.
+                    reassigned.append(g)
                     self.add(_gw_cnt_off(g), 1)       # gen word
                     self.store(_gw_cnt_off(g) + 8, 0)     # queued
                     self.store(_gw_cnt_off(g) + 24, 0)    # requests_total
@@ -303,6 +321,7 @@ class SharedRouterState:
         finally:
             self.store(HDR_OFF_EPOCH, odd + 1)        # even: consistent
         self.store(HDR_OFF_NGW, len(assigned))
+        return reassigned
 
     # ---- worker side: consistent roster read -----------------------------
 
@@ -423,15 +442,49 @@ class WorkerRouter:
     another replica until the deadline."""
 
     def __init__(self, state: SharedRouterState, worker_idx: int,
-                 transport: Optional[Callable] = None):
+                 transport: Optional[Callable] = None,
+                 shards=None, recorder=None):
         self.state = state
         self.widx = worker_idx
         self._transport = transport
+        # cross-process telemetry (both optional — the policy-parity
+        # suite and a telemetry-disarmed tier run without them):
+        # `shards` is an obs/shm_metrics.MetricShards attachment this
+        # worker observes its counters/histograms into; `recorder` is
+        # the process flight recorder (obs/recorder.py)
+        self.shards = shards
+        self.recorder = recorder
         self._roster_epoch = -1
         self._roster: dict[str, dict] = {}
         self._roster_lock = threading.Lock()
         self._lines: dict[int, _LocalLine] = {}
+        self._views: dict[int, object] = {}
         self._local = threading.local()
+
+    def _view(self, g: int):
+        """This worker's precomputed shard view for gateway slot `g`
+        (obs/shm_metrics.ShardGatewayView) — one observation = one
+        GIL-held PyDLL call; None when shards are off."""
+        v = self._views.get(g)
+        if v is None and self.shards is not None:
+            v = self._views[g] = self.shards.view(self.widx, g)
+        return v
+
+    def _note(self, kind: str, **data) -> None:
+        if self.recorder is not None:
+            self.recorder.note(kind, **data)
+
+    @staticmethod
+    def _detailed_trace() -> bool:
+        """Whether this request gets CHILD spans (admit/forward) or just
+        root-level events. Client-traced requests (inbound traceparent —
+        the root's parent is the caller's span) get the full chain; for
+        the rest, per-request child spans measurably tax the data plane
+        while the tail-sampling spool drops almost all of them — so the
+        admit/forward facts ride the root span as events instead, which
+        slow/error/sampled traces still carry."""
+        cur = trace.current()
+        return cur is not None and cur.parent_id is not None
 
     # ---- roster cache ----------------------------------------------------
 
@@ -494,19 +547,28 @@ class WorkerRouter:
     def _claim(self, name: str, gw: dict, deadline: float, high: bool,
                avoid: frozenset = frozenset()) -> _Claim:
         """Block until a slot claim succeeds; shed on queue bound or
-        deadline — Gateway._claim's contract over shared state."""
+        deadline — Gateway._claim's contract over shared state. Every
+        successful claim lands its queue wait in this worker's metric
+        shard (the admission queue-wait histogram); sheds and deadline
+        kills land in the shard counters — the telemetry PR 13 lost."""
         from .. import xerrors  # local import: workers must stay light
         st = self.state
         g = gw["slot"]
+        view = self._view(g)
         line = self._line(g)
         with line.lock:
             if not line.hi and (high or not line.lo):
                 c = self._try_claim(gw, avoid)
                 if c is not None:
+                    if view is not None:
+                        view.observe_queue_wait_zero()
                     return c
             qoff = _gw_cnt_off(g) + 8
             if st.load(qoff) >= gw["maxQueue"]:
                 st.add(_gw_cnt_off(g) + 32, 1)        # shed_total
+                if view is not None:
+                    view.inc_shed()
+                self._note("shed", gw=name, reason="queue_full")
                 raise xerrors.GatewayShedError(
                     f"{name}: admission queue full ({gw['maxQueue']})")
             st.add(qoff, 1)
@@ -514,6 +576,7 @@ class WorkerRouter:
             ticket = object()
             mine = line.hi if high else line.lo
             mine.append(ticket)
+        t0 = time.monotonic()          # queue-wait clock: queuing began
         relseq = _gw_cnt_off(g) + 16
         try:
             while True:
@@ -523,11 +586,17 @@ class WorkerRouter:
                     if at_head:
                         c = self._try_claim(gw, avoid)
                         if c is not None:
+                            if view is not None:
+                                view.observe_queue_wait(
+                                    (time.monotonic() - t0) * 1e3)
                             return c
                     seen = st.load(relseq)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     st.add(_gw_cnt_off(g) + 32, 1)    # shed_total
+                    if view is not None:
+                        view.inc_deadline()
+                    self._note("deadline", gw=name)
                     raise xerrors.GatewayDeadlineError(
                         f"{name}: no replica slot freed within the "
                         f"{gw['deadlineMs']:.0f}ms deadline")
@@ -552,9 +621,28 @@ class WorkerRouter:
 
     # ---- transport (pooled per thread+port, NODELAY) ---------------------
 
+    @staticmethod
+    def _replica_headers() -> dict:
+        """Outbound headers for a replica call: the current span's W3C
+        traceparent rides along, so the replica can echo it (and a future
+        replica-side collector can join the trace)."""
+        headers = {"Content-Type": "application/json"}
+        cur = trace.current()
+        if cur is not None:
+            headers["traceparent"] = trace.format_traceparent(
+                cur.trace_id, cur.span_id)
+        return headers
+
     def _call(self, port: int, body: bytes, timeout: float):
+        """One replica generate call. Returns (status, payload,
+        queue_wait_ms) — the replica advertises its batcher queue wait
+        per response (X-TDAPI-Queue-Wait-Ms), which is how replica-side
+        time stitches into the worker's trace; None when absent (or on
+        the injected test transports, which return 2-tuples)."""
         if self._transport is not None:
-            return self._transport(port, "POST", "/generate", body, timeout)
+            out = self._transport(port, "POST", "/generate", body, timeout)
+            status, payload = out[0], out[1]
+            return status, payload, (out[2] if len(out) > 2 else None)
         import http.client
         pool = getattr(self._local, "conns", None)
         if pool is None:
@@ -573,9 +661,15 @@ class WorkerRouter:
                 if conn.sock is not None:
                     conn.sock.settimeout(timeout)
             conn.request("POST", "/generate", body=body,
-                         headers={"Content-Type": "application/json"})
+                         headers=self._replica_headers())
             resp = conn.getresponse()
-            return resp.status, resp.read()
+            payload = resp.read()
+            qw = resp.getheader("X-TDAPI-Queue-Wait-Ms")
+            try:
+                qw = float(qw) if qw is not None else None
+            except ValueError:
+                qw = None
+            return resp.status, payload, qw
         except Exception:
             pool.pop(port, None)
             if conn is not None:
@@ -596,24 +690,57 @@ class WorkerRouter:
             raise KeyError(name)
         st = self.state
         g = gw["slot"]
+        view = self._view(g)
         st.add(_gw_cnt_off(g) + 24, 1)                # requests_total
+        if view is not None:
+            view.inc_requests()
         if not any(r["ready"] for r in gw["replicas"]):
             st.add(_gw_cnt_off(g) + 40, 1)            # wake hint
         t0 = time.monotonic()
         deadline = t0 + gw["deadlineMs"] / 1e3
         high = priority in ("high", "latency")
+        detailed = self._detailed_trace()
+        if detailed:
+            # ring entries per REQUEST only for client-traced traffic —
+            # errors/sheds/retries always note, and the claim ledger
+            # (postmortem claimDelta) names any in-flight work, so the
+            # always-on cost stays off the untraced hot path
+            self._note("req", gw=name)
         avoid: set = set()
         while True:
-            c = self._claim(name, gw, deadline, high=high,
-                            avoid=frozenset(avoid))
+            if detailed:
+                with trace.span("gateway.admit", target=name):
+                    c = self._claim(name, gw, deadline, high=high,
+                                    avoid=frozenset(avoid))
+            else:
+                c = self._claim(name, gw, deadline, high=high,
+                                avoid=frozenset(avoid))
             left = deadline - time.monotonic()
             try:
-                status, payload = self._call(c.port, body,
-                                             timeout=max(left, 0.05))
+                with (trace.span("gateway.forward", target=name,
+                                 replica=c.rep, port=c.port)
+                      if detailed
+                      else contextlib.nullcontext(
+                          trace.current())) as fsp:
+                    status, payload, qwait = self._call(
+                        c.port, body, timeout=max(left, 0.05))
+                    if fsp is not None and qwait is not None:
+                        # replica-side batcher queue wait, advertised on
+                        # the response: the replica's contribution to
+                        # this span's time, stitched without a replica-
+                        # side collector (root-level event when the
+                        # request is not client-traced)
+                        fsp.event("replica.queue_wait", ms=qwait)
             except Exception as e:  # noqa: BLE001 — replica gone/slow
                 self._release(c)
                 st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)  # errors
+                if view is not None:
+                    view.inc_retries()
+                self._note("retry", gw=name, replica=c.rep,
+                           error=type(e).__name__)
                 if time.monotonic() >= deadline:
+                    if view is not None:
+                        view.inc_deadline()
                     raise xerrors.GatewayDeadlineError(
                         f"{name}: replicas unreachable "
                         f"({type(e).__name__})")
@@ -626,6 +753,8 @@ class WorkerRouter:
                     avoid.clear()    # every replica failed once: retry all
                 continue
             self._release(c)
+            if view is not None:
+                view.observe_latency((time.monotonic() - t0) * 1e3)
             return status, payload
 
     # ---- HTTP handlers (the worker's route table) ------------------------
@@ -640,25 +769,42 @@ class WorkerRouter:
         if gw is None:
             raise KeyError(name)
         st = self.state
-        st.add(_gw_cnt_off(gw["slot"]) + 24, 1)       # requests_total
-        deadline = time.monotonic() + gw["deadlineMs"] / 1e3
+        g = gw["slot"]
+        view = self._view(g)
+        st.add(_gw_cnt_off(g) + 24, 1)                # requests_total
+        if view is not None:
+            view.inc_requests()
+        t0 = time.monotonic()
+        deadline = t0 + gw["deadlineMs"] / 1e3
         high = priority in ("high", "latency")
+        detailed = self._detailed_trace()
+        if detailed:
+            self._note("req", gw=name, stream=True)
         avoid: set = set()
         while True:
-            c = self._claim(name, gw, deadline, high=high,
-                            avoid=frozenset(avoid))
+            if detailed:
+                with trace.span("gateway.admit", target=name):
+                    c = self._claim(name, gw, deadline, high=high,
+                                    avoid=frozenset(avoid))
+            else:
+                c = self._claim(name, gw, deadline, high=high,
+                                avoid=frozenset(avoid))
             left = max(deadline - time.monotonic(), 0.05)
             conn = http.client.HTTPConnection("127.0.0.1", c.port,
                                               timeout=left)
             try:
                 conn.request("POST", "/generate", body=body,
-                             headers={"Content-Type": "application/json"})
+                             headers=self._replica_headers())
                 resp = conn.getresponse()
             except Exception as e:  # noqa: BLE001 — replica gone/slow
                 conn.close()
                 self._release(c)
                 st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)
+                if view is not None:
+                    view.inc_retries()
                 if time.monotonic() >= deadline:
+                    if view is not None:
+                        view.inc_deadline()
                     raise xerrors.GatewayDeadlineError(
                         f"{name}: replicas unreachable "
                         f"({type(e).__name__})")
@@ -671,7 +817,7 @@ class WorkerRouter:
                     avoid.clear()
                 continue
 
-            def relay(c=c, conn=conn, resp=resp):
+            def relay(c=c, conn=conn, resp=resp, view=view, t0=t0):
                 try:
                     while True:
                         chunk = resp.read(8192)
@@ -681,6 +827,11 @@ class WorkerRouter:
                 finally:
                     conn.close()
                     self._release(c)
+                    if view is not None:
+                        # latency spans the whole relay, like the
+                        # in-process _relay's observe
+                        view.observe_latency(
+                            (time.monotonic() - t0) * 1e3)
 
             return relay()
 
@@ -717,19 +868,46 @@ class WorkerRouter:
 # ---- the worker process -----------------------------------------------------
 
 def _worker_main(host: str, port: int, shm_name: str, worker_idx: int,
-                 api_key: str = "") -> None:
+                 api_key: str = "", metrics_name: str = "",
+                 spool_dir: str = "", telemetry: bool = True) -> None:
     """Child entry (spawn context): bind the data-plane port with
     SO_REUSEPORT, serve generate end-to-end, heartbeat into the segment,
-    drain gracefully on SIGTERM."""
+    drain gracefully on SIGTERM. Telemetry wiring: the metric-shard
+    segment attaches by name, finished spans spool to this process's
+    spans-<pid>.jsonl (the daemon tails and merges them), and the flight
+    recorder mirrors into the shard's shm ring so a SIGKILL still leaves
+    a readable final segment."""
+    if not telemetry:
+        trace.set_enabled(False)
     state = SharedRouterState(name=shm_name)
-    wr = WorkerRouter(state, worker_idx)
+    shards = None
+    if telemetry and metrics_name:
+        try:
+            shards = shm_metrics.MetricShards(name=metrics_name)
+        except Exception:  # noqa: BLE001 — serve without shards rather than not at all
+            log.exception("worker %d: metric shards unavailable",
+                          worker_idx)
+    recorder = FlightRecorder(
+        sink=shards.ring_writer(worker_idx) if shards is not None
+        else None)
+    recorder.note("boot", worker=worker_idx, pid=os.getpid())
+    spool = None
+    if telemetry and spool_dir:
+        try:
+            os.makedirs(spool_dir, exist_ok=True)
+            spool = SpanSpool(os.path.join(
+                spool_dir, f"spans-{os.getpid()}.jsonl"),
+                recorder=recorder)
+        except OSError:
+            log.exception("worker %d: span spool unavailable", worker_idx)
+    wr = WorkerRouter(state, worker_idx, shards=shards, recorder=recorder)
     router = Router()
     router.add("POST", "/api/v1/gateways/:name/generate", wr.h_generate)
     router.add("GET", "/api/v1/healthz", wr.h_healthz)
     router.add("GET", "/ping",
                lambda req: ok({"status": "pong", "worker": worker_idx}))
     srv = ApiServer(router, addr=f"{host}:{port}", api_key=api_key,
-                    reuse_port=True,
+                    reuse_port=True, traces=spool,
                     quiet_routes=frozenset(
                         {("POST", "/api/v1/gateways/:name/generate")}))
     stop = threading.Event()
@@ -756,6 +934,19 @@ def _worker_main(host: str, port: int, shm_name: str, worker_idx: int,
         # tdlint: disable=silent-swallow -- last-gasp drain; the process exits either way
         except Exception:  # noqa: BLE001
             pass
+        # graceful exit: drain the spool tail and flush the recorder to
+        # its postmortem file (the SIGTERM/atexit half of the recorder
+        # contract; SIGKILL relies on the shm ring instead)
+        recorder.note("exit", worker=worker_idx)
+        try:
+            if spool is not None:
+                spool.close()
+            if spool_dir:
+                recorder.flush_to(os.path.join(
+                    spool_dir, f"recorder-{os.getpid()}.json"))
+        # tdlint: disable=silent-swallow -- last-gasp telemetry flush; the process exits either way
+        except Exception:  # noqa: BLE001
+            pass
     os._exit(0)
 
 
@@ -771,8 +962,15 @@ class WorkerTier:
     #: a worker whose heartbeat is older than this is declared hung
     HEARTBEAT_STALE_S = 10.0
 
+    #: postmortem bundles retained for /healthz (newest last)
+    MAX_POSTMORTEMS = 8
+    #: recorder entries surfaced per postmortem bundle
+    POSTMORTEM_TAIL = 16
+
     def __init__(self, gateways, n: int, host: str = "127.0.0.1",
-                 port: int = 0, events=None, api_key: str = ""):
+                 port: int = 0, events=None, api_key: str = "",
+                 traces=None, spool_dir: Optional[str] = None,
+                 telemetry: bool = True):
         if not available():
             raise RuntimeError("worker tier unavailable "
                                "(needs Linux + native shm-atomics core)")
@@ -782,6 +980,19 @@ class WorkerTier:
         self.port = int(port)
         self.events = events
         self.api_key = api_key
+        # cross-process telemetry plane (obs/): the daemon-side handles.
+        # `traces` is the daemon's TraceCollector (worker span spools
+        # merge into it); `spool_dir` hosts spans-<pid>.jsonl +
+        # recorder-<pid>.json; telemetry=False runs the tier dark (the
+        # bench's obs_mp A/B arm)
+        self.traces = traces
+        self.spool_dir = spool_dir
+        self.telemetry = bool(telemetry)
+        self.metric_shards = None
+        self._tailer: Optional[SpoolTailer] = None
+        self._agg_cache: Optional[dict] = None
+        self._agg_at = 0.0
+        self.postmortems: deque = deque(maxlen=self.MAX_POSTMORTEMS)
         self.state: Optional[SharedRouterState] = None
         self.procs: list = [None] * self.n
         self.respawns = 0
@@ -806,6 +1017,24 @@ class WorkerTier:
 
     def start(self) -> None:
         self.state = SharedRouterState(create=True)
+        if self.telemetry:
+            try:
+                self.metric_shards = shm_metrics.MetricShards(create=True)
+            except Exception:  # noqa: BLE001 — the tier must serve even with shards unavailable
+                log.exception("worker tier: metric shards unavailable")
+            if self.spool_dir and self.traces is not None:
+                try:
+                    os.makedirs(self.spool_dir, exist_ok=True)
+                    # stale spool files from a PREVIOUS daemon run were
+                    # already merged into that daemon's collector (and
+                    # live on in its traces.jsonl) — re-tailing them
+                    # would duplicate old traces into the fresh ring and
+                    # grow the directory without bound across restarts
+                    self._prune_spool()
+                    self._tailer = SpoolTailer(self.spool_dir, self.traces)
+                except OSError:
+                    log.exception("worker tier: span spool dir "
+                                  "unavailable")
         self.state.publish(self.gateways.router_states())
         self.port = self._alloc_port()
         struct.pack_into("<q", self.state.shm.buf, 40, self.port)
@@ -823,13 +1052,38 @@ class WorkerTier:
         p = self._ctx.Process(
             target=_worker_main,
             args=(self.host, self.port, self.state.name, idx,
-                  self.api_key),
+                  self.api_key,
+                  (self.metric_shards.name
+                   if self.metric_shards is not None else ""),
+                  self.spool_dir or "", self.telemetry),
             name=f"gw-worker-{idx}", daemon=True)
         p.start()
         self.procs[idx] = p
 
     def poke(self) -> None:
         self._poke.set()
+
+    def _prune_spool(self, pid: Optional[int] = None) -> None:
+        """Remove spool artifacts: ONE dead worker's files (after the
+        reap's final merge — a long-lived tier must not accumulate a
+        file per crashed pid, each globbed and stat()ed every tailer
+        poll forever) or ALL of them (tier boot, see start())."""
+        if not self.spool_dir:
+            return
+        if pid is not None:
+            pats = [f"spans-{pid}.jsonl", f"spans-{pid}.jsonl.1",
+                    f"recorder-{pid}.json"]
+        else:
+            pats = ["spans-*.jsonl", "spans-*.jsonl.1",
+                    "recorder-*.json"]
+        for pat in pats:
+            for path in glob.glob(os.path.join(self.spool_dir, pat)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                if self._tailer is not None:
+                    self._tailer.forget(path)
 
     # ---- watchdog --------------------------------------------------------
 
@@ -842,10 +1096,20 @@ class WorkerTier:
                 if (self._poke.is_set()
                         or now - last_pub >= self.REPUBLISH_S):
                     self._poke.clear()
-                    self.state.publish(self.gateways.router_states())
+                    reassigned = self.state.publish(
+                        self.gateways.router_states())
+                    # a reassigned roster slot must not hand its metric
+                    # history to the new tenant gateway; the reset runs
+                    # HERE, outside the roster's publish window, under
+                    # the shard segment's own per-slot seqlock
+                    if self.metric_shards is not None:
+                        for g in reassigned:
+                            self.metric_shards.reset_gateway(g)
                     last_pub = now
                 self._check_workers()
                 self._relay_wake_hints(last_wake)
+                if self._tailer is not None:
+                    self._tailer.poll()     # merge worker span spools
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("worker-tier watchdog tick")
 
@@ -861,18 +1125,80 @@ class WorkerTier:
                     p.join(timeout=1)
                 else:
                     continue
-            # dead: reconcile its shared-memory footprint, then respawn —
-            # the kernel already stopped routing to its closed socket
+            # dead: snapshot the worker's held claims (the cells are
+            # stable — their writer is gone) for the postmortem's claim-
+            # reconcile delta, reconcile its shared-memory footprint,
+            # then respawn — the kernel already stopped routing to its
+            # closed socket
+            delta = self._claim_delta(i)
             reclaimed = self.state.reconcile_worker(i)
             self.reclaimed_claims += reclaimed
             if not self._stop.is_set():
                 self.respawns += 1
+                self._capture_postmortem(i, p, reclaimed, delta)
+                # final merge of the dead worker's spooled spans, then
+                # drop its files — the respawn writes under a new pid
+                if self._tailer is not None:
+                    try:
+                        self._tailer.poll()
+                    except Exception:  # noqa: BLE001 — the reap must finish
+                        log.exception("worker %d: final spool merge", i)
+                pid = getattr(p, "pid", None)
+                if pid:
+                    self._prune_spool(pid)
                 if self.events is not None:
                     self.events.record("gateway.worker_respawn",
                                        target=f"worker-{i}", code=500,
                                        reclaimed=reclaimed)
                 self.state.store(_wk_off(i), 0)
                 self._spawn(i)
+
+    def _claim_delta(self, w: int) -> dict:
+        """Per-gateway claims/queue tickets a dead worker still held —
+        exactly what reconcile_worker is about to subtract (read first:
+        reconcile zeroes the cells)."""
+        out: dict[str, dict] = {}
+        _, roster = self.state.read_roster()
+        slot_names = {ent["slot"]: name for name, ent in roster.items()}
+        for g in range(MAX_GATEWAYS):
+            q = self.state.load(_wk_queued_off(w, g))
+            claims = sum(self.state.load(_wk_claim_off(w, g, r))
+                         for r in range(MAX_REPLICAS))
+            if q or claims:
+                out[slot_names.get(g, f"slot-{g}")] = {
+                    "claims": claims, "queued": q}
+        return out
+
+    def _capture_postmortem(self, i: int, p, reclaimed: int,
+                            delta: dict) -> None:
+        """The flight-recorder half of reaping a dead worker: read its
+        shm recorder ring (readable even after SIGKILL — no handler ran
+        in the worker), bundle it with the claim-reconcile delta, retain
+        the bundle for the /healthz workers block, and surface a
+        `gateway.worker_postmortem` event."""
+        entries: list = []
+        if self.metric_shards is not None:
+            try:
+                entries = self.metric_shards.read_ring(i)
+            except Exception:  # noqa: BLE001 — a torn ring must not block the respawn
+                log.exception("worker %d: postmortem ring read", i)
+        tail = entries[-self.POSTMORTEM_TAIL:]
+        pm = {
+            "worker": i,
+            "pid": getattr(p, "pid", None),
+            "at": round(time.time(), 3),
+            "reclaimedClaims": reclaimed,
+            "claimDelta": delta,
+            "recorder": tail,
+        }
+        self.postmortems.append(pm)
+        if self.events is not None:
+            self.events.record(
+                "gateway.worker_postmortem", target=f"worker-{i}",
+                code=500, pid=pm["pid"], reclaimed=reclaimed,
+                claimDelta=delta,
+                recorderEntries=len(entries),
+                lastOps=[e.get("k", "?") for e in tail[-5:]])
 
     def _relay_wake_hints(self, last_wake: dict[int, int]) -> None:
         """Workers can't run the autoscaler; they bump a wake-hint
@@ -898,6 +1224,9 @@ class WorkerTier:
                             if p is not None and p.is_alive()),
                "respawns": self.respawns,
                "reclaimedClaims": self.reclaimed_claims,
+               "telemetry": self.telemetry
+               and self.metric_shards is not None,
+               "postmortems": list(self.postmortems),
                "gateways": {}}
         if self.state is not None:
             _, roster = self.state.read_roster()
@@ -910,6 +1239,64 @@ class WorkerTier:
                     "inflight": sum(c["inflight"]),
                 }
         return out
+
+    # ---- scrape-time aggregation (server/app.py collect callback) --------
+
+    #: one shard sweep serves every consumer of the SAME scrape: the
+    #: collect callback (per-worker counters), the merged latency
+    #: histogram's extern, and the queue-wait extern all render within
+    #: milliseconds of each other — re-sweeping per consumer tripled
+    #: the seqlock reads and word unpacks for identical data
+    AGG_CACHE_S = 0.2
+
+    def _shard_aggregates(self) -> dict:
+        """{gateway name: shm_metrics aggregate} for every live roster
+        slot — one seqlock-consistent read per gateway per SCRAPE (the
+        three scrape-time consumers share a short-lived snapshot, which
+        also keeps counters and histograms from the same sweep)."""
+        if self.metric_shards is None or self.state is None:
+            return {}
+        now = time.monotonic()
+        cached = self._agg_cache
+        if cached is not None and now - self._agg_at < self.AGG_CACHE_S:
+            return cached
+        _, roster = self.state.read_roster()
+        out = {}
+        for name, ent in roster.items():
+            out[name] = self.metric_shards.aggregate(ent["slot"],
+                                                     n_shards=self.n)
+        # racing scrapes may each compute once; both snapshots are
+        # valid, last writer wins — no lock needed
+        self._agg_cache, self._agg_at = out, now
+        return out
+
+    def latency_extern(self) -> dict:
+        """Worker-served request latencies, shaped for
+        Histogram.set_extern on tdapi_gateway_request_duration_ms — this
+        is what makes that family truthful under TDAPI_GW_WORKERS>0."""
+        out = {}
+        for name, agg in self._shard_aggregates().items():
+            lat = agg["lat"]
+            if lat["count"]:
+                out[(name,)] = (lat["buckets"], lat["sumMs"],
+                                lat["count"])
+        return out
+
+    def queue_wait_extern(self) -> dict:
+        """Admission queue-wait distribution per gateway
+        (tdapi_gw_worker_queue_wait_ms)."""
+        out = {}
+        for name, agg in self._shard_aggregates().items():
+            qw = agg["queueWait"]
+            if qw["count"]:
+                out[(name,)] = (qw["buckets"], qw["sumMs"], qw["count"])
+        return out
+
+    def per_worker_counts(self) -> dict:
+        """{gateway: [per-worker {requests, shed, deadline, retries}]}
+        for the tdapi_gw_worker_* counter families."""
+        return {name: agg["perWorker"][:self.n]
+                for name, agg in self._shard_aggregates().items()}
 
     # ---- stop ------------------------------------------------------------
 
@@ -931,6 +1318,15 @@ class WorkerTier:
                 if p.is_alive():
                     p.kill()
                     p.join(timeout=2)
+        if self._tailer is not None:
+            try:
+                self._tailer.poll()     # the drained workers' final spans
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("worker tier: final spool merge")
+            self._tailer = None
+        if self.metric_shards is not None:
+            self.metric_shards.close(unlink=True)
+            self.metric_shards = None
         if self.state is not None:
             self.state.close(unlink=True)
             self.state = None

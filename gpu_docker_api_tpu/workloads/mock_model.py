@@ -74,6 +74,11 @@ def _handler_for(st: _State, model: str):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            # trace continuity, same as serve.py: echo the caller's
+            # traceparent on every response
+            tp = self.headers.get("traceparent")
+            if tp:
+                self.send_header("traceparent", tp)
             with st.lock:
                 self.send_header("X-TDAPI-Slots", str(st.slots))
                 self.send_header("X-TDAPI-Active", str(st.active))
@@ -126,7 +131,11 @@ def _handler_for(st: _State, model: str):
                            extra={"Retry-After": "1",
                                   "X-TDAPI-Shed": "1"})
                 return
+            t_enq = time.monotonic()
             st.slot_sem.acquire()
+            # slot-wait telemetry, the serve.py contract: a fronting
+            # worker stitches this into its forward span
+            wait_ms = (time.monotonic() - t_enq) * 1e3
             with st.lock:
                 st.queued -= 1
                 st.active += 1
@@ -139,7 +148,9 @@ def _handler_for(st: _State, model: str):
                     st.active -= 1
                     st.served += 1
                 st.slot_sem.release()
-            self._send(200, "Success", {"tokens": out})
+            self._send(200, "Success", {"tokens": out},
+                       extra={"X-TDAPI-Queue-Wait-Ms":
+                              str(round(wait_ms, 3))})
 
     return Handler
 

@@ -31,6 +31,7 @@ import contextlib
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
@@ -179,6 +180,12 @@ class _Batcher:
         self._prefixes: "collections.OrderedDict" = collections.OrderedDict()
         self.prefix_hits = 0
         self.queue: "queue.Queue" = queue.Queue()
+        # queue-wait telemetry (submit -> slot admission): per-request
+        # value rides the item dict (stats_out) and the response header;
+        # these aggregates feed /healthz batching.queueWait
+        self.queue_wait_count = 0
+        self.queue_wait_ms_total = 0.0
+        self.last_queue_wait_ms: "float | None" = None
         self.slots: list = [None] * slots
         self._waiting = None      # paged: head-of-line item short on blocks
         self._sample_vec = None   # per-slot sampling vectors (cached)
@@ -269,13 +276,16 @@ class _Batcher:
             self._slot_blocks[i] = None
 
     def submit(self, prompt_row, max_new: int, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0) -> list[int]:
+               top_k: int = 0, top_p: float = 1.0,
+               stats_out: dict | None = None) -> list[int]:
         """Blocking: returns the stream for one sequence — greedy at
         temperature 0, else per-request sampling (the row picks its token
         via rowwise_pick inside the shared decode step; other rows'
         streams are untouched). Raises if the scheduler thread has died
         or the batcher is closed — a request must never hang on an event
-        nobody will set."""
+        nobody will set. `stats_out` (a dict) receives per-request
+        telemetry — queueWaitMs, the submit->slot-admission wait — for
+        the HTTP layer's response headers."""
         if self._stop or self._dead is not None:
             raise RuntimeError(
                 f"batcher unavailable: {self._dead or 'closed'}")
@@ -323,6 +333,11 @@ class _Batcher:
         item = {"prompt": prompt_row, "max_new": int(max_new),
                 "temperature": float(temperature), "top_k": int(top_k),
                 "top_p": float(top_p),
+                # queue-wait clock: _admit stamps wait_ms when the item
+                # lands in a slot; the HTTP layer advertises it per
+                # response (X-TDAPI-Queue-Wait-Ms) so a fronting worker's
+                # trace can stitch replica-side time in
+                "enq_at": time.monotonic(),
                 "done": threading.Event(), "out": None, "error": None}
         self.queue.put(item)
         # re-check AFTER the put: _fail_all may have drained the queue
@@ -335,6 +350,8 @@ class _Batcher:
         item["done"].wait()
         if item["error"] is not None:
             raise RuntimeError(f"batcher failed: {item['error']}")
+        if stats_out is not None and "wait_ms" in item:
+            stats_out["queueWaitMs"] = round(item["wait_ms"], 3)
         return item["out"]
 
     @property
@@ -478,6 +495,18 @@ class _Batcher:
                 if shared_tok:
                     self.cache["lengths"] = self.cache["lengths"].at[
                         i].set(shared_tok)
+            # admission is the queue-wait boundary: stamp once (a paged
+            # park re-offers the same item later — its wait keeps
+            # accruing until the admission that sticks). Lock-step
+            # non-zero ranks see broadcast-built items without the
+            # clock; only rank 0 (the one with real HTTP waiters)
+            # records.
+            if "wait_ms" not in item and "enq_at" in item:
+                item["wait_ms"] = (time.monotonic()
+                                   - item["enq_at"]) * 1e3
+                self.queue_wait_count += 1
+                self.queue_wait_ms_total += item["wait_ms"]
+                self.last_queue_wait_ms = item["wait_ms"]
             try:
                 rem = (item["prompt"][shared_tok:] if self._paged
                        else self._restore_prefix(i, item))
@@ -1195,7 +1224,8 @@ class _Server:
         self.n_params = sum(p.size for p in jax.tree.leaves(params))
 
     def generate(self, tokens, max_new: int, temperature: float,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0,
+                 stats_out: dict | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -1215,7 +1245,8 @@ class _Server:
             if prompt.shape[0] == 1:
                 return [self.batcher.submit(
                     prompt[0], int(max_new), temperature=float(temperature),
-                    top_k=int(top_k), top_p=float(top_p))]
+                    top_k=int(top_k), top_p=float(top_p),
+                    stats_out=stats_out)]
             # a multi-row request would run generate() concurrently with
             # the batcher's slot decode on the same chip — two full KV
             # caches + programs live at once, an OOM on a chip where
@@ -1270,6 +1301,13 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
             self.send_response(200)     # control-plane envelope style
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            # W3C trace continuity: echo the caller's traceparent so a
+            # fronting gateway/worker can confirm which trace this
+            # response belongs to (replica-side time stitches into the
+            # caller's span via X-TDAPI-Queue-Wait-Ms)
+            tp = self.headers.get("traceparent")
+            if tp:
+                self.send_header("traceparent", tp)
             # replica-side admission surface: a fronting gateway reads
             # the batcher's slot/queue state off EVERY response instead
             # of polling /healthz between requests (admit-on-slot-free)
@@ -1301,6 +1339,13 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                         "maxLen": b.max_len,
                         "alive": b.alive,
                         "prefixHits": b.prefix_hits,
+                        "queueWait": {
+                            "count": b.queue_wait_count,
+                            "totalMs": round(b.queue_wait_ms_total, 3),
+                            "lastMs": (round(b.last_queue_wait_ms, 3)
+                                       if b.last_queue_wait_ms is not None
+                                       else None),
+                        },
                     }
                     if b._draft is not None:
                         data["batching"]["speculative"] = {
@@ -1369,9 +1414,18 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                     temperature = round(temperature * 20) / 20
                     top_p = round(top_p * 20) / 20 or 0.05
                     top_k = min(top_k, 128)
+                stats: dict = {}
                 out = srv.generate(tokens, max_new, temperature,
-                                   top_k=top_k, top_p=top_p)
-                self._send(200, "Success", {"tokens": out})
+                                   top_k=top_k, top_p=top_p,
+                                   stats_out=stats)
+                extra = None
+                if "queueWaitMs" in stats:
+                    # per-request batcher queue wait: the span-event
+                    # source a fronting worker stitches into its
+                    # gateway.forward span
+                    extra = {"X-TDAPI-Queue-Wait-Ms":
+                             str(stats["queueWaitMs"])}
+                self._send(200, "Success", {"tokens": out}, extra=extra)
             except (KeyError, TypeError, ValueError) as e:
                 self._send(400, f"bad request: {e}", None)
 
@@ -1395,7 +1449,8 @@ class _MultihostServer:
         self.t_max = t_max
 
     def generate(self, tokens, max_new: int, temperature: float,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0,
+                 stats_out: dict | None = None):
         import jax
         import jax.numpy as jnp
         prompt = jnp.asarray(tokens, jnp.int32)

@@ -49,6 +49,20 @@ int shm_cas(void* p, int64_t expected, int64_t desired) {
              : 0;
 }
 
+// One-crossing histogram observe for the shared-memory metric shards
+// (gpu_docker_api_tpu/obs/shm_metrics.py): bucket cell += 1, sum word
+// += sum_delta, count word += 1 — three SEQ_CST adds on a contiguous
+// [buckets..., sum, count] block. The python side pays one FFI call per
+// observation instead of three; on the data-plane hot path that is the
+// difference between shard telemetry being noise and being a tax.
+void shm_hist_observe(void* hist_base, int64_t bucket_idx,
+                      int64_t n_buckets, int64_t sum_delta) {
+  int64_t* p = static_cast<int64_t*>(hist_base);
+  __atomic_add_fetch(p + bucket_idx, 1, __ATOMIC_SEQ_CST);
+  __atomic_add_fetch(p + n_buckets, sum_delta, __ATOMIC_SEQ_CST);
+  __atomic_add_fetch(p + n_buckets + 1, 1, __ATOMIC_SEQ_CST);
+}
+
 // Wait until the word's low 32 bits differ from `expected` or timeout_ms
 // elapses. Returns 0 on wake, 1 on timeout, 2 on value-already-changed,
 // -1 on error. The word lives in shared memory, so FUTEX_WAIT (not

@@ -569,12 +569,56 @@ def build_spec() -> dict:
              "cooldownSec": {"type": "number"}},
             desc="Backend circuit-breaker state (backend/guard.py); null "
                  "when the daemon runs unguarded"),
+        "WorkerPostmortem": obj(
+            {"worker": i("Worker slot index"),
+             "pid": {"type": "integer", "nullable": True,
+                     "description": "Dead process's pid"},
+             "at": {"type": "number", "description": "Unix seconds of "
+                                                     "the reap"},
+             "reclaimedClaims": i("Replica slot claims the watchdog "
+                                  "subtracted back (reconcile)"),
+             "claimDelta": obj(
+                 {}, additional=obj(
+                     {"claims": i("Held replica-slot claims"),
+                      "queued": i("Held admission-queue tickets")}),
+                 desc="gateway -> what the dead worker still held"),
+             "recorder": arr(
+                 obj({}, additional=True),
+                 "Final flight-recorder segment, read from the dead "
+                 "worker's shared-memory ring (survives SIGKILL — no "
+                 "handler ran in the worker); oldest first, bounded")},
+            desc="Postmortem bundle the watchdog captures when reaping "
+                 "a dead data-plane worker (server/workers.py); also "
+                 "surfaced as a gateway.worker_postmortem event"),
+        "WorkersBlock": obj(
+            {"count": i("Configured worker processes"),
+             "port": i("SO_REUSEPORT data-plane port"),
+             "alive": i(), "respawns": i(),
+             "reclaimedClaims": i("Total claims reconciled from dead "
+                                  "workers"),
+             "telemetry": b("Cross-process telemetry plane armed "
+                            "(shm metric shards + span spooling + "
+                            "flight recorder; obs/shm_metrics.py)"),
+             "postmortems": arr(ref("WorkerPostmortem"),
+                                "Recent dead-worker bundles, oldest "
+                                "first (bounded ring)"),
+             "gateways": obj(
+                 {}, additional=obj(
+                     {"requestsTotal": i(), "shedTotal": i(),
+                      "queued": i(), "inflight": i()}),
+                 desc="Per-gateway data-plane counters from the shared "
+                      "segment")},
+            desc="Multi-process data-plane tier status "
+                 "(server/workers.py describe); null when the tier is "
+                 "off (TDAPI_GW_WORKERS unset/0)"),
         "Healthz": obj(
             {"status": s(enum=["ok", "degraded"]),
              "health": ref("HealthReport"),
              "breaker": {"allOf": [ref("BreakerState")],
                          "nullable": True},
              "workqueue": obj({"pending": i(), "dropped": i()}),
+             "workers": {"allOf": [ref("WorkersBlock")],
+                         "nullable": True},
              "reconcileActions": i("Boot reconcile total; non-zero = the "
                                    "previous daemon died dirty")},
             desc="GET /api/v1/healthz payload (server/app.py h_healthz)"),
@@ -927,7 +971,12 @@ def build_spec() -> dict:
             tags=["meta"],
             desc="status='degraded' when the substrate is unreachable, "
                  "any chip is failing or cordoned, a container is "
-                 "flapping, or the breaker is not closed.")},
+                 "flapping, or the breaker is not closed. With the "
+                 "multi-process data-plane tier on (TDAPI_GW_WORKERS>0) "
+                 "the `workers` block carries per-gateway data-plane "
+                 "counters and the recent dead-worker POSTMORTEM "
+                 "bundles (flight-recorder segment + claim-reconcile "
+                 "delta).")},
         f"{v1}/tpus/{{id}}/cordon": {"post": op(
             "cordonTpu", "Exclude a chip from all future placements",
             envelope(ref("CordonResponse"), {"cordoned": [3]}),
@@ -1091,7 +1140,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.11.0",
+            "version": "0.12.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

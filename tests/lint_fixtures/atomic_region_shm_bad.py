@@ -1,0 +1,25 @@
+"""Seeded violations for the metric-shard half of atomic-region: shard
+counter/histogram words written through raw buffer paths instead of the
+native atomic ops — a plain store races (and can wipe) a worker's
+concurrent fetch_adds into the same cell."""
+
+import struct
+
+SH_CNT_OFF = 144
+
+
+def _sh_cnt_off(s, g, c):
+    return SH_CNT_OFF + (s * 16 + g) * 36 * 8 + c * 8
+
+
+def _sh_lat_off(s, g):
+    return _sh_cnt_off(s, g, 4)
+
+
+class Shards:
+    def bad_pack(self, s, g):
+        struct.pack_into("<q", self.shm.buf, _sh_cnt_off(s, g, 0), 7)
+
+    def bad_slice(self, s, g):
+        off = _sh_lat_off(s, g)
+        self.shm.buf[off:off + 8] = b"\x00" * 8

@@ -1,0 +1,26 @@
+"""Clean twin of atomic_region_shm_bad.py: shard counter words go
+through the atomic ops; raw buffer writes only touch the recorder-ring
+payload region (whose helper is deliberately outside the counter set —
+torn ring entries are skippable by contract)."""
+
+SH_CNT_OFF = 144
+
+
+def _sh_cnt_off(s, g, c):
+    return SH_CNT_OFF + (s * 16 + g) * 36 * 8 + c * 8
+
+
+def _sh_ring_slot_off(s, i):
+    return 40000 + s * 16000 + i * 256
+
+
+class Shards:
+    def good_counter(self, s, g):
+        self.add(_sh_cnt_off(s, g, 0), 1)
+        self.store(_sh_cnt_off(s, g, 1), 0)
+
+    def good_ring_payload(self, s, i, payload):
+        off = _sh_ring_slot_off(s, i)
+        self.store(off, 0)
+        self.shm.buf[off + 8:off + 8 + len(payload)] = payload
+        self.store(off, len(payload))

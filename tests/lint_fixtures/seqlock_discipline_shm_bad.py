@@ -1,0 +1,21 @@
+"""Seeded violations for the metric-shard half of seqlock-discipline:
+spooling I/O (writer write/flush, recorder-ring append) inside the
+per-gateway shard epoch window — a disk stall in the window stalls every
+scrape spinning on the epoch, and a crash parks it odd."""
+
+
+def _sh_epoch_off(g):
+    return 16 + g * 8
+
+
+class Shards:
+    def reset_gateway(self, g):
+        epoch = self.load(_sh_epoch_off(g))
+        odd = epoch + 1 if epoch % 2 == 0 else epoch
+        self.store(_sh_epoch_off(g), odd)
+        try:
+            self._w.write("resetting\n")             # spool write in window
+            self._w.flush()                          # spool flush in window
+            self.recorder.ring_note({"k": "reset"})  # ring append in window
+        finally:
+            self.store(_sh_epoch_off(g), odd + 1)

@@ -1,0 +1,26 @@
+"""Clean twin of seqlock_discipline_shm_bad.py: the shard epoch window
+holds nothing but atomic stores; the spooling happens before/after."""
+
+
+def _sh_epoch_off(g):
+    return 16 + g * 8
+
+
+def _sh_gw_off(s, g):
+    return 144 + (s * 16 + g) * 36 * 8
+
+
+class Shards:
+    def reset_gateway(self, g):
+        self._w.write("about to reset\n")            # outside the window
+        epoch = self.load(_sh_epoch_off(g))
+        odd = epoch + 1 if epoch % 2 == 0 else epoch
+        self.store(_sh_epoch_off(g), odd)
+        try:
+            for s in range(8):
+                base = _sh_gw_off(s, g)
+                for w in range(36):
+                    self.store(base + w * 8, 0)      # atomics only
+        finally:
+            self.store(_sh_epoch_off(g), odd + 1)
+        self._w.flush()                              # outside the window

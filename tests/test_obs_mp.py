@@ -1,0 +1,552 @@
+"""Cross-process telemetry plane sweep (`obs` marker, worker-tier half).
+
+Four layers:
+
+- SHARD MATH: the shm metric shards' fixed-bucket histograms
+  (obs/shm_metrics.py) must aggregate to exactly what the in-process
+  python Histogram computes for the same observations — bucket layout
+  mirroring is the merge's correctness condition;
+- SEQLOCK: a scrape racing a shard reset (gateway slot reassigned) must
+  see the full pre-reset totals or all-zeros, never a torn mix; the
+  recorder ring tolerates torn slots by skipping them;
+- CRASH: SIGKILL a worker mid-request — the watchdog's postmortem
+  bundle carries the dead worker's shm flight-recorder segment and the
+  claim-reconcile delta, surfaced as a `gateway.worker_postmortem`
+  event and in the /healthz workers block;
+- LIVE REST: with a real worker tier, the daemon's /metrics covers
+  worker-served requests under the SAME families as in-process serving
+  (metric-family parity), and GET /api/v1/traces/{id} returns the
+  stitched client -> worker admit/route -> replica trace.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu.events import EventLog
+from gpu_docker_api_tpu.obs import metrics as obs_metrics
+from gpu_docker_api_tpu.obs import shm_metrics
+from gpu_docker_api_tpu.obs import trace
+from gpu_docker_api_tpu.obs.recorder import FlightRecorder
+from gpu_docker_api_tpu.obs.spool import SpanSpool, SpoolTailer
+from gpu_docker_api_tpu.obs.trace import TraceCollector
+
+workers = pytest.importorskip("gpu_docker_api_tpu.server.workers")
+from test_workers import FakeManager, StubReplica, data_call, rep  # noqa: E402
+
+pytestmark = [
+    pytest.mark.obs,
+    pytest.mark.skipif(not workers.available(),
+                       reason="worker tier unavailable "
+                              "(no Linux SO_REUSEPORT / native core)"),
+]
+
+
+@pytest.fixture()
+def shards():
+    st = shm_metrics.MetricShards(create=True)
+    yield st
+    st.close(unlink=True)
+
+
+# ------------------------------------------------------------ shard math
+
+def test_shard_aggregation_matches_python_histogram(shards):
+    """Observations spread across shards must sum to exactly the python
+    Histogram's view of the same values — including boundary values (the
+    le-cumulative contract) and the overflow cell."""
+    h = obs_metrics.Histogram("t_lat", buckets=shm_metrics.LAT_BUCKETS_MS)
+    values = [0.3, 1.0, 1.0001, 7.5, 25.0, 999.0, 2500.0, 99999.0,
+              12.5, 0.0]
+    for i, v in enumerate(values):
+        h.observe(v)
+        shards.observe_latency(i % 3, 0, v)     # 3 shards, one gateway
+    agg = shards.aggregate(0)["lat"]
+    snap = h.snapshot()
+    # cumulative per-bucket equality
+    cum = 0
+    for bound, n in zip(shm_metrics.LAT_BUCKETS_MS, agg["buckets"]):
+        cum += n
+        assert cum == snap["buckets"][bound], bound
+    assert cum + agg["buckets"][-1] == snap["inf"]
+    assert agg["count"] == snap["count"] == len(values)
+    # sums agree to the shard's integer-microsecond resolution
+    assert abs(agg["sumMs"] - snap["sum"]) < 1e-2
+
+
+def test_histogram_extern_merges_shard_cells(shards):
+    """set_extern: shard data merges into the SAME family in-process
+    observations land in — render and snapshot both see the union."""
+    h = obs_metrics.Histogram("t_gw", labels=("gateway",),
+                              buckets=shm_metrics.LAT_BUCKETS_MS)
+    h.observe(5.0, gateway="g")
+    shards.observe_latency(0, 0, 5.0)
+    shards.observe_latency(1, 0, 700.0)
+
+    def extern():
+        lat = shards.aggregate(0)["lat"]
+        return {("g",): (lat["buckets"], lat["sumMs"], lat["count"])}
+
+    h.set_extern(extern)
+    snap = h.snapshot(gateway="g")
+    assert snap["count"] == 3
+    assert abs(snap["sum"] - 710.0) < 1e-2
+    text = "\n".join(h.render())
+    assert 't_gw_count{gateway="g"} 3' in text
+    # clearing the hook restores the in-process-only view
+    h.set_extern(None)
+    assert h.snapshot(gateway="g")["count"] == 1
+
+
+def test_counter_parity_families_present_without_workers(tmp_path):
+    """Family parity, static half: an App with the worker tier OFF still
+    declares every tdapi_gw_worker_* family (and the gateway families),
+    so dashboards built against either serving mode see the same family
+    set — values are just zero/empty."""
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    app = App(state_dir=str(tmp_path / "s"), backend="mock",
+              addr="127.0.0.1:0", topology=make_topology("v5p-8"),
+              api_key="", cpu_cores=4, store_maint_records=0)
+    try:
+        text = app.metrics.render() + obs_metrics.REGISTRY.render()
+        for fam in ("tdapi_gw_workers_alive",
+                    "tdapi_gw_worker_respawns_total",
+                    "tdapi_gw_worker_requests_total",
+                    "tdapi_gw_worker_shed_total",
+                    "tdapi_gw_worker_deadline_total",
+                    "tdapi_gw_worker_retries_total",
+                    "tdapi_gw_worker_queue_wait_ms",
+                    "tdapi_gateway_request_duration_ms"):
+            assert f"# TYPE {fam} " in text, fam
+        app.events.record("tpu.cordon", target="0")   # mirror check
+    finally:
+        app.stop()
+    # the daemon's own flight recorder flushed on graceful stop (the
+    # SIGTERM/atexit half of the recorder contract), mirroring events
+    blob = json.loads((tmp_path / "s" / "recorder-daemon.json")
+                      .read_text())
+    kinds = {e["k"] for e in blob["entries"]}
+    assert "stop" in kinds and "event" in kinds
+
+
+# ------------------------------------------------------------- seqlock
+
+def test_scrape_during_reset_never_torn(shards):
+    """A reset (slot reassignment zeroing every shard's cells) racing a
+    scrape: the aggregate is the FULL pre-reset picture or all-zeros —
+    a mixed read (some shards zeroed, some not; count without matching
+    sum) is exactly the torn read the per-gateway seqlock exists to
+    prevent."""
+    K = 48
+    V = 10.0
+
+    def populate():
+        for i in range(K):
+            s = i % 4
+            shards.inc(s, 0, shm_metrics.C_REQUESTS)
+            shards.observe_latency(s, 0, V)
+
+    bad: list = []
+    for _ in range(60):
+        populate()                       # quiescent: no reader racing
+        results: list = []
+
+        def read_many():
+            for _ in range(15):
+                results.append(shards.aggregate(0))
+
+        t = threading.Thread(target=read_many)
+        t.start()
+        shards.reset_gateway(0)
+        t.join(10)
+        for a in results:
+            c = a["lat"]["count"]
+            req = sum(w["requests"] for w in a["perWorker"])
+            if (c, req) not in ((K, K), (0, 0)) \
+                    or abs(a["lat"]["sumMs"] - c * V) > 1e-2:
+                bad.append((c, req, a["lat"]["sumMs"]))
+    assert not bad, f"torn scrapes: {bad[:5]}"
+
+
+def test_ring_roundtrip_truncation_and_torn_slot(shards):
+    """Ring entries round-trip oldest-first and wrap; an oversized entry
+    is truncated (and skipped on parse failure); a manually-torn slot is
+    skipped rather than crashing the postmortem read."""
+    for i in range(shm_metrics.RING_SLOTS + 5):
+        shards.ring_note(0, {"k": "e", "i": i})
+    got = shards.read_ring(0)
+    assert len(got) == shm_metrics.RING_SLOTS
+    assert got[0]["i"] == 5 and got[-1]["i"] == shm_metrics.RING_SLOTS + 4
+    # oversized payload truncates -> unparseable -> skipped, not raised
+    shards.ring_note(1, {"k": "big", "pad": "x" * 4096})
+    assert shards.read_ring(1) == []
+    # torn slot: garbage bytes with a plausible length word
+    shards.ring_note(2, {"k": "ok"})
+    off = shm_metrics._sh_ring_slot_off(2, 1)
+    shards.shm.buf[off + 8:off + 8 + 4] = b"\xff\xfe\x00{"
+    shards.store(off, 4)
+    shards.add(shm_metrics._sh_ring_off(2), 1)
+    assert [e["k"] for e in shards.read_ring(2)] == ["ok"]
+
+
+def test_flight_recorder_ring_sink_and_flush(shards, tmp_path):
+    """FlightRecorder mirrors notes into the shm ring (the SIGKILL
+    survivor) and flushes its in-memory ring to the postmortem file on
+    graceful exit; a broken sink never fails note()."""
+    rec = FlightRecorder(capacity=32, sink=shards.ring_writer(3))
+    for i in range(4):
+        rec.note("req", gw="g", i=i)
+    assert [e["i"] for e in shards.read_ring(3)] == [0, 1, 2, 3]
+    path = str(tmp_path / "rec.json")
+    assert rec.flush_to(path)
+    blob = json.loads(open(path).read())
+    assert blob["notesTotal"] == 4
+    assert [e["k"] for e in blob["entries"]] == ["req"] * 4
+    broken = FlightRecorder(sink=lambda e: (_ for _ in ()).throw(
+        RuntimeError("segment gone")))
+    broken.note("still", fine=True)      # must not raise
+    assert broken.dump()[-1]["k"] == "still"
+
+
+# ------------------------------------------------------ spool -> merge
+
+def test_span_spool_merges_into_collector(tmp_path):
+    """Worker-side spans spooled to spans-<pid>.jsonl merge into a
+    daemon TraceCollector with trace identity, root finalization, and
+    partial-line tolerance."""
+    spool_dir = tmp_path / "spans"
+    spool_dir.mkdir()
+    spool = SpanSpool(str(spool_dir / "spans-123.jsonl"))
+    tid = trace.new_trace_id()
+    parent = trace.format_traceparent(tid, trace.new_span_id())
+    with trace.root_span(spool, "POST /x/:name/generate",
+                         traceparent=parent, target="g"):
+        with trace.span("gateway.admit", target="g"):
+            pass
+        with trace.span("gateway.forward", target="g") as fsp:
+            fsp.event("replica.queue_wait", ms=1.5)
+    spool.close()
+
+    traces = TraceCollector(None)
+    tailer = SpoolTailer(str(spool_dir), traces)
+    merged = tailer.poll()
+    assert merged == 3
+    t = traces.get(tid)
+    assert t is not None and t["status"] == "ok"
+    ops = {s["op"] for s in t["spans"]}
+    assert {"POST /x/:name/generate", "gateway.admit",
+            "gateway.forward"} <= ops
+    fwd = next(s for s in t["spans"] if s["op"] == "gateway.forward")
+    assert fwd["events"][0] == {"name": "replica.queue_wait",
+                                "t": fwd["events"][0]["t"], "ms": 1.5}
+    # a torn tail line (worker died mid-write) parks until completed
+    with open(spool_dir / "spans-123.jsonl", "a") as f:
+        f.write('{"traceId": "')
+    assert tailer.poll() == 0
+    with open(spool_dir / "spans-123.jsonl", "a") as f:
+        f.write(f'{tid}", "spanId": "{trace.new_span_id()}", '
+                f'"op": "late", "start": 0, "durationMs": 1}}\n')
+    assert tailer.poll() == 1
+
+
+# ------------------------------------------------- crash: postmortem
+
+@pytest.fixture()
+def stub():
+    s = StubReplica()
+    yield s
+    s.close()
+
+
+def test_sigkill_mid_request_yields_postmortem(stub, tmp_path):
+    """SIGKILL the only worker while it holds the replica's slot: the
+    watchdog's reap must surface a gateway.worker_postmortem event whose
+    bundle carries the shm flight-recorder segment (the in-flight
+    request is visible in it — no handler ever ran in the worker) and
+    the claim-reconcile delta."""
+    events = EventLog(None)
+    traces = TraceCollector(None)
+    mgr = FakeManager([{"name": "g", "maxQueue": 8, "deadlineMs": 4000,
+                        "replicas": [rep(stub.port, slots=1)]}])
+    tier = workers.WorkerTier(mgr, n=1, events=events, traces=traces,
+                              spool_dir=str(tmp_path / "spans"))
+    tier.start()
+    try:
+        deadline = time.time() + 15
+        out = {}
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                time.sleep(0.05)
+        assert out.get("code") == 200, out
+        stub.hold.clear()
+        # a client-traced request: ring entries per request are gated on
+        # the traceparent (untraced hot-path cost), so the postmortem's
+        # recorder segment names exactly the traffic an operator can
+        # also look up by trace id
+        tp = trace.format_traceparent(trace.new_trace_id(),
+                                      trace.new_span_id())
+        t = threading.Thread(
+            target=lambda: data_call(tier.port, timeout=3,
+                                     headers={"traceparent": tp}))
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and stub.inflight == 0:
+            time.sleep(0.02)
+        assert stub.inflight == 1
+        tier.procs[0].kill()
+        t.join(10)
+        stub.hold.set()
+        deadline = time.time() + 10
+        while time.time() < deadline and not tier.postmortems:
+            time.sleep(0.05)
+        assert tier.postmortems, "watchdog never captured a postmortem"
+        pm = tier.postmortems[-1]
+        assert pm["worker"] == 0
+        assert pm["reclaimedClaims"] >= 1
+        assert pm["claimDelta"].get("g", {}).get("claims", 0) >= 1
+        kinds = [e.get("k") for e in pm["recorder"]]
+        assert "req" in kinds, kinds     # the in-flight request survived
+        assert "boot" in kinds or len(kinds) >= 1
+        evts = [e for e in events.recent(limit=50)
+                if e["op"] == "gateway.worker_postmortem"]
+        assert evts and evts[-1]["target"] == "worker-0"
+        assert evts[-1]["reclaimed"] >= 1
+        assert tier.describe()["postmortems"]
+    finally:
+        tier.stop()
+
+
+def test_respawn_preserves_cumulative_counters(stub):
+    """A worker respawn must not reset its shard (counters are
+    cumulative per SLOT): totals stay monotonic across the kill, so a
+    scrape during respawn never sees the data plane's history vanish."""
+    mgr = FakeManager([{"name": "g", "maxQueue": 8, "deadlineMs": 4000,
+                        "replicas": [rep(stub.port, slots=2)]}])
+    tier = workers.WorkerTier(mgr, n=1)
+    tier.start()
+    try:
+        deadline = time.time() + 15
+        served = 0
+        while time.time() < deadline and served < 5:
+            try:
+                _, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    served += 1
+            except OSError:
+                time.sleep(0.05)
+        assert served == 5
+        before = tier.per_worker_counts()["g"][0]["requests"]
+        assert before >= 5
+        tier.procs[0].kill()
+        deadline = time.time() + 10
+        while time.time() < deadline and tier.respawns < 1:
+            time.sleep(0.05)
+        assert tier.per_worker_counts()["g"][0]["requests"] >= before
+        deadline = time.time() + 10
+        out = {}
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(tier.port)
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                time.sleep(0.05)
+        assert out.get("code") == 200
+        assert tier.per_worker_counts()["g"][0]["requests"] > before
+    finally:
+        tier.stop()
+
+
+# --------------------------------------------- live REST e2e (slowish)
+
+class TelemetryStubReplica(StubReplica):
+    """StubReplica speaking the full telemetry contract: traceparent
+    echo + X-TDAPI-Queue-Wait-Ms on responses (mock_model/serve.py
+    parity) — what the worker stitches into its forward span."""
+
+    def __init__(self):
+        super().__init__()
+        self.srv.RequestHandlerClass = self._wrap(
+            self.srv.RequestHandlerClass)
+
+    @staticmethod
+    def _wrap(base):
+        class H(base):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(n)
+                body = b'{"code":200,"msg":"ok","data":{"tokens":[[1]]}}'
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    tp = self.headers.get("traceparent")
+                    if tp:
+                        self.send_header("traceparent", tp)
+                    self.send_header("X-TDAPI-Queue-Wait-Ms", "2.25")
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+        return H
+
+
+def _api(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    try:
+        payload = json.dumps(body).encode() if body is not None else b""
+        conn.request(method, path, payload,
+                     {"Content-Type": "application/json",
+                      **(headers or {})})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return {"raw": raw.decode("utf-8", "replace")}
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def telemetry_app(tmp_path):
+    from gpu_docker_api_tpu.gateway import READY, GatewayConfig
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    replica = TelemetryStubReplica()
+    app = App(state_dir=str(tmp_path / "state"), backend="mock",
+              addr="127.0.0.1:0", port_range=(47200, 47300),
+              topology=make_topology("v5p-8"), api_key="", cpu_cores=8,
+              store_maint_records=0, gw_workers=4)
+    app.start()
+    try:
+        assert app.workers is not None
+        app.gateways.create(GatewayConfig(
+            name="gw", image="img", cmd=["serve"],
+            minReplicas=1, maxReplicas=2, readiness="running",
+            scaleDownIdleS=3600, deadlineMs=4000, maxQueue=16))
+        gw = app.gateways.get("gw")
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                r.state is READY for r in gw.replicas.values()):
+            time.sleep(0.05)
+        with gw._cond:
+            for r in gw.replicas.values():
+                r.host_port = replica.port
+        app.workers.poke()
+        deadline = time.time() + 15
+        out = {}
+        while time.time() < deadline:
+            try:
+                _, _, out = data_call(app.workers.port, name="gw")
+                if out.get("code") == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        assert out.get("code") == 200, out
+        yield app, replica
+    finally:
+        app.stop()
+        replica.close()
+
+
+def test_e2e_trace_daemon_worker_replica(telemetry_app):
+    """The acceptance walk: a data-plane request with a client
+    traceparent, served by a WORKER process, shows up at the daemon's
+    GET /api/v1/traces/{id} as the stitched chain — worker ingress root
+    honoring the client trace id, admit + forward children, and the
+    replica's queue-wait as a span event on the forward."""
+    app, _ = telemetry_app
+    tid = trace.new_trace_id()
+    parent = trace.format_traceparent(tid, trace.new_span_id())
+    _, _, out = data_call(app.workers.port, name="gw",
+                          headers={"traceparent": parent})
+    assert out.get("code") == 200, out
+    t = None
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        got = _api(app.server.port, "GET", f"/api/v1/traces/{tid}")
+        if got.get("code") == 200:
+            t = got["data"]["trace"]
+            if len(t["spans"]) >= 3:
+                break
+        time.sleep(0.1)
+    assert t is not None, "worker spans never merged into the daemon"
+    ops = {s["op"] for s in t["spans"]}
+    assert "POST /api/v1/gateways/:name/generate" in ops
+    assert "gateway.admit" in ops and "gateway.forward" in ops
+    fwd = next(s for s in t["spans"] if s["op"] == "gateway.forward")
+    evs = {e["name"]: e for e in fwd.get("events", [])}
+    assert "replica.queue_wait" in evs
+    assert evs["replica.queue_wait"]["ms"] == 2.25
+    # the tree hangs together: admit/forward nest under the worker root
+    root = next(s for s in t["spans"]
+                if s["op"] == "POST /api/v1/gateways/:name/generate")
+    assert fwd["parentId"] == root["spanId"]
+    # and the summary list knows the trace
+    lst = _api(app.server.port, "GET",
+               "/api/v1/traces?op=generate&limit=10")
+    assert any(r["traceId"] == tid
+               for r in lst["data"]["traces"])
+
+
+def test_metric_family_parity_and_truthful_latency(telemetry_app):
+    """Family parity, dynamic half: worker-served requests land in the
+    SAME tdapi_gateway_* families the in-process path feeds — the
+    duration family's count covers worker traffic, the gw_worker_*
+    families attribute it per worker, and /healthz carries the workers
+    block."""
+    app, _ = telemetry_app
+    for _ in range(6):
+        _, _, out = data_call(app.workers.port, name="gw")
+        assert out.get("code") == 200
+    deadline = time.time() + 5
+    text = ""
+    while time.time() < deadline:
+        text = _api(app.server.port, "GET", "/metrics")["raw"]
+        if 'tdapi_gateway_request_duration_ms_count{gateway="gw"}' in text:
+            count = int([
+                ln for ln in text.splitlines()
+                if ln.startswith(
+                    'tdapi_gateway_request_duration_ms_count'
+                    '{gateway="gw"}')][0].split()[-1])
+            if count >= 7:
+                break
+        time.sleep(0.1)
+    assert 'tdapi_gateway_request_duration_ms_count{gateway="gw"}' in text
+    count = int([ln for ln in text.splitlines()
+                 if ln.startswith('tdapi_gateway_request_duration_ms_'
+                                  'count{gateway="gw"}')][0].split()[-1])
+    assert count >= 7            # the fixture's probe + our 6
+    assert 'tdapi_gateway_requests_total{gateway="gw"}' in text
+    # per-worker attribution exists and sums to at least our traffic
+    wk_lines = [ln for ln in text.splitlines()
+                if ln.startswith("tdapi_gw_worker_requests_total{")]
+    assert wk_lines
+    assert sum(int(ln.split()[-1]) for ln in wk_lines) >= 7
+    assert "tdapi_gw_workers_alive 4" in text
+    # queue-wait histogram is fed
+    assert 'tdapi_gw_worker_queue_wait_ms_count{gateway="gw"}' in text
+    # healthz workers block: telemetry armed, postmortems list present
+    hz = _api(app.server.port, "GET", "/api/v1/healthz")["data"]
+    assert hz["workers"]["telemetry"] is True
+    assert hz["workers"]["postmortems"] == []
+    # family parity with the workers-off mode is pinned by
+    # test_counter_parity_families_present_without_workers — here the
+    # worker-mode exposition must carry the same family declarations
+    for fam in ("tdapi_gw_worker_shed_total",
+                "tdapi_gw_worker_deadline_total",
+                "tdapi_gw_worker_retries_total"):
+        assert f"# TYPE {fam} " in text, fam

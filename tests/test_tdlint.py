@@ -125,16 +125,47 @@ def test_atomic_region_fires_and_clean_twin_silent():
     assert _lint(["atomic_region_ok.py"], ["atomic-region"]) == []
 
 
-def test_shm_rules_scoped_to_workers_only():
-    """The three shm rules reason about server/workers.py's segment
+def test_shm_rules_scoped_to_shm_modules_only():
+    """The shm rules reason about the two shm-segment modules' layout
     discipline; other scoped files must not be walked by them (their
-    helper names could collide)."""
+    helper names could collide). claim-order stays workers.py-only —
+    the claim ledger does not exist in the metric shards."""
     from tools.tdlint.rules import AtomicRegion, ClaimOrder, \
         SeqlockDiscipline
     for rule in (SeqlockDiscipline(), ClaimOrder(), AtomicRegion()):
         assert rule.applies("gpu_docker_api_tpu/server/workers.py")
         assert not rule.applies("gpu_docker_api_tpu/gateway.py")
         assert not rule.applies("gpu_docker_api_tpu/store/mvcc.py")
+    for rule in (SeqlockDiscipline(), AtomicRegion()):
+        assert rule.applies("gpu_docker_api_tpu/obs/shm_metrics.py")
+    assert not ClaimOrder().applies("gpu_docker_api_tpu/obs/shm_metrics.py")
+
+
+def test_seqlock_discipline_shm_shard_fires_and_clean_twin_silent():
+    """The metric-shard extension: spool write/flush and recorder-ring
+    appends inside a shard epoch window (closed via _sh_epoch_off) fire;
+    the atomics-only reset with spooling outside the window is silent."""
+    vs = _lint(["seqlock_discipline_shm_bad.py"], ["seqlock-discipline"])
+    assert len(vs) == 3
+    msgs = " | ".join(v.message for v in vs)
+    assert "spool/file I/O '.write()'" in msgs
+    assert "spool/file I/O '.flush()'" in msgs
+    assert "recorder ring write '.ring_note()'" in msgs
+    assert _lint(["seqlock_discipline_shm_ok.py"],
+                 ["seqlock-discipline"]) == []
+
+
+def test_atomic_region_shm_shard_fires_and_clean_twin_silent():
+    """The metric-shard extension: raw pack_into / slice writes into
+    _sh_* counter-region offsets fire; atomic-op writes and raw writes
+    into the recorder-ring payload region (helper outside the counter
+    set by design) are silent."""
+    vs = _lint(["atomic_region_shm_bad.py"], ["atomic-region"])
+    assert len(vs) == 2
+    msgs = " | ".join(v.message for v in vs)
+    assert "struct.pack_into targeting a counter-region offset" in msgs
+    assert "raw buffer slice assignment into the counter region" in msgs
+    assert _lint(["atomic_region_shm_ok.py"], ["atomic-region"]) == []
 
 
 def test_claim_order_ignores_non_inflight_cells():

@@ -695,16 +695,29 @@ class UntracedOp(Rule):
 # reason about. These three rules encode the shm segment's discipline
 # lexically, the same way unlocked-state encodes the lock discipline;
 # tdcheck (tools/tdcheck) is the dynamic half of the same defense.
+# PR 15's metric shards (obs/shm_metrics.py) are a second segment under
+# the SAME discipline: seqlock-discipline and atomic-region cover both.
+
+#: the shm-segment modules the lexical shm rules reason about
+SHM_MODULES = ("server/workers.py", "obs/shm_metrics.py")
 
 #: offset-helper names addressing the lock-free COUNTER region — cells
-#: that must only ever be touched through the atomic ops
+#: that must only ever be touched through the atomic ops. The _sh_*
+#: helpers address the metric-shard segment's counter/histogram words
+#: (obs/shm_metrics.py); its recorder-ring payload helpers
+#: (_sh_ring_slot_off) are deliberately NOT here — ring payload bytes
+#: are raw-written by contract (torn entries are skippable).
 COUNTER_OFF_HELPERS = frozenset({
     "_gw_cnt_off", "_rep_cnt_off", "_wk_claim_off", "_wk_queued_off",
     "_wk_off",
+    "_sh_gw_off", "_sh_cnt_off", "_sh_lat_off", "_sh_qw_off",
 })
-COUNTER_OFF_NAMES = frozenset({"CNT_OFF", "WK_OFF"})
-#: the seqlock epoch word's offset constant (publish-window anchor)
+COUNTER_OFF_NAMES = frozenset({"CNT_OFF", "WK_OFF", "SH_CNT_OFF"})
+#: the seqlock epoch word: a named offset constant (workers.py roster
+#: epoch) or a per-slot epoch-offset helper (shm_metrics.py per-gateway
+#: shard epochs)
 EPOCH_NAME = "HDR_OFF_EPOCH"
+EPOCH_OFF_HELPERS = frozenset({"_sh_epoch_off"})
 
 
 def _exact_helper_call(node: ast.AST,
@@ -750,23 +763,29 @@ def _mentions_counter_offset(node: ast.AST,
 
 class SeqlockDiscipline(Rule):
     name = "seqlock-discipline"
-    description = ("blocking work (backend op, store write, sleep, open, "
-                   "fsync, futex wait, logging) inside the seqlock "
-                   "publish window — every reader spins for the window's "
-                   "whole duration, and a crash inside it parks the "
-                   "epoch odd")
+    description = ("blocking work (backend op, store write, spool write, "
+                   "sleep, open, fsync, futex wait, logging) inside the "
+                   "seqlock publish window — every reader spins for the "
+                   "window's whole duration, and a crash inside it parks "
+                   "the epoch odd")
 
     def applies(self, rel: str) -> bool:
-        return rel.endswith("server/workers.py")
+        return rel.endswith(SHM_MODULES)
 
     @staticmethod
     def _is_epoch_store(node: ast.AST) -> bool:
-        """`<x>.store(HDR_OFF_EPOCH, ...)` — the window's closing store."""
-        return (isinstance(node, ast.Call)
+        """`<x>.store(HDR_OFF_EPOCH, ...)` or
+        `<x>.store(_sh_epoch_off(g), ...)` — a window's closing store."""
+        if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "store" and node.args
-                and isinstance(node.args[0], ast.Name)
-                and node.args[0].id == EPOCH_NAME)
+                and node.func.attr == "store" and node.args):
+            return False
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id == EPOCH_NAME:
+            return True
+        return (isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id in EPOCH_OFF_HELPERS)
 
     def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
         out: list[Violation] = []
@@ -806,6 +825,16 @@ class SeqlockDiscipline(Rule):
         if isinstance(f, ast.Attribute):
             if f.attr in ("futex_wait", "wait"):
                 return f"blocking '.{f.attr}()'"
+            if f.attr in ("write", "flush"):
+                # spooling/telemetry file I/O (RotatingWriter.write,
+                # SpanSpool flushes, raw file handles): a disk stall
+                # inside the window stalls every reader with it
+                return f"spool/file I/O '.{f.attr}()'"
+            if f.attr == "ring_note":
+                # recorder-ring appends serialize JSON and memcpy the
+                # payload — telemetry work that belongs outside the
+                # window, like every other spooling write
+                return "recorder ring write '.ring_note()'"
             if (isinstance(f.value, ast.Name) and f.value.id == "log"):
                 return f"logging call 'log.{f.attr}()'"
         return None
@@ -890,7 +919,7 @@ class AtomicRegion(Rule):
                    "fetch_adds")
 
     def applies(self, rel: str) -> bool:
-        return rel.endswith("server/workers.py")
+        return rel.endswith(SHM_MODULES)
 
     def check_file(self, ctx: FileCtx) -> Iterable[Violation]:
         out: list[Violation] = []
