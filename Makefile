@@ -8,7 +8,7 @@ STATE ?= ./tpu-docker-api-state
 .PHONY: all native native-san test test-fast verify-crash verify-faults \
     verify-perf verify-retry verify-migrate verify-mt verify-races \
     verify-obs verify-gateway verify-gang verify-workers verify-tdcheck \
-    bench serve serve-mock dryrun apidoc lint clean
+    verify-fed bench serve serve-mock dryrun apidoc lint clean
 
 all: native
 
@@ -33,6 +33,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-gang    (elastic gang / reshard sweep: -m gang)"
 	@echo "  make verify-workers (multi-process data-plane sweep: -m workers)"
 	@echo "  make verify-tdcheck (cross-process protocol model-check: -m tdcheck)"
+	@echo "  make verify-fed     (federated control-plane sweep: -m fed)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -70,6 +71,9 @@ verify-workers: native  ## multi-process data-plane sweep: policy parity, kill/r
 
 verify-tdcheck: native  ## cross-process protocol model-check: interleaving + kill sweep, mutant liveness
 	$(PY) -m pytest tests/ -q -m tdcheck
+
+verify-fed:             ## federated control plane: leases, takeover models, list+watch, SIGKILL e2e
+	$(PY) -m pytest tests/ -q -m fed
 
 lint: native            ## compile baseline + tdlint rules (stale pragmas fail) + rule/checker liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

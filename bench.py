@@ -2136,6 +2136,148 @@ def obs_mp_bench() -> dict:
         shutil.rmtree(state_dir, ignore_errors=True)
 
 
+def federation_bench() -> dict:
+    """Federated control plane (docs/federation.md): grant-acquire
+    throughput as the member count scales 1->2->4, SIGKILL-style
+    takeover heal latency against the TTL+heartbeat bound, and watch
+    fan-out to 1k informer-style subscribers with a per-subscriber
+    gapless-delivery audit. Headlines: fed_takeover_ms,
+    fed_dropped_revisions (the FW1 invariant, must be 0) and
+    fed_grant_scale (4-member vs 1-member grant rate — the arbiter is
+    ONE lock over one store by design, the honest single point where
+    the reference has etcd, so ~1.0 is the expected shape; the number
+    is here to catch it ever getting WORSE than flat)."""
+    import threading
+
+    from gpu_docker_api_tpu.federation import (FleetArbiter, FleetMember,
+                                               HashRing, WatchHub,
+                                               WatchedStore)
+    from gpu_docker_api_tpu.store.client import ResourcePrefix
+    from gpu_docker_api_tpu.store.mvcc import MVCCStore
+
+    out: dict = {}
+
+    # ---- grant throughput, 1 -> 2 -> 4 members -------------------------
+    n_names = 1200
+    names = [f"rs{i}" for i in range(n_names)]
+    sweep = {}
+    for n in (1, 2, 4):
+        arb = FleetArbiter(MVCCStore(), ttl=60.0)
+        members = [f"m{i}" for i in range(n)]
+        for m in members:
+            arb.join(m, addr=f"host{m}:2378")
+        # each member acquires exactly the slice the ring assigns it —
+        # the production access pattern (guard_mutation's fast path)
+        mine = {m: [nm for nm in names
+                    if HashRing.owner_of(f"containers/{nm}",
+                                         set(members)) == m]
+                for m in members}
+
+        def worker(m):
+            for nm in mine[m]:
+                arb.acquire("containers", nm, m)
+
+        threads = [threading.Thread(target=worker, args=(m,))
+                   for m in members]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert len(arb.grants()) == n_names
+        sweep[f"m{n}"] = {"grants_per_sec": round(n_names / dt),
+                          "members": n}
+    out["grants"] = sweep
+    out["fed_grant_scale"] = round(
+        sweep["m4"]["grants_per_sec"] / sweep["m1"]["grants_per_sec"], 2)
+
+    # ---- takeover heal latency ----------------------------------------
+    # b joins, owns its slice, then is "SIGKILLed" (simply never renews);
+    # a heartbeats at ttl/3 and must adopt every orphan. The measured
+    # wall (kill -> last grant adopted) is checked against the documented
+    # bound: one TTL (b's lease must expire) + one heartbeat round.
+    ttl, beat = 0.5, 0.1
+    arb = FleetArbiter(MVCCStore(), ttl=ttl)
+    a = FleetMember("a", arb, addr="hosta:2378")
+    a.start(interval=beat)
+    try:
+        arb.join("b", addr="hostb:2378")
+        victims = [f"rs{i}" for i in range(16)
+                   if HashRing.owner_of(f"containers/rs{i}",
+                                        {"a", "b"}) == "b"][:8]
+        for nm in victims:
+            arb.acquire("containers", nm, "b")
+        t_kill = time.perf_counter()   # b's last sign of life
+        deadline = t_kill + 30.0
+        while time.perf_counter() < deadline:
+            if all(g["holder"] == "a" for g in arb.grants()):
+                break
+            time.sleep(0.01)
+        healed = [g["holder"] for g in arb.grants()]
+        assert healed and all(h == "a" for h in healed), healed
+        takeover_ms = (time.perf_counter() - t_kill) * 1e3
+    finally:
+        a.stop()
+    out["takeover"] = {
+        "orphans": len(victims), "ttl_s": ttl, "heartbeat_s": beat,
+        "fed_takeover_ms": round(takeover_ms, 1),
+        "bound_ms": round((ttl + beat) * 1e3 * 1.5, 1),
+        "within_bound": takeover_ms <= (ttl + beat) * 1e3 * 1.5,
+    }
+
+    # ---- watch fan-out + gapless audit --------------------------------
+    # 1k informer-style subscribers against one hub (the 10k documented
+    # target scales linearly — 1k keeps this section inside the bench
+    # budget on a 1-core box); every subscriber must see every revision
+    # exactly once, in order: drops+dups is the FW1 invariant and the
+    # fed_dropped_revisions headline, not a best-effort stat.
+    n_subs, n_events = 1000, 1000
+    hub = WatchHub(capacity=n_events * 4)
+    store = WatchedStore(MVCCStore(), hub)
+    base = ResourcePrefix.Base
+    rev0 = store.revision
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        store.put(f"{base}/containers/n{i % 64}", f'{{"i": {i}}}')
+    write_s = time.perf_counter() - t0
+    expected = list(range(rev0 + 1, rev0 + 1 + n_events))
+    cursors = [rev0] * n_subs
+    bad = 0
+    delivered = 0
+    t0 = time.perf_counter()
+    for si in range(n_subs):
+        seen = []
+        while True:
+            evs = hub.events_since(cursors[si], "containers")
+            if not evs:
+                break
+            for e in evs:
+                if e["revision"] <= cursors[si]:
+                    bad += 1        # duplicate
+                cursors[si] = e["revision"]
+                seen.append(e["revision"])
+            delivered += len(evs)
+        if seen != expected:
+            bad += 1                # dropped / reordered
+    fan_s = time.perf_counter() - t0
+    out["watch"] = {
+        "subscribers": n_subs, "events": n_events,
+        "write_events_per_sec": round(n_events / write_s),
+        "fanout_deliveries_per_sec": round(delivered / fan_s),
+        "fed_dropped_revisions": bad,
+        "note": "10k subscribers is the documented target; deliveries "
+                "scale linearly in subscriber count (one events_since "
+                "scan per subscriber)",
+    }
+    log(f"federation: grant scale {out['fed_grant_scale']}x, takeover "
+        f"{out['takeover']['fed_takeover_ms']}ms (bound "
+        f"{out['takeover']['bound_ms']}ms), fan-out "
+        f"{out['watch']['fanout_deliveries_per_sec']:,}/s, dropped "
+        f"revisions {bad} (criterion == 0)")
+    return out
+
+
 def check_claims(extra: dict) -> dict:
     """Diff this run's extras against BASELINE.json's machine-readable
     claims table (the same numbers BASELINE.md publishes). Any ratio
@@ -2327,6 +2469,10 @@ def main() -> None:
     run_section(extra, "obs_mp", obs_mp_bench,
                 note="cross-process telemetry overhead bench (worker "
                      "tier telemetry armed vs disarmed, paired)...")
+    run_section(extra, "federation", federation_bench,
+                note="federation bench (grant throughput 1->2->4 "
+                     "members, takeover heal latency, 1k-subscriber "
+                     "watch fan-out + gapless audit)...")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
@@ -2448,6 +2594,13 @@ def build_summary(p50, platform, vs, extra) -> dict:
             "gw_mp_obs_overhead_pct": _dig("obs_mp",
                                            "gw_mp_obs_overhead_pct"),
             "store_native_speedup": _dig("store", "store_native_speedup"),
+            # federation headlines (docs/federation.md): heal latency,
+            # the FW1 zero-drop audit, and grant-rate scaling shape
+            "fed_takeover_ms": _dig("federation", "takeover",
+                                    "fed_takeover_ms"),
+            "fed_dropped_revisions": _dig("federation", "watch",
+                                          "fed_dropped_revisions"),
+            "fed_grant_scale": _dig("federation", "fed_grant_scale"),
             "claims_ok": _dig("claims", "ok"),
             "claims_failed": len(_dig("claims", "failed", default=[]) or []),
         },

@@ -87,6 +87,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="explicit data-plane port for --gw-workers "
                         "(default: TDAPI_GW_DATA_PORT env, else pick a "
                         "free one; see /api/v1/healthz workers.port)")
+    p.add_argument("--fleet-member", default=None, metavar="ID",
+                   help="join a multi-daemon fleet under this member id: "
+                        "lease heartbeats, hash-ring resource ownership, "
+                        "takeover of dead members' slices (default: "
+                        "TDAPI_FLEET_MEMBER env, else single-daemon)")
+    p.add_argument("--fleet-host", default=None, metavar="HOST:PORT",
+                   help="the daemon hosting the fleet arbiter (default: "
+                        "TDAPI_FLEET_HOST env, else this daemon hosts "
+                        "its own — the fleet's one shared point, like "
+                        "the reference's etcd endpoint)")
+    p.add_argument("--fleet-ttl", type=float, default=None, metavar="SEC",
+                   help="fleet lease TTL; heartbeat runs at TTL/3 "
+                        "(default: TDAPI_FLEET_TTL env, else 5)")
+    p.add_argument("--cpu-cores", type=int, default=None, metavar="N",
+                   help="override the schedulable core count (default: "
+                        "probe /proc/cpuinfo; mock-backend fleets on "
+                        "small hosts need more cores than exist)")
     return p
 
 
@@ -122,7 +139,11 @@ def main(argv=None) -> int:
               health_interval=args.health_interval,
               auto_cordon=not args.no_auto_cordon,
               gw_workers=args.gw_workers,
-              gw_data_port=args.gw_data_port)
+              gw_data_port=args.gw_data_port,
+              fleet_member=args.fleet_member,
+              fleet_host=args.fleet_host,
+              fleet_ttl=args.fleet_ttl,
+              cpu_cores=args.cpu_cores)
     app.start()
 
     status = app.tpu.get_status()

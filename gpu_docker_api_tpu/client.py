@@ -46,6 +46,37 @@ class ApiError(RuntimeError):
         self.trace_id = trace_id
 
 
+class EventGapError(RuntimeError):
+    """The event ring evicted past a Last-Event-ID resume point: events
+    between `last_event_id` and `first_retained` are GONE, and the server
+    said so (`event: gap`) instead of silently serving the survivors.
+    Refetch state (GET the resources you mirror), then re-follow from
+    now — the stream after this error would be complete but the hole
+    before it cannot be closed."""
+
+    def __init__(self, last_event_id: int, first_retained: int):
+        super().__init__(
+            f"event stream gap: resumed from seq {last_event_id} but the "
+            f"ring starts at {first_retained} — events in between were "
+            f"evicted; refetch state and re-follow")
+        self.last_event_id = last_event_id
+        self.first_retained = first_retained
+
+
+class RelistRequiredError(RuntimeError):
+    """The watch stream cannot serve `from_revision`: it predates the
+    server's retention floor (refused up front) or the ring lapped this
+    follower mid-stream (`event: gap`). Take a fresh list snapshot and
+    resume from its revision — `Informer` does this automatically."""
+
+    def __init__(self, floor: int, from_revision: int = -1):
+        super().__init__(
+            f"watch revision too old (floor {floor}): relist and resume "
+            f"from the snapshot revision")
+        self.floor = floor
+        self.from_revision = from_revision
+
+
 class SchemaError(ValueError):
     """Request body rejected by the spec BEFORE sending."""
 
@@ -536,22 +567,238 @@ class ApiClient:
                 raise ApiError(resp.status, "event stream refused",
                                "follow_events")
             data_lines: list[str] = []
+            event_type = ""
             while True:
                 raw = resp.readline()
                 if not raw:          # server closed (drain/shutdown)
                     return
                 line = raw.decode("utf-8").rstrip("\r\n")
                 if not line:         # frame boundary
+                    if event_type == "gap":
+                        # ring overrun on resume: the events between our
+                        # Last-Event-ID and the ring's tail were evicted
+                        # — typed error, never a silent hole
+                        info = json.loads("\n".join(data_lines) or "{}")
+                        raise EventGapError(
+                            int(info.get("lastEventId",
+                                         last_event_id or -1)),
+                            int(info.get("firstRetained", 0)))
                     if data_lines:
                         yield json.loads("\n".join(data_lines))
-                        data_lines = []
+                    data_lines = []
+                    event_type = ""
                     continue
                 if line.startswith(":"):
                     if yield_heartbeats:
                         yield {"heartbeat": True}
+                elif line.startswith("event:"):
+                    event_type = line[6:].strip()
                 elif line.startswith("data:"):
                     data_lines.append(line[5:].strip())
                 # id:/retry: fields ride inside the data JSON (seq) — no
                 # separate bookkeeping needed here
         finally:
             conn.close()
+
+    # ---- list+watch on MVCC revisions (federation watch plane) ----
+
+    def list_resource(self, resource: str) -> tuple[int, list[dict]]:
+        """Atomic `(revision, items)` snapshot of one resource — the
+        revision is an exact watch resume point for that item set."""
+        path = ("/api/v1/watch?list=1&"
+                + urllib.parse.urlencode({"resource": resource}))
+        data = self._envelope(self._raw("GET", path),
+                              "list_resource").get("data") or {}
+        return int(data.get("revision", 0)), list(data.get("items", []))
+
+    def watch(self, resource: str = "",
+              from_revision: Optional[int] = None,
+              heartbeat: Optional[float] = None,
+              yield_heartbeats: bool = False) -> Iterator[dict]:
+        """Generator over `GET /api/v1/watch` (SSE): yields
+        `{revision, resource, name, type, value}` events in exact
+        revision order, from `from_revision` (exclusive; default = now).
+        Raises RelistRequiredError when the resume point predates the
+        server's retention floor or the server evicts past this follower
+        mid-stream — list_resource() then yields a fresh snapshot whose
+        revision is the new resume point (Informer automates the loop).
+        Dedicated connection, like follow_events."""
+        hb = heartbeat if heartbeat is not None else 15.0
+        if not 0.0 <= hb <= 3600.0:
+            hb = 3600.0
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=max(self.timeout, 2.0 * hb + 10.0))
+        q: dict[str, Any] = {}
+        if resource:
+            q["resource"] = resource
+        if from_revision is not None:
+            q["fromRevision"] = int(from_revision)
+        if heartbeat is not None:
+            q["heartbeat"] = heartbeat
+        path = "/api/v1/watch" + ("?" + urllib.parse.urlencode(q)
+                                  if q else "")
+        headers: dict[str, str] = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        try:
+            conn.request("GET", path, None, headers)
+            resp = conn.getresponse()
+            ct = resp.getheader("Content-Type") or ""
+            if resp.status != 200 or "text/event-stream" not in ct:
+                body = resp.read(65536)
+                try:
+                    self._envelope(body, "watch")
+                except ApiError as e:
+                    if e.code == 1036:    # WatchCompacted: relist
+                        try:
+                            floor = json.loads(body)["data"]["floor"]
+                        except Exception:  # noqa: BLE001
+                            floor = 0
+                        raise RelistRequiredError(
+                            int(floor), int(from_revision or -1)) from e
+                    raise
+                raise ApiError(resp.status, "watch stream refused",
+                               "watch")
+            data_lines: list[str] = []
+            event_type = ""
+            while True:
+                raw = resp.readline()
+                if not raw:
+                    return               # server closed (drain/shutdown)
+                line = raw.decode("utf-8").rstrip("\r\n")
+                if not line:
+                    if event_type == "gap":
+                        info = json.loads("\n".join(data_lines) or "{}")
+                        raise RelistRequiredError(
+                            int(info.get("floor", 0)),
+                            int(from_revision or -1))
+                    if data_lines:
+                        yield json.loads("\n".join(data_lines))
+                    data_lines = []
+                    event_type = ""
+                    continue
+                if line.startswith(":"):
+                    if yield_heartbeats:
+                        yield {"heartbeat": True}
+                elif line.startswith("event:"):
+                    event_type = line[6:].strip()
+                elif line.startswith("data:"):
+                    data_lines.append(line[5:].strip())
+        finally:
+            conn.close()
+
+
+class Informer:
+    """Client-side list+watch cache over one resource.
+
+    The kube-style informer loop on this API's watch plane: one atomic
+    list snapshot seeds the cache at an exact revision, then the SSE
+    watch applies every mutation after it in revision order. On ANY
+    break — connection loss, daemon death, `revision too old`, a
+    mid-stream gap — the informer rotates to the next endpoint and
+    resumes from its last-seen revision; only when the server refuses
+    that resume (compaction, or a different daemon's revision space
+    after a fleet takeover) does it relist. The cache therefore survives
+    daemon takeover: `revisions` records every applied revision so a
+    test can assert the sequence is strictly increasing and gapless
+    within one server's stream.
+    """
+
+    def __init__(self, endpoints: list[tuple[str, int]], resource: str,
+                 api_key: str = "", heartbeat: float = 0.5,
+                 retry_delay: float = 0.2):
+        if not endpoints:
+            raise ValueError("Informer needs at least one endpoint")
+        self.endpoints = list(endpoints)
+        self.resource = resource
+        self.api_key = api_key
+        self.heartbeat = heartbeat
+        self.retry_delay = retry_delay
+        self.cache: dict[str, dict] = {}
+        self.revision = 0
+        self.revisions: list[int] = []   # every applied revision, in order
+        self.relists = 0
+        self.rotations = 0
+        self._idx = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ---- one protocol step each; the thread just loops them ----
+
+    def _conn(self) -> "ApiClient":
+        host, port = self.endpoints[self._idx % len(self.endpoints)]
+        # spec-less construction: the watch surface is fixed, fetching
+        # /openapi.json per rotation would triple the reconnect cost
+        return ApiClient(host, port, spec={"paths": {}},
+                         api_key=self.api_key, timeout=10.0)
+
+    def _rotate(self) -> None:
+        self._idx += 1
+        self.rotations += 1
+
+    def _apply(self, evt: dict) -> None:
+        with self._lock:
+            rev = int(evt["revision"])
+            self.revision = rev
+            self.revisions.append(rev)
+            if evt["type"] == "delete":
+                self.cache.pop(evt["name"], None)
+            else:
+                self.cache[evt["name"]] = {"value": evt["value"],
+                                           "modRevision": rev}
+
+    def relist(self, client: "ApiClient") -> None:
+        rev, items = client.list_resource(self.resource)
+        with self._lock:
+            self.cache = {i["name"]: {"value": i["value"],
+                                      "modRevision": i["modRevision"]}
+                          for i in items}
+            self.revision = rev
+            self.relists += 1
+
+    def snapshot(self) -> tuple[int, dict[str, dict]]:
+        with self._lock:
+            return self.revision, {k: dict(v)
+                                   for k, v in self.cache.items()}
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Drive the loop until `stop` (or stop()) is set. Endpoint
+        errors rotate + retry — the informer outlives any one daemon."""
+        stop = stop or self._stop
+        listed = False
+        while not stop.is_set():
+            client = self._conn()
+            try:
+                if not listed:
+                    self.relist(client)
+                    listed = True
+                for evt in client.watch(self.resource,
+                                        from_revision=self.revision,
+                                        heartbeat=self.heartbeat,
+                                        yield_heartbeats=True):
+                    if stop.is_set():
+                        return
+                    if "revision" in evt:
+                        self._apply(evt)
+            except RelistRequiredError:
+                listed = False           # compaction/takeover: resync
+            except (ApiError, OSError, ConnectionError,
+                    http.client.HTTPException, json.JSONDecodeError):
+                self._rotate()           # daemon gone: try the next seat
+                stop.wait(self.retry_delay)
+            finally:
+                client.close()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name=f"informer-{self.resource}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -110,6 +110,15 @@ class EventLog:
         with self._lock:
             return self._seq
 
+    @property
+    def first_retained(self) -> int:
+        """Oldest seq still in the ring (0 when empty). A Last-Event-ID
+        resume below first_retained - 1 has lost events to eviction —
+        the SSE stream reports that as an `event: gap` frame instead of
+        silently serving the survivors (server/app.py)."""
+        with self._lock:
+            return self._ring[0]["seq"] if self._ring else 0
+
     def _newer_than(self, seq: int) -> list[dict]:
         """Ring events with seq > `seq`, oldest first. Caller holds the
         lock. The ring is seq-ordered, so walk it backwards and stop at

@@ -38,6 +38,7 @@ endpoint under each mode.
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
 
@@ -105,6 +106,14 @@ CRASHPOINTS: dict[str, str] = {
     "volume.delete.after_remove": "backend volume removed, store keys remain",
     # write-behind persistence: the daemon dies before a queued write exists
     "workqueue.before_submit": "mutation applied in memory, persist never queued",
+    # federation leases (federation.py FleetMember): the member dies
+    # between the arbiter persisting a grant and the member recording /
+    # acting on it — the grant is "leaked" until the lease TTL expires,
+    # at which point a surviving ring owner steals and adopts it
+    "fed.after_acquire": "grant persisted by the arbiter, member died "
+                         "before recording ownership",
+    "fed.after_takeover": "orphaned grant stolen, member died before "
+                          "adopting the resource state",
 }
 
 _lock = threading.Lock()
@@ -191,10 +200,24 @@ FAULT_MODES: dict[str, str] = {
     "drop_response": "execute, then sever the connection before the "
                      "response is written, on the first N crossings "
                      "(arg = N, default 1)",
+    # inter-daemon partition: PERSISTENT InjectedFault on every crossing
+    # while armed — arm it on 'fed.rpc' (RestArbiter's gate) to sever a
+    # member from the fleet host without touching its substrate. Unlike
+    # error_n this never burns down: a partition heals by disarming, not
+    # by being retried through.
+    "partition": "raise InjectedFault on EVERY crossing while armed "
+                 "(heals on disarm, never by retry)",
+    # daemon death at a crossing: SIGKILL the CURRENT process — the real
+    # thing, not InjectedCrash's unwind-free raise. For the takeover e2e:
+    # arm 'fed.rpc:daemon_kill' on a member daemon and its next heartbeat
+    # kills it mid-protocol, exactly how an OOM kill lands.
+    "daemon_kill": "SIGKILL this process at the first crossing (arg = N "
+                   "crossings to let through first, default 0)",
 }
 
 _DEFAULT_ARG = {"error_once": 1.0, "error_n": 1.0, "latency": 0.05,
-                "hang": 2.0, "drop_response": 1.0}
+                "hang": 2.0, "drop_response": 1.0, "partition": 1.0,
+                "daemon_kill": 0.0}
 
 
 class _Fault:
@@ -205,9 +228,12 @@ class _Fault:
         self.mode = mode
         self.arg = arg
         # error_once/error_n/hang/drop_response fire a bounded number of
-        # times so a retried op can converge; latency is persistent (a
-        # slow substrate stays slow — every attempt pays it)
-        self.remaining = (int(arg) if mode in ("error_n", "drop_response")
+        # times so a retried op can converge; latency and partition are
+        # persistent (a slow substrate stays slow, a partition heals by
+        # disarm); daemon_kill's countdown is crossings LET THROUGH
+        # before the kill lands
+        self.remaining = (int(arg) if mode in ("error_n", "drop_response",
+                                               "daemon_kill")
                           else 1 if mode in ("error_once", "hang")
                           else -1)
 
@@ -271,11 +297,17 @@ def fault_gate(op: str) -> None:
         f = _faults.get(op)
         if f is None or f.mode == "drop_response":
             return          # drop_response is the HTTP layer's gate
-        if f.remaining == 0:
+        if f.mode == "daemon_kill":
+            if f.remaining > 0:
+                f.remaining -= 1     # crossings let through pre-kill
+                return
+        elif f.remaining == 0:
             return
-        if f.remaining > 0:
+        elif f.remaining > 0:
             f.remaining -= 1
         mode, arg = f.mode, f.arg
+    if mode == "daemon_kill":
+        os.kill(os.getpid(), signal.SIGKILL)
     if mode == "latency":
         time.sleep(arg)
         return

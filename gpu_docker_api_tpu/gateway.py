@@ -1065,38 +1065,55 @@ class GatewayManager:
         ones as STOPPED (warm re-admission candidates). Runs after the
         reconciler, so half-done scale mutations are already settled."""
         for kv in self._client.range(GATEWAYS):
-            name = kv.key.rsplit("/", 1)[1]
-            try:
-                cfg = GatewayConfig.from_json(json.loads(kv.value))
-            except (ValueError, TypeError):
-                log.exception("unreadable gateway record %s", name)
-                continue
-            gw = Gateway(cfg, self._svc, self._intents, events=self.events,
-                         traces=self.traces, transport=self._transport,
-                         on_change=self._roster_changed)
-            pat = re.compile(re.escape(name) + _REPLICA_RE)
-            for rname in replica_names_for(self._client, name):
-                idx = int(pat.fullmatch(rname).group(1))
-                r = Replica(rname, idx)
-                try:
-                    info = self._svc.get_container_info(rname)
-                except xerrors.XError:
-                    continue
-                r.container = info["containerName"]
-                spec = info.get("spec") or {}
-                r.chips = list(spec.get("tpu_chips") or [])
-                bindings = spec.get("port_bindings") or {}
-                r.host_port = int(bindings.get(cfg.port, 0) or 0)
-                if info.get("resourcesReleased"):
-                    r.state = STOPPED
-                else:
-                    r.state = STARTING
-                    r.started_at = time.monotonic()
-                gw.replicas[r.name] = r
-            with self._lock:
-                self._gateways[name] = gw
-            gw.start()
+            self.boot_one(kv.key.rsplit("/", 1)[1])
         self._roster_changed()
+
+    def boot_one(self, name: str) -> bool:
+        """Rebuild ONE gateway from its stored record (the boot() body,
+        per name — also the fleet takeover adoption path: a daemon that
+        just stole this gateway's grant derives the roster from stored
+        state, never from the dead owner). Idempotent: an already-live
+        gateway is left running untouched."""
+        with self._lock:
+            if name in self._gateways:
+                return False
+        kv = self._client.get(GATEWAYS, name)
+        if kv is None:
+            return False
+        try:
+            cfg = GatewayConfig.from_json(json.loads(kv.value))
+        except (ValueError, TypeError):
+            log.exception("unreadable gateway record %s", name)
+            return False
+        gw = Gateway(cfg, self._svc, self._intents, events=self.events,
+                     traces=self.traces, transport=self._transport,
+                     on_change=self._roster_changed)
+        pat = re.compile(re.escape(name) + _REPLICA_RE)
+        for rname in replica_names_for(self._client, name):
+            idx = int(pat.fullmatch(rname).group(1))
+            r = Replica(rname, idx)
+            try:
+                info = self._svc.get_container_info(rname)
+            except xerrors.XError:
+                continue
+            r.container = info["containerName"]
+            spec = info.get("spec") or {}
+            r.chips = list(spec.get("tpu_chips") or [])
+            bindings = spec.get("port_bindings") or {}
+            r.host_port = int(bindings.get(cfg.port, 0) or 0)
+            if info.get("resourcesReleased"):
+                r.state = STOPPED
+            else:
+                r.state = STARTING
+                r.started_at = time.monotonic()
+            gw.replicas[r.name] = r
+        with self._lock:
+            if name in self._gateways:   # lost a boot race — keep theirs
+                gw.stop()
+                return False
+            self._gateways[name] = gw
+        gw.start()
+        return True
 
     def stop_all(self) -> None:
         with self._lock:

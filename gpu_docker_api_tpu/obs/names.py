@@ -65,6 +65,20 @@ EVENT_OPS = frozenset({
     # watchdog-reaped dead worker: flight-recorder segment + claim-
     # reconcile delta bundle (server/workers.py _capture_postmortem)
     "gateway.worker_postmortem",
+    # federation: leased multi-daemon fleet (federation.py). join/leave
+    # are membership transitions; expire is a lease the arbiter lazily
+    # reaped; grant/steal/takeover trace resource ownership moving
+    # between members (steal = live acquire of an expired holder's
+    # grant, takeover = the heartbeat sweep adopting orphans).
+    "fed.join",
+    "fed.leave",
+    "fed.expire",
+    "fed.grant",
+    "fed.steal",
+    "fed.takeover",
+    # revision watch plane: an SSE watcher resumed past the hub's
+    # retained window and was told to relist (server/app.py)
+    "watch.gap",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.
@@ -148,4 +162,15 @@ METRIC_NAMES = frozenset({
     "tdapi_gw_worker_deadline_total",
     "tdapi_gw_worker_retries_total",
     "tdapi_gw_worker_queue_wait_ms",
+    # federation: fleet membership + grant table + revision watch hub
+    # (server/app.py collect callback over federation.FleetArbiter /
+    # WatchHub counters)
+    "tdapi_fed_members",
+    "tdapi_fed_grants",
+    "tdapi_fed_owned",
+    "tdapi_fed_renewals_total",
+    "tdapi_fed_steals_total",
+    "tdapi_fed_expiries_total",
+    "tdapi_fed_watch_events_total",
+    "tdapi_fed_watch_head_revision",
 })

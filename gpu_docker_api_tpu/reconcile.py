@@ -92,6 +92,13 @@ INFORMATIONAL_STEPS = frozenset({
     "granted", "persisted", "precopied", "quiesced", "resharded",
     "stopped_old", "started_new", "removed_old", "stopped", "restored",
     "removed", "cloned", "replica_started", "replica_stopped",
+    # federation lease crashpoints (federation.py FleetMember): a member
+    # that died between the arbiter persisting a grant and recording its
+    # own belief leaves NO intent step — the grant table is the truth
+    # and the next heartbeat re-derives belief from it. Registered here
+    # so a fed-adjacent intent journaling them never trips the
+    # unknown-step alarm.
+    "fed.after_acquire", "fed.after_takeover",
 })
 
 KNOWN_STEPS = CONSULTED_STEPS | INFORMATIONAL_STEPS

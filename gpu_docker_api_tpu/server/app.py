@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from .. import federation
 from .. import idempotency as idem
 from .. import xerrors
 from ..backend import make_backend
@@ -53,6 +54,7 @@ from ..version import (
 )
 from ..workqueue import WorkQueue
 from .codes import ResCode
+from .fleet import FleetPlane
 from .http import (
     ApiServer, RawResponse, Request, Response, Router, StreamingResponse,
     err, ok, precondition_failed, too_many, unavailable,
@@ -229,7 +231,10 @@ class App:
                  mutation_wait_timeout: float = 10.0,
                  idem_ttl: Optional[float] = None,
                  gw_workers: Optional[int] = None,
-                 gw_data_port: Optional[int] = None):
+                 gw_data_port: Optional[int] = None,
+                 fleet_member: Optional[str] = None,
+                 fleet_host: Optional[str] = None,
+                 fleet_ttl: Optional[float] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
 
@@ -270,8 +275,14 @@ class App:
         # span sink: mutations traced end-to-end land here (bounded ring,
         # keep-slowest retention, traces.jsonl) — GET /api/v1/traces
         self.traces = TraceCollector(state_dir)
-        self.store = open_store(wal_path=os.path.join(state_dir, "state.wal"),
-                                engine=store_engine)
+        # every store mutation feeds the watch hub in exact revision
+        # order (federation.WatchedStore) — the seam GET /api/v1/watch
+        # and the fleet's list+watch informers resume against
+        self.hub = federation.WatchHub()
+        self.store = federation.WatchedStore(
+            open_store(wal_path=os.path.join(state_dir, "state.wal"),
+                       engine=store_engine),
+            self.hub)
         self.client = StateClient(self.store)
         self.wq = WorkQueue(self.client, events=self.events)
         self.wq.start()
@@ -395,6 +406,26 @@ class App:
                             "unavailable (native shm-atomics core not "
                             "built?) — serving stays in-process",
                             n_workers)
+        # fleet control plane (server/fleet.py): the arbiter is ALWAYS
+        # hosted (any daemon can be the --fleet-host others point at);
+        # a member seat only when configured — a single-daemon
+        # deployment pays neither heartbeats nor ownership checks. The
+        # member is configured in start(): its advertised address is
+        # this server's BOUND port, which does not exist yet.
+        if fleet_ttl is None:
+            try:
+                fleet_ttl = float(os.environ.get("TDAPI_FLEET_TTL", "")
+                                  or federation.DEFAULT_TTL)
+            except ValueError:
+                fleet_ttl = federation.DEFAULT_TTL
+        self.fleet = FleetPlane(self.store, self.hub, events=self.events,
+                                ttl=fleet_ttl)
+        self._fleet_member_id = (fleet_member
+                                 or os.environ.get("TDAPI_FLEET_MEMBER", ""))
+        self._fleet_host = (fleet_host if fleet_host is not None
+                            else os.environ.get("TDAPI_FLEET_HOST", ""))
+        self._api_key = (api_key if api_key is not None
+                         else os.environ.get("APIKEY", ""))
         # SSE follower count (tdapi_events_stream_clients) — mutated from
         # stream generator threads under this lock
         self._stream_lock = threading.Lock()
@@ -444,6 +475,11 @@ class App:
         r.add("POST", f"{v1}/gateways/:name/generate", self.h_gw_generate,
               raw=True)
         r.add("GET", f"{v1}/events", self.h_events)
+        # list+watch on MVCC revisions + fleet lease/grant plane
+        # (server/fleet.py; the fleet routes register raw — heartbeat
+        # traffic must not consume mutation-gate slots)
+        r.add("GET", f"{v1}/watch", self.h_watch)
+        self.fleet.register(r, v1)
         r.add("GET", f"{v1}/traces", self.h_traces)
         r.add("GET", f"{v1}/traces/:traceId", self.h_trace)
         r.add("GET", f"{v1}/reconcile", self.h_reconcile)
@@ -480,6 +516,13 @@ class App:
                                    reason=reason, request_id=req.request_id)
                 return too_many(reason)
             try:
+                # fleet ownership: a member daemon refuses mutations for
+                # resources the hash ring assigns elsewhere (the refusal
+                # names the owner so the client re-routes) — BEFORE the
+                # idempotency layer, so a refused call caches nothing
+                denied = self.fleet.guard_mutation(req)
+                if denied is not None:
+                    return denied
                 return self._with_idempotency(req, handler)
             finally:
                 self.gate.release(req.client_addr or "?")
@@ -1002,8 +1045,11 @@ class App:
         API): each event goes out as `id: <seq>` + `data: <json>`; a
         reconnecting client sends `Last-Event-ID` (header, or the
         lastEventId query param) and resumes from the ring — a resume
-        point older than the ring's tail yields what is retained, the gap
-        visible as a seq jump. Heartbeat comments mark idle intervals."""
+        point the ring has already evicted past gets an explicit
+        `event: gap` frame naming the first retained seq (the client
+        raises EventGapError / refetches instead of silently missing
+        events), then the retained tail. Heartbeat comments mark idle
+        intervals."""
         try:
             hb = float(req.query.get(
                 "heartbeat", [str(self.SSE_HEARTBEAT_S)])[0])
@@ -1023,11 +1069,28 @@ class App:
         except ValueError:
             return err(ResCode.InvalidParams)
 
+        # ring-overrun detection BEFORE streaming: the client resumed
+        # from a seq whose successor has already been evicted — events
+        # are gone, and a silent seq jump is indistinguishable from a
+        # quiet target filter. first_retained == 0 (empty ring) only
+        # happens when nothing was ever recorded OR capacity is 0;
+        # either way nothing after `since` was lost unless seq moved on.
+        first = self.events.first_retained
+        gap = None
+        if str(last_id).strip() and since < (first - 1 if first
+                                             else self.events.last_seq):
+            gap = {"firstRetained": first, "lastEventId": since}
+            self.events.record("watch.gap", target="events",
+                               detail=gap, request_id=req.request_id)
+
         def gen(since: int):
             with self._stream_lock:
                 self._stream_clients += 1
             try:
                 yield b"retry: 2000\n\n"
+                if gap is not None:
+                    yield (f"event: gap\ndata: "
+                           f"{json.dumps(gap)}\n\n").encode()
                 last_sent = time.monotonic()
                 while not self.server._draining:
                     evts = self.events.wait_since(since, timeout=hb)
@@ -1057,6 +1120,25 @@ class App:
                     self._stream_clients -= 1
 
         return StreamingResponse(gen(since))
+
+    def h_watch(self, req: Request) -> Response:
+        """List+watch on MVCC revisions — see FleetPlane.h_watch for the
+        wire contract (snapshot with ?list=1, else SSE of revision
+        frames; `revision too old` forces a relist)."""
+        return self.fleet.h_watch(req, lambda: self.server._draining)
+
+    def _fleet_adopt(self, resource: str, name: str) -> None:
+        """Takeover adoption: this daemon just stole `resource/name`
+        from a dead member. Derive-don't-store — nothing is copied from
+        the dead owner; one reconciler pass cross-checks stored records
+        against grants/backends/intents exactly like boot does, and an
+        adopted gateway rebuilds its roster from stored container
+        records (boot_one)."""
+        with self._reconcile_lock:
+            if not self.intents.open_intents():
+                self.last_reconcile = self.reconciler.run()
+        if resource == "gateways":
+            self.gateways.boot_one(name)
 
     def h_traces(self, req: Request) -> Response:
         """Finished-trace summaries, slowest first; ?op= substring-matches
@@ -1245,6 +1327,30 @@ class App:
             g_brk = m.gauge("tdapi_breaker_state",
                             "0 = closed, 1 = half-open, 2 = open")
             g_brk_f = m.gauge("tdapi_breaker_consecutive_failures")
+        # federation: fleet membership + grant table + watch hub
+        # (declared unconditionally — family parity across single- and
+        # multi-daemon deployments, zero-valued when no fleet)
+        g_fed_mem = m.gauge("tdapi_fed_members",
+                            "live-leased fleet members (this arbiter)")
+        g_fed_gr = m.gauge("tdapi_fed_grants",
+                           "resource grants in the fleet grant table")
+        g_fed_own = m.gauge("tdapi_fed_owned",
+                            "resources this daemon's member seat "
+                            "believes it owns")
+        g_fed_renew = m.gauge("tdapi_fed_renewals_total", typ="counter")
+        g_fed_steal = m.gauge(
+            "tdapi_fed_steals_total",
+            "grants stolen from expired members (takeovers arbitrated)",
+            typ="counter")
+        g_fed_exp = m.gauge("tdapi_fed_expiries_total",
+                            "leases lazily reaped after TTL",
+                            typ="counter")
+        g_fed_wev = m.gauge("tdapi_fed_watch_events_total",
+                            "store mutations fed to the watch hub",
+                            typ="counter")
+        g_fed_whead = m.gauge("tdapi_fed_watch_head_revision",
+                              "highest MVCC revision the watch hub has "
+                              "seen")
         # tracing + streaming self-observation
         g_traces = m.gauge("tdapi_traces_retained",
                            "finished traces held in the ring "
@@ -1369,6 +1475,16 @@ class App:
                 g_brk.set(breaker_gauge(brk["state"]))
                 g_brk_f.set(brk["consecutiveFailures"])
             g_traces.set(self.traces.stats()["retained"])
+            arb = self.fleet.arbiter
+            g_fed_mem.set(len(arb.members()))
+            g_fed_gr.set(len(arb.grants()))
+            g_fed_own.set(len(self.fleet.member.owned)
+                          if self.fleet.member is not None else 0)
+            g_fed_renew.set(arb.renewals_total)
+            g_fed_steal.set(arb.steals_total)
+            g_fed_exp.set(arb.expiries_total)
+            g_fed_wev.set(self.hub.events_total)
+            g_fed_whead.set(self.hub.head)
             for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
                       g_gw_scale, g_wk_req, g_wk_shed, g_wk_dead,
                       g_wk_retry):
@@ -1466,6 +1582,14 @@ class App:
         self.server.start()
         if self.workers is not None:
             self.workers.start()
+        if self._fleet_member_id:
+            # configured here, not __init__: the advertised address is
+            # the port the server just bound
+            self.fleet.configure_member(
+                self._fleet_member_id, addr=self.address,
+                host=self._fleet_host, api_key=self._api_key,
+                adopt=self._fleet_adopt)
+            self.fleet.start()
         self._start_store_maintenance()
         self.health.start()   # no-op when health_interval <= 0
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
@@ -1506,6 +1630,10 @@ class App:
         """Graceful shutdown: drain queue, flush all state (reference Stop,
         main.go:139-154)."""
         self.server.stop()
+        # leave the fleet while the store (local arbiter) / the host
+        # daemon (remote) is still reachable: a graceful exit releases
+        # this member's grants instead of waiting out the TTL
+        self.fleet.stop()
         if self.workers is not None:
             # the module-global latency family must not keep scraping a
             # dead tier's unlinked segment (and a later App's tier will

@@ -65,6 +65,15 @@ class ResCode(enum.IntEnum):
     GatewayDeleteFailed = 1034
     GatewayRequestFailed = 1035
 
+    # federation / fleet control plane (1036-1049): multi-daemon
+    # ownership + revision watch. WatchCompacted tells an informer its
+    # resume point predates the hub's retained window (forced relist);
+    # FleetNotOwner carries the owning member's address so clients can
+    # re-route; FleetLeaseFailed covers acquire/renew refusals.
+    WatchCompacted = 1036
+    FleetNotOwner = 1037
+    FleetLeaseFailed = 1038
+
     VolumeCreateFailed = 1100
     VolumeNameCannotBeEmpty = 1101
     VolumeDeleteFailed = 1102
@@ -150,6 +159,15 @@ _MESSAGES: dict[ResCode, str] = {
     ResCode.GatewayDeleteFailed: "Failed to delete gateway",
     ResCode.GatewayRequestFailed:
         "Gateway could not serve the request (no replica answered)",
+
+    ResCode.WatchCompacted:
+        "Watch revision too old — the requested fromRevision predates the "
+        "retained window; relist and resume from the snapshot revision",
+    ResCode.FleetNotOwner:
+        "This daemon does not own the resource — retry against the owning "
+        "fleet member (see data.owner)",
+    ResCode.FleetLeaseFailed:
+        "Fleet lease operation failed",
 
     ResCode.VolumeCreateFailed: "Failed to create volume",
     ResCode.VolumeNameCannotBeEmpty: "Volume name cannot be empty",

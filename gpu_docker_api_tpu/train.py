@@ -396,7 +396,12 @@ def restore_checkpoint(path: str, abstract_state=None) -> tuple[Any, int]:
             state = mngr.restore(
                 step, args=ocp.args.StandardRestore(abstract_state))
         else:
-            state = mngr.restore(step)
+            # an explicit template-less StandardRestore: a bare
+            # mngr.restore(step) hits CompositeCheckpointHandler's
+            # "provide a CheckpointArgs subclass" refusal on this orbax
+            # (0.7.x), which killed the serving workload at startup and
+            # surfaced as healthz never opening in the train->serve e2e
+            state = mngr.restore(step, args=ocp.args.StandardRestore())
         return state, step
 
 

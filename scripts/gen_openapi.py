@@ -165,6 +165,20 @@ GW_PARAM = {"name": "name", "in": "path", "required": True,
 #: documented on them (their 429 is the GATEWAY's own admission shed)
 DATA_PLANE_OPS = {"gatewayGenerate"}
 
+MEMBER_PARAM = {"name": "member", "in": "path", "required": True,
+                "schema": {"type": "string"},
+                "description": "Fleet member id (the daemon's "
+                               "--fleet-member)"}
+
+#: fleet-plane operations: registered raw (server/fleet.py) — they bypass
+#: the mutation gate and idempotency middleware because they ARE the
+#: coordination substrate those layers would sit on (a heartbeat that can
+#: be shed by the admission gate expires its own lease). Retries are safe
+#: by protocol instead: join/renew/acquire are idempotent per holder,
+#: release/leave tolerate repeats.
+FLEET_OPS = {"fleetJoin", "fleetRenew", "fleetLeave", "fleetAcquire",
+             "fleetRelease"}
+
 # Attached to EVERY operation (post-processing in build_spec): W3C Trace
 # Context ingress (obs/trace.py; the shipped client stamps one per call)
 TRACEPARENT_PARAM = {
@@ -488,6 +502,42 @@ def build_spec() -> dict:
                           "thread) — links this row to its span tree at "
                           "GET /api/v1/traces/{traceId}")},
             desc="Operation event (events.py record)"),
+        "WatchEvent": obj(
+            {"revision": i("MVCC revision the mutation committed at — "
+                           "the SSE event id and the exact resume "
+                           "point"),
+             "resource": s("e.g. 'containers', 'gateways', "
+                           "'fleet.grants'"),
+             "name": s(), "type": s(enum=["put", "delete"]),
+             "value": s("Stored JSON document (null on delete)",
+                        nullable=True)},
+            desc="One watched store mutation (federation.py WatchHub)"),
+        "WatchItem": obj(
+            {"name": s(), "value": s("Stored JSON document"),
+             "modRevision": i()},
+            desc="One resource row in a list snapshot"),
+        "FleetMemberInfo": obj(
+            {"member": s(), "addr": s("Advertised HOST:PORT for "
+                                      "re-routing"),
+             "epoch": i("Lease generation — bumps on every rejoin"),
+             "ttlRemaining": {"type": "number"}},
+            desc="One live fleet lease (federation.py FleetArbiter)"),
+        "FleetGrant": obj(
+            {"resource": s(), "name": s(),
+             "holder": s("Member that owns this resource"),
+             "epoch": i("Fencing token — bumps on every ownership "
+                        "CHANGE (steal/takeover), never on the "
+                        "holder's idempotent re-acquire"),
+             "stolenFrom": s("Previous holder when this acquire was a "
+                             "takeover steal; empty otherwise"),
+             "modRevision": i()},
+            desc="One row of the fleet grant table"),
+        "FleetLease": obj(
+            {"member": s(), "ttl": {"type": "number"},
+             "epoch": i(), "members": arr(s(), "Live members, sorted")},
+            desc="Join/renew response: the lease plus the live "
+                 "membership the caller's hash ring must be computed "
+                 "over"),
         "SpanEvent": obj(
             {"name": s("Point-in-time marker: an intent step name, "
                        "'retry', 'failed', or 'breaker.rejected'"),
@@ -884,9 +934,12 @@ def build_spec() -> dict:
                     "JSON>`; `: heartbeat` comment frames mark idle "
                     "intervals. Reconnect with Last-Event-ID (or "
                     "?lastEventId=) to resume from the ring — a resume "
-                    "point older than the ring's tail yields what is "
-                    "retained, the gap visible as a seq jump. Subscribe "
-                    "instead of polling (client.follow_events()).",
+                    "point older than the ring's tail first yields an "
+                    "`event: gap` frame (data: {firstRetained}) so the "
+                    "client KNOWS records were lost, then the retained "
+                    "suffix; the shipped client.follow_events() raises "
+                    "a typed EventGapError there. Subscribe instead of "
+                    "polling.",
                 "content": {
                     "application/json": {"schema": {
                         "allOf": [ref("Envelope"), {
@@ -921,6 +974,78 @@ def build_spec() -> dict:
                      "description": "Header form of lastEventId (what an "
                                     "EventSource reconnect sends)"}],
             tags=["meta"])},
+        f"{v1}/watch": {"get": op(
+            "watch", "Per-resource list+watch on MVCC store revisions — "
+            "with ?list=1 an atomic snapshot, otherwise a revision-"
+            "ordered Server-Sent Events stream",
+            {"200": {
+                "description":
+                    "With ?list=1: envelope {resource, revision, items} "
+                    "— an atomic snapshot plus the exact revision to "
+                    "pass back as fromRevision, the list half of "
+                    "list+watch (client.Informer does both). Otherwise "
+                    "a close-delimited text/event-stream: every store "
+                    "mutation under the resource goes out as `id: "
+                    "<revision>` + `data: <WatchEvent JSON>` in strict "
+                    "revision order with no gaps or duplicates "
+                    "(model-checked invariant FW1, tools/tdcheck); `: "
+                    "heartbeat` comments mark idle intervals. Resume "
+                    "with fromRevision= or Last-Event-ID. A resume "
+                    "point the ring has compacted past is REFUSED "
+                    "before streaming (envelope code 1036, data.floor) "
+                    "— relist, then watch from the snapshot revision; a "
+                    "fromRevision ahead of the store's head is refused "
+                    "the same way (code 1036 with data.head: a "
+                    "revision from another daemon's store, e.g. after "
+                    "fleet takeover moved the client to a different "
+                    "member). If compaction overtakes an attached slow "
+                    "consumer mid-stream, the stream emits one `event: "
+                    "gap` frame and closes; the client must relist.",
+                "content": {
+                    "application/json": {"schema": {
+                        "allOf": [ref("Envelope"), {
+                            "type": "object", "properties": {
+                                "data": obj(
+                                    {"resource": s(),
+                                     "revision": i(
+                                         "Store revision the snapshot "
+                                         "is consistent at — watch "
+                                         "from here"),
+                                     "items": arr(ref("WatchItem"))})}}]}},
+                    "text/event-stream": {
+                        "schema": {"type": "string"}}}}},
+            params=[{"name": "resource", "in": "query", "required": True,
+                     "schema": {"type": "string"},
+                     "description": "Store subtree to watch: "
+                                    "'containers', 'gateways', 'volumes' "
+                                    "... or the fleet planes "
+                                    "'fleet.grants' / 'fleet.leases'"},
+                    {"name": "list", "in": "query", "required": False,
+                     "schema": {"type": "string"},
+                     "description": "Set to 1 for the atomic snapshot "
+                                    "instead of the stream"},
+                    {"name": "fromRevision", "in": "query",
+                     "required": False,
+                     "schema": {"type": "integer", "minimum": 0},
+                     "description": "Stream mutations with revision "
+                                    "strictly greater than this "
+                                    "(default: now — live tail only)"},
+                    {"name": "heartbeat", "in": "query", "required": False,
+                     "schema": {"type": "number", "minimum": 0.05},
+                     "description": "Idle-heartbeat cadence in seconds "
+                                    "(default 15)"},
+                    {"name": "Last-Event-ID", "in": "header",
+                     "required": False,
+                     "schema": {"type": "integer", "minimum": 0},
+                     "description": "Header form of fromRevision (what "
+                                    "an EventSource reconnect sends)"}],
+            tags=["meta"],
+            desc="The federation wire: fleet members watch "
+                 "'fleet.grants' to mirror ownership, informers keep "
+                 "caches warm across daemon takeover "
+                 "(docs/federation.md). Revisions are per-daemon; after "
+                 "redirecting to a new member, relist rather than "
+                 "resuming with the old daemon's revision.")},
         f"{v1}/traces": {"get": op(
             "traces", "Finished-trace summaries, slowest first "
             "(keep-slowest retention: the ring pins its slowest traces "
@@ -1088,6 +1213,106 @@ def build_spec() -> dict:
                  "(envelope 504) when the per-request deadline passes "
                  "before a slot frees; both feed the autoscaler. The "
                  "replica's envelope is relayed verbatim.")},
+        f"{v1}/fleet/lease": {"post": op(
+            "fleetJoin", "Join the fleet (or rejoin after expiry): "
+            "acquire this member's TTL lease",
+            envelope(ref("FleetLease"),
+                     {"member": "b", "ttl": 5.0, "epoch": 1,
+                      "members": ["a", "b"]}),
+            body=obj({"member": s("Member id (--fleet-member)"),
+                      "addr": s("Advertised HOST:PORT other daemons "
+                                "redirect writes to")},
+                     required=["member"]),
+            tags=["fleet"],
+            desc="Rejoining after one's own lease expired bumps the "
+                 "lease epoch; the member fences first (drops every "
+                 "believed-owned resource) and re-acquires through the "
+                 "grant table, so a paused-and-resumed daemon can never "
+                 "act on stale ownership. Raw route: bypasses the "
+                 "mutation gate and idempotency middleware (a heartbeat "
+                 "that can be shed expires its own lease).")},
+        f"{v1}/fleet/lease/{{member}}/renew": {"post": op(
+            "fleetRenew", "Heartbeat: extend the lease TTL",
+            envelope(ref("FleetLease")),
+            params=[MEMBER_PARAM], tags=["fleet"],
+            desc="Runs at TTL/3 from FleetMember.start(). Envelope code "
+                 "1038 with data.reason='no-lease' once the lease has "
+                 "already expired — the member must rejoin (fence + "
+                 "fresh epoch), not keep renewing.")},
+        f"{v1}/fleet/lease/{{member}}": {"delete": op(
+            "fleetLeave", "Leave the fleet: release the lease and every "
+            "grant this member holds",
+            envelope(obj({"member": s(),
+                          "released": arr(s(), "Grant keys freed, "
+                                          "'resource:name'")})),
+            params=[MEMBER_PARAM], tags=["fleet"],
+            desc="Graceful shutdown path (daemon stop). The freed "
+                 "slices are re-acquired by the surviving members' next "
+                 "heartbeat sweep — same machinery as crash takeover, "
+                 "minus the TTL wait.")},
+        f"{v1}/fleet/members": {"get": op(
+            "fleetMembers", "Live fleet membership",
+            envelope(obj({"members": arr(ref("FleetMemberInfo")),
+                          "ttl": {"type": "number",
+                                  "description": "Configured lease TTL "
+                                                 "(seconds)"}})),
+            tags=["fleet"],
+            desc="Reading membership lazily sweeps expired leases "
+                 "first, so the answer never lists a dead member as "
+                 "live. The member set is the hash-ring input: "
+                 "ownership of a resource is owner_of(key, members) — "
+                 "derived, never stored (docs/federation.md).")},
+        f"{v1}/fleet/grants": {
+            "get": op(
+                "fleetGrants", "The grant table: which member owns "
+                "which resource slice",
+                envelope(obj({"grants": arr(ref("FleetGrant"))})),
+                tags=["fleet"],
+                desc="Grant epochs are fencing tokens: takeover bumps "
+                     "them, so a stale holder's writes are detectable. "
+                     "Watchable live via GET /api/v1/watch?resource="
+                     "fleet.grants — model-checked invariant L1: at "
+                     "most one live holder per resource at every "
+                     "instant (tools/tdcheck LeaseModel)."),
+            "post": op(
+                "fleetAcquire", "Acquire (or take over) ownership of "
+                "one resource",
+                envelope(ref("FleetGrant"),
+                         {"resource": "containers", "name": "rs0",
+                          "holder": "a", "epoch": 2,
+                          "stolenFrom": "b", "modRevision": 41}),
+                body=obj({"resource": s(), "name": s(),
+                          "member": s("Acquiring member — must hold a "
+                                      "live lease and own the key on "
+                                      "the current hash ring")},
+                         required=["resource", "name", "member"]),
+                tags=["fleet"],
+                desc="Refusals are typed in the envelope: code 1038 "
+                     "data.reason='no-lease' (caller's lease expired), "
+                     "'not-owner' (hash ring places the key "
+                     "elsewhere), or 'held' with data.owner/"
+                     "data.ownerAddr (another member's lease is still "
+                     "live — redirect the write there; this is also "
+                     "code 1037 on the fenced mutation routes). "
+                     "Stealing succeeds only once the holder's lease "
+                     "expired, bumping the grant epoch; a concurrent "
+                     "steal race has exactly one winner, the loser "
+                     "gets the clean 'held' refusal. The holder's own "
+                     "re-acquire is idempotent and does NOT bump the "
+                     "epoch.")},
+        f"{v1}/fleet/grants/release": {"post": op(
+            "fleetRelease", "Release one grant this member holds",
+            envelope(obj({"released": b("Whether a grant was removed "
+                                        "(repeat releases answer "
+                                        "false)")})),
+            body=obj({"resource": s(), "name": s(),
+                      "member": s("Releasing member — must be the "
+                                  "current holder")},
+                     required=["resource", "name", "member"]),
+            tags=["fleet"],
+            desc="Used when a resource is deleted or its ring slice "
+                 "moved after membership change. Releasing a grant "
+                 "held by someone else is refused (code 1038).")},
         "/metrics": {"get": op(
             "metrics", "Prometheus text exposition",
             {"200": {"description": "text/plain; version=0.0.4",
@@ -1116,6 +1341,9 @@ def build_spec() -> dict:
         for method, o in path_item.items():
             if method not in ("post", "patch", "delete"):
                 continue
+            if o["operationId"] in FLEET_OPS:
+                # raw coordination routes: no gate, no idempotency cache
+                continue
             if o["operationId"] in DATA_PLANE_OPS:
                 # the gateway's own shed/deadline responses, not the
                 # mutation gate's
@@ -1140,7 +1368,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.12.0",
+            "version": "0.13.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "
@@ -1165,7 +1393,12 @@ def build_spec() -> dict:
         },
         "servers": [{"url": "http://localhost:2378"}],
         "tags": [{"name": "replicaSet"}, {"name": "volume"},
-                 {"name": "resource"}, {"name": "meta"}],
+                 {"name": "resource"}, {"name": "gateway"},
+                 {"name": "fleet",
+                  "description": "Federated control plane: TTL leases, "
+                                 "hash-ring resource ownership, "
+                                 "takeover (docs/federation.md)"},
+                 {"name": "meta"}],
         "security": [{"bearer": []}],
         "paths": paths,
         "components": {

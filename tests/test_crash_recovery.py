@@ -337,6 +337,59 @@ def post_vol_delete(app, stored):
     assert app.backend.volume_list() == []
 
 
+def setup_fed_acquire(app):
+    app.fleet.configure_member("m0", addr="local")
+    app.fleet.member.join()
+
+
+def scenario_fed_acquire(app):
+    app.fleet.member.ensure_owned("containers", "demo")
+
+
+def post_fed_acquire(app, stored):
+    # the arbiter persisted the grant before the member died recording
+    # its belief: the grant survived the crash as an orphan (m0's lease
+    # was boot-swept) and a successor seat adopts it on one heartbeat
+    grants = {(g["resource"], g["name"]): g["holder"]
+              for g in app.fleet.arbiter.grants()}
+    assert grants.get(("containers", "demo")) == "m0"
+    m = app.fleet.configure_member("m1", addr="local")
+    m.join()
+    out = m.heartbeat_once()
+    assert "containers/demo" in out["adopted"]
+    assert ("containers", "demo") in m.owned
+
+
+def setup_fed_takeover(app):
+    # manufacture an orphan: a lone member acquires, then its lease row
+    # is dropped (expiry) — the grant outlives it, exactly the state a
+    # takeover sweep exists for
+    from gpu_docker_api_tpu import federation
+    app.fleet.arbiter.join("m_dead")
+    app.fleet.arbiter.acquire("containers", "demo", "m_dead")
+    app.store.delete(f"{federation.LEASE_PREFIX}/m_dead")
+    app.fleet.configure_member("m0", addr="local")
+    app.fleet.member.join()
+
+
+def scenario_fed_takeover(app):
+    app.fleet.member.heartbeat_once()    # steals the orphan, then dies
+
+
+def post_fed_takeover(app, stored):
+    # m0 stole the grant and died before adopting: the grant re-orphans
+    # (m0 never came back) and the NEXT member's sweep adopts it —
+    # bounded heal, no manual repair
+    grants = {(g["resource"], g["name"]): g["holder"]
+              for g in app.fleet.arbiter.grants()}
+    assert grants.get(("containers", "demo")) == "m0"
+    m = app.fleet.configure_member("m1", addr="local")
+    m.join()
+    out = m.heartbeat_once()
+    assert "containers/demo" in out["adopted"]
+    assert ("containers", "demo") in m.owned
+
+
 # crashpoint-name prefix -> (setup, mutate, extra post-assertions)
 SCENARIOS = [
     ("run.", (None, scenario_run, post_run)),
@@ -358,6 +411,12 @@ SCENARIOS = [
                         post_vol_delete)),
     ("workqueue.", (None, scenario_run, post_run)),
     ("gwscale.", (setup_gwscale, scenario_gwscale, post_gwscale)),
+    # the two federation lease crashpoints have distinct recovery shapes
+    # (orphaned fresh grant vs re-orphaned stolen grant) — own rows
+    ("fed.after_acquire", (setup_fed_acquire, scenario_fed_acquire,
+                           post_fed_acquire)),
+    ("fed.after_takeover", (setup_fed_takeover, scenario_fed_takeover,
+                            post_fed_takeover)),
 ]
 
 

@@ -1,0 +1,624 @@
+"""Federation sweep (`fed` marker, `make verify-fed`).
+
+Four layers, mirroring the tdcheck suite's shape:
+
+- UNIT: the hash ring is deterministic and balanced; the lease/grant
+  arbiter enforces the full lifecycle (join -> acquire -> renew ->
+  expire -> steal) with typed refusals; a steal race has exactly one
+  winner and a clean loser; the watch hub serves gap-free resumes and
+  refuses compacted ones.
+- MODEL: the tdcheck `lease` and `fedwatch` models sweep exhaustively,
+  every invariant checker (L1 split brain, L2 bounded heal, FW1
+  drop/dup) fires on its seeded mutant, and the sweeps are
+  deterministic (digest-stable).
+- HTTP: `GET /api/v1/watch` list+watch over a live daemon — atomic
+  snapshots, revision-ordered SSE, compaction/foreign-revision
+  refusals, the client informer, and the ownership guard's
+  FleetNotOwner re-route envelope.
+- E2E: two real daemons, one fleet — SIGKILL the non-host member and
+  prove the survivor steals every orphaned grant (zero leaked, zero
+  double-owned) while an informer's watched-revision sequence stays
+  strictly increasing and its cache converges to the grant table.
+
+Plus the satellite regression: an events-ring overrun on SSE resume
+must surface as a typed EventGapError, never a silent hole.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import federation
+from gpu_docker_api_tpu.client import (
+    ApiClient, EventGapError, Informer, RelistRequiredError,
+)
+from gpu_docker_api_tpu.federation import (
+    FleetArbiter, FleetMember, HashRing, LeaseError, WatchCompactedError,
+    WatchHub, WatchedStore, grant_key, parse_watch_key,
+)
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.store.mvcc import MVCCStore
+from gpu_docker_api_tpu.topology import make_topology
+from tools.tdcheck import models
+from tools.tdcheck.sched import InvariantViolation, ReplayStrategy
+
+from conftest import wait_for
+
+pytestmark = [pytest.mark.fed]
+
+#: well above both fed models' full trees — the sweep tests assert the
+#: frontier emptied BELOW this (same contract as tests/test_tdcheck.py)
+CAP = 30000
+
+
+# ------------------------------------------------------------- hash ring
+
+def test_hash_ring_deterministic_and_total():
+    members = {"m0", "m1", "m2"}
+    keys = [f"containers/rs-{i}" for i in range(64)]
+    first = {k: HashRing.owner_of(k, members) for k in keys}
+    # stable across calls and across membership-iteration order
+    assert first == {k: HashRing.owner_of(k, sorted(members))
+                     for k in keys}
+    assert set(first.values()) <= members
+    # balanced enough that every member owns SOMETHING at 64 keys —
+    # the property takeover relies on (a member with zero slice would
+    # make the fleet a hot-standby, not a partition)
+    assert set(first.values()) == members
+
+
+def test_hash_ring_minimal_reshuffle_on_leave():
+    keys = [f"containers/rs-{i}" for i in range(64)]
+    before = {k: HashRing.owner_of(k, {"m0", "m1", "m2"}) for k in keys}
+    after = {k: HashRing.owner_of(k, {"m0", "m1"}) for k in keys}
+    for k in keys:
+        if before[k] != "m2":       # survivors keep their slices
+            assert after[k] == before[k]
+
+
+# --------------------------------------------------- lease/grant arbiter
+
+def make_arbiter(ttl=5.0):
+    clock = {"t": 0.0}
+    arb = FleetArbiter(MVCCStore(), ttl=ttl, clock=lambda: clock["t"])
+    return arb, clock
+
+
+def ring_owned(resource: str, members, want: str, count: int = 1):
+    """First `count` names the ring assigns to `want` among `members`."""
+    out = []
+    i = 0
+    while len(out) < count:
+        name = f"rs{i}"
+        if HashRing.owner_of(f"{resource}/{name}", set(members)) == want:
+            out.append(name)
+        i += 1
+    return out
+
+
+def test_lease_lifecycle_acquire_renew_expire():
+    arb, clock = make_arbiter(ttl=5.0)
+    arb.join("m0")
+    assert [m["member"] for m in arb.members()] == ["m0"]
+    (name,) = ring_owned("containers", {"m0"}, "m0")
+    g = arb.acquire("containers", name, "m0")
+    assert g["holder"] == "m0" and g["epoch"] == 1
+    # re-acquire is idempotent for the holder: same epoch, no churn
+    assert arb.acquire("containers", name, "m0")["epoch"] == 1
+    clock["t"] = 4.0
+    arb.renew("m0")
+    clock["t"] = 8.0                # 4s since renew < ttl: still live
+    assert arb.members()
+    clock["t"] = 14.0               # 10s since renew > ttl: expired
+    assert arb.members() == []
+    assert arb.expiries_total >= 1
+    with pytest.raises(LeaseError) as ei:
+        arb.renew("m0")
+    assert ei.value.reason == "no-lease"
+    # the grant row survives expiry (it is state to be taken over, not
+    # session data) — and the SAME member reclaiming it after a rejoin
+    # is not an ownership change, so the fencing epoch stays put
+    assert arb.grants()[0]["holder"] == "m0"
+    arb.join("m0")
+    assert arb.acquire("containers", name, "m0")["epoch"] == 1
+
+
+def test_acquire_refusals_are_typed():
+    arb, _ = make_arbiter()
+    with pytest.raises(LeaseError) as ei:
+        arb.acquire("containers", "rs-0", "ghost")
+    assert ei.value.reason == "no-lease"
+    arb.join("m0")
+    arb.join("m1")
+    (name,) = ring_owned("containers", {"m0", "m1"}, "m1")
+    with pytest.raises(LeaseError) as ei:
+        arb.acquire("containers", name, "m0")
+    assert ei.value.reason == "not-owner"
+    assert ei.value.owner == "m1"
+
+
+def test_steal_refused_while_holder_lease_live():
+    arb, clock = make_arbiter(ttl=5.0)
+    arb.join("m0")
+    # m0 alone owns the whole ring: acquire a name that will hash to m1
+    # once m1 joins
+    (name,) = ring_owned("containers", {"m0", "m1"}, "m1")
+    arb.acquire("containers", name, "m0")
+    arb.join("m1")
+    with pytest.raises(LeaseError) as ei:
+        arb.acquire("containers", name, "m1")
+    assert ei.value.reason == "held"
+    assert ei.value.owner == "m0"
+    # m0 expires (m1 keeps renewing) -> the steal goes through
+    clock["t"] = 4.0
+    arb.renew("m1")
+    clock["t"] = 6.0
+    g = arb.acquire("containers", name, "m1")
+    assert g["holder"] == "m1" and g["stolenFrom"] == "m0"
+    assert g["epoch"] == 2
+    assert arb.steals_total == 1
+
+
+def test_steal_race_has_one_winner_and_a_clean_loser():
+    """Two survivors race to steal the same orphan. The arbiter's lock
+    plus the ring make the outcome deterministic-per-ring but the RACE
+    must still be clean: exactly one winner, the loser gets a typed
+    LeaseError (never a double-grant, never an unhandled state)."""
+    for _ in range(20):
+        arb, clock = make_arbiter(ttl=5.0)
+        arb.join("m_dead")
+        (name,) = ring_owned("containers", {"m_dead"}, "m_dead")
+        arb.acquire("containers", name, "m_dead")
+        clock["t"] = 6.0            # m_dead expired
+        arb.join("m0")
+        arb.join("m1")
+        wins, losses = [], []
+
+        def contend(m):
+            try:
+                wins.append(arb.acquire("containers", name, m))
+            except LeaseError as e:
+                losses.append(e)
+
+        ts = [threading.Thread(target=contend, args=(m,))
+              for m in ("m0", "m1")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(wins) == 1 and len(losses) == 1
+        assert losses[0].reason in ("not-owner", "held")
+        rows = arb.grants()
+        assert len(rows) == 1
+        assert rows[0]["holder"] == wins[0]["holder"]
+        assert rows[0]["epoch"] == 2    # exactly one steal happened
+
+
+def test_member_fences_before_rejoin():
+    arb, clock = make_arbiter(ttl=5.0)
+    member = FleetMember("m0", arb, crash_seam=lambda tag: None)
+    member.join()
+    (name,) = ring_owned("containers", {"m0"}, "m0")
+    member.ensure_owned("containers", name)
+    assert ("containers", name) in member.owned
+    clock["t"] = 6.0                # lease expired behind our back
+    out = member.heartbeat_once()   # fences, rejoins, re-derives
+    # the grant row still names m0, so the re-derive rebinds it —
+    # belief came back from the TABLE, not from the stale local set
+    assert ("containers", name) in member.owned
+    assert out["adopted"] == []
+
+
+# -------------------------------------------------------------- watch hub
+
+def test_parse_watch_key_surface():
+    base = federation.ResourcePrefix.Base
+    assert parse_watch_key(f"{base}/containers/rs-0") == \
+        ("containers", "rs-0")
+    assert parse_watch_key(f"{base}/gateways/gw") == ("gateways", "gw")
+    assert parse_watch_key(grant_key("containers", "rs-0")) == \
+        ("fleet.grants", "containers:rs-0")
+    # one level deeper is implementation detail (version history rows)
+    assert parse_watch_key(f"{base}/versions/rs-0/1") is None
+    assert parse_watch_key("/elsewhere/entirely") is None
+
+
+def test_watched_store_feeds_every_revision_in_order():
+    hub = WatchHub()
+    store = WatchedStore(MVCCStore(), hub)
+    base = federation.ResourcePrefix.Base
+    r1 = store.put(f"{base}/containers/a", "1")
+    r2 = store.put_many([(f"{base}/containers/b", "2"),
+                         (f"{base}/containers/c", "3")])
+    store.delete(f"{base}/containers/a")
+    evts = hub.events_since(0)
+    assert [e["revision"] for e in evts] == [r1, r2 - 1, r2, r2 + 1]
+    assert [e["type"] for e in evts] == ["put", "put", "put", "delete"]
+    assert evts[-1]["name"] == "a" and evts[-1]["value"] is None
+    # resume is exclusive: from r2, only the delete remains
+    assert [e["revision"] for e in hub.events_since(r2)] == [r2 + 1]
+    rev, items = store.list_snapshot("containers")
+    assert rev == store.revision
+    assert sorted(i["name"] for i in items) == ["b", "c"]
+
+
+def test_watch_hub_compaction_refuses_stale_resume():
+    hub = WatchHub(capacity=16)     # constructor floor-clamps to 16
+    store = WatchedStore(MVCCStore(), hub)
+    base = federation.ResourcePrefix.Base
+    for i in range(40):
+        store.put(f"{base}/containers/rs-{i}", str(i))
+    assert hub.floor > 0            # the ring evicted
+    with pytest.raises(WatchCompactedError) as ei:
+        hub.events_since(0)
+    assert ei.value.floor == hub.floor
+    # resume exactly at the floor is complete (floor itself evicted,
+    # everything after retained)
+    evts = hub.events_since(hub.floor)
+    assert [e["revision"] for e in evts] == \
+        list(range(hub.floor + 1, hub.head + 1))
+
+
+# ------------------------------------------------------------ model sweeps
+
+def test_lease_model_swept_exhaustively():
+    stats = models.sweep_lease(max_schedules=CAP)
+    assert 0 < stats["schedules"] < CAP, "cap hit: sweep not exhaustive"
+    assert stats["killed_runs"] > 50    # the kill pass really injected
+
+
+def test_fedwatch_model_swept_exhaustively():
+    stats = models.sweep_fedwatch(max_schedules=CAP)
+    assert 0 < stats["schedules"] < CAP, "cap hit: sweep not exhaustive"
+    assert stats["killed_runs"] > 100
+
+
+def test_lease_l1_checker_live_on_mutant():
+    """The split-brain checker must catch an arbiter that steals from
+    LIVE holders — and the failure must replay bit-for-bit."""
+    with pytest.raises(InvariantViolation) as ei:
+        models.sweep_lease(arbiter_cls=models.BrokenFleetArbiter,
+                           max_schedules=CAP)
+    v = ei.value
+    assert "L1 split brain" in str(v)
+    assert v.schedule, "failure report lost its schedule"
+    kills = 1 if v.variant == "kill" else 0
+    preempt = 0 if v.variant == "kill" else 2
+    with pytest.raises(InvariantViolation) as ei2:
+        models.run_model(
+            lambda s: models.LeaseModel(
+                s, arbiter_cls=models.BrokenFleetArbiter),
+            ReplayStrategy(v.schedule), kills=kills, preemptions=preempt)
+    assert ei2.value.message == v.message
+
+
+def test_lease_l2_checker_live_on_noexpiry_mutant():
+    """The bounded-heal checker must catch an arbiter whose leases never
+    expire: a SIGKILLed member's grants stay pinned forever and no
+    survivor can steal them."""
+    with pytest.raises(InvariantViolation) as ei:
+        models.sweep_lease(arbiter_cls=models.NoExpiryFleetArbiter,
+                           max_schedules=CAP)
+    assert "L2 heal incomplete" in str(ei.value)
+    assert ei.value.schedule
+
+
+def test_fedwatch_checker_live_on_dup_mutant():
+    with pytest.raises(InvariantViolation) as ei:
+        models.sweep_fedwatch(hub_cls=models.BrokenWatchHubDup,
+                              max_schedules=CAP)
+    assert "FW1 duplicated" in str(ei.value)
+    assert ei.value.schedule
+
+
+def test_fedwatch_checker_live_on_drop_mutant():
+    with pytest.raises(InvariantViolation) as ei:
+        models.sweep_fedwatch(hub_cls=models.BrokenWatchHubDrop,
+                              max_schedules=CAP)
+    assert "FW1 dropped" in str(ei.value)
+    assert ei.value.schedule
+
+
+def test_fed_sweeps_deterministic():
+    a = models.sweep_lease(max_schedules=400)
+    b = models.sweep_lease(max_schedules=400)
+    assert a["digest"] == b["digest"]
+    assert a["schedules"] == b["schedules"]
+    c = models.sweep_fedwatch(max_schedules=400)
+    d = models.sweep_fedwatch(max_schedules=400)
+    assert c["digest"] == d["digest"]
+
+
+# ------------------------------------------------------------- HTTP plane
+
+@pytest.fixture()
+def app(tmp_path):
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43400, 43500),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16)
+    a.start()
+    yield a
+    a.stop()
+
+
+def call(app, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=10)
+    payload = json.dumps(body) if body is not None else None
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request(method, path, payload, hdrs)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, json.loads(raw) if raw else None
+
+
+def watch_client(app) -> ApiClient:
+    return ApiClient("127.0.0.1", app.server.port, spec={"paths": {}})
+
+
+def make_rs(app, name, chips=1):
+    status, body = call(app, "POST", "/api/v1/replicaSet", {
+        "imageName": "ubuntu:22.04", "replicaSetName": name,
+        "tpuCount": chips, "cpuCount": 1, "memory": "1GB"})
+    assert status == 200 and body["code"] == 200, body
+    return body["data"]
+
+
+def test_watch_list_then_stream_is_gapless(app):
+    c = watch_client(app)
+    rev0, items0 = c.list_resource("containers")
+    assert items0 == []
+    make_rs(app, "wa")
+    make_rs(app, "wb")
+    seen = []
+    stream = c.watch("containers", from_revision=rev0, heartbeat=0.2)
+    for evt in stream:
+        seen.append(evt)
+        if len(seen) >= 2:
+            break
+    stream.close()
+    names = {e["name"] for e in seen}
+    assert names == {"wa", "wb"}
+    revs = [e["revision"] for e in seen]
+    assert revs == sorted(revs) and len(set(revs)) == 2
+    assert all(r > rev0 for r in revs)
+    # the snapshot taken NOW resumes exactly after those events
+    rev1, items1 = c.list_resource("containers")
+    assert rev1 >= revs[-1]
+    assert {i["name"] for i in items1} == names
+
+
+def test_watch_refuses_compacted_and_foreign_revisions(app):
+    c = watch_client(app)
+    # overrun the ring so the retention floor rises past old history
+    app.hub.capacity = 16
+    base = federation.ResourcePrefix.Base
+    for i in range(40):
+        app.store.put(f"{base}/containers/x{i}", "{}")
+    assert app.hub.floor > 0
+    # below the floor: refused up front with the floor in the envelope
+    with pytest.raises(RelistRequiredError) as ei:
+        next(c.watch("containers", from_revision=app.hub.floor - 1))
+    assert ei.value.floor == app.hub.floor
+    # ahead of the head (another daemon's revision space, post-takeover)
+    with pytest.raises(RelistRequiredError):
+        next(c.watch("containers", from_revision=app.hub.head + 1000))
+
+
+def test_informer_converges_and_applies_in_order(app):
+    inf = Informer([("127.0.0.1", app.server.port)], "containers",
+                   heartbeat=0.2)
+    inf.start()
+    try:
+        make_rs(app, "infa")
+        make_rs(app, "infb")
+        wait_for(lambda: len(inf.snapshot()[1]) == 2,
+                 msg="informer caught both creates")
+        status, body = call(app, "DELETE", "/api/v1/replicaSet/infa")
+        assert body["code"] == 200, body
+        wait_for(lambda: "infa" not in inf.snapshot()[1],
+                 msg="informer applied the delete")
+        rev, cache = inf.snapshot()
+        assert set(cache) == {"infb"}
+        # gap-free: every applied revision strictly increasing, cache
+        # revision equals the last applied one
+        assert inf.revisions == sorted(set(inf.revisions))
+        assert rev == inf.revisions[-1]
+        assert inf.relists == 1      # the seed list only; no forced relist
+        srev, sitems = watch_client(app).list_resource("containers")
+        assert {i["name"] for i in sitems} == set(cache)
+    finally:
+        inf.stop()
+
+
+def test_fleet_rest_surface_and_ownership_guard(tmp_path):
+    """Member seat live: mutations for ring-owned names proceed (and
+    leave a grant row); a name the ring assigns to ANOTHER live member
+    is refused with FleetNotOwner + the owner's address for re-route."""
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43400, 43500),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16,
+            fleet_member="a", fleet_ttl=60.0)
+    a.start()
+    try:
+        # a phantom second member with a live 60s lease splits the ring
+        status, body = call(a, "POST", "/api/v1/fleet/lease",
+                            {"member": "b", "addr": "10.0.0.2:2378"})
+        assert body["code"] == 200, body
+        status, body = call(a, "GET", "/api/v1/fleet/members")
+        assert {m["member"] for m in body["data"]["members"]} == \
+            {"a", "b"}
+        mine = ring_owned("containers", {"a", "b"}, "a", count=1)[0]
+        theirs = ring_owned("containers", {"a", "b"}, "b", count=1)[0]
+        make_rs(a, mine)
+        _, body = call(a, "GET", "/api/v1/fleet/grants")
+        grants = {(g["resource"], g["name"]): g["holder"]
+                  for g in body["data"]["grants"]}
+        assert grants[("containers", mine)] == "a"
+        status, body = call(a, "POST", "/api/v1/replicaSet", {
+            "imageName": "ubuntu:22.04", "replicaSetName": theirs,
+            "tpuCount": 1, "cpuCount": 1, "memory": "1GB"})
+        assert status == 200
+        assert body["code"] == 1037, body       # FleetNotOwner
+        assert body["data"]["owner"] == "b"
+        assert body["data"]["ownerAddr"] == "10.0.0.2:2378"
+        # reads are never fenced: GET on the foreign name still 404s
+        # through the normal handler, not the guard
+        _, body = call(a, "GET", f"/api/v1/replicaSet/{theirs}")
+        assert body["code"] != 1037
+    finally:
+        a.stop()
+
+
+# -------------------------------------- satellite: events-ring gap (SSE)
+
+def test_follow_events_raises_typed_gap_on_ring_overrun(app):
+    """Resume with a Last-Event-ID the ring has evicted: the server must
+    open the stream with an `event: gap` frame and the client must
+    surface it as EventGapError — never silently skip the hole."""
+    make_rs(app, "gapseed")        # some real traffic first
+    # shrink the retention ring in place, then overrun it
+    app.events._ring = collections.deque(app.events._ring, maxlen=8)
+    for i in range(32):
+        app.events.record("test.noise", target=f"n{i}")
+    first = app.events.first_retained
+    assert first > 2                # the resume point below is evicted
+    c = watch_client(app)
+    with pytest.raises(EventGapError) as ei:
+        next(c.follow_events(last_event_id=1))
+    assert ei.value.first_retained == first
+    assert ei.value.last_event_id == 1
+    # a resume INSIDE the retained window is not a gap: the next event
+    # after the cursor arrives normally. Re-read the floor — the gap
+    # audit event the server just recorded moved the ring itself.
+    first = app.events.first_retained
+    evts = c.follow_events(last_event_id=first)
+    evt = next(evts)
+    assert evt["seq"] == first + 1
+    evts.close()
+    # and the daemon recorded the gap for the audit trail
+    status, body = call(app, "GET", "/api/v1/events?target=events")
+    ops = [e["op"] for e in body["data"]["events"]]
+    assert "watch.gap" in ops
+
+
+# ------------------------------------------------- e2e: SIGKILL takeover
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_daemon_sigkill_takeover(tmp_path):
+    """Two real daemons, one fleet, TTL 1s. The non-host member acquires
+    its ring slice over REST, then dies by SIGKILL. The host must steal
+    every orphaned grant within a few TTLs (zero leaked to the dead
+    member, zero double-owned), and an informer watching the grant
+    table on the surviving daemon must see a strictly-increasing,
+    relist-free revision sequence whose final cache equals the table."""
+    ttl = 1.0
+    a = App(state_dir=str(tmp_path / "a"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43400, 43500),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16,
+            fleet_member="a", fleet_ttl=ttl)
+    a.start()
+    port_b = free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("APIKEY", None)
+    blog = open(tmp_path / "b.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpu_docker_api_tpu.cli",
+         "-a", f"127.0.0.1:{port_b}", "-s", str(tmp_path / "b"),
+         "-b", "mock", "-t", "v4-32", "-p", "43400-43500",
+         "--health-interval", "0", "--warm-pool", "0", "--cpu-cores", "16",
+         "--fleet-member", "b",
+         "--fleet-host", f"127.0.0.1:{a.server.port}",
+         "--fleet-ttl", str(ttl)],
+        env=env, stdout=blog, stderr=blog, cwd="/root/repo")
+    inf = Informer([("127.0.0.1", a.server.port)], "fleet.grants",
+                   heartbeat=0.2)
+    try:
+        def ping_b():
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port_b,
+                                                  timeout=2)
+                conn.request("GET", "/ping")
+                ok = conn.getresponse().status == 200
+                conn.close()
+                return ok
+            except OSError:
+                return False
+        wait_for(ping_b, timeout=60, msg="daemon b serving")
+        wait_for(lambda: {m["member"] for m in a.fleet.arbiter.members()}
+                 == {"a", "b"}, timeout=15, msg="b joined the fleet")
+
+        inf.start()
+        names_b = ring_owned("containers", {"a", "b"}, "b", count=2)
+        cb = ApiClient("127.0.0.1", port_b, spec={"paths": {}})
+        for n in names_b:
+            payload = json.dumps({
+                "imageName": "ubuntu:22.04", "replicaSetName": n,
+                "tpuCount": 1, "cpuCount": 1, "memory": "1GB"}).encode()
+            out = cb._envelope(
+                cb._raw("POST", "/api/v1/replicaSet", payload), "create")
+            assert out["code"] == 200, out
+        cb.close()
+        wait_for(lambda: {g["name"] for g in a.fleet.arbiter.grants()}
+                 == set(names_b), timeout=10,
+                 msg="b's grants landed on the host")
+        before = {g["name"]: g for g in a.fleet.arbiter.grants()}
+        assert all(g["holder"] == "b" for g in before.values())
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # survivor must fence out the corpse and adopt its whole slice
+        wait_for(lambda: all(g["holder"] == "a"
+                             for g in a.fleet.arbiter.grants()),
+                 timeout=10 * ttl, msg="takeover")
+        grants = a.fleet.arbiter.grants()
+        assert len(grants) == len(names_b)      # zero leaked, zero dup
+        for g in grants:
+            assert g["epoch"] == before[g["name"]]["epoch"] + 1
+            assert ("containers", g["name"]) in a.fleet.member.owned
+        assert {m["member"] for m in a.fleet.arbiter.members()} == {"a"}
+        assert a.fleet.member.takeovers_total == len(names_b)
+
+        # informer watched the whole churn on the survivor: the steal
+        # rewrites must arrive, in order, without a forced relist
+        wait_for(lambda: all(
+            json.loads(v["value"])["holder"] == "a"
+            for v in inf.snapshot()[1].values()) and
+            len(inf.snapshot()[1]) == len(names_b),
+            timeout=10, msg="informer converged on the takeover")
+        revs = list(inf.revisions)
+        assert revs == sorted(set(revs)), "dropped/duplicated revision"
+        assert inf.relists == 1                 # the seed list only
+        rev, cache = inf.snapshot()
+        table = {f"containers:{g['name']}": g for g in grants}
+        assert set(cache) == set(table)
+        for k, v in cache.items():
+            assert json.loads(v["value"])["epoch"] == table[k]["epoch"]
+    finally:
+        inf.stop()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        blog.close()
+        a.stop()

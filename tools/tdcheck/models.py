@@ -38,14 +38,36 @@ WAL group commit (Python twin of native/mvcc_store.cc Append/Commit):
       crash at ANY yield point never loses an acked record, across
       leader handoff (a follower acked by another leader's flush).
   W2  the flushed stream is strictly ordered and duplicate-free.
+
+federation leases (real `FleetArbiter` / `FleetMember.heartbeat_once`,
+federation.py — the member's crash seams are the production
+fed.after_acquire / fed.after_takeover crashpoints, so every injected
+kill lands in a window the crash sweep also exercises):
+  L1  at most one live member believes it owns a resource, at every
+      observable store state (a steal from a LIVE-leased holder is the
+      split-brain this catches).
+  L2  bounded heal: after a member SIGKILL at any yield point, one lease
+      expiry plus the surviving members' heartbeats re-grant EVERY
+      resource to a live-leased member and the believed sets match the
+      grant table (a leaked grant that nobody can steal is the
+      stuck-ownership direction).
+
+federation watch (real `WatchedStore` + `WatchHub`, federation.py):
+  FW1 an informer consuming the hub across a mid-stream consumer kill +
+      cursor-resume (the takeover handoff) applies a strictly-increasing
+      revision sequence — zero duplicated revisions — and its final
+      cache equals the store's watched state — zero dropped revisions.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 from typing import Callable, Optional
 
+from gpu_docker_api_tpu import federation
 from gpu_docker_api_tpu.server import workers
+from gpu_docker_api_tpu.store.mvcc import MVCCStore
 
 from .instrument import BrokenSeqlockState, InstrumentedState, install_seams
 from .sched import (
@@ -562,6 +584,286 @@ class WalModel(Model):
             raise self.violation("wal run exceeded its step budget")
 
 
+# ------------------------------------------------------- federation lease
+
+#: two names chosen so the two-member ring splits them (rs/r2 -> m0,
+#: rs/r0 -> m1) while a lone member owns both — the interleavings where
+#: one member acquires a name before the other joins, and the ring
+#: reassigns it on the join, are exactly where a broken arbiter splits
+#: ownership
+LEASE_RESOURCES = ("r2", "r0")
+
+
+class BrokenFleetArbiter(federation.FleetArbiter):
+    """Seeded mutant for L1: acquire skips the holder-lease-liveness
+    check — any ring owner 'steals' a grant from a LIVE member, who
+    keeps believing it owns the resource. Split-brain by construction."""
+
+    def acquire(self, resource, name, member):
+        with self._lock:
+            now = self.clock()
+            live = self._sweep_expired(now)
+            if member not in live:
+                raise federation.LeaseError("no-lease", f"{member} dead")
+            owner = federation.HashRing.owner_of(f"{resource}/{name}",
+                                                 live)
+            if owner != member:
+                raise federation.LeaseError("not-owner", f"-> {owner}",
+                                            owner=owner or "")
+            gk = federation.grant_key(resource, name)
+            kv = self.store.get(gk)
+            prev = json.loads(kv.value) if kv is not None else None
+            # BUG: no prev["holder"] in live refusal — live holders are
+            # stolen from exactly like expired ones
+            doc = {"resource": resource, "name": name, "holder": member,
+                   "epoch": (prev or {}).get("epoch", 0) + 1}
+            self.store.put(gk, json.dumps(doc))
+            doc = dict(doc)
+            doc["stolenFrom"] = (prev or {}).get("holder", "")
+            return doc
+
+
+class NoExpiryFleetArbiter(federation.FleetArbiter):
+    """Seeded mutant for L2: the expiry sweep never expires anything, so
+    a SIGKILLed member's lease pins its grants forever — no survivor can
+    steal, ownership never heals."""
+
+    def _sweep_expired(self, now):
+        return self._leases()      # BUG: every lease is forever live
+
+
+class LeaseModel(Model):
+    """Two FleetMembers working the REAL arbiter + member protocol over
+    an in-memory MVCC store, on a logical clock. Each member joins, races
+    to acquire both names through the ring, then heartbeats; the healer
+    (the surviving daemons' watchdog cadence, not killable) waits for the
+    members to settle, expires the dead by advancing the clock past the
+    TTL, and drives the survivors' heartbeats — which must fence, rejoin,
+    re-derive, and steal every orphan. L1 is checked at EVERY scheduler
+    step via step_hook; L2 at the frozen end state."""
+
+    name = "lease"
+
+    TTL = 10.0
+
+    def __init__(self, sched: Scheduler,
+                 arbiter_cls: type = federation.FleetArbiter):
+        super().__init__(sched)
+        self.now = 0.0
+        self.store = MVCCStore()
+        self.arbiter = arbiter_cls(self.store, ttl=self.TTL,
+                                   clock=lambda: self.now)
+        self.members: dict[str, federation.FleetMember] = {}
+        for m in ("m0", "m1"):
+            member = federation.FleetMember(
+                m, self.arbiter,
+                crash_seam=lambda tag: sched.yield_point(("seam", tag)))
+            self.members[m] = member
+            sched.spawn(m, self._member_fn(member))
+        sched.spawn("healer", self._healer, killable=False)
+        sched.step_hook = self._check_l1
+
+    def _member_fn(self, member) -> Callable[[], None]:
+        def fn() -> None:
+            member.join()
+            self.sched.yield_point(("joined", 0))
+            for r in LEASE_RESOURCES:
+                try:
+                    member.ensure_owned("rs", r)
+                except federation.LeaseError:
+                    pass        # not ours on the current ring — clean loss
+                self.sched.yield_point(("acq", 0))
+            member.heartbeat_once()
+        return fn
+
+    def _healer(self) -> None:
+        procs = self.sched.procs
+        while not all(procs[m].done or procs[m].killed
+                      for m in self.members):
+            self.sched.yield_point(("heal-wait", 0))
+        if not any(procs[m].killed for m in self.members):
+            return
+        # the arbiter's clock passes the dead member's expiry; survivors'
+        # next beats fence (their own leases expired too), rejoin, and
+        # sweep the orphans. Two beats: the first may spend its pass
+        # rejoining, the second must converge.
+        self.now += self.TTL + 1.0
+        for _ in range(2):
+            for m, member in self.members.items():
+                if not procs[m].killed:
+                    member.heartbeat_once()
+
+    # ---- invariants ------------------------------------------------------
+
+    def _check_l1(self) -> None:
+        for r in LEASE_RESOURCES:
+            holders = [m for m, member in self.members.items()
+                       if ("rs", r) in member.owned
+                       and not self.sched.procs[m].killed]
+            if len(holders) > 1:
+                raise self.violation(
+                    f"L1 split brain: {holders} both believe they own "
+                    f"rs/{r}")
+
+    def finish(self, result: RunResult) -> None:
+        self._check_l1()
+        live_procs = {m for m in self.members
+                      if not self.sched.procs[m].killed}
+        if not live_procs:
+            return      # whole fleet dead: nothing to heal with
+        # L2 is about GRANTS healing: every grant row a dead member left
+        # behind must have been stolen by a live ring owner within one
+        # expiry + two heartbeat rounds, and every surviving grant must
+        # be believed by its holder. (A name the dead member never
+        # acquired has no grant to heal — it is reacquired on demand by
+        # the next ensure_owned, not by the takeover sweep.)
+        leases = {d["member"] for d in self.arbiter.members()}
+        for g in self.arbiter.grants():
+            holder = g["holder"]
+            rid = (g["resource"], g["name"])
+            if holder not in live_procs or holder not in leases:
+                raise self.violation(
+                    f"L2 heal incomplete: {g['resource']}/{g['name']} "
+                    f"still granted to {holder!r} (live procs "
+                    f"{sorted(live_procs)}, live leases {sorted(leases)}) "
+                    f"after expiry + 2 heartbeat rounds")
+            if rid not in self.members[holder].owned:
+                raise self.violation(
+                    f"L2 grant/belief split: {g['resource']}/{g['name']} "
+                    f"granted to {holder} but the member does not "
+                    f"believe it")
+
+    def check(self, result: RunResult) -> None:
+        if result.wedged:
+            raise self.violation("lease run exceeded its step budget")
+
+
+# ------------------------------------------------------- federation watch
+
+class BrokenWatchHubDup(federation.WatchHub):
+    """Seeded mutant for FW1 (duplicate direction): resume returns
+    events with revision >= cursor — the last-applied event is delivered
+    again after every reconnect."""
+
+    def _since_locked(self, revision, resource):
+        if revision < self.floor:
+            raise federation.WatchCompactedError(revision, self.floor)
+        return [e for e in self._ring
+                if e["revision"] >= revision        # BUG: off-by-one
+                and (not resource or e["resource"] == resource)]
+
+
+class BrokenWatchHubDrop(federation.WatchHub):
+    """Seeded mutant for FW1 (drop direction): resume skips the first
+    pending event — a takeover resume silently loses one revision."""
+
+    def _since_locked(self, revision, resource):
+        if revision < self.floor:
+            raise federation.WatchCompactedError(revision, self.floor)
+        return [e for e in self._ring
+                if e["revision"] > revision + 1     # BUG: skips one
+                and (not resource or e["resource"] == resource)]
+
+
+class FedWatchModel(Model):
+    """One writer mutating watched keys through the REAL WatchedStore;
+    one killable consumer applying hub events to an informer cache with
+    an atomically-updated cursor; one resume consumer (the informer
+    reconnected against the takeover survivor, not killable) that drains
+    from the shared cursor once the first consumer settles. FW1 at the
+    frozen end state."""
+
+    name = "fedwatch"
+
+    KEYS = ("c0", "c1")
+
+    def __init__(self, sched: Scheduler,
+                 hub_cls: type = federation.WatchHub):
+        super().__init__(sched)
+        self.hub = hub_cls(capacity=64)
+        self.store = federation.WatchedStore(MVCCStore(), self.hub)
+        self.cache: dict[str, dict] = {}
+        self.applied: list[int] = []
+        self.cursor = self.store.revision
+        sched.spawn("writer", self._writer)
+        sched.spawn("consumer", self._consumer_fn(resume=False))
+        sched.spawn("resume", self._consumer_fn(resume=True),
+                    killable=False)
+
+    def _writer(self) -> None:
+        base = "/tpu-docker-api/apis/v1/containers"
+        self.store.put(f"{base}/{self.KEYS[0]}", "v1")
+        self.sched.yield_point(("put", 0))
+        self.store.put(f"{base}/{self.KEYS[1]}", "v1")
+        self.sched.yield_point(("put", 1))
+        self.store.put(f"{base}/{self.KEYS[0]}", "v2")
+        self.sched.yield_point(("put", 2))
+        self.store.delete(f"{base}/{self.KEYS[1]}")
+
+    def _drain(self) -> bool:
+        """Apply every pending event; cache+applied+cursor move together
+        between yield points (the informer's apply is one critical
+        section — a kill lands before or after an apply, never inside)."""
+        evts = self.hub.events_since(self.cursor, resource="containers")
+        for e in evts:
+            if e["type"] == "delete":
+                self.cache.pop(e["name"], None)
+            else:
+                self.cache[e["name"]] = {"value": e["value"],
+                                         "modRevision": e["revision"]}
+            self.applied.append(e["revision"])
+            self.cursor = e["revision"]
+            self.sched.yield_point(("apply", e["revision"]))
+        return bool(evts)
+
+    def _consumer_fn(self, resume: bool) -> Callable[[], None]:
+        def fn() -> None:
+            procs = self.sched.procs
+            if resume:
+                # the reconnected informer takes over only after the
+                # first consumer is gone — one live consumer per cursor,
+                # which is the informer contract (the resume happens
+                # AGAINST the surviving daemon, not alongside the dying
+                # one)
+                while not (procs["consumer"].done
+                           or procs["consumer"].killed):
+                    self.sched.yield_point(("wait-handoff", 0))
+            upstream = ("writer", "consumer") if resume else ("writer",)
+            while True:
+                progressed = self._drain()
+                if not progressed and all(procs[u].done or procs[u].killed
+                                          for u in upstream):
+                    if not self._drain():     # settled: one final sweep
+                        return
+                self.sched.yield_point(("poll", int(resume)))
+        return fn
+
+    def finish(self, result: RunResult) -> None:
+        for prev, cur in zip(self.applied, self.applied[1:]):
+            if cur <= prev:
+                raise self.violation(
+                    f"FW1 duplicated/reordered revision: applied "
+                    f"sequence {self.applied} is not strictly increasing")
+        if self.sched.procs["writer"].killed:
+            # store state is still well-defined (kills land at yield
+            # points, never inside a put) — the cache must match it
+            pass
+        want = {}
+        prefix = "/tpu-docker-api/apis/v1/containers/"
+        for kv in self.store.range(prefix):
+            want[kv.key[len(prefix):]] = {"value": kv.value,
+                                          "modRevision": kv.mod_revision}
+        got = {k: v for k, v in self.cache.items()}
+        if got != want:
+            raise self.violation(
+                f"FW1 dropped revision: informer cache {got} != watched "
+                f"store state {want} after the resume consumer settled")
+
+    def check(self, result: RunResult) -> None:
+        if result.wedged:
+            raise self.violation("fedwatch run exceeded its step budget")
+
+
 # ---------------------------------------------------------------- sweeps
 
 def _annotating(variant: str, run_once):
@@ -661,11 +963,65 @@ def sweep_wal(mode: str = "exhaustive", max_schedules: int = 4000,
     return _seal(stats)
 
 
-SWEEPS = {"seqlock": sweep_seqlock, "claim": sweep_claim, "wal": sweep_wal}
+def sweep_lease(mode: str = "exhaustive", max_schedules: int = 4000,
+                seed: int = 0, preemptions: int = 2,
+                arbiter_cls: type = federation.FleetArbiter) -> dict:
+    """Two passes, same shape as claim: the no-kill pass explores
+    acquire/join/ring-change interleavings at the preemption bound; the
+    kill pass injects one member SIGKILL at every yield point (the
+    production crash seams included) with the kill placement as the
+    enumerated disturbance."""
+    stats = _new_stats("lease")
+
+    def no_kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: LeaseModel(s, arbiter_cls=arbiter_cls),
+                         strategy, preemptions=preemptions, kills=0)
+
+    def kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: LeaseModel(s, arbiter_cls=arbiter_cls),
+                         strategy, preemptions=0, kills=1)
+
+    for run_once in (_annotating("no-kill", no_kill),
+                     _annotating("kill", kill)):
+        for res in explore(run_once, mode=mode,
+                           max_schedules=max_schedules, seed=seed):
+            _tally(stats, res)
+    return _seal(stats)
+
+
+def sweep_fedwatch(mode: str = "exhaustive", max_schedules: int = 4000,
+                   seed: int = 0, preemptions: int = 2,
+                   hub_cls: type = federation.WatchHub) -> dict:
+    stats = _new_stats("fedwatch")
+
+    def no_kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: FedWatchModel(s, hub_cls=hub_cls),
+                         strategy, preemptions=preemptions, kills=0)
+
+    def kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: FedWatchModel(s, hub_cls=hub_cls),
+                         strategy, preemptions=0, kills=1)
+
+    for run_once in (_annotating("no-kill", no_kill),
+                     _annotating("kill", kill)):
+        for res in explore(run_once, mode=mode,
+                           max_schedules=max_schedules, seed=seed):
+            _tally(stats, res)
+    return _seal(stats)
+
+
+SWEEPS = {"seqlock": sweep_seqlock, "claim": sweep_claim, "wal": sweep_wal,
+          "lease": sweep_lease, "fedwatch": sweep_fedwatch}
 
 MUTANTS = {
     "seqlock": lambda **kw: sweep_seqlock(state_cls=BrokenSeqlockState,
                                           **kw),
     "claim": lambda **kw: sweep_claim(router_cls=BrokenClaimRouter, **kw),
     "wal": lambda **kw: sweep_wal(twin_cls=BrokenWalTwin, **kw),
+    # the CLI gate proves one mutant per model; the L2 (NoExpiry) and
+    # drop-direction watch mutants are proven in tests/test_federation.py
+    "lease": lambda **kw: sweep_lease(arbiter_cls=BrokenFleetArbiter,
+                                      **kw),
+    "fedwatch": lambda **kw: sweep_fedwatch(hub_cls=BrokenWatchHubDup,
+                                            **kw),
 }
