@@ -8,7 +8,8 @@ STATE ?= ./tpu-docker-api-state
 .PHONY: all native native-san test test-fast verify-crash verify-faults \
     verify-perf verify-retry verify-migrate verify-mt verify-races \
     verify-obs verify-gateway verify-gang verify-workers verify-tdcheck \
-    verify-fed bench serve serve-mock dryrun apidoc lint clean
+    verify-fed verify-durability bench serve serve-mock dryrun apidoc \
+    lint clean
 
 all: native
 
@@ -34,6 +35,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-workers (multi-process data-plane sweep: -m workers)"
 	@echo "  make verify-tdcheck (cross-process protocol model-check: -m tdcheck)"
 	@echo "  make verify-fed     (federated control-plane sweep: -m fed)"
+	@echo "  make verify-durability (durable state plane sweep: -m durability)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -74,6 +76,9 @@ verify-tdcheck: native  ## cross-process protocol model-check: interleaving + ki
 
 verify-fed:             ## federated control plane: leases, takeover models, list+watch, SIGKILL e2e
 	$(PY) -m pytest tests/ -q -m fed
+
+verify-durability: native  ## durable state plane: WAL integrity, backup/restore, replication, promote
+	$(PY) -m pytest tests/ -q -m durability
 
 lint: native            ## compile baseline + tdlint rules (stale pragmas fail) + rule/checker liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

@@ -2278,6 +2278,178 @@ def federation_bench() -> dict:
     return out
 
 
+def durability_bench() -> dict:
+    """Durable state plane (docs/durability.md): v1 CRC-framing overhead
+    against the unframed v0 append path (criterion <= 5% — the integrity
+    tax must stay invisible), snapshot/backup throughput, warm-standby
+    replication lag through a live daemon's HTTP watch stream, and
+    promote-on-loss heal latency against the documented
+    1.5x(TTL + heartbeat) takeover bound. Headlines:
+    wal_crc_overhead_pct, snapshot_mb_s, repl_lag_ms_p99, promote_ms."""
+    import shutil
+    import threading
+
+    from gpu_docker_api_tpu.federation import (FleetArbiter, FleetMember,
+                                               HashRing)
+    from gpu_docker_api_tpu.replication import (StandbyReplicator,
+                                                resource_key)
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.store.client import ResourcePrefix
+    from gpu_docker_api_tpu.store.mvcc import MVCCStore
+    from gpu_docker_api_tpu.store.native import open_store
+    from gpu_docker_api_tpu.topology import make_topology
+
+    out: dict = {}
+
+    # ---- WAL CRC framing overhead -------------------------------------
+    # same engine, same payloads; the only variable is the append
+    # format — a fresh store writes v1 frames, a store opened on a
+    # seeded v0 file keeps appending unframed v0 lines (no mixed files)
+    n_puts = 4000
+
+    def put_rate(seed_v0: bool) -> float:
+        d = tempfile.mkdtemp(prefix="tdapi-walfmt-")
+        try:
+            p = os.path.join(d, "wal")
+            if seed_v0:
+                with open(p, "w") as f:
+                    f.write('{"op": "put", "k": "/seed", "v": "0", '
+                            '"r": 1}\n')
+            s = open_store(p, engine="python")
+            t0 = time.perf_counter()
+            for i in range(n_puts):
+                s.put(f"/k{i % 97}", "x" * 64)
+            dt = time.perf_counter() - t0
+            s.close()
+            return n_puts / dt
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    # best-of-3 each way: the comparison is a format diff, not a noise
+    # measurement
+    v1_rate = max(put_rate(False) for _ in range(3))
+    v0_rate = max(put_rate(True) for _ in range(3))
+    out["wal"] = {
+        "puts": n_puts,
+        "v1_puts_per_sec": round(v1_rate),
+        "v0_puts_per_sec": round(v0_rate),
+        "wal_crc_overhead_pct": round(
+            max(0.0, (v0_rate - v1_rate) / v0_rate * 100.0), 2),
+    }
+
+    # ---- snapshot/backup throughput -----------------------------------
+    d = tempfile.mkdtemp(prefix="tdapi-snap-")
+    try:
+        s = open_store(os.path.join(d, "wal"), engine="python")
+        val = "y" * 1024
+        for i in range(16000):
+            s.put(f"/snap/k{i}", val)
+        bk = os.path.join(d, "bk.wal")
+        t0 = time.perf_counter()
+        s.backup(bk)
+        dt = time.perf_counter() - t0
+        mb = os.path.getsize(bk) / 1e6
+        s.close()
+        out["snapshot"] = {"mb": round(mb, 1),
+                           "snapshot_mb_s": round(mb / dt, 1)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    # ---- replication lag through a live watch stream ------------------
+    # one daemon, one StandbyReplicator tailing it over HTTP; per put,
+    # the wall from the store ack to the replica's horizon covering it
+    state_dir = tempfile.mkdtemp(prefix="tdapi-repl-")
+    app = App(state_dir=state_dir, backend="mock", addr="127.0.0.1:0",
+              topology=make_topology("v4-32"), api_key="", cpu_cores=4)
+    app.start()
+    repl = StandbyReplicator(f"127.0.0.1:{app.server.port}",
+                             os.path.join(state_dir, "replica"),
+                             engine="python")
+    repl.start()
+    try:
+        deadline = time.time() + 10.0
+        while not repl.connected and time.time() < deadline:
+            time.sleep(0.01)
+        lats = []
+        base = ResourcePrefix.Base
+        for i in range(300):
+            rev = app.store.put(f"{base}/containers/bench{i % 32}",
+                                f'{{"i": {i}}}')
+            t0 = time.perf_counter()
+            while repl.horizon < rev:
+                time.sleep(0.0005)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+        out["repl"] = {
+            "events": len(lats),
+            "repl_lag_ms_p50": round(lats[len(lats) // 2], 2),
+            "repl_lag_ms_p99": round(lats[int(len(lats) * 0.99)], 2),
+            "resyncs": repl.resyncs_total,
+        }
+    finally:
+        repl.stop()
+        app.stop()
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    # ---- promote-on-loss heal latency ---------------------------------
+    # the federation takeover shape plus the promote leg: b owns a slice
+    # and stops renewing (the SIGKILL analogue); a — holding a warm
+    # replica of b's records — must steal each grant behind the bumped
+    # epoch AND install the replicated copy. The measured wall is
+    # kill -> last record promoted, against the same documented bound
+    # takeover itself is held to.
+    ttl, beat = 0.5, 0.1
+    store = MVCCStore()
+    arb = FleetArbiter(store, ttl=ttl)
+    replica = MVCCStore()
+    promoted: list[tuple[str, str]] = []
+
+    def promote(resource: str, name: str) -> None:
+        kv = replica.get(resource_key(resource, name))
+        if kv is not None and store.get(resource_key(resource,
+                                                     name)) is None:
+            store.put(resource_key(resource, name), kv.value)
+        promoted.append((resource, name))
+
+    a = FleetMember("a", arb, addr="hosta:2378", promote=promote)
+    a.start(interval=beat)
+    try:
+        arb.join("b", addr="hostb:2378")
+        victims = [f"rs{i}" for i in range(32)
+                   if HashRing.owner_of(f"containers/rs{i}",
+                                        {"a", "b"}) == "b"][:8]
+        for nm in victims:
+            arb.acquire("containers", nm, "b")
+            replica.put(resource_key("containers", nm), f'{{"n": "{nm}"}}')
+        t_kill = time.perf_counter()   # b's last sign of life
+        deadline = t_kill + 30.0
+        want = {("containers", nm) for nm in victims}
+        while time.perf_counter() < deadline:
+            if want <= set(promoted):
+                break
+            time.sleep(0.01)
+        assert want <= set(promoted), f"promote incomplete: {promoted}"
+        promote_ms = (time.perf_counter() - t_kill) * 1e3
+        for nm in victims:
+            assert store.get(resource_key("containers", nm)) is not None
+    finally:
+        a.stop()
+    out["promote"] = {
+        "records": len(victims), "ttl_s": ttl, "heartbeat_s": beat,
+        "promote_ms": round(promote_ms, 1),
+        "bound_ms": round((ttl + beat) * 1e3 * 1.5, 1),
+        "within_bound": promote_ms <= (ttl + beat) * 1e3 * 1.5,
+    }
+
+    log(f"durability: crc overhead "
+        f"{out['wal']['wal_crc_overhead_pct']}% (criterion <= 5%), "
+        f"snapshot {out['snapshot']['snapshot_mb_s']} MB/s, repl lag "
+        f"p99 {out['repl']['repl_lag_ms_p99']}ms, promote "
+        f"{out['promote']['promote_ms']}ms (bound "
+        f"{out['promote']['bound_ms']}ms)")
+    return out
+
+
 def check_claims(extra: dict) -> dict:
     """Diff this run's extras against BASELINE.json's machine-readable
     claims table (the same numbers BASELINE.md publishes). Any ratio
@@ -2473,6 +2645,10 @@ def main() -> None:
                 note="federation bench (grant throughput 1->2->4 "
                      "members, takeover heal latency, 1k-subscriber "
                      "watch fan-out + gapless audit)...")
+    run_section(extra, "durability", durability_bench,
+                note="durability bench (WAL CRC framing overhead, "
+                     "snapshot throughput, live replication lag, "
+                     "promote-on-loss heal latency)...")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
@@ -2601,6 +2777,15 @@ def build_summary(p50, platform, vs, extra) -> dict:
             "fed_dropped_revisions": _dig("federation", "watch",
                                           "fed_dropped_revisions"),
             "fed_grant_scale": _dig("federation", "fed_grant_scale"),
+            # durability headlines (docs/durability.md): integrity tax,
+            # snapshot rate, standby freshness, promote heal latency
+            "wal_crc_overhead_pct": _dig("durability", "wal",
+                                         "wal_crc_overhead_pct"),
+            "snapshot_mb_s": _dig("durability", "snapshot",
+                                  "snapshot_mb_s"),
+            "repl_lag_ms_p99": _dig("durability", "repl",
+                                    "repl_lag_ms_p99"),
+            "promote_ms": _dig("durability", "promote", "promote_ms"),
             "claims_ok": _dig("claims", "ok"),
             "claims_failed": len(_dig("claims", "failed", default=[]) or []),
         },

@@ -58,7 +58,7 @@ def _newest_source_mtime() -> float:
 #: canary that keeps a stale build from binding the argtypes below to an
 #: older ABI (a segfault, not a clean error). Bump these when the ABI
 #: changes incompatibly.
-_ABI_CANARY = {"mvccstore": "mvcc_get_fast",
+_ABI_CANARY = {"mvccstore": "mvcc_put_at",
                "topoalloc": "topo_find_box",
                "shmatomics": "shm_hist_observe"}
 
@@ -174,6 +174,21 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.mvcc_revision.restype = c.c_int64
         lib.mvcc_revision.argtypes = [c.c_void_p]
         lib.mvcc_free.argtypes = [c.c_void_p]
+        # durable state plane (PR 17): replica-side exact-revision
+        # applies, point-in-time backup, read-only detector, WAL format
+        lib.mvcc_put_at.restype = c.c_int
+        lib.mvcc_put_at.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                    c.c_int64, c.c_int64, c.c_int64]
+        lib.mvcc_delete_at.restype = c.c_int
+        lib.mvcc_delete_at.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.mvcc_backup.restype = c.c_int64
+        lib.mvcc_backup.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.mvcc_read_only.restype = c.c_int
+        lib.mvcc_read_only.argtypes = [c.c_void_p]
+        lib.mvcc_clear_read_only.restype = None
+        lib.mvcc_clear_read_only.argtypes = [c.c_void_p]
+        lib.mvcc_wal_format.restype = c.c_int
+        lib.mvcc_wal_format.argtypes = [c.c_void_p]
     elif name == "topoalloc":
         lib.topo_find_box.restype = c.c_int
         lib.topo_find_box.argtypes = [

@@ -100,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-ttl", type=float, default=None, metavar="SEC",
                    help="fleet lease TTL; heartbeat runs at TTL/3 "
                         "(default: TDAPI_FLEET_TTL env, else 5)")
+    p.add_argument("--repl-peer", default=None, metavar="HOST:PORT",
+                   help="warm-standby replication: tail this peer "
+                        "daemon's revision watch into a local replica "
+                        "store, so a fleet takeover of the dead peer "
+                        "promotes its records instead of losing them "
+                        "(default: TDAPI_REPL_PEER env, else off; "
+                        "docs/durability.md)")
     p.add_argument("--cpu-cores", type=int, default=None, metavar="N",
                    help="override the schedulable core count (default: "
                         "probe /proc/cpuinfo; mock-backend fleets on "
@@ -107,7 +114,96 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_store_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-docker-api store",
+        description="offline durability tooling for the embedded MVCC "
+                    "store (docs/durability.md)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sc = sub.add_parser("scrub", help="verify WAL frame integrity "
+                        "(CRC + framing) and report where it breaks")
+    sc.add_argument("wal", help="path to the WAL file (state.wal, "
+                    "replica.wal, or a backup file)")
+
+    bk = sub.add_parser("backup", help="write a consistent point-in-time "
+                        "snapshot of the store to a portable WAL file")
+    bk.add_argument("-s", "--state-dir", default="./tpu-docker-api-state",
+                    help="daemon state dir holding state.wal")
+    bk.add_argument("-o", "--out", required=True,
+                    help="backup file to write (atomic: tmp + rename)")
+    bk.add_argument("-r", "--revision", type=int, default=None,
+                    help="snapshot at this revision (default: current "
+                         "head; must be >= the compaction floor)")
+    bk.add_argument("--engine", default="auto",
+                    choices=["auto", "python", "native"])
+
+    rs = sub.add_parser("restore", help="install a backup file as a "
+                        "state dir's WAL (the backup replays to the "
+                        "exact revision history it captured)")
+    rs.add_argument("-s", "--state-dir", default="./tpu-docker-api-state",
+                    help="daemon state dir to restore into")
+    rs.add_argument("-f", "--from", dest="src", required=True,
+                    help="backup file written by `store backup`")
+    rs.add_argument("--force", action="store_true",
+                    help="overwrite an existing state.wal")
+    return p
+
+
+def store_main(argv) -> int:
+    import json as _json
+    import shutil
+
+    from .store import walio
+
+    args = build_store_parser().parse_args(argv)
+    if args.cmd == "scrub":
+        report = walio.scrub(args.wal)
+        print(_json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+    if args.cmd == "backup":
+        from .store import open_store
+        wal = os.path.join(args.state_dir, "state.wal")
+        if not os.path.exists(wal):
+            print(f"no WAL at {wal}", file=sys.stderr)
+            return 1
+        store = open_store(wal_path=wal, engine=args.engine)
+        try:
+            info = store.backup(args.out, revision=args.revision)
+        finally:
+            store.close()
+        print(_json.dumps({"backup": args.out, **info}, sort_keys=True))
+        return 0
+    # restore: scrub-verify the backup, then file placement — the backup
+    # IS a valid WAL, so installing it and letting the next boot replay
+    # is the whole restore (no store object needed, either engine reads it)
+    report = walio.scrub(args.src)
+    if not report["ok"]:
+        print(_json.dumps(report, indent=2, sort_keys=True),
+              file=sys.stderr)
+        print(f"refusing to restore from corrupt backup {args.src}",
+              file=sys.stderr)
+        return 1
+    os.makedirs(args.state_dir, exist_ok=True)
+    wal = os.path.join(args.state_dir, "state.wal")
+    if os.path.exists(wal) and not args.force:
+        print(f"{wal} exists; pass --force to overwrite", file=sys.stderr)
+        return 1
+    tmp = wal + ".restore-tmp"
+    shutil.copyfile(args.src, tmp)
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, wal)
+    print(_json.dumps({"restored": wal, "records": report["records"],
+                       "format": report["format"]}, sort_keys=True))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "store":
+        return store_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.logLevel.upper()),
@@ -143,6 +239,7 @@ def main(argv=None) -> int:
               fleet_member=args.fleet_member,
               fleet_host=args.fleet_host,
               fleet_ttl=args.fleet_ttl,
+              repl_peer=args.repl_peer,
               cpu_cores=args.cpu_cores)
     app.start()
 

@@ -114,6 +114,20 @@ CRASHPOINTS: dict[str, str] = {
                          "before recording ownership",
     "fed.after_takeover": "orphaned grant stolen, member died before "
                           "adopting the resource state",
+    # promote-on-loss (federation.py FleetMember + replication.py): the
+    # taker-over dies after installing the dead member's replicated
+    # records into its own store but before booting the resource — the
+    # records are durable (installed through the normal put path) and the
+    # stolen grant re-orphans, so the NEXT sweep adopts without re-promote
+    "fed.after_promote": "replicated records installed after a takeover "
+                         "steal, member died before adopting/booting",
+    # standby replication (replication.py StandbyReplicator): death right
+    # after a replica checkpoint (replica WAL compacted + horizon sidecar
+    # persisted) — resume must re-tail from the persisted horizon, and
+    # re-applying any already-applied revision is a no-op (put_at/
+    # delete_at idempotency)
+    "repl.after_snapshot": "replica checkpointed + horizon persisted, "
+                           "replicator died before resuming the tail",
 }
 
 _lock = threading.Lock()
@@ -314,6 +328,118 @@ def fault_gate(op: str) -> None:
     if mode == "hang":
         time.sleep(arg)
     raise InjectedFault(op, mode)
+
+
+# ------------------------------------------------------- disk faults
+
+#: mode -> behavior at the WAL append gate (store/mvcc.py _wal_append)
+DISK_FAULT_MODES: dict[str, str] = {
+    # the write syscall answers ENOSPC: the store must latch read-only
+    # (mutations -> StoreReadOnlyError -> 503 + Retry-After) instead of
+    # leaving group commit in an undefined state. Persistent until
+    # disarmed — a full disk stays full; the store's timed re-probe is
+    # what heals it after the disarm.
+    "enospc": "raise OSError(ENOSPC) on every armed append (heals on "
+              "disarm + store re-probe)",
+    # a crash mid-write: a PREFIX of the record reaches the file, then
+    # the process dies (InjectedCrash). Replay must truncate the torn
+    # frame and keep everything before it.
+    "torn_tail": "write half the record bytes, then die (arg = N appends "
+                 "let through first, default 0)",
+    # silent media corruption: the record is written with one bit
+    # flipped. v1 replay/scrub must detect the CRC mismatch (tail ->
+    # truncate; mid-log -> WalCorruptError).
+    "bitflip": "flip one bit in the record before writing it (arg = N "
+               "appends let through first, default 0)",
+}
+
+_DISK_DEFAULT_ARG = {"enospc": -1.0, "torn_tail": 0.0, "bitflip": 0.0}
+
+_disk_faults: dict[str, _Fault] = {}
+
+
+def arm_disk_fault(spec: str) -> None:
+    """Arm one disk fault from a `path_substring:mode[:arg]` spec. The
+    path substring matches against the store's WAL path, so a test can
+    target one store (state vs replica vs events) on a shared tmpdir."""
+    path_sub, _, rest = spec.partition(":")
+    mode, _, arg_s = rest.partition(":")
+    if not path_sub or mode not in DISK_FAULT_MODES:
+        raise ValueError(f"bad disk fault spec {spec!r} — want "
+                         f"path_substring:mode[:arg] with mode in "
+                         f"{sorted(DISK_FAULT_MODES)}")
+    arg = float(arg_s) if arg_s else _DISK_DEFAULT_ARG[mode]
+    f = _Fault(path_sub, mode, arg)
+    # enospc is persistent (remaining -1); torn_tail/bitflip fire ONCE
+    # after `arg` appends are let through
+    f.remaining = -1 if mode == "enospc" else int(arg)
+    with _lock:
+        _disk_faults[path_sub] = f
+
+
+def disarm_disk_faults() -> None:
+    with _lock:
+        _disk_faults.clear()
+
+
+def disk_fault(path: str) -> str:
+    """Crossed by the store's WAL append with the WAL path; returns the
+    mode to inject ('' = none). torn_tail/bitflip consume a let-through
+    countdown first, then fire once; enospc fires on every crossing."""
+    if not _disk_faults:
+        return ""
+    with _lock:
+        for f in _disk_faults.values():
+            if f.op not in path:
+                continue
+            if f.mode == "enospc":
+                return f.mode
+            if f.remaining > 0:
+                f.remaining -= 1     # appends let through pre-fault
+                return ""
+            if f.remaining == 0:
+                f.remaining = -2     # fired; inert until re-armed
+                return f.mode
+    return ""
+
+
+def corrupt_wal(path: str, mode: str, line_at: float = 0.5) -> int:
+    """OFFLINE corruption helper for scrub/replay tests (the live gate
+    above only reaches the python engine's append path; this damages any
+    engine's closed WAL file directly). Returns the byte offset damaged.
+
+    mode: 'torn_tail' chops the final record mid-frame; 'bitflip' flips
+    one bit inside the record line at relative position `line_at`
+    (0.0-1.0 through the file's lines, default the middle — pass 1.0 to
+    hit the final record, the tail-vs-mid-log classification boundary).
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.splitlines(keepends=True)
+    if not lines:
+        raise ValueError(f"{path} is empty — nothing to corrupt")
+    if mode == "torn_tail":
+        last = lines[-1]
+        kept = data[:len(data) - len(last)] + last[:max(1, len(last) // 2)]
+        with open(path, "wb") as f:
+            f.write(kept)
+        return len(kept)
+    if mode == "bitflip":
+        # skip the magic header line; flip a bit mid-payload of the
+        # chosen record so both the CRC and the JSON see the damage
+        first = 1 if lines[0] == b"TDWAL1\n" else 0
+        if first >= len(lines):
+            raise ValueError(f"{path} has no records to corrupt")
+        idx = first + min(int((len(lines) - first - 1) * line_at),
+                          len(lines) - first - 1)
+        off = sum(len(ln) for ln in lines[:idx])
+        pos = off + len(lines[idx]) // 2
+        flipped = data[:pos] + bytes([data[pos] ^ 0x01]) + data[pos + 1:]
+        with open(path, "wb") as f:
+            f.write(flipped)
+        return pos
+    raise ValueError(f"unknown corruption mode {mode!r} — want torn_tail "
+                     f"or bitflip")
 
 
 def should_drop_response(op: str) -> bool:

@@ -238,7 +238,29 @@ class WatchedStore:
     def list_snapshot(self, resource: str) -> tuple[int, list[dict]]:
         """Atomic (revision, items) pair: the revision is a valid watch
         resume point for exactly this item set (writers can't interleave
-        — they need the feed lock)."""
+        — they need the feed lock).
+
+        resource "" lists EVERY watch-visible key (all resources plus the
+        fleet.* planes) — the full-resync snapshot a StandbyReplicator
+        rebuilds its replica from after a WatchCompacted gap. Those items
+        additionally carry resource / createRevision / version so the
+        replica reconstructs exact lifetime counters."""
+        if resource == "":
+            with self._wlock:
+                rev = self._inner.revision
+                kvs = list(self._inner.range(ResourcePrefix.Base + "/"))
+                kvs += list(self._inner.range(FLEET_PREFIX + "/"))
+            items = []
+            for kv in kvs:
+                ident = parse_watch_key(kv.key)
+                if ident is None:
+                    continue
+                items.append({"resource": ident[0], "name": ident[1],
+                              "value": kv.value,
+                              "modRevision": kv.mod_revision,
+                              "createRevision": kv.create_revision,
+                              "version": kv.version})
+            return rev, items
         if resource.startswith("fleet."):
             prefix = f"{FLEET_PREFIX}/{resource[len('fleet.'):]}/"
         else:
@@ -583,12 +605,22 @@ class FleetMember:
 
     def __init__(self, member_id: str, arbiter, addr: str = "",
                  adopt: Optional[Callable[[str, str], None]] = None,
+                 promote: Optional[Callable[[str, str], None]] = None,
                  events=None,
                  crash_seam: Callable[[str], None] = crashpoint):
         self.member_id = member_id
         self.arbiter = arbiter
         self.addr = addr
         self.adopt = adopt
+        # promote(resource, name) runs after a takeover steal SUCCEEDS
+        # and before adopt: install the dead daemon's replicated record
+        # into the local store so adopt reconciles real state instead of
+        # a hole (replication.py; docs/durability.md §promote). The
+        # successful acquire IS the fence — the epoch it minted makes any
+        # later write from the dead daemon's lineage refusable, and the
+        # arbiter's single-winner steal gives at most one promoted
+        # lineage (tdcheck promote model, R2).
+        self.promote = promote
         self.events = events
         self.crash_seam = crash_seam
         self.owned: set[tuple[str, str]] = set()
@@ -673,6 +705,21 @@ class FleetMember:
             self.owned.add(rid)
             self.takeovers_total += 1
             adopted.append(f"{g['resource']}/{g['name']}")
+            if self.promote is not None:
+                # behind the steal's fencing epoch: install the replica's
+                # copy of the record, then adopt reconciles it. A crash
+                # between the two is safe — promote is idempotent (it
+                # never overwrites a record the local store already has)
+                # and the grant is already ours, so the next beat re-runs
+                # both (crashpoint fed.after_promote pins this).
+                self.promote(g["resource"], g["name"])
+                self.crash_seam("fed.after_promote")
+                if self.events is not None:
+                    self.events.record(
+                        "fed.promote",
+                        target=f"{g['resource']}/{g['name']}",
+                        detail={"holder": self.member_id,
+                                "stolenFrom": g["holder"]})
             if self.adopt is not None:
                 self.adopt(g["resource"], g["name"])
             if self.events is not None:

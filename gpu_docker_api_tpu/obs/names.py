@@ -76,9 +76,20 @@ EVENT_OPS = frozenset({
     "fed.grant",
     "fed.steal",
     "fed.takeover",
+    # promote-on-loss: a takeover installed the dead daemon's records
+    # from the warm-standby replica before adopting (replication.py +
+    # federation.FleetMember promote hook)
+    "fed.promote",
     # revision watch plane: an SSE watcher resumed past the hub's
     # retained window and was told to relist (server/app.py)
     "watch.gap",
+    # durable state plane (store/mvcc.py + replication.py): the store
+    # latched read-only after a WAL append failure (ENOSPC et al. —
+    # mutations answer 503 + Retry-After until a probe heals it); the
+    # standby replicator fell past the peer's watch retention and
+    # rebuilt its replica from a full snapshot
+    "store.read_only",
+    "repl.resync",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.
@@ -173,4 +184,12 @@ METRIC_NAMES = frozenset({
     "tdapi_fed_expiries_total",
     "tdapi_fed_watch_events_total",
     "tdapi_fed_watch_head_revision",
+    # warm-standby replication (replication.py StandbyReplicator.status,
+    # refreshed by the server/app.py collect callback; zero-valued when
+    # no --repl-peer is configured — family parity)
+    "tdapi_repl_horizon",
+    "tdapi_repl_lag_revisions",
+    "tdapi_repl_events_applied_total",
+    "tdapi_repl_resyncs_total",
+    "tdapi_repl_connected",
 })

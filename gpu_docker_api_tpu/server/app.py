@@ -45,7 +45,9 @@ from ..schedulers import (
     SHARE_QUANTA, CpuScheduler, PortScheduler, TpuScheduler, parse_tpu_count,
 )
 from ..services import ReplicaSetService, VolumeService
-from ..store import StateClient, open_store
+from .. import replication
+from ..replication import StandbyReplicator
+from ..store import StateClient, StoreReadOnlyError, open_store
 from ..topology import TpuTopology, discover_topology
 from ..utils import copyfast
 from ..utils.file import valid_size_unit
@@ -234,7 +236,8 @@ class App:
                  gw_data_port: Optional[int] = None,
                  fleet_member: Optional[str] = None,
                  fleet_host: Optional[str] = None,
-                 fleet_ttl: Optional[float] = None):
+                 fleet_ttl: Optional[float] = None,
+                 repl_peer: Optional[str] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
 
@@ -426,6 +429,21 @@ class App:
                             else os.environ.get("TDAPI_FLEET_HOST", ""))
         self._api_key = (api_key if api_key is not None
                          else os.environ.get("APIKEY", ""))
+        # warm-standby replication (replication.py): tail a peer daemon's
+        # watch stream into a local replica store; on a fleet takeover
+        # the promote hook installs the dead peer's records from it.
+        # Constructed here (the replica opens immediately — promote must
+        # work even before start()), the tail thread starts in start().
+        self._repl_peer = (repl_peer
+                           or os.environ.get("TDAPI_REPL_PEER", ""))
+        self.replicator: Optional[StandbyReplicator] = None
+        if self._repl_peer:
+            self.replicator = StandbyReplicator(
+                self._repl_peer, os.path.join(state_dir, "replica"),
+                api_key=self._api_key, engine=store_engine,
+                events=self.events)
+        # store.read_only event edge detector (one event per latch trip)
+        self._ro_trips_seen = 0
         # SSE follower count (tdapi_events_stream_clients) — mutated from
         # stream generator threads under this lock
         self._stream_lock = threading.Lock()
@@ -523,10 +541,41 @@ class App:
                 denied = self.fleet.guard_mutation(req)
                 if denied is not None:
                     return denied
-                return self._with_idempotency(req, handler)
+                denials = getattr(self.store, "read_only_denials", 0)
+                resp = self._with_idempotency(req, handler)
+                if getattr(self.store, "read_only_denials", 0) > denials:
+                    # the latch refused a write inside this request but
+                    # a handler-level catch-all swallowed the typed
+                    # refusal — the store's denial counter is the truth
+                    return self._read_only_response(
+                        req, getattr(self.store, "read_only", None)
+                        or "WAL write failed",
+                        getattr(self.store, "read_only_retry_s", 0.0))
+                return resp
+            except StoreReadOnlyError as e:
+                return self._read_only_response(req, e.reason,
+                                                e.retry_after)
             finally:
                 self.gate.release(req.client_addr or "?")
         return wrapped
+
+    def _read_only_response(self, req: Request, reason: str,
+                            retry_after: float) -> Response:
+        """WAL append failed (ENOSPC &c): the store latched read-only.
+        Degrade, don't crash — 503 + Retry-After matched to the store's
+        re-probe window, one event per latch trip (docs/durability.md)."""
+        trips = getattr(self.store, "read_only_trips", 0)
+        if trips > self._ro_trips_seen:
+            self._ro_trips_seen = trips
+            self.events.record(
+                "store.read_only", target=req.path,
+                code=int(ResCode.BackendUnavailable),
+                reason=reason, request_id=req.request_id)
+        return Response(
+            ResCode.BackendUnavailable,
+            {"reason": f"store is read-only: {reason}"},
+            http_status=503,
+            headers={"Retry-After": str(max(1, int(retry_after)))})
 
     def _with_idempotency(self, req: Request, handler) -> Response:
         key = req.headers.get("Idempotency-Key", "").strip()
@@ -1127,6 +1176,25 @@ class App:
         frames; `revision too old` forces a relist)."""
         return self.fleet.h_watch(req, lambda: self.server._draining)
 
+    def _fleet_promote(self, resource: str, name: str) -> None:
+        """Takeover promotion: before adopting `resource/name` stolen
+        from a dead member, install the replica's copy of its record
+        into this daemon's own store — so _fleet_adopt reconciles real
+        state instead of a hole. Runs behind the steal's fencing epoch
+        (FleetMember.heartbeat_once). Idempotent and non-destructive:
+        a record this store already has wins (it is at least as fresh —
+        this daemon may have served the resource before), so a crash
+        between promote and adopt (crashpoint fed.after_promote) just
+        re-runs it."""
+        if self.replicator is None:
+            return
+        kv = self.replicator.get_record(resource, name)
+        if kv is None:
+            return    # the replica never saw it (or saw its deletion)
+        key = replication.resource_key(resource, name)
+        if self.store.get(key) is None:
+            self.store.put(key, kv.value)
+
     def _fleet_adopt(self, resource: str, name: str) -> None:
         """Takeover adoption: this daemon just stole `resource/name`
         from a dead member. Derive-don't-store — nothing is copied from
@@ -1191,6 +1259,9 @@ class App:
             breaker = self.backend.breaker.describe()
             if breaker["state"] != "closed":
                 rep["status"] = "degraded"
+        read_only = getattr(self.store, "read_only", None)
+        if read_only:
+            rep["status"] = "degraded"
         return ok({
             "status": rep["status"],
             "health": rep,
@@ -1200,6 +1271,9 @@ class App:
             "workers": (self.workers.describe()
                         if self.workers is not None else None),
             "reconcileActions": self.last_reconcile["actions"],
+            "storeReadOnly": read_only,
+            "replication": (self.replicator.describe()
+                            if self.replicator is not None else None),
         })
 
     def _chip_index(self, req: Request) -> int:
@@ -1351,6 +1425,23 @@ class App:
         g_fed_whead = m.gauge("tdapi_fed_watch_head_revision",
                               "highest MVCC revision the watch hub has "
                               "seen")
+        # warm-standby replication (replication.py). Declared
+        # unconditionally — same family-parity contract as the fed
+        # gauges; zero-valued when no --repl-peer is configured
+        g_repl_hor = m.gauge("tdapi_repl_horizon",
+                             "highest peer revision contiguously applied "
+                             "to the replica store")
+        g_repl_lag = m.gauge("tdapi_repl_lag_revisions",
+                             "peer head minus replicated horizon")
+        g_repl_ev = m.gauge("tdapi_repl_events_applied_total",
+                            "watch events applied to the replica",
+                            typ="counter")
+        g_repl_rs = m.gauge("tdapi_repl_resyncs_total",
+                            "full snapshot resyncs after WatchCompacted",
+                            typ="counter")
+        g_repl_con = m.gauge("tdapi_repl_connected",
+                             "1 while the replication tail holds a live "
+                             "watch stream to the peer")
         # tracing + streaming self-observation
         g_traces = m.gauge("tdapi_traces_retained",
                            "finished traces held in the ring "
@@ -1485,6 +1576,17 @@ class App:
             g_fed_exp.set(arb.expiries_total)
             g_fed_wev.set(self.hub.events_total)
             g_fed_whead.set(self.hub.head)
+            if self.replicator is not None:
+                rs = self.replicator.describe()
+                g_repl_hor.set(rs["horizon"])
+                g_repl_lag.set(rs["lagRevisions"])
+                g_repl_ev.set(rs["eventsApplied"])
+                g_repl_rs.set(rs["resyncs"])
+                g_repl_con.set(1 if rs["connected"] else 0)
+            else:
+                for g in (g_repl_hor, g_repl_lag, g_repl_ev, g_repl_rs,
+                          g_repl_con):
+                    g.set(0)
             for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
                       g_gw_scale, g_wk_req, g_wk_shed, g_wk_dead,
                       g_wk_retry):
@@ -1588,8 +1690,10 @@ class App:
             self.fleet.configure_member(
                 self._fleet_member_id, addr=self.address,
                 host=self._fleet_host, api_key=self._api_key,
-                adopt=self._fleet_adopt)
+                adopt=self._fleet_adopt, promote=self._fleet_promote)
             self.fleet.start()
+        if self.replicator is not None:
+            self.replicator.start()
         self._start_store_maintenance()
         self.health.start()   # no-op when health_interval <= 0
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
@@ -1634,6 +1738,10 @@ class App:
         # daemon (remote) is still reachable: a graceful exit releases
         # this member's grants instead of waiting out the TTL
         self.fleet.stop()
+        if self.replicator is not None:
+            # after fleet.stop(): a takeover mid-shutdown must still be
+            # able to promote from the replica
+            self.replicator.stop()
         if self.workers is not None:
             # the module-global latency family must not keep scraping a
             # dead tier's unlinked segment (and a later App's tier will

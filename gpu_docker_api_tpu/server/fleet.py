@@ -76,13 +76,16 @@ class FleetPlane:
 
     def configure_member(self, member_id: str, addr: str,
                          host: str = "", api_key: str = "",
-                         adopt=None) -> FleetMember:
+                         adopt=None, promote=None) -> FleetMember:
         """Give this daemon a seat. `host` empty means this daemon hosts
-        the arbiter itself (in-process, no HTTP hop)."""
+        the arbiter itself (in-process, no HTTP hop). `promote` runs
+        after a takeover steal, before adopt — the App installs the dead
+        daemon's replicated records there (replication.py)."""
         arbiter = (RestArbiter(host, api_key=api_key) if host
                    else self.arbiter)
         self.member = FleetMember(member_id, arbiter, addr=addr,
-                                  adopt=adopt, events=self.events)
+                                  adopt=adopt, promote=promote,
+                                  events=self.events)
         return self.member
 
     def start(self) -> None:

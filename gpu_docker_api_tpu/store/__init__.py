@@ -1,3 +1,5 @@
-from .mvcc import KeyValue, MVCCStore  # noqa: F401
+from .mvcc import (KeyValue, MVCCStore,  # noqa: F401
+                   StoreReadOnlyError, WalCorruptError)
 from .client import StateClient, ResourcePrefix  # noqa: F401
 from .native import NativeMVCCStore, native_available, open_store  # noqa: F401
+from . import walio  # noqa: F401

@@ -14,6 +14,16 @@ leader has made their record durable — N concurrent mutations cost one
 flush/fsync instead of N (see _commit; docs/performance.md). A C++ core
 (native/mvcc_store.cc) provides the same API via ctypes for the hot path;
 this file is the always-available reference implementation and fallback.
+
+WAL integrity (docs/durability.md): new WALs are written in the v1 framed
+format (walio.py — magic header + per-record CRC32) so replay can tell a
+torn tail (truncate + continue) from mid-log damage (typed WalCorruptError
+pointing at the scrub tool). Legacy v0 JSONL files replay and keep
+appending v0 (no migration downtime); any rewrite (maintain / snapshot /
+backup) upgrades the file to v1. A failed WAL append (ENOSPC &c) latches
+the store read-only: the mutation raises StoreReadOnlyError (mapped to
+503 + Retry-After by the server), reads keep serving, and a timed
+re-probe lets one mutation test the disk again (see _check_writable).
 """
 
 from __future__ import annotations
@@ -25,6 +35,9 @@ import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from . import walio
+from .walio import WalCorruptError  # noqa: F401  (re-export: engine API)
+from .. import faults
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -33,6 +46,18 @@ from ..obs import trace as obs_trace
 # batch. 0 (default) flushes as soon as a leader picks the batch up —
 # latency-optimal, and still amortizes whenever writers actually race.
 WAL_BATCH_MS_ENV = "TDAPI_WAL_BATCH_MS"
+
+
+class StoreReadOnlyError(RuntimeError):
+    """A WAL append failed (ENOSPC, I/O error): the store refuses further
+    mutations until a timed re-probe succeeds. Reads are unaffected. The
+    server maps this to 503 + Retry-After (docs/durability.md)."""
+
+    def __init__(self, reason: str, retry_after: float):
+        self.reason = reason
+        self.retry_after = retry_after
+        super().__init__(f"store is read-only ({reason}); disk re-probe "
+                         f"in <= {retry_after:.0f}s")
 
 
 @dataclass(frozen=True)
@@ -84,6 +109,17 @@ class MVCCStore:
                 0.0, float(os.environ.get(WAL_BATCH_MS_ENV, "0") or 0)) / 1e3
         except ValueError:
             self._batch_window = 0.0
+        # WAL file format: 1 = CRC-framed (walio), 0 = legacy JSONL. An
+        # existing v0 file keeps appending v0 (homogeneous files); every
+        # rewrite upgrades to v1.
+        self._wal_fmt = 1
+        # ---- read-only latch (set on WAL append failure; plain
+        # attributes — writes are atomic under the GIL and the one
+        # read/clear site holds _lock)
+        self._ro_reason: Optional[str] = None
+        self._ro_probe_at = 0.0
+        self._ro_trips = 0
+        self._ro_denials = 0
         if wal_path:
             if os.path.exists(wal_path):
                 self._replay(wal_path)
@@ -91,16 +127,31 @@ class MVCCStore:
             # binary append: BufferedWriter is internally locked, so the
             # flush leader can run without _lock while writers append
             self._wal = open(wal_path, "ab")
+            if self._wal_fmt == 1 and os.path.getsize(wal_path) == 0:
+                self._wal.write(walio.MAGIC)
+                self._wal.flush()
 
     # ---- write path ----
 
     def put(self, key: str, value: str) -> int:
         """Write value; returns the new global revision once durable."""
         with self._lock:
+            self._check_writable()
             self._rev += 1
             rev = self._rev
+            try:
+                seq = self._wal_append(
+                    {"op": "put", "k": key, "v": value, "r": rev})
+            except StoreReadOnlyError:
+                # keep the revision minted and the memory state applied:
+                # the record may sit in the write buffer and drain on a
+                # later successful flush, so memory-ahead-of-disk is the
+                # one consistent outcome (disk never diverges from what
+                # memory claims). The caller got the error — nothing was
+                # acked — and the boot reconciler heals a death here.
+                self._apply_put(key, value, rev)
+                raise
             self._apply_put(key, value, rev)
-            seq = self._wal_append({"op": "put", "k": key, "v": value, "r": rev})
         self._commit(seq)
         return rev
 
@@ -112,15 +163,25 @@ class MVCCStore:
         batch is empty)."""
         seq = 0
         with self._lock:
+            self._check_writable()
             for key, value in items:
                 self._rev += 1
+                try:
+                    seq = self._wal_append(
+                        {"op": "put", "k": key, "v": value, "r": self._rev},
+                        inline_flush=False)
+                except StoreReadOnlyError:
+                    # same memory-ahead contract as put(); items after
+                    # the failure point are neither minted nor applied
+                    self._apply_put(key, value, self._rev)
+                    raise
                 self._apply_put(key, value, self._rev)
-                seq = self._wal_append(
-                    {"op": "put", "k": key, "v": value, "r": self._rev},
-                    inline_flush=False)
             rev = self._rev
             if seq and self._wal is not None and not self._fsync:
-                self._wal.flush()   # one flush for the whole batch
+                try:
+                    self._wal.flush()   # one flush for the whole batch
+                except OSError as e:
+                    self._set_read_only(e)
         self._commit(seq)
         return rev
 
@@ -131,16 +192,86 @@ class MVCCStore:
             revs = self._log.get(key)
             if not revs or revs[-1].tombstone:
                 return False
+            self._check_writable()
             self._rev += 1
-            seq = self._wal_append({"op": "del", "k": key, "r": self._rev})
+            try:
+                seq = self._wal_append(
+                    {"op": "del", "k": key, "r": self._rev})
+            except StoreReadOnlyError:
+                self._apply_delete(key, self._rev)
+                raise
             self._apply_delete(key, self._rev)
         self._commit(seq)
         return True
 
+    # ---- replication apply (replication.py StandbyReplicator) ----
+
+    def put_at(self, key: str, value: str, rev: int,
+               create_revision: Optional[int] = None,
+               version: Optional[int] = None) -> bool:
+        """Install `value` at the EXACT revision `rev` — the replica-side
+        twin of put(), applying a peer daemon's watch stream in order.
+        Idempotent: a revision at or below the key's latest mod_revision
+        (or below the compaction floor) is a no-op returning False, so a
+        replicator that crashes between applying and persisting its
+        horizon simply re-applies. create_revision/version pin the key's
+        lifetime counters when the replica didn't see the whole lifetime
+        (resync-from-snapshot); omitted, they derive from the local log
+        exactly like put()."""
+        with self._lock:
+            self._check_writable()
+            if rev <= self._compacted:
+                return False
+            revs = self._log.get(key)
+            if revs and revs[-1].mod_revision >= rev:
+                return False
+            self._rev = max(self._rev, rev)
+            rec = {"op": "put", "k": key, "v": value, "r": rev}
+            if create_revision is not None and version is not None:
+                rec["cr"] = int(create_revision)
+                rec["ver"] = int(version)
+            try:
+                seq = self._wal_append(rec)
+            except StoreReadOnlyError:
+                self._apply_put(key, value, rev, create_revision, version)
+                raise
+            self._apply_put(key, value, rev, create_revision, version)
+        self._commit(seq)
+        return True
+
+    def delete_at(self, key: str, rev: int) -> bool:
+        """Tombstone `key` at the exact revision `rev` (see put_at).
+        Idempotent the same way; always advances the revision counter so
+        the replica's head tracks the peer's even when the delete itself
+        is a no-op (key absent: the stream can race a resync)."""
+        with self._lock:
+            self._check_writable()
+            if rev <= self._compacted:
+                return False
+            revs = self._log.get(key)
+            if revs and revs[-1].mod_revision >= rev:
+                return False
+            self._rev = max(self._rev, rev)
+            if not revs or revs[-1].tombstone:
+                return False
+            try:
+                seq = self._wal_append({"op": "del", "k": key, "r": rev})
+            except StoreReadOnlyError:
+                self._apply_delete(key, rev)
+                raise
+            self._apply_delete(key, rev)
+        self._commit(seq)
+        return True
+
     # tdlint: disable=unlocked-state -- contract: caller holds _lock
-    def _apply_put(self, key: str, value: str, rev: int) -> None:
+    def _apply_put(self, key: str, value: str, rev: int,
+                   cr: Optional[int] = None,
+                   ver: Optional[int] = None) -> None:
         revs = self._log.setdefault(key, [])
-        if revs and not revs[-1].tombstone:
+        if cr is not None and ver is not None:
+            # exact lifetime counters (backup restore / resync apply)
+            revs.append(_Rev(rev, cr, ver, value))
+        elif revs and not revs[-1].tombstone:
             last = revs[-1]
             revs.append(_Rev(rev, last.create_revision, last.version + 1, value))
         else:
@@ -233,6 +364,7 @@ class MVCCStore:
         the reference has no answer to this, SURVEY §2 bug 5). Returns the
         number of revision entries dropped."""
         with self._lock:
+            self._check_writable()
             dropped = self._compact_locked(revision, keep_history_prefixes)
             # durable: replay must re-apply the compaction, or a restart
             # would resurrect compacted revisions and reset _compacted
@@ -281,6 +413,12 @@ class MVCCStore:
         with self._lock:
             return self._wal_records
 
+    @property
+    def wal_format(self) -> int:
+        """0 = legacy v0 JSONL WAL file, 1 = CRC-framed v1 (walio.py)."""
+        with self._lock:
+            return self._wal_fmt
+
     def maintain(self, keep_history_prefixes: tuple[str, ...] = ()) -> dict:
         """Bound the WAL: compact in-memory history up to the current
         revision (keys under keep_history_prefixes keep full history), then
@@ -307,9 +445,15 @@ class MVCCStore:
                 # would half-apply (memory mutated, WAL append raising)
                 self._wal = open(self._wal_path, "ab")
                 raise
+            # the rewrite always produces v1, even over a legacy v0 file —
+            # this is the upgrade path (homogeneous files: appends framed
+            # from here on)
+            self._wal_fmt = 1
             # re-count: the snapshot holds one "rev" record + the live kvs
-            with open(self._wal_path, "r", encoding="utf-8") as f:
-                self._wal_records = sum(1 for line in f if line.strip())
+            # (first line is the format header, not a record)
+            with open(self._wal_path, "rb") as f:
+                self._wal_records = sum(
+                    1 for line in f if line.strip() and line != walio.MAGIC)
             # restore the compaction floor on future replays (the snapshot
             # itself carries only puts) — a no-op prune that sets _compacted
             self._wal_append({"op": "compact", "r": self._compacted,
@@ -329,16 +473,99 @@ class MVCCStore:
         leaves the flush to the group-commit leader; non-fsync mode flushes
         inline — a page-cache flush costs microseconds, less than parking
         the writer on the commit condition variable would. put_many passes
-        inline_flush=False and flushes once for the whole batch."""
+        inline_flush=False and flushes once for the whole batch.
+
+        Records are framed per the file's format (v1 CRC frames / legacy
+        v0 lines). An OSError from the write or flush latches the store
+        read-only and surfaces as StoreReadOnlyError."""
         if self._wal is None:
             return 0
-        self._wal.write(
-            (json.dumps(rec, separators=(",", ":")) + "\n").encode("utf-8"))
-        if not self._fsync and inline_flush:
-            self._wal.flush()
+        payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        buf = walio.frame(payload) if self._wal_fmt else payload + b"\n"
+        mode = faults.disk_fault(self._wal_path) if self._wal_path else ""
+        try:
+            if mode:
+                buf = self._inject_disk_fault(mode, buf)
+            self._wal.write(buf)
+            if not self._fsync and inline_flush:
+                self._wal.flush()
+        except OSError as e:
+            self._set_read_only(e)
         self._wal_records += 1
         self._seq += 1
         return self._seq
+
+    # contract: caller holds _lock
+    def _inject_disk_fault(self, mode: str, buf: bytes) -> bytes:
+        """Apply one armed disk-fault mode to this append (faults.py)."""
+        if mode == "enospc":
+            raise OSError(28, "No space left on device (injected)")
+        if mode == "bitflip":
+            pos = len(buf) // 2
+            return buf[:pos] + bytes([buf[pos] ^ 0x01]) + buf[pos + 1:]
+        if mode == "torn_tail":
+            # a prefix reaches the disk, then the process "dies" — the
+            # InjectedCrash must unwind nothing (BaseException), exactly
+            # like the crashpoint machinery
+            self._wal.write(buf[:max(1, len(buf) // 2)])
+            self._wal.flush()
+            raise faults.InjectedCrash(f"disk:torn_tail:{self._wal_path}")
+        return buf
+
+    # ---- read-only degradation (ENOSPC &c) ----
+
+    #: seconds a read-only latch denies mutations before letting ONE
+    #: through to re-probe the disk (failure re-arms the latch)
+    READ_ONLY_PROBE_S = 5.0
+
+    # contract: caller holds _lock (the _commit leader path sets the
+    # latch without it: attribute writes are GIL-atomic and the reader
+    # tolerates either order)
+    def _set_read_only(self, exc: OSError) -> None:
+        """Latch read-only and raise the typed refusal (from `exc`)."""
+        self._ro_reason = f"{type(exc).__name__}: {exc}"
+        self._ro_probe_at = time.monotonic() + self.READ_ONLY_PROBE_S
+        self._ro_trips += 1
+        self._ro_denials += 1
+        raise StoreReadOnlyError(self._ro_reason,
+                                 self.READ_ONLY_PROBE_S) from exc
+
+    # contract: caller holds _lock
+    def _check_writable(self) -> None:
+        if self._ro_reason is None:
+            return
+        remaining = self._ro_probe_at - time.monotonic()
+        if remaining > 0:
+            self._ro_denials += 1
+            raise StoreReadOnlyError(self._ro_reason, max(0.1, remaining))
+        # probe window: clear the latch and let this mutation try the
+        # disk — a failed append re-arms it (self-healing, no operator
+        # intervention once space returns)
+        self._ro_reason = None
+
+    @property
+    def read_only(self) -> Optional[str]:
+        """The latch reason while read-only, else None (healthz)."""
+        return self._ro_reason
+
+    @property
+    def read_only_trips(self) -> int:
+        """Times the latch tripped (event/metric edge detection)."""
+        return self._ro_trips
+
+    @property
+    def read_only_denials(self) -> int:
+        """Mutations the latch refused. The HTTP layer diffs this across
+        a request to surface 503 even when an intermediate layer
+        swallowed the typed refusal."""
+        return self._ro_denials
+
+    @property
+    def read_only_retry_s(self) -> float:
+        """Seconds until the next disk re-probe (0 when writable)."""
+        if self._ro_reason is None:
+            return 0.0
+        return max(0.1, self._ro_probe_at - time.monotonic())
 
     # ---- group commit ----
 
@@ -423,6 +650,15 @@ class MVCCStore:
                         self._durable_seq = target
                     self._commit_cond.notify_all()
                 if err is not None:
+                    if isinstance(err, OSError):
+                        # group-commit leader hit the disk error: latch
+                        # read-only so the NEXT mutation is refused fast
+                        # instead of re-entering a failing flush. Parked
+                        # followers retry as leaders, hit the same error,
+                        # and surface the same typed refusal — the
+                        # "undefined error path under group commit" is
+                        # now defined (docs/durability.md).
+                        self._set_read_only(err)
                     raise err
 
     @property
@@ -445,42 +681,116 @@ class MVCCStore:
     # tdlint: disable=unlocked-state -- boot-time only: runs from __init__
     # before any other thread can hold a reference to this store
     def _replay(self, path: str) -> None:
-        with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail write — stop-the-line would lose the rest
-                self._wal_records += 1
-                rev = rec.get("r", self._rev + 1)
-                self._rev = max(self._rev, rev)
-                if rec["op"] == "put":
-                    self._apply_put(rec["k"], rec["v"], rev)
-                elif rec["op"] == "del":
-                    self._apply_delete(rec["k"], rev)
-                elif rec["op"] == "compact":
-                    self._replaying_compact(rev, tuple(rec.get("keep", ())))
-                # op == "rev": counter checkpoint only, handled above
+        s = walio.scan(path)
+        if s.corrupt_at is not None:
+            raise WalCorruptError(path, s.corrupt_at, s.detail)
+        if s.truncate_to is not None:
+            # torn tail: physically drop the damaged frame so the next
+            # append starts at a clean boundary (a v1 reader would
+            # otherwise mis-frame every record after it)
+            with open(path, "r+b") as tf:
+                tf.truncate(s.truncate_to)
+        self._wal_fmt = s.fmt
+        for payload in s.payloads:
+            try:
+                rec = json.loads(payload)
+            except json.JSONDecodeError:
+                if s.fmt == 0:
+                    continue  # legacy tolerance (scan pre-filters; belt)
+                raise WalCorruptError(
+                    path, 0, "CRC-valid frame holds invalid JSON")
+            self._wal_records += 1
+            rev = rec.get("r", self._rev + 1)
+            self._rev = max(self._rev, rev)
+            if rec["op"] == "put":
+                self._apply_put(rec["k"], rec["v"], rev,
+                                rec.get("cr"), rec.get("ver"))
+            elif rec["op"] == "del":
+                self._apply_delete(rec["k"], rev)
+            elif rec["op"] == "compact":
+                self._replaying_compact(rev, tuple(rec.get("keep", ())))
+            # op == "rev": counter checkpoint only, handled above
+
+    def _write_frames(self, f, records: Iterator[dict]) -> int:
+        """Write the v1 header + framed `records` to open binary file
+        `f`; returns the record count."""
+        n = 0
+        f.write(walio.MAGIC)
+        for rec in records:
+            f.write(walio.frame(
+                json.dumps(rec, separators=(",", ":")).encode("utf-8")))
+            n += 1
+        return n
 
     def snapshot(self, path: str) -> None:
         """Write a compacted replayable WAL to `path` (latest lifetime of each
-        key only), atomically."""
-        tmp = path + ".tmp"
-        with self._lock, open(tmp, "w", encoding="utf-8") as f:
+        key only), atomically. Always v1-framed; put records carry cr/ver
+        so lifetime counters survive the rewrite exactly (a floor entry
+        kept by compaction has create_revision/version from revisions the
+        snapshot omits)."""
+        def records():
             # preserve the global revision counter even when the highest
             # revisions belong to deletes/compacted entries that the snapshot
             # omits — replaying must never re-mint issued revision numbers
-            f.write(json.dumps({"op": "rev", "r": self._rev},
-                               separators=(",", ":")) + "\n")
+            yield {"op": "rev", "r": self._rev}
             for key in sorted(self._log):
                 for kv in self.history(key):
-                    f.write(json.dumps(
-                        {"op": "put", "k": key, "v": kv.value, "r": kv.mod_revision},
-                        separators=(",", ":")) + "\n")
+                    yield {"op": "put", "k": key, "v": kv.value,
+                           "r": kv.mod_revision, "cr": kv.create_revision,
+                           "ver": kv.version}
+
+        tmp = path + ".tmp"
+        with self._lock, open(tmp, "wb") as f:
+            self._write_frames(f, records())
         os.replace(tmp, path)
+
+    def backup(self, path: str, revision: Optional[int] = None) -> dict:
+        """Consistent point-in-time backup at an exact revision — the
+        retained history (tombstones included) at-or-below `revision`
+        (default: current), written atomically as a v1-framed replayable
+        WAL. Restore is file placement: the backup IS a WAL either engine
+        opens, reconstructing identical revision history (cr/ver fields
+        pin lifetime counters across the compaction floor). Atomic under
+        MVCC: one lock acquisition snapshots an exact revision even while
+        writers race. Returns {revision, records, compacted}."""
+        with self._lock:
+            target = self._rev if revision is None else int(revision)
+            if target > self._rev:
+                raise ValueError(f"revision {target} is ahead of the "
+                                 f"store (at {self._rev})")
+            if target < self._compacted:
+                raise ValueError(f"revision {target} compacted "
+                                 f"(< {self._compacted})")
+            entries = []
+            for key, revs in self._log.items():
+                for r in revs:
+                    if r.mod_revision <= target:
+                        entries.append((r.mod_revision, key, r))
+            entries.sort(key=lambda t: t[0])
+
+            def records():
+                yield {"op": "rev", "r": target}
+                # floor record FIRST: replaying it on the still-empty
+                # store sets the compaction floor without dropping the
+                # retained sub-floor entries (keep-prefix keys retain
+                # full history a compact-after would destroy)
+                yield {"op": "compact", "r": self._compacted, "keep": []}
+                for mod, key, r in entries:
+                    if r.tombstone:
+                        yield {"op": "del", "k": key, "r": mod}
+                    else:
+                        yield {"op": "put", "k": key, "v": r.value,
+                               "r": mod, "cr": r.create_revision,
+                               "ver": r.version}
+
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                n = self._write_frames(f, records())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return {"revision": target, "records": n,
+                    "compacted": self._compacted}
 
     def close(self) -> None:
         with self._lock:

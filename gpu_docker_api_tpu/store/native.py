@@ -19,10 +19,12 @@ import json
 import os
 import struct
 import threading
+import time
 from typing import Iterable, Optional, Union
 
 from .._native import load
-from .mvcc import KeyValue, MVCCStore
+from . import walio
+from .mvcc import KeyValue, MVCCStore, StoreReadOnlyError, WalCorruptError
 
 
 def native_available() -> bool:
@@ -38,7 +40,24 @@ class NativeMVCCStore:
             raise RuntimeError("native mvcc core unavailable")
         if wal_path:
             os.makedirs(os.path.dirname(os.path.abspath(wal_path)), exist_ok=True)
+            # WAL-integrity classification runs HERE, in walio (the single
+            # implementation both engines share): a torn tail is truncated
+            # before the core opens the file, mid-log corruption refuses
+            # the open. The core's own Replay still verifies CRCs and
+            # stops at the first bad frame as defense in depth.
+            s = walio.scan(wal_path)
+            if s.corrupt_at is not None:
+                raise WalCorruptError(wal_path, s.corrupt_at, s.detail)
+            if s.truncate_to is not None and os.path.exists(wal_path):
+                with open(wal_path, "r+b") as f:
+                    f.truncate(s.truncate_to)
         self._fsync = bool(fsync)
+        # read-only latch policy lives in the wrapper (the core only
+        # detects the first failed write: mvcc_read_only -> errno)
+        self._ro_probe_at = 0.0
+        self._ro_reason: Optional[str] = None
+        self._ro_trips = 0
+        self._ro_denials = 0
         self._h = self._lib.mvcc_open((wal_path or "").encode(),
                                       1 if fsync else 0)
         # the fast read path returns pointers into the handle's single
@@ -77,10 +96,61 @@ class NativeMVCCStore:
         return KeyValue(d["key"], d["value"], d["create_revision"],
                         d["mod_revision"], d["version"])
 
+    # ---- read-only degradation (ENOSPC &c; MVCCStore is the spec) ----
+
+    def _check_writable(self) -> None:
+        e = self._lib.mvcc_read_only(self._handle)
+        if not e:
+            return
+        remaining = self._ro_probe_at - time.monotonic()
+        if remaining > 0:
+            self._ro_denials += 1
+            raise StoreReadOnlyError(self._ro_reason or f"errno {e}",
+                                     max(0.1, remaining))
+        # probe window: clear the core's latch and let this mutation try
+        # the disk — a failed flush re-arms it (self-healing)
+        self._lib.mvcc_clear_read_only(self._handle)
+
+    def _after_write(self) -> None:
+        """Raise the typed refusal when this mutation's flush latched the
+        core. Memory stays ahead of disk exactly like the Python engine:
+        the record is applied + buffered, the caller just got no ack."""
+        e = self._lib.mvcc_read_only(self._handle)
+        if not e:
+            return
+        self._ro_reason = f"OSError: [Errno {e}] {os.strerror(e)}"
+        self._ro_probe_at = time.monotonic() + MVCCStore.READ_ONLY_PROBE_S
+        self._ro_trips += 1
+        self._ro_denials += 1
+        raise StoreReadOnlyError(self._ro_reason, MVCCStore.READ_ONLY_PROBE_S)
+
+    @property
+    def read_only(self) -> Optional[str]:
+        if self._lib.mvcc_read_only(self._handle):
+            return self._ro_reason or "WAL write failed"
+        return None
+
+    @property
+    def read_only_trips(self) -> int:
+        return self._ro_trips
+
+    @property
+    def read_only_denials(self) -> int:
+        return self._ro_denials
+
+    @property
+    def read_only_retry_s(self) -> float:
+        if not self._lib.mvcc_read_only(self._handle):
+            return 0.0
+        return max(0.1, self._ro_probe_at - time.monotonic())
+
     # ---- MVCCStore API ----
 
     def put(self, key: str, value: str) -> int:
-        return self._lib.mvcc_put(self._handle, key.encode(), value.encode())
+        self._check_writable()
+        rev = self._lib.mvcc_put(self._handle, key.encode(), value.encode())
+        self._after_write()
+        return rev
 
     def put_many(self, items: Iterable[tuple[str, str]]) -> int:
         """Apply all puts under one native lock acquisition and one batch
@@ -98,10 +168,39 @@ class NativeMVCCStore:
             n += 1
         if n == 0:
             return self.revision
-        return self._lib.mvcc_put_many(self._handle, b"".join(parts), n)
+        self._check_writable()
+        rev = self._lib.mvcc_put_many(self._handle, b"".join(parts), n)
+        self._after_write()
+        return rev
 
     def delete(self, key: str) -> bool:
-        return bool(self._lib.mvcc_delete(self._handle, key.encode()))
+        self._check_writable()
+        ok = bool(self._lib.mvcc_delete(self._handle, key.encode()))
+        if ok:
+            self._after_write()
+        return ok
+
+    # ---- replication apply (store/mvcc.py put_at/delete_at is the spec) ----
+
+    def put_at(self, key: str, value: str, rev: int,
+               create_revision: Optional[int] = None,
+               version: Optional[int] = None) -> bool:
+        self._check_writable()
+        cr = -1 if create_revision is None else int(create_revision)
+        ver = -1 if version is None else int(version)
+        ok = bool(self._lib.mvcc_put_at(self._handle, key.encode(),
+                                        value.encode(), int(rev), cr, ver))
+        if ok:
+            self._after_write()
+        return ok
+
+    def delete_at(self, key: str, rev: int) -> bool:
+        self._check_writable()
+        ok = bool(self._lib.mvcc_delete_at(self._handle, key.encode(),
+                                           int(rev)))
+        if ok:
+            self._after_write()
+        return ok
 
     def get(self, key: str) -> Optional[KeyValue]:
         meta = self._get_meta
@@ -164,6 +263,23 @@ class NativeMVCCStore:
     def snapshot(self, path: str) -> None:
         if not self._lib.mvcc_snapshot(self._handle, path.encode()):
             raise OSError(f"snapshot to {path} failed")
+
+    def backup(self, path: str, revision: Optional[int] = None) -> dict:
+        """Point-in-time backup at exact `revision` (default: current) —
+        same contract and file format as MVCCStore.backup."""
+        target = self.revision if revision is None else int(revision)
+        rc = self._lib.mvcc_backup(self._handle, path.encode(), target)
+        if rc == -2:
+            raise ValueError(f"revision {target} outside the retained "
+                             f"range (compacted/ahead of head)")
+        if rc < 0:
+            raise OSError(f"backup to {path} failed")
+        return {"revision": target, "records": rc}
+
+    @property
+    def wal_format(self) -> int:
+        """0 = legacy v0 JSONL WAL file, 1 = CRC-framed v1 (walio.py)."""
+        return self._lib.mvcc_wal_format(self._handle)
 
     @property
     def wal_records(self) -> int:

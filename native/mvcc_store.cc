@@ -4,10 +4,12 @@
 // gpu_docker_api_tpu/store/mvcc.py (etcd-style: global revision counter,
 // per-key create/mod revision + version, tombstoned deletes, WAL
 // persistence, floor-preserving compaction). The WAL format is byte-
-// compatible with the Python implementation (JSONL records
-// {"op":"put","k":...,"v":...,"r":N} / {"op":"del",...} /
-// {"op":"compact","r":N,"keep":[...]} / {"op":"rev","r":N}) so either
-// engine can open the other's state.
+// compatible with the Python implementation — v1 CRC-framed records
+// (store/walio.py: magic header + crc32/len frame around each JSON
+// record {"op":"put","k":...,"v":...,"r":N} / {"op":"del",...} /
+// {"op":"compact","r":N,"keep":[...]} / {"op":"rev","r":N}), with
+// legacy v0 bare-JSONL files replayed and appended as v0 — so either
+// engine can open the other's state in either format.
 //
 // Durability mirrors the Python engine exactly: writers append records to
 // an in-memory pending buffer under the store mutex and block in Commit()
@@ -32,6 +34,8 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -43,6 +47,67 @@
 #include <vector>
 
 namespace {
+
+// ---------- WAL v1 framing (store/walio.py is the spec) ----------
+//
+// v1 file: "TDWAL1\n" header, then per record
+//   crc32(payload):%08x SP len(payload) SP payload \n
+// Legacy v0 files are bare JSONL; a file keeps its format on append and
+// every rewrite (Maintain/Snapshot/Backup) produces v1. The wrapper
+// (store/native.py) pre-scans with walio.scan() before mvcc_open, so
+// torn-tail truncation and the mid-log WalCorruptError classification
+// have ONE implementation; Replay here still verifies CRCs and stops at
+// the first bad frame as defense in depth.
+
+const char kWalMagic[] = "TDWAL1\n";
+const size_t kWalMagicLen = 7;
+
+// standard CRC-32 (IEEE 802.3, poly 0xEDB88320) — matches zlib.crc32
+uint32_t crc32_of(const char* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = table[(crc ^ static_cast<unsigned char>(data[i])) & 0xFF] ^
+          (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// one framed v1 line for `payload` (a JSON record, newline-free)
+std::string frame_v1(const std::string& payload) {
+  char head[32];
+  std::snprintf(head, sizeof head, "%08x %zu ",
+                crc32_of(payload.data(), payload.size()), payload.size());
+  return std::string(head) + payload + "\n";
+}
+
+// payload of one complete v1 line (trailing \n included); false when the
+// frame is damaged/incomplete
+bool parse_frame_v1(const std::string& line, std::string* payload) {
+  if (line.size() < 12 || line.back() != '\n' || line[8] != ' ')
+    return false;
+  char* end = nullptr;
+  unsigned long crc = std::strtoul(line.substr(0, 8).c_str(), &end, 16);
+  if (!end || *end) return false;
+  size_t sp = line.find(' ', 9);
+  if (sp == std::string::npos) return false;
+  long long n = std::strtoll(line.substr(9, sp - 9).c_str(), &end, 10);
+  if (!end || *end || n < 0) return false;
+  size_t plen = line.size() - sp - 2;  // minus the trailing newline
+  if (static_cast<long long>(plen) != n) return false;
+  if (crc32_of(line.data() + sp + 1, plen) != crc) return false;
+  payload->assign(line, sp + 1, plen);
+  return true;
+}
 
 struct Rev {
   int64_t mod = 0;
@@ -158,6 +223,8 @@ void skip_ws(const std::string& s, size_t* i) {
 struct Record {
   std::string op, k, v;
   int64_t r = -1;
+  int64_t cr = -1;   // pinned create_revision (backup/resync records)
+  int64_t ver = -1;  // pinned version
   std::vector<std::string> keep;
   bool ok = false;
 };
@@ -203,7 +270,10 @@ Record parse_record(const std::string& line) {
       // number / literal
       size_t start = i;
       while (i < line.size() && line[i] != ',' && line[i] != '}') ++i;
-      if (key == "r") rec.r = std::strtoll(line.substr(start, i - start).c_str(), nullptr, 10);
+      int64_t num = std::strtoll(line.substr(start, i - start).c_str(), nullptr, 10);
+      if (key == "r") rec.r = num;
+      else if (key == "cr") rec.cr = num;
+      else if (key == "ver") rec.ver = num;
     }
   }
   return rec;
@@ -225,6 +295,14 @@ class Store {
       wal_path_ = wal_path;
       Replay();
       wal_ = std::fopen(wal_path_.c_str(), "ab");
+      if (wal_ && wal_fmt_ == 1) {
+        // new/empty v1 file: write the format header before any record
+        long pos = std::ftell(wal_);
+        if (pos == 0) {
+          std::fwrite(kWalMagic, 1, kWalMagicLen, wal_);
+          std::fflush(wal_);
+        }
+      }
     }
   }
 
@@ -256,15 +334,55 @@ class Store {
       std::lock_guard<std::mutex> g(mu_);
       rev = ++rev_;
       ApplyPut(key, value, rev);
-      std::string line = "{\"op\":\"put\",\"k\":";
-      json_escape(key, &line);
-      line += ",\"v\":";
-      json_escape(value, &line);
-      line += ",\"r\":" + std::to_string(rev) + "}\n";
-      seq = Append(line);
+      seq = Append(WalLine(PutPayload(key, value, rev, -1, -1)));
     }
     Commit(seq);
     return rev;
+  }
+
+  // Install `value` at the EXACT revision `rev` — the replica-side twin
+  // of Put (store/mvcc.py put_at is the spec). Idempotent: a revision at
+  // or below the key's latest mod_revision (or the compaction floor) is
+  // a no-op returning false. cr/ver >= 0 pin the lifetime counters.
+  bool PutAt(const std::string& key, const std::string& value, int64_t rev,
+             int64_t cr, int64_t ver) {
+    int64_t seq;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (rev <= compacted_) return false;
+      auto it = log_.find(key);
+      if (it != log_.end() && !it->second.empty() &&
+          it->second.back().mod >= rev)
+        return false;
+      rev_ = std::max(rev_, rev);
+      ApplyPut(key, value, rev, cr, ver);
+      seq = Append(WalLine(PutPayload(key, value, rev, cr, ver)));
+    }
+    Commit(seq);
+    return true;
+  }
+
+  // Tombstone at the exact revision (see PutAt). Advances the revision
+  // counter even when the delete is a no-op (key absent/tombstoned) so
+  // the replica head tracks the peer's.
+  bool DeleteAt(const std::string& key, int64_t rev) {
+    int64_t seq;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (rev <= compacted_) return false;
+      auto it = log_.find(key);
+      bool seen = it != log_.end() && !it->second.empty();
+      if (seen && it->second.back().mod >= rev) return false;
+      rev_ = std::max(rev_, rev);
+      if (!seen || it->second.back().tombstone) return false;
+      ApplyDelete(key, rev);
+      std::string payload = "{\"op\":\"del\",\"k\":";
+      json_escape(key, &payload);
+      payload += ",\"r\":" + std::to_string(rev) + "}";
+      seq = Append(WalLine(payload));
+    }
+    Commit(seq);
+    return true;
   }
 
   // records: n entries of [u32 klen][u32 vlen][key bytes][value bytes].
@@ -288,12 +406,7 @@ class Store {
         p += vlen;
         rev = ++rev_;
         ApplyPut(key, value, rev);
-        std::string line = "{\"op\":\"put\",\"k\":";
-        json_escape(key, &line);
-        line += ",\"v\":";
-        json_escape(value, &line);
-        line += ",\"r\":" + std::to_string(rev) + "}\n";
-        seq = Append(line);
+        seq = Append(WalLine(PutPayload(key, value, rev, -1, -1)));
       }
     }
     Commit(seq);
@@ -310,10 +423,10 @@ class Store {
         return false;
       ++rev_;
       ApplyDelete(key, rev_);
-      std::string line = "{\"op\":\"del\",\"k\":";
-      json_escape(key, &line);
-      line += ",\"r\":" + std::to_string(rev_) + "}\n";
-      seq = Append(line);
+      std::string payload = "{\"op\":\"del\",\"k\":";
+      json_escape(key, &payload);
+      payload += ",\"r\":" + std::to_string(rev_) + "}";
+      seq = Append(WalLine(payload));
     }
     Commit(seq);
     return true;
@@ -430,7 +543,7 @@ class Store {
     {
       std::lock_guard<std::mutex> g(mu_);
       dropped = CompactLocked(revision, keep);
-      seq = Append(CompactLine(revision, keep));
+      seq = Append(WalLine(CompactPayload(revision, keep)));
     }
     Commit(seq);
     return dropped;
@@ -440,6 +553,21 @@ class Store {
     std::lock_guard<std::mutex> g(mu_);
     return SnapshotLocked(path, nullptr);
   }
+
+  int64_t Backup(const std::string& path, int64_t revision) {
+    return BackupTo(path, revision);
+  }
+
+  int wal_format() {
+    std::lock_guard<std::mutex> g(mu_);
+    return wal_fmt_;
+  }
+
+  // errno of the first failed WAL write/flush since the last clear
+  // (0 = healthy). The Python wrapper owns the read-only latch policy
+  // (probe window &c, store/native.py) — this is just the detector.
+  int read_only_errno() { return ro_errno_.load(); }
+  void clear_read_only() { ro_errno_.store(0); }
 
   // Bound the WAL: compact up to the current revision (keys under `keep`
   // retain full history), rewrite the WAL as a snapshot of the pruned
@@ -479,9 +607,12 @@ class Store {
                     // subsequent write from persistence
       }
       wal_records_ = records;
+      // the rewrite produced a v1 file, even over a legacy v0 one —
+      // this is the upgrade path (appends framed from here on)
+      wal_fmt_ = 1;
       // restore the compaction floor on future replays (the snapshot
       // itself carries only puts) — a no-op prune that re-sets compacted_
-      std::string line = CompactLine(compacted_, keep);
+      std::string line = WalLine(CompactPayload(compacted_, keep));
       std::fwrite(line.data(), 1, line.size(), wal_);
       std::fflush(wal_);
       ++wal_records_;
@@ -537,9 +668,20 @@ class Store {
   // caller holds wal_mu_ AND mu_
   void FlushPendingLocked() {
     if (!pending_.empty() && wal_) {
-      std::fwrite(pending_.data(), 1, pending_.size(), wal_);
+      size_t want = pending_.size();
+      size_t wrote = std::fwrite(pending_.data(), 1, want, wal_);
+      if (wrote != want) NoteWriteError();
       pending_.clear();
     }
+  }
+
+  // first failed WAL write/flush latches ro_errno_ (ENOSPC &c) — the
+  // wrapper turns it into the same read-only refusal as the Python
+  // engine's _set_read_only. Memory stays ahead of disk either way.
+  void NoteWriteError() {
+    int e = errno ? errno : 5 /* EIO */;
+    int expect = 0;
+    ro_errno_.compare_exchange_strong(expect, e);
   }
 
   // caller holds commit_mu_
@@ -580,9 +722,12 @@ class Store {
           batch.swap(pending_);
         }
         if (!batch.empty() && wal_) {
-          std::fwrite(batch.data(), 1, batch.size(), wal_);
-          std::fflush(wal_);
-          if (fsync_) ::fsync(fileno(wal_));
+          // the group-commit error path: the leader detects the failed
+          // write for the whole batch (mirrors _commit's OSError latch)
+          size_t wrote = std::fwrite(batch.data(), 1, batch.size(), wal_);
+          if (wrote != batch.size() || std::fflush(wal_) != 0)
+            NoteWriteError();
+          if (fsync_ && ::fsync(fileno(wal_)) != 0) NoteWriteError();
         }
       }
       lk.lock();
@@ -591,16 +736,40 @@ class Store {
     }
   }
 
-  static std::string CompactLine(int64_t revision,
-                                 const std::vector<std::string>& keep) {
+  static std::string CompactPayload(int64_t revision,
+                                    const std::vector<std::string>& keep) {
     std::string line = "{\"op\":\"compact\",\"r\":" + std::to_string(revision) +
                        ",\"keep\":[";
     for (size_t i = 0; i < keep.size(); ++i) {
       if (i) line += ",";
       json_escape(keep[i], &line);
     }
-    line += "]}\n";
+    line += "]}";
     return line;
+  }
+
+  static std::string PutPayload(const std::string& key,
+                                const std::string& value, int64_t rev,
+                                int64_t cr, int64_t ver) {
+    std::string p = "{\"op\":\"put\",\"k\":";
+    json_escape(key, &p);
+    p += ",\"v\":";
+    json_escape(value, &p);
+    p += ",\"r\":" + std::to_string(rev);
+    if (cr >= 0 && ver >= 0) {
+      p += ",\"cr\":" + std::to_string(cr);
+      p += ",\"ver\":" + std::to_string(ver);
+    }
+    p += "}";
+    return p;
+  }
+
+  // frame `payload` per the file's format — v1 CRC frame, or the bare
+  // legacy line while appending to a v0 file (homogeneous files; any
+  // rewrite upgrades). caller holds mu_.
+  std::string WalLine(const std::string& payload) const {
+    if (wal_fmt_ == 1) return frame_v1(payload);
+    return payload + "\n";
   }
 
   // caller holds mu_. The transfer buffer is mmap'd (anonymous) so the
@@ -617,12 +786,18 @@ class Store {
     rb_cap_ = cap;
     return rb_;
   }
-  void ApplyPut(const std::string& key, const std::string& value, int64_t rev) {
+  // cr/ver >= 0 pin the lifetime counters exactly (backup restore /
+  // resync apply); negative derives them from the log like put()
+  void ApplyPut(const std::string& key, const std::string& value, int64_t rev,
+                int64_t cr = -1, int64_t ver = -1) {
     auto& revs = log_[key];
     Rev r;
     r.mod = rev;
     r.value = value;
-    if (!revs.empty() && !revs.back().tombstone) {
+    if (cr >= 0 && ver >= 0) {
+      r.create = cr;
+      r.version = ver;
+    } else if (!revs.empty() && !revs.back().tombstone) {
       r.create = revs.back().create;
       r.version = revs.back().version + 1;
     } else {
@@ -640,12 +815,17 @@ class Store {
     revs.push_back(std::move(r));
   }
 
+  // always v1-framed; put records carry cr/ver so lifetime counters
+  // survive the rewrite exactly (a floor entry kept by compaction has
+  // create/version from revisions the snapshot omits)
   bool SnapshotLocked(const std::string& path, int64_t* records_out) {
     std::string tmp = path + ".tmp";
     FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return false;
+    std::fwrite(kWalMagic, 1, kWalMagicLen, f);
     int64_t records = 1;
-    std::string line = "{\"op\":\"rev\",\"r\":" + std::to_string(rev_) + "}\n";
+    std::string line =
+        frame_v1("{\"op\":\"rev\",\"r\":" + std::to_string(rev_) + "}");
     std::fwrite(line.data(), 1, line.size(), f);
     for (const auto& [key, revs] : log_) {
       std::vector<const Rev*> live;
@@ -654,11 +834,8 @@ class Store {
         else live.push_back(&r);
       }
       for (const Rev* r : live) {
-        line = "{\"op\":\"put\",\"k\":";
-        json_escape(key, &line);
-        line += ",\"v\":";
-        json_escape(r->value, &line);
-        line += ",\"r\":" + std::to_string(r->mod) + "}\n";
+        line = frame_v1(PutPayload(key, r->value, r->mod, r->create,
+                                   r->version));
         std::fwrite(line.data(), 1, line.size(), f);
         ++records;
       }
@@ -667,6 +844,55 @@ class Store {
     if (std::rename(tmp.c_str(), path.c_str()) != 0) return false;
     if (records_out) *records_out = records;
     return true;
+  }
+
+  // Consistent point-in-time backup at exact `revision` (default -1 =
+  // current): the retained history (tombstones included) at-or-below it,
+  // written atomically as a v1 replayable WAL (store/mvcc.py backup is
+  // the spec — the floor record precedes the puts so keep-prefix full
+  // history survives restore). Returns record count, -1 on I/O error,
+  // -2 when `revision` is out of the retained range.
+  int64_t BackupTo(const std::string& path, int64_t revision) {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t target = revision < 0 ? rev_ : revision;
+    if (target > rev_ || target < compacted_) return -2;
+    std::vector<std::pair<int64_t, std::pair<const std::string*, const Rev*>>>
+        entries;
+    for (const auto& [key, revs] : log_) {
+      for (const auto& r : revs) {
+        if (r.mod <= target) entries.push_back({r.mod, {&key, &r}});
+      }
+    }
+    std::sort(entries.begin(), entries.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    std::fwrite(kWalMagic, 1, kWalMagicLen, f);
+    int64_t records = 2;
+    std::string line =
+        frame_v1("{\"op\":\"rev\",\"r\":" + std::to_string(target) + "}");
+    std::fwrite(line.data(), 1, line.size(), f);
+    line = frame_v1(CompactPayload(compacted_, {}));
+    std::fwrite(line.data(), 1, line.size(), f);
+    for (const auto& e : entries) {
+      const std::string& key = *e.second.first;
+      const Rev& r = *e.second.second;
+      if (r.tombstone) {
+        std::string p = "{\"op\":\"del\",\"k\":";
+        json_escape(key, &p);
+        p += ",\"r\":" + std::to_string(r.mod) + "}";
+        line = frame_v1(p);
+      } else {
+        line = frame_v1(PutPayload(key, r.value, r.mod, r.create, r.version));
+      }
+      std::fwrite(line.data(), 1, line.size(), f);
+      ++records;
+    }
+    bool ok = std::fflush(f) == 0 && ::fsync(fileno(f)) == 0;
+    std::fclose(f);
+    if (!ok || std::rename(tmp.c_str(), path.c_str()) != 0) return -1;
+    return records;
   }
 
   int64_t CompactLocked(int64_t revision, const std::vector<std::string>& keep) {
@@ -703,29 +929,56 @@ class Store {
 
   void Replay() {
     FILE* f = std::fopen(wal_path_.c_str(), "rb");
-    if (!f) return;
+    if (!f) return;  // fresh store: wal_fmt_ stays 1
     std::string line;
     char buf[1 << 16];
-    auto apply_line = [&](const std::string& l) {
+    auto apply_payload = [&](const std::string& l) {
       Record rec = parse_record(l);
-      if (!rec.ok) return;  // torn tail record
+      if (!rec.ok) return;  // v0 torn/junk line tolerance
       ++wal_records_;
       int64_t rev = rec.r >= 0 ? rec.r : rev_ + 1;
       rev_ = std::max(rev_, rev);
-      if (rec.op == "put") ApplyPut(rec.k, rec.v, rev);
+      if (rec.op == "put") ApplyPut(rec.k, rec.v, rev, rec.cr, rec.ver);
       else if (rec.op == "del") ApplyDelete(rec.k, rev);
       else if (rec.op == "compact") CompactLocked(rev, rec.keep);
       // "rev": counter checkpoint only
     };
+    // format detection: a v1 file leads with the magic header. The
+    // wrapper (store/native.py) runs walio.scan() before mvcc_open, so
+    // torn tails are already truncated and mid-log corruption already
+    // raised — stopping at the first bad frame here is defense in depth,
+    // not the classification authority.
+    char head[kWalMagicLen];
+    size_t got = std::fread(head, 1, kWalMagicLen, f);
+    bool v1 = got == kWalMagicLen &&
+              std::memcmp(head, kWalMagic, kWalMagicLen) == 0;
+    if (!v1) {
+      if (got == 0) {  // empty file: treat as a fresh v1 store
+        std::fclose(f);
+        return;
+      }
+      wal_fmt_ = 0;
+      std::fseek(f, 0, SEEK_SET);
+    }
     while (std::fgets(buf, sizeof buf, f)) {
       line += buf;
       if (line.empty() || line.back() != '\n') continue;  // long line: keep reading
-      apply_line(line);
+      if (v1) {
+        std::string payload;
+        if (!parse_frame_v1(line, &payload)) break;  // damaged frame: stop
+        apply_payload(payload);
+      } else {
+        apply_payload(line);
+      }
       line.clear();
     }
-    // a crash can flush a complete record without its trailing newline —
-    // the Python engine applies it (json parses after strip), so must we
-    if (!line.empty()) apply_line(line);
+    if (!line.empty() && !v1) {
+      // a crash can flush a complete v0 record without its trailing
+      // newline — the Python engine applies it, so must we. (In v1 a
+      // newline-less tail is BY SPEC a torn frame — walio.parse_frame
+      // requires the terminator — so both engines drop it.)
+      apply_payload(line);
+    }
     std::fclose(f);
   }
 
@@ -748,6 +1001,8 @@ class Store {
   std::string wal_path_;
   FILE* wal_ = nullptr;
   bool fsync_ = false;
+  int wal_fmt_ = 1;  // 0 = legacy v0 JSONL file, 1 = framed (walio.py)
+  std::atomic<int> ro_errno_{0};  // first WAL write failure (0 = healthy)
   int64_t batch_window_us_ = 0;
   // group-commit state: pending_/seq_ under mu_; the file itself under
   // wal_mu_ (ordered wal_mu_ -> mu_); durable_seq_/flushing_/counters
@@ -835,6 +1090,38 @@ int64_t mvcc_compact(void* h, int64_t revision, const char* keep_prefixes) {
 
 int mvcc_snapshot(void* h, const char* path) {
   return static_cast<Store*>(h)->Snapshot(path) ? 1 : 0;
+}
+
+// Replica-side exact-revision apply (see Store::PutAt). cr/ver < 0 derive
+// lifetime counters locally. Returns 1 applied / 0 idempotent no-op.
+int mvcc_put_at(void* h, const char* key, const char* value, int64_t rev,
+                int64_t cr, int64_t ver) {
+  return static_cast<Store*>(h)->PutAt(key, value, rev, cr, ver) ? 1 : 0;
+}
+
+int mvcc_delete_at(void* h, const char* key, int64_t rev) {
+  return static_cast<Store*>(h)->DeleteAt(key, rev) ? 1 : 0;
+}
+
+// Point-in-time backup (revision < 0 = current). Returns record count,
+// -1 on I/O failure, -2 when revision is outside the retained range.
+int64_t mvcc_backup(void* h, const char* path, int64_t revision) {
+  return static_cast<Store*>(h)->Backup(path, revision);
+}
+
+// errno of the first failed WAL write/flush since the last clear (0 =
+// healthy); the Python wrapper owns the read-only latch policy.
+int mvcc_read_only(void* h) {
+  return static_cast<Store*>(h)->read_only_errno();
+}
+
+void mvcc_clear_read_only(void* h) {
+  static_cast<Store*>(h)->clear_read_only();
+}
+
+// WAL file format in use: 0 = legacy v0 JSONL, 1 = CRC-framed v1.
+int mvcc_wal_format(void* h) {
+  return static_cast<Store*>(h)->wal_format();
 }
 
 // keep_prefixes: same NUL-separated format as mvcc_compact. Returns dropped

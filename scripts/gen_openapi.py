@@ -670,8 +670,33 @@ def build_spec() -> dict:
              "workers": {"allOf": [ref("WorkersBlock")],
                          "nullable": True},
              "reconcileActions": i("Boot reconcile total; non-zero = the "
-                                   "previous daemon died dirty")},
+                                   "previous daemon died dirty"),
+             "storeReadOnly": {"type": "string", "nullable": True,
+                               "description":
+                                   "Read-only latch reason while the WAL "
+                                   "cannot be appended (ENOSPC &c; "
+                                   "mutations answer 503 + Retry-After "
+                                   "until the timed re-probe heals it); "
+                                   "null when writable "
+                                   "(docs/durability.md)"},
+             "replication": {"allOf": [ref("ReplicationBlock")],
+                             "nullable": True}},
             desc="GET /api/v1/healthz payload (server/app.py h_healthz)"),
+        "ReplicationBlock": obj(
+            {"peer": s("host:port of the replicated peer daemon "
+                       "(--repl-peer / TDAPI_REPL_PEER)"),
+             "horizon": i("Highest peer revision contiguously applied "
+                          "to the local replica store"),
+             "peerHead": i("Highest peer revision observed on the "
+                           "watch stream"),
+             "lagRevisions": i("peerHead - horizon (0 = caught up)"),
+             "eventsApplied": i("Watch events applied since boot"),
+             "resyncs": i("Full relist resyncs after WatchCompacted"),
+             "connected": b("True while the watch stream is attached")},
+            desc="Warm-standby replication status "
+                 "(replication.py StandbyReplicator.describe; "
+                 "docs/durability.md); null when no peer is "
+                 "configured"),
         "CordonResponse": obj(
             {"cordoned": arr(i(), "Full cordoned set after the change")}),
         "DrainItem": obj(
@@ -1368,7 +1393,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.13.0",
+            "version": "0.14.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

@@ -390,6 +390,86 @@ def post_fed_takeover(app, stored):
     assert ("containers", "demo") in m.owned
 
 
+def _promote_install(app, value):
+    """The App._fleet_promote shape: install the replica's copy only
+    when the local store lacks the key (install-once, never clobber)."""
+    def promote(resource, name):
+        key = f"/tpu-docker-api/apis/v1/{resource}/{name}"
+        if app.store.get(key) is None:
+            app.store.put(key, value)
+    return promote
+
+
+def setup_fed_promote(app):
+    # orphan grant on a plane no subsystem reconciles, held by a member
+    # whose lease is gone — the promote-armed takeover target
+    from gpu_docker_api_tpu import federation
+    app.fleet.arbiter.join("m_dead")
+    app.fleet.arbiter.acquire("notes", "r0", "m_dead")
+    app.store.delete(f"{federation.LEASE_PREFIX}/m_dead")
+    app.fleet.configure_member("m0", addr="local",
+                               promote=_promote_install(app, "replica-1"))
+    app.fleet.member.join()
+
+
+def scenario_fed_promote(app):
+    app.fleet.member.heartbeat_once()   # steal -> promote -> dies
+
+
+def post_fed_promote(app, stored):
+    # the steal (fencing epoch) and the promoted record both persisted
+    # before the seam; m0 never adopted, so the grant re-orphans and the
+    # next seat's sweep re-runs promote — which must be a no-op install
+    # (the crashed promote's record wins, never clobbered)
+    grants = {(g["resource"], g["name"]): g["holder"]
+              for g in app.fleet.arbiter.grants()}
+    assert grants.get(("notes", "r0")) == "m0"
+    kv = app.store.get("/tpu-docker-api/apis/v1/notes/r0")
+    assert kv is not None and kv.value == "replica-1"
+    installed_rev = kv.mod_revision
+    m = app.fleet.configure_member("m1", addr="local",
+                                   promote=_promote_install(app,
+                                                            "replica-2"))
+    m.join()
+    out = m.heartbeat_once()
+    assert "notes/r0" in out["adopted"]
+    kv2 = app.store.get("/tpu-docker-api/apis/v1/notes/r0")
+    assert kv2.value == "replica-1"
+    assert kv2.mod_revision == installed_rev
+
+
+def _replica_dir(app):
+    return os.path.join(app.state_dir, "replica")
+
+
+def setup_repl_snapshot(app):
+    # a detached replicator (no live peer needed: checkpoint is local)
+    # with one applied event, so the checkpoint has real state to pin
+    from gpu_docker_api_tpu.replication import StandbyReplicator
+    r = StandbyReplicator("127.0.0.1:1", _replica_dir(app),
+                          engine="python")
+    r.apply_event({"revision": 5, "resource": "containers", "name": "c0",
+                   "type": "put", "value": "x"})
+    app._test_repl = r
+
+
+def scenario_repl_snapshot(app):
+    app._test_repl.checkpoint()     # maintain + persist, then dies
+
+
+def post_repl_snapshot(app, stored):
+    # the crash seam sits AFTER both durability steps: a replicator
+    # rebuilt from the same dir sees the checkpointed horizon and the
+    # record behind it (sidecar never claims what the store lacks)
+    from gpu_docker_api_tpu.replication import StandbyReplicator
+    r = StandbyReplicator("127.0.0.1:1", _replica_dir(app),
+                          engine="python")
+    assert r.horizon == 5
+    kv = r.get_record("containers", "c0")
+    assert kv is not None and kv.value == "x" and kv.mod_revision == 5
+    r.store.close()
+
+
 # crashpoint-name prefix -> (setup, mutate, extra post-assertions)
 SCENARIOS = [
     ("run.", (None, scenario_run, post_run)),
@@ -417,6 +497,14 @@ SCENARIOS = [
                            post_fed_acquire)),
     ("fed.after_takeover", (setup_fed_takeover, scenario_fed_takeover,
                             post_fed_takeover)),
+    # promote-on-loss: crash between the replica install and the adopt —
+    # recovery must re-promote idempotently behind the same epoch
+    ("fed.after_promote", (setup_fed_promote, scenario_fed_promote,
+                           post_fed_promote)),
+    # standby replication: crash right after a checkpoint's two
+    # durability steps (maintain, then horizon sidecar)
+    ("repl.after_snapshot", (setup_repl_snapshot, scenario_repl_snapshot,
+                             post_repl_snapshot)),
 ]
 
 

@@ -1,0 +1,581 @@
+"""Durable state plane sweep (`durability` marker, `make
+verify-durability`).
+
+Five layers, cheapest first:
+
+- UNIT: walio frame/scan/scrub classify every WAL shape — clean v1,
+  torn tail (truncate + continue), mid-log corruption (typed refusal
+  pointing at the scrub tool), v0 legacy.
+- SWEEP: kill-at-any-point (live torn_tail disk fault at every append
+  index) plus offline torn-tail/bitflip damage — replay must land on
+  the SAME observable state in BOTH engines, byte-identical WALs.
+- BACKUP: point-in-time snapshot/restore round-trips preserve exact
+  revision history (cr/ver counters, tombstones) within and ACROSS
+  engines, via the `store backup|restore|scrub` CLI too.
+- FAULTS: ENOSPC latches the store read-only (memory-ahead-of-disk),
+  surfaces as 503 + Retry-After + a `store.read_only` event at the app
+  layer, and heals through the timed re-probe.
+- REPLICATION: a StandbyReplicator tails a live daemon gap-free,
+  resyncs from one atomic snapshot after a WatchCompacted, and the
+  promote model's R2 checker is proven live on its seeded mutant; the
+  acceptance e2e (SIGKILL the primary, standby promotes behind the
+  fencing epoch with zero acked-revision loss) closes the file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import faults
+from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.replication import StandbyReplicator, resource_key
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.store import (
+    StoreReadOnlyError, WalCorruptError, native_available, open_store,
+    walio,
+)
+from gpu_docker_api_tpu.topology import make_topology
+from tools.tdcheck import models
+from tools.tdcheck.sched import InvariantViolation
+
+from conftest import wait_for
+
+pytestmark = pytest.mark.durability
+
+ENGINES = ["python", "native"] if native_available() else ["python"]
+BOTH = pytest.mark.parametrize("engine", ENGINES)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    faults.disarm_disk_faults()
+    yield
+    faults.disarm_all()
+    faults.disarm_disk_faults()
+
+
+def observable(s):
+    return {
+        "rev": s.revision,
+        "range": [(kv.key, kv.value, kv.create_revision, kv.mod_revision,
+                   kv.version) for kv in s.range("/")],
+    }
+
+
+# ------------------------------------------------------------ walio unit
+
+def test_frame_roundtrip():
+    payload = b'{"op":"put","k":"/a","v":"x","r":1}'
+    line = walio.frame(payload)
+    assert line.endswith(b"\n")
+    assert walio.parse_frame(line) == payload
+
+
+def test_parse_frame_rejects_damage():
+    line = walio.frame(b'{"op":"put"}')
+    assert walio.parse_frame(line[:-5]) is None          # torn
+    flipped = line[:15] + bytes([line[15] ^ 0x01]) + line[16:]
+    assert walio.parse_frame(flipped) is None            # crc mismatch
+
+
+def _v1_wal(path, payloads):
+    with open(path, "wb") as f:
+        f.write(walio.MAGIC)
+        for p in payloads:
+            f.write(walio.frame(p))
+
+
+def test_scan_clean_torn_and_midlog(tmp_path):
+    p = str(tmp_path / "w.wal")
+    recs = [b'{"op":"put","k":"/a","v":"1","r":1}',
+            b'{"op":"put","k":"/b","v":"2","r":2}',
+            b'{"op":"put","k":"/a","v":"3","r":3}']
+    _v1_wal(p, recs)
+    s = walio.scan(p)
+    assert (s.fmt, len(s.payloads), s.truncate_to, s.corrupt_at) == \
+        (1, 3, None, None)
+
+    # torn tail: bad frames only at the end -> truncate point, records
+    # before it all served
+    size = os.path.getsize(p)
+    with open(p, "ab") as f:
+        f.write(walio.frame(recs[0])[:10])
+    s = walio.scan(p)
+    assert len(s.payloads) == 3 and s.truncate_to == size
+    assert s.corrupt_at is None
+
+    # mid-log: a valid frame AFTER the bad one makes it corruption, not
+    # a crash artifact — typed refusal pointing at the scrub tool. The
+    # bad frame must be newline-terminated (a bitflip, not a tear) or
+    # the scanner correctly merges it with what follows as one torn line
+    good = walio.frame(recs[1])
+    bad = good[:15] + bytes([good[15] ^ 0x01]) + good[16:]
+    with open(p, "wb") as f:
+        f.write(walio.MAGIC)
+        for r in recs:
+            f.write(walio.frame(r))
+        f.write(bad)
+        f.write(walio.frame(recs[1]))
+    s = walio.scan(p)
+    assert s.corrupt_at == size
+    with pytest.raises(WalCorruptError) as ei:
+        open_store(wal_path=p, engine="python")
+    assert "scrub" in str(ei.value)
+
+
+def test_scan_v0_legacy(tmp_path):
+    p = str(tmp_path / "v0.wal")
+    with open(p, "w") as f:
+        f.write('{"op": "put", "k": "/a", "v": "x", "r": 1}\n')
+    s = walio.scan(p)
+    assert s.fmt == 0 and len(s.payloads) == 1
+
+
+def test_scrub_reports(tmp_path):
+    p = str(tmp_path / "w.wal")
+    _v1_wal(p, [b'{"op":"put","k":"/a","v":"1","r":1}'])
+    rep = walio.scrub(p)
+    assert rep["ok"] and rep["format"] == 1 and rep["records"] == 1
+    # damage to the FINAL record is indistinguishable from a crash
+    # mid-write: scrub reports it as a (recoverable) torn tail
+    faults.corrupt_wal(p, "bitflip", line_at=1.0)
+    rep = walio.scrub(p)
+    assert rep["ok"] and "tornTailAt" in rep and rep["records"] == 0
+
+
+# --------------------------------------- kill / corruption replay sweeps
+
+N_OPS = 8
+
+
+def _mutate(s, i):
+    if i % 4 == 3:
+        s.delete(f"/k{(i - 1) % 3}")
+    else:
+        s.put(f"/k{i % 3}", f"v{i}")
+
+
+def _replay_both(tmp_path, src_path, tag):
+    """Replay one damaged-then-healed WAL in both engines; identical
+    observable state and identical post-replay WAL bytes."""
+    outs = {}
+    for engine in ENGINES:
+        p = str(tmp_path / f"replay-{tag}-{engine}.wal")
+        with open(src_path, "rb") as f:
+            data = f.read()
+        with open(p, "wb") as f:
+            f.write(data)
+        s = open_store(wal_path=p, engine=engine)
+        outs[engine] = (observable(s), open(p, "rb").read())
+        s.close()
+    first = outs[ENGINES[0]]
+    for engine in ENGINES[1:]:
+        assert outs[engine] == first, f"engine divergence at {tag}"
+    return first[0]
+
+
+def test_kill_at_any_append_replays_identically(tmp_path):
+    """Live torn_tail at every append index: the writer dies mid-write,
+    replay truncates the torn frame and keeps the prefix — in both
+    engines, landing on the same state."""
+    for kill_at in range(N_OPS):
+        p = str(tmp_path / f"kill{kill_at}.wal")
+        s = open_store(wal_path=p, engine="python")
+        faults.arm_disk_fault(f"kill{kill_at}.wal:torn_tail:{kill_at}")
+        try:
+            with pytest.raises(InjectedCrash):
+                for i in range(N_OPS):
+                    _mutate(s, i)
+                raise AssertionError("disk fault never fired")
+        finally:
+            faults.disarm_disk_faults()
+        # abandon without close (the crash); replay both engines
+        state = _replay_both(tmp_path, p, f"kill{kill_at}")
+        assert state["rev"] <= kill_at  # torn record never acked
+
+
+@BOTH
+@pytest.mark.parametrize("mode", ["torn_tail", "bitflip"])
+def test_offline_tail_damage_truncates_both_engines(tmp_path, engine,
+                                                    mode):
+    p = str(tmp_path / "w.wal")
+    s = open_store(wal_path=p, engine=engine)
+    for i in range(N_OPS):
+        _mutate(s, i)
+    undamaged = observable(s)
+    s.close()
+    faults.corrupt_wal(p, mode, line_at=1.0)
+    state = _replay_both(tmp_path, p, f"{engine}-{mode}")
+    assert state["rev"] == undamaged["rev"] - 1
+
+
+@BOTH
+def test_midlog_bitflip_refused_both_engines(tmp_path, engine):
+    p = str(tmp_path / "w.wal")
+    s = open_store(wal_path=p, engine=engine)
+    for i in range(N_OPS):
+        _mutate(s, i)
+    s.close()
+    faults.corrupt_wal(p, "bitflip", line_at=0.4)
+    for eng in ENGINES:
+        with pytest.raises(WalCorruptError):
+            open_store(wal_path=p, engine=eng)
+    assert not walio.scrub(p)["ok"]
+
+
+@BOTH
+def test_v0_wal_replays_and_maintain_upgrades(tmp_path, engine):
+    p = str(tmp_path / "v0.wal")
+    with open(p, "w") as f:
+        f.write('{"op": "put", "k": "/a", "v": "x", "r": 1}\n')
+        f.write('{"op": "put", "k": "/b", "v": "y", "r": 2}\n')
+        f.write('{"op": "del", "k": "/a", "r": 3}\n')
+    s = open_store(wal_path=p, engine=engine)
+    assert s.wal_format == 0
+    assert s.revision == 3 and s.get("/a") is None
+    s.put("/c", "z")                  # appended in v0 (no mixed files)
+    s.maintain()                      # every rewrite upgrades to v1
+    assert s.wal_format == 1
+    state = observable(s)
+    s.close()
+    assert open(p, "rb").read().startswith(walio.MAGIC)
+    s2 = open_store(wal_path=p, engine=engine)
+    assert observable(s2) == state
+    s2.close()
+
+
+# ------------------------------------------------- backup/restore + CLI
+
+def _seed(s):
+    s.put("/a", "1")
+    s.put("/b", "2")
+    s.put("/a", "3")
+    s.delete("/b")
+    s.put("/c", "4")
+    return s.revision             # 5
+
+
+@BOTH
+def test_backup_restore_roundtrip(tmp_path, engine):
+    p = str(tmp_path / "src.wal")
+    s = open_store(wal_path=p, engine=engine)
+    rev = _seed(s)
+    want = observable(s)
+    out = s.backup(str(tmp_path / "bk.wal"))
+    assert out["revision"] == rev
+    s.close()
+    # restore = open the backup file as a WAL, in EITHER engine
+    for eng in ENGINES:
+        r = open_store(wal_path=str(tmp_path / "bk.wal"), engine=eng)
+        got = observable(r)
+        assert got == want, f"restore diverged in {eng}"
+        # tombstone replayed: /b deleted but its revision retained
+        assert r.get("/b") is None
+        r.close()
+
+
+@BOTH
+def test_backup_point_in_time_and_validation(tmp_path, engine):
+    s = open_store(wal_path=str(tmp_path / "src.wal"), engine=engine)
+    _seed(s)
+    s.backup(str(tmp_path / "bk3.wal"), revision=3)
+    with pytest.raises(ValueError):
+        s.backup(str(tmp_path / "bad.wal"), revision=99)
+    s.close()
+    r = open_store(wal_path=str(tmp_path / "bk3.wal"), engine="python")
+    assert r.revision == 3
+    assert r.get("/a").value == "3" and r.get("/b").value == "2"
+    assert r.get("/c") is None
+    # lifetime counters preserved exactly, not re-minted
+    assert r.get("/a").create_revision == 1 and r.get("/a").version == 2
+    r.close()
+
+
+def test_store_cli_backup_restore_scrub(tmp_path):
+    sd = tmp_path / "sd"
+    s = open_store(wal_path=str(sd / "state.wal"), engine="python")
+    rev = _seed(s)
+    want = observable(s)
+    s.close()
+
+    def cli(*a):
+        return subprocess.run(
+            [sys.executable, "-m", "gpu_docker_api_tpu.cli", "store", *a],
+            capture_output=True, text=True, cwd="/root/repo")
+
+    r = cli("scrub", str(sd / "state.wal"))
+    assert r.returncode == 0 and json.loads(r.stdout)["ok"]
+    r = cli("backup", "-s", str(sd), "-o", str(tmp_path / "bk.wal"))
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["revision"] == rev
+    r = cli("restore", "-s", str(tmp_path / "sd2"),
+            "--from", str(tmp_path / "bk.wal"))
+    assert r.returncode == 0, r.stderr
+    # refuses to clobber without --force
+    r = cli("restore", "-s", str(tmp_path / "sd2"),
+            "--from", str(tmp_path / "bk.wal"))
+    assert r.returncode == 1 and "--force" in r.stderr
+    # refuses a corrupt backup outright
+    faults.corrupt_wal(str(tmp_path / "bk.wal"), "bitflip", line_at=0.4)
+    r = cli("restore", "-s", str(tmp_path / "sd3"),
+            "--from", str(tmp_path / "bk.wal"), "--force")
+    assert r.returncode == 1 and "corrupt" in r.stderr
+    s2 = open_store(wal_path=str(tmp_path / "sd2" / "state.wal"),
+                    engine="python")
+    assert observable(s2) == want
+    s2.close()
+
+
+# ------------------------------------------------ put_at / delete_at
+
+@BOTH
+def test_put_at_delete_at_idempotent(tmp_path, engine):
+    s = open_store(wal_path=str(tmp_path / "r.wal"), engine=engine)
+    assert s.put_at("/a", "x", 5, create_revision=5, version=1)
+    assert s.revision == 5
+    # replay below the head is a no-op (the replicator's crash-replay
+    # guarantee), not a new revision
+    assert not s.put_at("/a", "x", 5)
+    assert not s.put_at("/a", "stale", 4)
+    assert s.revision == 5 and s.get("/a").value == "x"
+    assert s.delete_at("/a", 7)
+    assert s.revision == 7 and s.get("/a") is None
+    assert not s.delete_at("/a", 7)
+    # counters pinned exactly on a fresh key
+    assert s.put_at("/b", "y", 9, create_revision=2, version=6)
+    kv = s.get("/b")
+    assert (kv.create_revision, kv.version) == (2, 6)
+    s.close()
+
+
+# ----------------------------------------------- ENOSPC -> read-only 503
+
+def make_app(tmp_path, **kw):
+    a = App(state_dir=str(tmp_path / "state"), backend="mock",
+            addr="127.0.0.1:0", port_range=(43600, 43700),
+            topology=make_topology("v4-32"), api_key="", cpu_cores=16,
+            store_engine="python", **kw)
+    a.start()
+    return a
+
+
+def _post(app, path, body):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", app.server.port,
+                                      timeout=10)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, out, headers
+
+
+def test_enospc_latches_read_only_503_then_heals(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        "gpu_docker_api_tpu.store.mvcc.MVCCStore.READ_ONLY_PROBE_S", 0.2)
+    app = make_app(tmp_path)
+    try:
+        status, out, _ = _post(app, "/api/v1/volumes",
+                               {"name": "vol", "size": "1GB"})
+        assert status == 200 and out["code"] == 200
+        faults.arm_disk_fault("state.wal:enospc")
+        status, out, headers = _post(app, "/api/v1/volumes",
+                                     {"name": "ro", "size": "1GB"})
+        assert status == 503, out
+        assert int(headers["Retry-After"]) >= 1
+        assert "ENOSPC" in out["data"]["reason"] or \
+            "No space" in out["data"]["reason"]
+        assert any(e["op"] == "store.read_only"
+                   for e in app.events.recent(50))
+        # latched: the next mutation is denied without touching disk
+        status, _, _ = _post(app, "/api/v1/volumes",
+                             {"name": "ro2", "size": "1GB"})
+        assert status == 503
+        # the disk recovers; the timed re-probe heals the latch
+        faults.disarm_disk_faults()
+        time.sleep(0.25)
+        status, out, _ = _post(app, "/api/v1/volumes",
+                               {"name": "ok", "size": "1GB"})
+        assert status == 200 and out["code"] == 200, out
+        assert app.store.read_only is None
+    finally:
+        faults.disarm_disk_faults()
+        app.stop()
+
+
+# ----------------------------------------------------------- replication
+
+def test_replicator_tails_live_daemon(tmp_path):
+    a = make_app(tmp_path)
+    b_dir = tmp_path / "replB"
+    try:
+        _post(a, "/api/v1/volumes", {"name": "vol", "size": "1GB"})
+        r = StandbyReplicator(f"127.0.0.1:{a.server.port}", str(b_dir),
+                              engine="python")
+        pk = a.store.get(resource_key("volumes", "vol"))
+        r.start()
+        try:
+            wait_for(lambda: r.horizon >= pk.mod_revision,
+                     msg="replica caught up")
+        finally:
+            r.stop()
+        kv = r.store.get(resource_key("volumes", "vol"))
+        # stop() closed the replica store; reopen to assert durability
+        r2 = StandbyReplicator(f"127.0.0.1:{a.server.port}", str(b_dir),
+                               engine="python")
+        kv = r2.get_record("volumes", "vol")
+        assert kv is not None and kv.mod_revision == pk.mod_revision
+        assert kv.value == pk.value
+        assert r2.horizon >= pk.mod_revision
+        st = r2.describe()
+        assert st["peer"].endswith(str(a.server.port))
+        r2.store.close()
+    finally:
+        a.stop()
+
+
+def test_replicator_gap_forces_full_resync(tmp_path):
+    a = make_app(tmp_path)
+    try:
+        _post(a, "/api/v1/volumes", {"name": "vol", "size": "1GB"})
+        r = StandbyReplicator(f"127.0.0.1:{a.server.port}",
+                              str(tmp_path / "replB"), engine="python")
+        # a horizon AHEAD of the peer's head is a foreign revision
+        # space — the watch answers WatchCompacted, the replicator must
+        # resync from one atomic snapshot, not stream garbage
+        r.horizon = a.store.revision + 1000
+        r.run_once()
+        assert r.resyncs_total == 1
+        kv = r.get_record("volumes", "vol")
+        pk = a.store.get(resource_key("volumes", "vol"))
+        assert kv is not None and kv.mod_revision == pk.mod_revision
+        assert kv.create_revision == pk.create_revision
+        assert kv.version == pk.version
+        r.store.close()
+    finally:
+        a.stop()
+
+
+# ------------------------------------------------------ promote-on-loss
+
+def test_promote_model_r2_mutant_is_caught():
+    """The R1 mutant is proven by `make lint`'s CLI gate; the R2 mutant
+    (promote after a LOST steal) is proven here, mirroring the lease
+    model's NoExpiry split."""
+    with pytest.raises(InvariantViolation) as ei:
+        models.sweep_promote(max_schedules=800,
+                             member_cls=models.BrokenPromoteMember)
+    assert "R2" in str(ei.value.message)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_sigkill_primary_standby_promotes(tmp_path):
+    """The acceptance scenario: daemon A (a fleet member) owns a
+    replicaSet and dies by SIGKILL; daemon B — arbiter host, standby
+    replicator tailing A — must steal the orphan grant behind a fresh
+    fencing epoch AND install A's replicated record, losing no
+    acknowledged revision at-or-below the replicated horizon."""
+    ttl = 1.0
+    port_a = free_port()
+    b = make_app(tmp_path, fleet_member="b", fleet_ttl=ttl,
+                 repl_peer=f"127.0.0.1:{port_a}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("APIKEY", None)
+    alog = open(tmp_path / "a.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpu_docker_api_tpu.cli",
+         "-a", f"127.0.0.1:{port_a}", "-s", str(tmp_path / "a"),
+         "-b", "mock", "-t", "v4-32", "-p", "43600-43700",
+         "--health-interval", "0", "--warm-pool", "0", "--cpu-cores", "16",
+         "--fleet-member", "a",
+         "--fleet-host", f"127.0.0.1:{b.server.port}",
+         "--fleet-ttl", str(ttl)],
+        env=env, stdout=alog, stderr=alog, cwd="/root/repo")
+    try:
+        import http.client
+
+        from gpu_docker_api_tpu.federation import HashRing
+
+        def ping_a():
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port_a,
+                                                  timeout=2)
+                conn.request("GET", "/ping")
+                ok = conn.getresponse().status == 200
+                conn.close()
+                return ok
+            except OSError:
+                return False
+        wait_for(ping_a, timeout=60, msg="daemon a serving")
+        wait_for(lambda: {m["member"]
+                          for m in b.fleet.arbiter.members()} == {"a", "b"},
+                 timeout=15, msg="a joined the fleet")
+
+        # a replicaSet name A's ring slice owns
+        i = 0
+        while HashRing.owner_of(f"containers/rs{i}", {"a", "b"}) != "a":
+            i += 1
+        name = f"rs{i}"
+        conn = http.client.HTTPConnection("127.0.0.1", port_a, timeout=10)
+        conn.request("POST", "/api/v1/replicaSet", json.dumps({
+            "imageName": "ubuntu:22.04", "replicaSetName": name,
+            "tpuCount": 1, "cpuCount": 1, "memory": "1GB"}),
+            {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        conn.close()
+        assert out["code"] == 200, out
+
+        # the write is acked to the client; B's replica must catch up
+        # to it before we murder A (the warm standby steady state)
+        wait_for(lambda: b.replicator is not None
+                 and b.replicator.get_record("containers", name)
+                 is not None,
+                 timeout=20, msg="replica caught the acked record")
+        replica_kv = b.replicator.get_record("containers", name)
+        assert b.replicator.horizon >= replica_kv.mod_revision
+        grant_before = {g["name"]: g for g in b.fleet.arbiter.grants()}
+        assert grant_before[name]["holder"] == "a"
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # B's heartbeat sweep: steal behind a fresh epoch, promote the
+        # replicated record, adopt
+        wait_for(lambda: ("containers", name) in b.fleet.member.owned,
+                 timeout=15 * ttl, msg="standby takeover")
+        grants = {g["name"]: g for g in b.fleet.arbiter.grants()}
+        assert grants[name]["holder"] == "b"
+        assert grants[name]["epoch"] == grant_before[name]["epoch"] + 1
+        # zero acked-revision loss: the promoted record carries A's
+        # last replicated state of the acked write
+        kv = b.store.get(resource_key("containers", name))
+        assert kv is not None, "promoted record missing"
+        assert kv.value == replica_kv.value
+        ops = [e["op"] for e in b.events.recent(200)]
+        assert "fed.promote" in ops and "fed.takeover" in ops
+        # promoted exactly once: one lineage (R2 in the live plane)
+        assert ops.count("fed.promote") == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        alog.close()
+        b.stop()

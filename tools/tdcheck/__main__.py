@@ -19,9 +19,10 @@ import sys
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tdcheck")
-    ap.add_argument("--model", default="seqlock,claim,wal,lease,fedwatch",
+    ap.add_argument("--model",
+                    default="seqlock,claim,wal,lease,fedwatch,promote",
                     help="comma-separated subset of: seqlock, claim, wal, "
-                    "lease, fedwatch")
+                    "lease, fedwatch, promote")
     ap.add_argument("--mode", default="exhaustive",
                     choices=["exhaustive", "random"])
     ap.add_argument("--schedules", type=int, default=2000,
@@ -61,8 +62,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         from .models import (
-            ClaimModel, FedWatchModel, LeaseModel, SeqlockModel, WalModel,
-            run_model,
+            ClaimModel, FedWatchModel, LeaseModel, PromoteModel,
+            SeqlockModel, WalModel, run_model,
         )
         schedule = parse_schedule(args.replay)
         strat = ReplayStrategy(schedule)
@@ -100,6 +101,13 @@ def main(argv=None) -> int:
                               preemptions=args.preemptions)
                 else:
                     run_model(lambda s: FedWatchModel(s), strat, kills=1,
+                              preemptions=0)
+            elif m == "promote":
+                if args.variant == "no-kill":
+                    run_model(lambda s: PromoteModel(s), strat, kills=0,
+                              preemptions=args.preemptions)
+                else:
+                    run_model(lambda s: PromoteModel(s), strat, kills=1,
                               preemptions=0)
             else:
                 run_model(lambda s: WalModel(s), strat, kills=1,

@@ -57,6 +57,19 @@ federation watch (real `WatchedStore` + `WatchHub`, federation.py):
       cursor-resume (the takeover handoff) applies a strictly-increasing
       revision sequence — zero duplicated revisions — and its final
       cache equals the store's watched state — zero dropped revisions.
+
+promote-on-loss (real `FleetMember.heartbeat_once` promote hook +
+`WatchedStore`/`WatchHub`, with the StandbyReplicator apply contract as
+an in-model twin — replication.py, docs/durability.md §promote):
+  R1  no revision acknowledged at-or-below the replicated horizon at
+      promote time is lost: the promoted store's record is at least as
+      new as the last ack the horizon covers (a replicator that skips
+      an event while advancing its horizon is the seeded lie).
+  R2  at most one promoted lineage: across every kill placement and
+      standby race, the set of members that promote a resource never
+      exceeds one — the takeover steal's single-winner epoch is the
+      fence (a member that promotes after LOSING the steal is the
+      seeded break).
 """
 
 from __future__ import annotations
@@ -65,7 +78,7 @@ import hashlib
 import json
 from typing import Callable, Optional
 
-from gpu_docker_api_tpu import federation
+from gpu_docker_api_tpu import federation, replication
 from gpu_docker_api_tpu.server import workers
 from gpu_docker_api_tpu.store.mvcc import MVCCStore
 
@@ -864,6 +877,242 @@ class FedWatchModel(Model):
             raise self.violation("fedwatch run exceeded its step budget")
 
 
+# --------------------------------------------------------- promote-on-loss
+
+#: the one acked-write key the promote model replicates and promotes
+PROMOTE_RESOURCE = ("containers", "c0")
+
+
+class ReplicaTwin:
+    """The StandbyReplicator's apply contract over the in-model hub:
+    drain watch events in revision order into a replica store at the
+    peer's EXACT revisions (put_at/delete_at), horizon = highest drained
+    revision. The HTTP transport the real replicator rides is
+    integration-tested (tests/test_durability.py); what the model checks
+    is the contract the promote path leans on: the replica is a prefix
+    of the watchable history through `horizon`."""
+
+    def __init__(self, hub: federation.WatchHub, replica: MVCCStore):
+        self.hub = hub
+        self.replica = replica
+        self.horizon = 0
+
+    def apply_filter(self, evts: list[dict]) -> list[dict]:
+        return evts
+
+    def drain(self) -> bool:
+        evts = self.hub.events_since(self.horizon)
+        for e in self.apply_filter(evts):
+            key = replication.resource_key(e["resource"], e["name"])
+            if e["type"] == "delete":
+                self.replica.delete_at(key, e["revision"])
+            else:
+                self.replica.put_at(key, e["value"], e["revision"])
+        if evts:
+            self.horizon = max(self.horizon, evts[-1]["revision"])
+        return bool(evts)
+
+
+class BrokenReplicaSkip(ReplicaTwin):
+    """Seeded mutant for R1: drops one event but still advances the
+    horizon past it — the replicated-horizon promise is a lie by one
+    revision, and a promote at that horizon loses an acked write."""
+
+    def __init__(self, hub, replica):
+        super().__init__(hub, replica)
+        self._dropped = False
+
+    def apply_filter(self, evts):
+        if evts and not self._dropped:
+            self._dropped = True
+            return evts[1:]     # BUG: horizon still reaches evts[-1]
+        return evts
+
+
+class BrokenPromoteMember(federation.FleetMember):
+    """Seeded mutant for R2: the takeover sweep promotes even when the
+    arbiter refused the steal (and skips the ring check so two standbys
+    both try) — the single-winner acquire IS the fence this discards,
+    so two members install two lineages of the dead daemon's records."""
+
+    def heartbeat_once(self) -> dict:
+        try:
+            out = self.arbiter.renew(self.member_id)
+        except federation.LeaseError as e:
+            if e.reason != "no-lease":
+                raise
+            self.fence()
+            out = self.join()
+        live = set(out["members"])
+        grants = self.arbiter.grants()
+        self.owned = {(g["resource"], g["name"]) for g in grants
+                      if g["holder"] == self.member_id}
+        adopted = []
+        for g in grants:
+            rid = (g["resource"], g["name"])
+            if g["holder"] in live or rid in self.owned:
+                continue
+            try:
+                self.arbiter.acquire(g["resource"], g["name"],
+                                     self.member_id)
+            except federation.LeaseError:
+                pass    # BUG: lost the steal race — promote anyway
+            self.crash_seam("fed.after_takeover")
+            self.owned.add(rid)
+            adopted.append(f"{g['resource']}/{g['name']}")
+            if self.promote is not None:
+                self.promote(g["resource"], g["name"])
+                self.crash_seam("fed.after_promote")
+        return {"adopted": adopted}
+
+
+class PromoteModel(Model):
+    """Promote-on-loss over the REAL protocol pieces: a killable primary
+    (FleetMember seat + WatchedStore feeding a WatchHub) writes acked
+    revisions to its granted resource; a replica twin drains the hub in
+    order (the StandbyReplicator apply contract); two standbys — real
+    FleetMembers with the production promote hook shape — wait out the
+    primary, expire its lease, and race heartbeat_once to steal the
+    orphan grant and install the replica's record behind the steal's
+    fencing epoch. The injected SIGKILL enumerates every yield point of
+    the primary, crash seams included.
+
+    R1  no acked revision at-or-below the horizon-at-promote is lost:
+        the promoted store's record is at least as new as the last ack
+        the horizon covers.
+    R2  at most one promoted lineage: the promoters set never exceeds
+        one member (the arbiter's single-winner steal is the fence).
+    """
+
+    name = "promote"
+
+    TTL = 10.0
+    ACKS = ("v1", "v2", "v3")
+
+    def __init__(self, sched: Scheduler,
+                 replica_cls: type = ReplicaTwin,
+                 member_cls: type = federation.FleetMember):
+        super().__init__(sched)
+        self.now = 0.0
+        self.astore = MVCCStore()       # the arbiter's table (survives)
+        self.arbiter = federation.FleetArbiter(self.astore, ttl=self.TTL,
+                                               clock=lambda: self.now)
+        self.hub = federation.WatchHub(capacity=64)
+        self.pstore = federation.WatchedStore(MVCCStore(), self.hub)
+        self.repl = replica_cls(self.hub, MVCCStore())
+        self.acked: list[tuple[int, str]] = []
+        self.promotes: list[tuple[str, str, str, int]] = []
+        self._expired = False
+        seam = lambda tag: sched.yield_point(("seam", tag))  # noqa: E731
+        self.primary = federation.FleetMember("primary", self.arbiter,
+                                              crash_seam=seam)
+        self.stores: dict[str, MVCCStore] = {}
+        self.standbys: dict[str, federation.FleetMember] = {}
+        for m in ("s0", "s1"):
+            self.stores[m] = MVCCStore()
+            self.standbys[m] = member_cls(
+                m, self.arbiter, promote=self._promote_hook(m),
+                crash_seam=seam)
+        sched.spawn("primary", self._primary)
+        sched.spawn("repl", self._replicator, killable=False)
+        for m in ("s0", "s1"):
+            sched.spawn(m, self._standby_fn(m), killable=False)
+
+    def _promote_hook(self, m: str) -> Callable[[str, str], None]:
+        def hook(resource: str, name: str) -> None:
+            # mirror of App._fleet_promote: install the replica's copy
+            # only when the local store lacks the key (idempotent —
+            # a crash between promote and adopt re-runs it harmlessly)
+            self.promotes.append((m, resource, name, self.repl.horizon))
+            key = replication.resource_key(resource, name)
+            kv = self.repl.replica.get(key)
+            if kv is not None and self.stores[m].get(key) is None:
+                self.stores[m].put(key, kv.value)
+        return hook
+
+    def _primary(self) -> None:
+        self.primary.join()
+        self.sched.yield_point(("joined", 0))
+        try:
+            self.primary.ensure_owned(*PROMOTE_RESOURCE)
+        except federation.LeaseError:
+            return      # not ours on this ring — nothing to write
+        key = replication.resource_key(*PROMOTE_RESOURCE)
+        for i, v in enumerate(self.ACKS):
+            rev = self.pstore.put(key, v)
+            # the put returned: the write is acked to the client AND in
+            # the hub (WatchedStore feeds it under the same lock) — a
+            # kill can land after this step, never between the two
+            self.acked.append((rev, v))
+            self.sched.yield_point(("ack", i))
+
+    def _replicator(self) -> None:
+        procs = self.sched.procs
+        while True:
+            progressed = self.repl.drain()
+            if not progressed and (procs["primary"].done
+                                   or procs["primary"].killed):
+                if not self.repl.drain():   # settled: one final sweep
+                    return
+            self.sched.yield_point(("drain", 0))
+
+    def _standby_fn(self, m: str) -> Callable[[], None]:
+        member = self.standbys[m]
+
+        def fn() -> None:
+            procs = self.sched.procs
+            while not (procs["primary"].done or procs["primary"].killed):
+                self.sched.yield_point(("standby-wait", 0))
+            if not procs["primary"].killed:
+                return      # clean exit: nothing to take over
+            # the replica settles first: promote's promise is relative
+            # to the horizon at promote time whatever it is, but the
+            # acceptance scenario is the drained standby
+            while not procs["repl"].done:
+                self.sched.yield_point(("repl-wait", 0))
+            if not self._expired:
+                self._expired = True
+                self.now += self.TTL + 1.0
+            member.join()
+            self.sched.yield_point(("sjoined", 0))
+            # two beats, same convergence bound as the lease model: the
+            # first may spend its pass rejoining, the second must settle
+            for _ in range(2):
+                member.heartbeat_once()
+                self.sched.yield_point(("sbeat", 0))
+        return fn
+
+    # ---- invariants ------------------------------------------------------
+
+    @staticmethod
+    def _idx(value: str) -> int:
+        return int(value[1:])       # "v3" -> 3
+
+    def finish(self, result: RunResult) -> None:
+        promoters = {m for (m, _, _, _) in self.promotes}
+        if len(promoters) > 1:
+            raise self.violation(
+                f"R2 double promote: {sorted(promoters)} each installed "
+                f"a lineage of {'/'.join(PROMOTE_RESOURCE)} — the steal "
+                f"fence admitted two winners")
+        for m, resource, name, horizon in self.promotes:
+            covered = [v for (rev, v) in self.acked if rev <= horizon]
+            if not covered:
+                continue
+            key = replication.resource_key(resource, name)
+            got = self.stores[m].get(key)
+            if got is None or self._idx(got.value) < self._idx(covered[-1]):
+                raise self.violation(
+                    f"R1 acked revision lost: horizon at promote was "
+                    f"{horizon}, which covers ack {covered[-1]!r}, but "
+                    f"{m}'s promoted store has "
+                    f"{got.value if got else None!r} for {key}")
+
+    def check(self, result: RunResult) -> None:
+        if result.wedged:
+            raise self.violation("promote run exceeded its step budget")
+
+
 # ---------------------------------------------------------------- sweeps
 
 def _annotating(variant: str, run_once):
@@ -1010,8 +1259,39 @@ def sweep_fedwatch(mode: str = "exhaustive", max_schedules: int = 4000,
     return _seal(stats)
 
 
+def sweep_promote(mode: str = "exhaustive", max_schedules: int = 4000,
+                  seed: int = 0, preemptions: int = 2,
+                  replica_cls: type = ReplicaTwin,
+                  member_cls: type = federation.FleetMember) -> dict:
+    """Two passes, same shape as lease: the no-kill pass explores
+    writer/replicator interleavings (no takeover fires — the clean-exit
+    baseline); the kill pass injects one primary SIGKILL at every yield
+    point — acks, crash seams, and the replicator's drain windows are
+    the enumerated disturbance — and the standbys' takeover + promote
+    must satisfy R1/R2 on every placement."""
+    stats = _new_stats("promote")
+
+    def no_kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: PromoteModel(s, replica_cls=replica_cls,
+                                                member_cls=member_cls),
+                         strategy, preemptions=preemptions, kills=0)
+
+    def kill(strategy: Strategy) -> RunResult:
+        return run_model(lambda s: PromoteModel(s, replica_cls=replica_cls,
+                                                member_cls=member_cls),
+                         strategy, preemptions=0, kills=1)
+
+    for run_once in (_annotating("no-kill", no_kill),
+                     _annotating("kill", kill)):
+        for res in explore(run_once, mode=mode,
+                           max_schedules=max_schedules, seed=seed):
+            _tally(stats, res)
+    return _seal(stats)
+
+
 SWEEPS = {"seqlock": sweep_seqlock, "claim": sweep_claim, "wal": sweep_wal,
-          "lease": sweep_lease, "fedwatch": sweep_fedwatch}
+          "lease": sweep_lease, "fedwatch": sweep_fedwatch,
+          "promote": sweep_promote}
 
 MUTANTS = {
     "seqlock": lambda **kw: sweep_seqlock(state_cls=BrokenSeqlockState,
@@ -1019,9 +1299,12 @@ MUTANTS = {
     "claim": lambda **kw: sweep_claim(router_cls=BrokenClaimRouter, **kw),
     "wal": lambda **kw: sweep_wal(twin_cls=BrokenWalTwin, **kw),
     # the CLI gate proves one mutant per model; the L2 (NoExpiry) and
-    # drop-direction watch mutants are proven in tests/test_federation.py
+    # drop-direction watch mutants are proven in tests/test_federation.py,
+    # the R2 (BrokenPromoteMember) mutant in tests/test_durability.py
     "lease": lambda **kw: sweep_lease(arbiter_cls=BrokenFleetArbiter,
                                       **kw),
     "fedwatch": lambda **kw: sweep_fedwatch(hub_cls=BrokenWatchHubDup,
                                             **kw),
+    "promote": lambda **kw: sweep_promote(replica_cls=BrokenReplicaSkip,
+                                          **kw),
 }
