@@ -60,7 +60,7 @@ def _newest_source_mtime() -> float:
 #: changes incompatibly.
 _ABI_CANARY = {"mvccstore": "mvcc_put_at",
                "topoalloc": "topo_find_box",
-               "shmatomics": "shm_hist_observe"}
+               "shmatomics": "shm_cells_publish"}
 
 
 def load(name: str) -> Optional[ctypes.CDLL]:
@@ -210,3 +210,10 @@ def _declare(name: str, lib: ctypes.CDLL) -> None:
         lib.shm_futex_wait.argtypes = [c.c_void_p, c.c_uint32, c.c_int64]
         lib.shm_futex_wake.restype = c.c_int
         lib.shm_futex_wake.argtypes = [c.c_void_p, c.c_int]
+        # KV-affinity sketch cells (PR 18): mini-seqlock group publish/read
+        lib.shm_cells_publish.restype = c.c_int
+        lib.shm_cells_publish.argtypes = [c.c_void_p, c.c_void_p,
+                                          c.POINTER(c.c_int64), c.c_int64]
+        lib.shm_cells_read.restype = c.c_int
+        lib.shm_cells_read.argtypes = [c.c_void_p, c.c_void_p,
+                                       c.POINTER(c.c_int64), c.c_int64]

@@ -128,6 +128,13 @@ CRASHPOINTS: dict[str, str] = {
     # delete_at idempotency)
     "repl.after_snapshot": "replica checkpointed + horizon persisted, "
                            "replicator died before resuming the tail",
+    # disaggregated KV handoff (gateway.py _forward_disagg): prefill ran
+    # and the prompt KV sits exported under its key on the prefill
+    # replica — the gateway dies before the decode claim. The export's
+    # TTL purge frees the blocks (zero leaked KV), and the prefill claim
+    # must be released by the forward's own unwind (no stuck slot)
+    "kvhandoff.after_prefill": "prefill done + prompt KV exported, decode "
+                               "phase never dispatched",
 }
 
 _lock = threading.Lock()

@@ -43,16 +43,18 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import re
 import socket
 import threading
 import time
+import uuid
 
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from . import xerrors
+from . import faults, kvaffinity, xerrors
 from .dtos import ContainerRun
 from .intents import KIND_GATEWAY
 from .obs import metrics as obs_metrics
@@ -111,6 +113,13 @@ class GatewayConfig:
     readiness: str = "http"      # "http" (poll /healthz) | "running" (inspect)
     readyTimeoutS: float = 30.0  # starting -> failed after this
     cooldownS: float = 1.0       # min gap between scale decisions
+    # "shared": every replica serves whole requests. "disaggregated":
+    # replicas split by idx parity into a prefill pool (even) and a
+    # decode pool (odd); long-prompt requests prefill on one pool, the
+    # prompt KV hands off via the replica's /kv export, and decode runs
+    # on the other — parity (not a stored role field) so adopt-by-name
+    # recovers each replica's pool from its name alone after a crash
+    poolPolicy: str = "shared"
 
     def to_json(self) -> dict:
         return {
@@ -126,6 +135,7 @@ class GatewayConfig:
             "readiness": self.readiness,
             "readyTimeoutS": self.readyTimeoutS,
             "cooldownS": self.cooldownS,
+            "poolPolicy": self.poolPolicy,
         }
 
     @classmethod
@@ -157,6 +167,9 @@ class GatewayConfig:
             raise ValueError("maxQueue must be >= 1")
         if self.readiness not in ("http", "running"):
             raise ValueError("readiness must be 'http' or 'running'")
+        if self.poolPolicy not in ("shared", "disaggregated"):
+            raise ValueError(
+                "poolPolicy must be 'shared' or 'disaggregated'")
 
 
 class Replica:
@@ -175,6 +188,20 @@ class Replica:
         self.failures = 0
         self.started_at = 0.0         # scale trigger time (ready latency)
         self.ready_at = 0.0
+        # KV affinity state, refreshed from the replica's response
+        # headers: its advertised prefix Bloom sketch + cached-block
+        # occupancy (kvaffinity module); last_hit is the sketch hit the
+        # most recent scored pick credited to this replica
+        self.kv_occ = 0
+        self.kv_sketch: Optional[list] = None
+        self.last_hit = 0
+
+    @property
+    def role(self) -> str:
+        """Pool under poolPolicy=disaggregated, derived from idx PARITY
+        (even=prefill, odd=decode) so a crash-rebuilt roster (adopt-by-
+        name) recovers pool membership with no stored role state."""
+        return "prefill" if self.idx % 2 == 0 else "decode"
 
     def describe(self) -> dict:
         return {
@@ -182,6 +209,7 @@ class Replica:
             "hostPort": self.host_port, "state": self.state,
             "slots": self.slots, "inflight": self.inflight,
             "chips": list(self.chips), "failures": self.failures,
+            "role": self.role, "kvOcc": self.kv_occ,
         }
 
 
@@ -254,6 +282,17 @@ class Gateway:
         self.shed_total = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        # KV-aware routing (PR 18): prefix-affinity scoring on by
+        # default (TDAPI_GW_AFFINITY=0 restores pure least-queued — the
+        # paired bench's baseline arm), prompt-length bar for the
+        # disaggregated prefill/decode split, and its counters
+        self._affinity = os.environ.get("TDAPI_GW_AFFINITY", "1") != "0"
+        self._disagg_prompt = int(os.environ.get(
+            "TDAPI_GW_DISAGG_PROMPT", "64"))
+        self.affinity_hits = 0
+        self.affinity_tokens = 0
+        self.kv_handoffs = 0
+        self._affinity_event_at = 0.0  # router.affinity_hit rate limit
         self.last_scale_ready_ms: Optional[float] = None
         # trigger->READY latencies, newest last (bench/status: the event
         # ring under load evicts faster than a run can read it back)
@@ -312,9 +351,18 @@ class Gateway:
             self._record("gateway.wake")
 
     def _call(self, port: int, method: str, path: str, body: bytes,
-              timeout: float) -> tuple[int, bytes]:
+              timeout: float, headers: Optional[dict] = None,
+              meta: Optional[dict] = None) -> tuple[int, bytes]:
+        """`headers` adds outbound headers (the disaggregation handoff's
+        X-TDAPI-Phase / X-TDAPI-KV-*); `meta`, when a dict, is populated
+        with the response's X-TDAPI-* headers (lowercased keys). Injected
+        transports keep the plain 5-arg contract — they may return an
+        optional third element (a dict) that lands in `meta`."""
         if self._transport is not None:
-            return self._transport(port, method, path, body, timeout)
+            out = self._transport(port, method, path, body, timeout)
+            if meta is not None and len(out) > 2 and out[2]:
+                meta.update(out[2])
+            return out[0], out[1]
         # pooled keep-alive connection per (handler thread, replica port):
         # the forward path must not pay TCP handshake + slow start per
         # request (the router-overhead criterion prices exactly this)
@@ -338,10 +386,17 @@ class Gateway:
                 conn.timeout = timeout
                 if conn.sock is not None:
                     conn.sock.settimeout(timeout)
-            conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"})
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
-            return resp.status, resp.read()
+            payload = resp.read()
+            if meta is not None:
+                for k, v in resp.getheaders():
+                    if k.lower().startswith("x-tdapi-"):
+                        meta[k.lower()] = v
+            return resp.status, payload
         except Exception:
             # never reuse a connection in an unknown state
             pool.pop(port, None)
@@ -362,6 +417,37 @@ class Gateway:
         return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
 
     # ------------------------------------------------------- the router
+
+    @staticmethod
+    def _prompt_tokens(body: bytes) -> Optional[list]:
+        """The request's (flat) prompt token list, or None when the body
+        has no parseable tokens — affinity hashing and the disaggregation
+        length bar both read it; a malformed body returns None here and
+        fails with the replica's own 400 later."""
+        try:
+            tokens = json.loads(body).get("tokens")
+        except (ValueError, AttributeError):
+            return None
+        if (isinstance(tokens, list) and tokens
+                and isinstance(tokens[0], list)):
+            tokens = tokens[0]                # [batch, len] request shape
+        return tokens if isinstance(tokens, list) else None
+
+    def _note_replica_kv(self, r: Replica, meta: dict) -> None:
+        """Fold a response's advertised prefix sketch + KV occupancy
+        (X-TDAPI-KV-Sketch / X-TDAPI-KV-Occ) into the replica handle —
+        the in-process twin of the worker tier's shm kv cells."""
+        words = kvaffinity.decode_sketch_hex(
+            meta.get("x-tdapi-kv-sketch") or "")
+        if words is None:
+            return
+        try:
+            occ = int(meta.get("x-tdapi-kv-occ") or 0)
+        except ValueError:
+            occ = 0
+        with self._cond:
+            r.kv_sketch = words
+            r.kv_occ = occ
 
     def forward(self, body: bytes, stream: bool = False,
                 priority: str = ""):
@@ -393,9 +479,35 @@ class Gateway:
         if wake:
             self._record("gateway.wake")
         high = priority in ("high", "latency")
+        tokens = hashes = None
+        if self._affinity or self.cfg.poolPolicy == "disaggregated":
+            tokens = self._prompt_tokens(body)
+        if self._affinity and tokens:
+            try:
+                hashes = kvaffinity.chunk_hashes(tokens) or None
+            except (TypeError, ValueError):
+                hashes = None
+        if (self.cfg.poolPolicy == "disaggregated" and not stream
+                and tokens is not None
+                and len(tokens) >= self._disagg_prompt):
+            out = self._forward_disagg(body, tokens, hashes, deadline,
+                                       t0, high)
+            if out is not None:
+                return out
+            # fall through: pools not split yet, prefill failed, or the
+            # request is unsuitable — the shared path serves it whole
         while True:
-            r = self._claim(deadline, high=high)
+            r = self._claim(deadline, high=high, hashes=hashes)
+            if r.last_hit > 0:
+                now = time.monotonic()
+                if now - self._affinity_event_at > 5.0:
+                    # rate-limited: one ring entry per burst, not per
+                    # request — counters carry the totals
+                    self._affinity_event_at = now
+                    self._record("router.affinity_hit", replica=r.name,
+                                 hitTokens=r.last_hit)
             left = deadline - time.monotonic()
+            meta: dict = {}
             try:
                 if stream and self._transport is None:
                     resp = self._request_stream(r.host_port, body,
@@ -406,7 +518,7 @@ class Gateway:
                     return resp.status, self._relay(r, resp, t0)
                 status, payload = self._call(
                     r.host_port, "POST", "/generate", body,
-                    timeout=max(left, 0.05))
+                    timeout=max(left, 0.05), meta=meta)
             except Exception as e:  # noqa: BLE001 — replica gone/slow
                 self._release(r, error=True)
                 if time.monotonic() >= deadline:
@@ -414,6 +526,8 @@ class Gateway:
                         f"{self.cfg.name}: replicas unreachable "
                         f"({type(e).__name__})")
                 continue                     # another replica, same FIFO
+            if meta:
+                self._note_replica_kv(r, meta)
             ms = (time.monotonic() - t0) * 1e3
             self._release(r, latency_ms=ms)
             obs_metrics.GATEWAY_LATENCY.observe(ms, gateway=self.cfg.name)
@@ -422,6 +536,94 @@ class Gateway:
                 # by contract: relay the whole payload as one chunk
                 return status, iter((payload,))
             return status, payload
+
+    def _forward_disagg(self, body: bytes, tokens: list,
+                        hashes: Optional[list], deadline: float,
+                        t0: float, high: bool):
+        """Prefill/decode disaggregation: run the prompt phase on the
+        prefill pool (max_new forced to 1 by the X-TDAPI-Phase header;
+        the replica exports the prompt KV under this request's key),
+        then decode on the decode pool, which pulls the exported KV from
+        the prefill replica (X-TDAPI-KV-Source) and continues without
+        re-prefilling. The decode response — prompt, first token, and
+        the remaining tokens — is byte-compatible with a single-shot
+        response, so the client sees one ordinary reply. Returns None to
+        fall back to the shared path (pools not split, short budget,
+        prefill trouble): the handoff is a throughput fast path, never a
+        correctness dependency. Claims release on ALL exits, including
+        an injected crash between the phases (BaseException-safe) — the
+        orphaned export is then freed by the replica's TTL purge, which
+        is the zero-leaked-KV invariant the crash sweep pins."""
+        try:
+            data = json.loads(body)
+            max_new = int(data.get("max_new", 16))
+        except (ValueError, TypeError):
+            return None
+        if max_new < 2:
+            return None          # nothing left to decode after handoff
+        with self._cond:
+            roles = {r.role for r in self.replicas.values()
+                     if r.state is READY}
+        if roles != {"prefill", "decode"}:
+            return None
+        key = uuid.uuid4().hex
+        pre = self._claim(deadline, high=high, hashes=hashes,
+                          pool="prefill")
+        dec = None
+        lat = None
+        try:
+            try:
+                meta: dict = {}
+                status, payload = self._call(
+                    pre.host_port, "POST", "/generate", body,
+                    timeout=max(deadline - time.monotonic(), 0.05),
+                    headers={"X-TDAPI-Phase": "prefill",
+                             "X-TDAPI-KV-Key": key}, meta=meta)
+                if status != 200:
+                    return None
+                row = json.loads(payload)["data"]["tokens"][0]
+                # replica rows carry prompt + generated tokens; the
+                # prefill phase generated exactly one
+                if len(row) != len(tokens) + 1:
+                    return None
+                if meta:
+                    self._note_replica_kv(pre, meta)
+                faults.crashpoint("kvhandoff.after_prefill")
+                dec = self._claim(deadline, high=high, pool="decode")
+                data2 = dict(data)
+                data2["tokens"] = [row]
+                data2["max_new"] = max_new - 1
+                meta2: dict = {}
+                status2, payload2 = self._call(
+                    dec.host_port, "POST", "/generate",
+                    json.dumps(data2).encode(),
+                    timeout=max(deadline - time.monotonic(), 0.05),
+                    headers={"X-TDAPI-KV-Key": key,
+                             "X-TDAPI-KV-Source":
+                                 f"127.0.0.1:{pre.host_port}"},
+                    meta=meta2)
+                if status2 != 200:
+                    return None
+                if meta2:
+                    self._note_replica_kv(dec, meta2)
+            except (xerrors.GatewayShedError,
+                    xerrors.GatewayDeadlineError):
+                raise            # admission verdicts stand as-is
+            # tdlint: disable=silent-swallow -- handoff is a fast path only: any failure (replica gone, bad row, fetch miss) falls back to the shared full-prefill path, which sheds or raises with the full budget
+            except Exception:
+                return None
+            lat = (time.monotonic() - t0) * 1e3
+            obs_metrics.GATEWAY_LATENCY.observe(lat,
+                                                gateway=self.cfg.name)
+            with self._cond:
+                self.kv_handoffs += 1
+            self._record("gateway.kv_handoff", prefill=pre.name,
+                         decode=dec.name, promptTokens=len(tokens))
+            return status2, payload2
+        finally:
+            self._release(pre)
+            if dec is not None:
+                self._release(dec, latency_ms=lat)
 
     def _request_stream(self, port: int, body: bytes, timeout: float):
         """Issue the replica request on this thread's pooled connection
@@ -484,10 +686,14 @@ class Gateway:
             obs_metrics.GATEWAY_LATENCY.observe(ms,
                                                 gateway=self.cfg.name)
 
-    def _claim(self, deadline: float, high: bool = False) -> Replica:
+    def _claim(self, deadline: float, high: bool = False,
+               hashes: Optional[list] = None,
+               pool: Optional[str] = None) -> Replica:
         """Block until a ready replica has slot capacity (strict-priority
         FIFO: the high line drains first, each line FIFO within itself);
-        shed on queue bound or deadline."""
+        shed on queue bound or deadline. `hashes`/`pool` steer the pick
+        (prefix affinity, disaggregation pool) without changing the
+        admission contract."""
         with self._cond:
             # fast path: nobody this request would have to queue behind
             # and a slot is free — claim without a ticket (a ticket would
@@ -496,7 +702,7 @@ class Gateway:
             # requests only need the HIGH line empty: barging the
             # best-effort line is the priority contract.
             if not self._fifo_hi and (high or not self._fifo):
-                r = self._pick()
+                r = self._pick(hashes, pool)
                 if r is not None:
                     r.inflight += 1
                     return r
@@ -514,7 +720,7 @@ class Gateway:
                     at_head = mine[0] is ticket and (
                         high or not self._fifo_hi)
                     if at_head:
-                        r = self._pick()
+                        r = self._pick(hashes, pool)
                         if r is not None:
                             r.inflight += 1
                             return r
@@ -541,16 +747,36 @@ class Gateway:
                 self._queued -= 1
                 self._cond.notify_all()
 
-    def _pick(self) -> Optional[Replica]:
-        """Least-queued ready replica with a free batcher slot — the
+    def _pick(self, hashes: Optional[list] = None,
+              pool: Optional[str] = None) -> Optional[Replica]:
+        """Affinity-scored ready replica with a free batcher slot — the
         admit-on-slot-free invariant: gateway in-flight per replica never
-        exceeds the slot count the replica advertised."""
+        exceeds the slot count the replica advertised. Candidates order
+        by kvaffinity.score(sketch hit, inflight): with no hashes or no
+        sketches this is exactly least-queued (affinity refines the
+        order, never overrides a visibly shorter queue). `pool` filters
+        to one disaggregation pool by idx parity, degrading to the full
+        roster when that pool has no capacity (availability over
+        purity)."""
+        cands = [r for r in self.replicas.values()
+                 if r.state is READY and r.inflight < r.slots]
+        if pool is not None:
+            pooled = [r for r in cands if r.role == pool]
+            if pooled:
+                cands = pooled
         best = None
-        for r in self.replicas.values():
-            if r.state is not READY or r.inflight >= r.slots:
-                continue
-            if best is None or r.inflight < best.inflight:
-                best = r
+        best_score = best_hit = 0
+        for r in cands:
+            hit = (kvaffinity.hit_tokens(r.kv_sketch, hashes)
+                   if hashes else 0)
+            s = kvaffinity.score(hit, r.inflight)
+            if best is None or s < best_score:
+                best, best_score, best_hit = r, s, hit
+        if best is not None:
+            best.last_hit = best_hit
+            if best_hit > 0:
+                self.affinity_hits += 1        # under _cond (callers)
+                self.affinity_tokens += best_hit
         return best
 
     def _release(self, r: Replica, latency_ms: Optional[float] = None,
@@ -655,7 +881,14 @@ class Gateway:
                 and s["inflight"] == 0
                 and len(s["ready"]) > self.cfg.minReplicas
                 and (len(s["ready"]) > 1 or not s["starting"])):
-            victim = max(s["ready"], key=lambda r: r.idx)
+            pool = s["ready"]
+            if self.cfg.poolPolicy == "disaggregated" and len(pool) > 1:
+                # shrink the LARGER pool so an idle window never strips
+                # one phase bare while the other keeps spare replicas
+                n_pre = sum(1 for r in pool if r.idx % 2 == 0)
+                want = 0 if n_pre >= len(pool) - n_pre else 1
+                pool = [r for r in pool if r.idx % 2 == want] or pool
+            victim = max(pool, key=lambda r: r.idx)
             self._last_scale = now
             self.scale_down(victim.name, reason="idle")
 
@@ -726,13 +959,28 @@ class Gateway:
 
     # ------------------------------------------------- scale operations
 
-    def _next_idx(self) -> int:
+    def _next_idx(self, parity: Optional[int] = None) -> int:
+        """Smallest free replica idx; `parity` (0=prefill, 1=decode)
+        restricts to one disaggregation pool's idx stride."""
         with self._cond:
             used = {r.idx for r in self.replicas.values()}
-        i = 0
+        i = parity or 0
+        step = 1 if parity is None else 2
         while i in used:
-            i += 1
+            i += step
         return i
+
+    def _scale_parity(self) -> Optional[int]:
+        """Which pool the next scale-up should grow under the
+        disaggregated policy: the smaller live pool (ties go to
+        prefill). None under the shared policy."""
+        if self.cfg.poolPolicy != "disaggregated":
+            return None
+        with self._cond:
+            live = [r.idx for r in self.replicas.values()
+                    if r.state in (READY, STARTING)]
+        n_pre = sum(1 for i in live if i % 2 == 0)
+        return 0 if n_pre <= len(live) - n_pre else 1
 
     def _donor(self) -> tuple[str, set]:
         """(warm donor container or "", chips hosting live replicas —
@@ -755,9 +1003,15 @@ class Gateway:
         if self._wake_pending:
             trigger = min(trigger, self._wake_pending)
         with self._scale_mutex:
+            # pool-aware growth: under disaggregation each scale-up
+            # feeds the smaller pool, so the split stays balanced and
+            # both phases keep capacity as the fleet grows/shrinks
+            parity = self._scale_parity()
             with self._cond:
                 stopped = sorted((r for r in self.replicas.values()
-                                  if r.state in (STOPPED, FAILED)),
+                                  if r.state in (STOPPED, FAILED)
+                                  and (parity is None
+                                       or r.idx % 2 == parity)),
                                  key=lambda r: r.idx)
             donor, avoid = self._donor()
             with trace.root_span(self.traces, "gateway.scale_up",
@@ -765,8 +1019,8 @@ class Gateway:
                 if stopped:
                     out = self._readmit(stopped[0], reason)
                 else:
-                    out = self._spawn(self._next_idx(), donor, avoid,
-                                      reason)
+                    out = self._spawn(self._next_idx(parity), donor,
+                                      avoid, reason)
         with self._cond:
             self._wake_pending = 0.0
             self.scale_ups += 1
@@ -899,6 +1153,9 @@ class Gateway:
             "p99Ms": round(p99, 3) if p99 is not None else None,
             "requestsTotal": self.requests_total,
             "shedTotal": self.shed_total,
+            "affinityHits": self.affinity_hits,
+            "affinityTokens": self.affinity_tokens,
+            "kvHandoffs": self.kv_handoffs,
             "scaleUps": self.scale_ups,
             "scaleDowns": self.scale_downs,
             "lastScaleReadyMs": (round(self.last_scale_ready_ms, 3)

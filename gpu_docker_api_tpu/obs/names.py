@@ -60,6 +60,11 @@ EVENT_OPS = frozenset({
     "gateway.replica_down",
     "gateway.shed",
     "gateway.wake",
+    # KV-aware serving data plane (PR 18): one event per disaggregated
+    # prefill->decode handoff; rate-limited note that the affinity
+    # scorer steered a request onto a prefix-warm replica
+    "gateway.kv_handoff",
+    "router.affinity_hit",
     # multi-process data-plane worker tier (server/workers.py)
     "gateway.worker_respawn",
     # watchdog-reaped dead worker: flight-recorder segment + claim-
@@ -162,6 +167,13 @@ METRIC_NAMES = frozenset({
     "tdapi_gateway_requests_total",
     "tdapi_gateway_shed_total",
     "tdapi_gateway_scale_events_total",
+    # KV-aware routing (PR 18): affinity pick totals (in-process router
+    # + worker-tier shm counters, summed at scrape), replica prefix-
+    # cache occupancy, and disaggregated handoffs completed
+    "tdapi_gw_affinity_hits_total",
+    "tdapi_gw_affinity_tokens_total",
+    "tdapi_kv_prefix_blocks",
+    "tdapi_kv_prefix_handoffs_total",
     # cross-process telemetry plane: shared-memory metric shards of the
     # multi-process worker tier (obs/shm_metrics.py, summed at scrape by
     # the server/app.py collect callback). Declared in BOTH serving

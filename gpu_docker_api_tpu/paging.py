@@ -331,3 +331,38 @@ class BlockAllocator:
             self._rc[b] -= 1
             if self._rc[b] == 0:
                 self._free.append(b)
+
+
+def paged_extract_blocks(cache, block_ids) -> dict:
+    """Host copies of the pool blocks backing a KV handoff export
+    (prefill/decode disaggregation, workloads/serve.py): one gather per
+    KV buffer, device_get'd into numpy. bf16 pools convert to float32 —
+    lossless for every bf16 value — so the wire format never depends on
+    ml_dtypes being importable on the decode side; int8 (quantized)
+    pools ship exact."""
+    import numpy as np
+    idx = jnp.asarray(block_ids, jnp.int32)
+    out = {}
+    for name in _buf_keys(cache):
+        arr = np.asarray(jax.device_get(cache[name][:, idx]))
+        if arr.dtype not in (np.dtype(np.int8), np.dtype(np.float32)):
+            arr = arr.astype(np.float32)
+        out[name] = arr
+    return out
+
+
+def paged_inject_blocks(cache, block_ids, bufs) -> dict:
+    """Inverse of paged_extract_blocks: scatter fetched KV into this
+    slot's (private, freshly-allocated) pool blocks. Returns the new
+    cache dict; raises on geometry mismatch — the caller treats that as
+    'no import' and prefills from scratch."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    new = dict(cache)
+    for name in _buf_keys(cache):
+        buf = bufs[name]
+        if tuple(buf.shape) != (cache[name].shape[0], len(block_ids),
+                                *cache[name].shape[2:]):
+            raise ValueError(f"kv import buffer {name} shape mismatch")
+        new[name] = cache[name].at[:, idx].set(
+            jnp.asarray(buf, cache[name].dtype))
+    return new

@@ -1465,6 +1465,26 @@ class App:
         g_gw_scale = m.gauge("tdapi_gateway_scale_events_total",
                              labels=("gateway", "direction"),
                              typ="counter")
+        # KV-aware routing (PR 18): affinity picks by the in-process
+        # router + every worker process (same family-parity contract as
+        # the request counters), replica prefix-cache occupancy, and
+        # disaggregated prefill->decode handoffs completed
+        g_gw_aff = m.gauge("tdapi_gw_affinity_hits_total",
+                           "requests steered to a prefix-warm replica "
+                           "by the KV affinity scorer",
+                           labels=("gateway",), typ="counter")
+        g_gw_aff_tok = m.gauge(
+            "tdapi_gw_affinity_tokens_total",
+            "prompt tokens the affinity scorer predicted KV-resident on "
+            "the picked replica", labels=("gateway",), typ="counter")
+        g_kv_blocks = m.gauge("tdapi_kv_prefix_blocks",
+                              "cached prefix entries advertised per "
+                              "replica (X-TDAPI-KV-Occ)",
+                              labels=("gateway", "replica"))
+        g_kv_handoff = m.gauge(
+            "tdapi_kv_prefix_handoffs_total",
+            "disaggregated prefill->decode KV handoffs completed",
+            labels=("gateway",), typ="counter")
         # multi-process data-plane worker tier (server/workers.py +
         # obs/shm_metrics.py). Declared UNCONDITIONALLY: family presence
         # must not depend on TDAPI_GW_WORKERS, or dashboards built in one
@@ -1588,7 +1608,8 @@ class App:
                           g_repl_con):
                     g.set(0)
             for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
-                      g_gw_scale, g_wk_req, g_wk_shed, g_wk_dead,
+                      g_gw_scale, g_gw_aff, g_gw_aff_tok, g_kv_blocks,
+                      g_kv_handoff, g_wk_req, g_wk_shed, g_wk_dead,
                       g_wk_retry):
                 g.reset()
             # worker-tier counts fold into the SAME gateway families the
@@ -1618,6 +1639,16 @@ class App:
                                direction="up")
                 g_gw_scale.set(gw["scaleDowns"], gateway=name,
                                direction="down")
+                g_gw_aff.set(gw.get("affinityHits", 0)
+                             + wk.get("affinityHits", 0), gateway=name)
+                g_gw_aff_tok.set(gw.get("affinityTokens", 0)
+                                 + wk.get("affinityTokens", 0),
+                                 gateway=name)
+                g_kv_handoff.set(gw.get("kvHandoffs", 0), gateway=name)
+                for r in gw["replicas"]:
+                    if r.get("kvOcc"):
+                        g_kv_blocks.set(r["kvOcc"], gateway=name,
+                                        replica=r["name"])
             if tier_desc is not None:
                 g_wk_alive.set(tier_desc["alive"])
                 g_wk_respawn.set(tier_desc["respawns"])

@@ -44,6 +44,7 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import glob
+import json
 import logging
 import os
 import signal
@@ -56,6 +57,7 @@ from collections import deque
 from multiprocessing import get_context, shared_memory
 from typing import Callable, Optional
 
+from .. import kvaffinity
 from .._native import load
 from ..obs import shm_metrics
 from ..obs import trace
@@ -107,9 +109,15 @@ CONF_SZ = MAX_GATEWAYS * GW_CONF_SZ
 
 # counter region (atomics, NEVER seqlock-protected): per gateway
 #   gen | queued | relseq | requests_total | shed_total | wake_hint |
-#   per replica: inflight | errors
-GW_CNT_WORDS = 6
-REP_CNT_WORDS = 2
+#   affinity_hits_total | affinity_tokens_total |
+#   per replica: inflight | errors | kv_gen | kv_occ | sketch[KV_SKETCH]
+# The kv cells (gen + occ + sketch words) form a mini-seqlock group
+# (shm_cells_publish/read): workers fold each replica RESPONSE's
+# advertised prefix sketch in, and the claim path reads it for affinity
+# scoring — torn reads degrade to "no sketch", never retry.
+KV_SKETCH_WORDS = 4                    # = kvaffinity.SKETCH_WORDS
+GW_CNT_WORDS = 8
+REP_CNT_WORDS = 2 + 1 + 1 + KV_SKETCH_WORDS
 GW_CNT_SZ = 8 * (GW_CNT_WORDS + MAX_REPLICAS * REP_CNT_WORDS)
 CNT_OFF = CONF_OFF + CONF_SZ
 CNT_SZ = MAX_GATEWAYS * GW_CNT_SZ
@@ -133,6 +141,11 @@ def _gw_cnt_off(g: int) -> int:
 
 def _rep_cnt_off(g: int, r: int) -> int:
     return _gw_cnt_off(g) + 8 * (GW_CNT_WORDS + r * REP_CNT_WORDS)
+
+
+def _rep_kv_off(g: int, r: int) -> int:
+    """Replica's kv cell group: gen word, then occ + sketch words."""
+    return _rep_cnt_off(g, r) + 16
 
 
 def _wk_off(w: int) -> int:
@@ -297,9 +310,15 @@ class SharedRouterState:
                     self.store(_gw_cnt_off(g) + 24, 0)    # requests_total
                     self.store(_gw_cnt_off(g) + 32, 0)    # shed_total
                     self.store(_gw_cnt_off(g) + 40, 0)    # wake_hint
+                    self.store(_gw_cnt_off(g) + 48, 0)    # affinity_hits
+                    self.store(_gw_cnt_off(g) + 56, 0)    # affinity_tokens
                     for r in range(MAX_REPLICAS):
-                        self.store(_rep_cnt_off(g, r), 0)
-                        self.store(_rep_cnt_off(g, r) + 8, 0)
+                        # inflight, errors, AND the kv sketch group —
+                        # the new tenant must not inherit the old one's
+                        # prefix advertisement (mis-steered affinity)
+                        self.store(_rep_cnt_off(g, r), 0)   # inflight
+                        for word in range(1, REP_CNT_WORDS):
+                            self.store(_rep_cnt_off(g, r) + 8 * word, 0)
                     for w in range(MAX_WORKERS):
                         self.store(_wk_queued_off(w, g), 0)
                         for r in range(MAX_REPLICAS):
@@ -365,8 +384,39 @@ class SharedRouterState:
                 "requestsTotal": self.load(_gw_cnt_off(g) + 24),
                 "shedTotal": self.load(_gw_cnt_off(g) + 32),
                 "wakeHint": self.load(_gw_cnt_off(g) + 40),
+                "affinityHits": self.load(_gw_cnt_off(g) + 48),
+                "affinityTokens": self.load(_gw_cnt_off(g) + 56),
                 "inflight": [self.load(_rep_cnt_off(g, r))
                              for r in range(MAX_REPLICAS)]}
+
+    def publish_replica_kv(self, g: int, r: int, occ: int, words) -> None:
+        """Advertise one replica's prefix sketch + KV occupancy through
+        its mini-seqlock cell group. Concurrent writers (several workers
+        seeing responses from the same replica) race benignly: a losing
+        publish is dropped — the next response refreshes it."""
+        from .. import kvaffinity
+        vals = (ctypes.c_int64 * (1 + KV_SKETCH_WORDS))(
+            int(occ), *(kvaffinity.signed64(w) for w in words))
+        self.lib.shm_cells_publish(self.base + _rep_kv_off(g, r),
+                                   self.base + _rep_kv_off(g, r) + 8,
+                                   vals, 1 + KV_SKETCH_WORDS)
+
+    def read_replica_kv(self, g: int, r: int):
+        """(occupancy, sketch words) — None on a torn read or when the
+        replica has advertised nothing yet. One attempt, no retry: the
+        claim path treats None as 'no affinity signal' and the ordering
+        degenerates to least-queued, which is always safe."""
+        n = 1 + KV_SKETCH_WORDS
+        out = (ctypes.c_int64 * n)()
+        if self.lib.shm_cells_read(self.base + _rep_kv_off(g, r),
+                                   self.base + _rep_kv_off(g, r) + 8,
+                                   out, n):
+            return None
+        occ = out[0]
+        words = [w & 0xFFFFFFFFFFFFFFFF for w in out[1:]]
+        if occ <= 0 and not any(words):
+            return None
+        return occ, words
 
     def reconcile_worker(self, w: int) -> int:
         """Subtract a dead worker's held claims + queue tickets from the
@@ -460,6 +510,12 @@ class WorkerRouter:
         self._lines: dict[int, _LocalLine] = {}
         self._views: dict[int, object] = {}
         self._local = threading.local()
+        # KV prefix-affinity routing (PR 18): hash each prompt's chunk
+        # prefixes and steer toward replicas whose advertised sketch says
+        # the prefix is KV-resident. Purely an ordering refinement over
+        # least-queued (kvaffinity.score) — turning it off restores the
+        # exact prior pick, which is also what the paired bench compares.
+        self._affinity = os.environ.get("TDAPI_GW_AFFINITY", "1") != "0"
 
     def _view(self, g: int):
         """This worker's precomputed shard view for gateway slot `g`
@@ -506,23 +562,57 @@ class WorkerRouter:
 
     # ---- claim / release -------------------------------------------------
 
-    def _try_claim(self, gw: dict,
-                   avoid: frozenset = frozenset()) -> Optional[_Claim]:
-        """Least-queued atomic claim: order ready replicas by global
-        inflight, fetch_add the best, undo on overshoot. The claim cell
-        (this worker's ledger for crash reconcile) is incremented only
-        after the global claim stuck. `avoid` holds replicas that already
-        failed THIS request's forward — replica failure marking is
-        control-plane state the daemon owns, so the worker only steers
-        the current request away (identical outcome: a dead replica's
-        error never fails the request while a healthy one exists)."""
+    @staticmethod
+    def _prefix_hashes(body: bytes) -> Optional[list]:
+        """Chunk-prefix hashes of the request's prompt tokens, or None
+        when the body has no hashable prefix (short prompt, non-JSON, no
+        tokens). One parse per request, paid only with affinity on; a
+        malformed body returns None here and fails later where the
+        replica reports the real error."""
+        try:
+            tokens = json.loads(body).get("tokens")
+        except (ValueError, AttributeError):
+            return None
+        if (isinstance(tokens, list) and tokens
+                and isinstance(tokens[0], list)):
+            tokens = tokens[0]                # nested [batch, len] shape
+        if not isinstance(tokens, list):
+            return None
+        try:
+            return kvaffinity.chunk_hashes(tokens) or None
+        except (TypeError, ValueError):
+            return None
+
+    def _try_claim(self, gw: dict, avoid: frozenset = frozenset(),
+                   hashes=None) -> Optional[_Claim]:
+        """Affinity-scored atomic claim: order ready replicas by
+        kvaffinity.score(sketch hit, global inflight) — with no prompt
+        hashes or no advertised sketches the ordering degenerates to
+        exactly least-queued — then fetch_add the best, undo on
+        overshoot. Sketch reads come from this segment's per-replica kv
+        cells ONLY (zero daemon round-trips on the route path; a torn
+        read means hit=0, never a retry). The claim cell (this worker's
+        ledger for crash reconcile) is incremented only after the global
+        claim stuck. `avoid` holds replicas that already failed THIS
+        request's forward — replica failure marking is control-plane
+        state the daemon owns, so the worker only steers the current
+        request away (identical outcome: a dead replica's error never
+        fails the request while a healthy one exists)."""
         st = self.state
         g = gw["slot"]
-        ready = [(st.load(_rep_cnt_off(g, r["idx"])), r)
-                 for r in gw["replicas"]
-                 if r["ready"] and r["port"] and r["idx"] not in avoid]
+        ready = []
+        for r in gw["replicas"]:
+            if not r["ready"] or not r["port"] or r["idx"] in avoid:
+                continue
+            inflight = st.load(_rep_cnt_off(g, r["idx"]))
+            hit = 0
+            if hashes:
+                kv = st.read_replica_kv(g, r["idx"])
+                if kv is not None:
+                    hit = kvaffinity.hit_tokens(kv[1], hashes)
+            ready.append((kvaffinity.score(hit, inflight), hit, r))
         ready.sort(key=lambda t: t[0])
-        for _, r in ready:
+        for _, hit, r in ready:
             off = _rep_cnt_off(g, r["idx"])
             if st.add(off, 1) <= r["slots"]:
                 if st.load(_gw_cnt_off(g)) != gw["gen"]:
@@ -531,6 +621,9 @@ class WorkerRouter:
                     st.dec_floor0(off)
                     continue
                 st.add(_wk_claim_off(self.widx, g, r["idx"]), 1)
+                if hit > 0:
+                    st.add(_gw_cnt_off(g) + 48, 1)    # affinity_hits
+                    st.add(_gw_cnt_off(g) + 56, hit)  # affinity_tokens
                 return _Claim(g, r["idx"], gw["gen"], r["port"])
             st.dec_floor0(off)
         return None
@@ -545,7 +638,7 @@ class WorkerRouter:
         st.futex_wake_all(relseq)
 
     def _claim(self, name: str, gw: dict, deadline: float, high: bool,
-               avoid: frozenset = frozenset()) -> _Claim:
+               avoid: frozenset = frozenset(), hashes=None) -> _Claim:
         """Block until a slot claim succeeds; shed on queue bound or
         deadline — Gateway._claim's contract over shared state. Every
         successful claim lands its queue wait in this worker's metric
@@ -558,7 +651,7 @@ class WorkerRouter:
         line = self._line(g)
         with line.lock:
             if not line.hi and (high or not line.lo):
-                c = self._try_claim(gw, avoid)
+                c = self._try_claim(gw, avoid, hashes)
                 if c is not None:
                     if view is not None:
                         view.observe_queue_wait_zero()
@@ -584,7 +677,7 @@ class WorkerRouter:
                     at_head = mine and mine[0] is ticket and (
                         high or not line.hi)
                     if at_head:
-                        c = self._try_claim(gw, avoid)
+                        c = self._try_claim(gw, avoid, hashes)
                         if c is not None:
                             if view is not None:
                                 view.observe_queue_wait(
@@ -635,14 +728,19 @@ class WorkerRouter:
 
     def _call(self, port: int, body: bytes, timeout: float):
         """One replica generate call. Returns (status, payload,
-        queue_wait_ms) — the replica advertises its batcher queue wait
-        per response (X-TDAPI-Queue-Wait-Ms), which is how replica-side
-        time stitches into the worker's trace; None when absent (or on
-        the injected test transports, which return 2-tuples)."""
+        queue_wait_ms, kv) — the replica advertises its batcher queue
+        wait per response (X-TDAPI-Queue-Wait-Ms), which is how
+        replica-side time stitches into the worker's trace, and its
+        prefix-cache state (X-TDAPI-KV-Occ / X-TDAPI-KV-Sketch) which
+        this worker folds into the shm kv cells; either is None when
+        absent. Injected test transports return 2-tuples (both None),
+        or up to 4-tuples with kv as an (occ, sketch_words) pair."""
         if self._transport is not None:
             out = self._transport(port, "POST", "/generate", body, timeout)
             status, payload = out[0], out[1]
-            return status, payload, (out[2] if len(out) > 2 else None)
+            return (status, payload,
+                    out[2] if len(out) > 2 else None,
+                    out[3] if len(out) > 3 else None)
         import http.client
         pool = getattr(self._local, "conns", None)
         if pool is None:
@@ -669,7 +767,16 @@ class WorkerRouter:
                 qw = float(qw) if qw is not None else None
             except ValueError:
                 qw = None
-            return resp.status, payload, qw
+            kv = None
+            words = kvaffinity.decode_sketch_hex(
+                resp.getheader("X-TDAPI-KV-Sketch") or "")
+            if words is not None:
+                try:
+                    occ = int(resp.getheader("X-TDAPI-KV-Occ") or 0)
+                except ValueError:
+                    occ = 0
+                kv = (occ, words)
+            return resp.status, payload, qw, kv
         except Exception:
             pool.pop(port, None)
             if conn is not None:
@@ -706,15 +813,17 @@ class WorkerRouter:
             # (postmortem claimDelta) names any in-flight work, so the
             # always-on cost stays off the untraced hot path
             self._note("req", gw=name)
+        hashes = self._prefix_hashes(body) if self._affinity else None
         avoid: set = set()
         while True:
             if detailed:
                 with trace.span("gateway.admit", target=name):
                     c = self._claim(name, gw, deadline, high=high,
-                                    avoid=frozenset(avoid))
+                                    avoid=frozenset(avoid),
+                                    hashes=hashes)
             else:
                 c = self._claim(name, gw, deadline, high=high,
-                                avoid=frozenset(avoid))
+                                avoid=frozenset(avoid), hashes=hashes)
             left = deadline - time.monotonic()
             try:
                 with (trace.span("gateway.forward", target=name,
@@ -722,7 +831,7 @@ class WorkerRouter:
                       if detailed
                       else contextlib.nullcontext(
                           trace.current())) as fsp:
-                    status, payload, qwait = self._call(
+                    status, payload, qwait, kv = self._call(
                         c.port, body, timeout=max(left, 0.05))
                     if fsp is not None and qwait is not None:
                         # replica-side batcher queue wait, advertised on
@@ -753,6 +862,12 @@ class WorkerRouter:
                     avoid.clear()    # every replica failed once: retry all
                 continue
             self._release(c)
+            if kv is not None and st.load(_gw_cnt_off(c.gslot)) == c.gen:
+                # fold the replica's advertised prefix sketch into its
+                # shm kv cells so EVERY worker's next claim sees it —
+                # this is the only write path; the route path never asks
+                # the daemon (or the replica) anything
+                st.publish_replica_kv(c.gslot, c.rep, kv[0], kv[1])
             if view is not None:
                 view.observe_latency((time.monotonic() - t0) * 1e3)
             return status, payload
@@ -780,15 +895,17 @@ class WorkerRouter:
         detailed = self._detailed_trace()
         if detailed:
             self._note("req", gw=name, stream=True)
+        hashes = self._prefix_hashes(body) if self._affinity else None
         avoid: set = set()
         while True:
             if detailed:
                 with trace.span("gateway.admit", target=name):
                     c = self._claim(name, gw, deadline, high=high,
-                                    avoid=frozenset(avoid))
+                                    avoid=frozenset(avoid),
+                                    hashes=hashes)
             else:
                 c = self._claim(name, gw, deadline, high=high,
-                                avoid=frozenset(avoid))
+                                avoid=frozenset(avoid), hashes=hashes)
             left = max(deadline - time.monotonic(), 0.05)
             conn = http.client.HTTPConnection("127.0.0.1", c.port,
                                               timeout=left)
@@ -1237,6 +1354,8 @@ class WorkerTier:
                     "shedTotal": c["shedTotal"],
                     "queued": c["queued"],
                     "inflight": sum(c["inflight"]),
+                    "affinityHits": c["affinityHits"],
+                    "affinityTokens": c["affinityTokens"],
                 }
         return out
 

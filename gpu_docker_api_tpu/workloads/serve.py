@@ -186,6 +186,19 @@ class _Batcher:
         self.queue_wait_count = 0
         self.queue_wait_ms_total = 0.0
         self.last_queue_wait_ms: "float | None" = None
+        # EWMA twin of last_queue_wait_ms: the affinity router scores on
+        # this (X-TDAPI-Queue-Wait-EWMA-Ms / healthz ewmaMs) — a point
+        # sample is too noisy under bursts; the old field stays for
+        # compat. Alpha 0.2 ~= a 5-request memory.
+        self.queue_wait_ewma_ms: "float | None" = None
+        # KV handoff (prefill/decode disaggregation): prompt-KV exports
+        # parked for a decode replica's GET /kv, TTL-purged by the
+        # scheduler so a crashed/vanished decode peer can never leak
+        # pool blocks (the kill-mid-handoff sweep invariant)
+        self._kv_export_ttl = float(
+            os.environ.get("TDAPI_KV_EXPORT_TTL_S", "30"))
+        self.kv_handoffs_in = 0              # imports spliced (decode side)
+        self.prefix_evictions = 0            # trie leaves dropped (pressure)
         self.slots: list = [None] * slots
         self._waiting = None      # paged: head-of-line item short on blocks
         self._sample_vec = None   # per-slot sampling vectors (cached)
@@ -214,7 +227,25 @@ class _Batcher:
                 kv_sharded=True)
             self._alloc = BlockAllocator(self.kv_pool_blocks)
             self._slot_blocks: list = [None] * len(self.slots)
+            # paged prefix store is a TRIE over block-sized token chunks
+            # (shared-prefix prompts share nodes AND physical blocks);
+            # rebuilt with the allocator on crash-restart so the two can
+            # never disagree about which blocks are live
+            from ..batching import PrefixTrie
+            self._trie = (PrefixTrie(self.kv_block)
+                          if self.prefix_cache else None)
+            self._kv_exports: dict = {}
+            # (sketch hex, occupied blocks, indexed prefixes) — refreshed
+            # by the scheduler thread when the trie changes; the HTTP
+            # thread only ever reads the tuple (atomic reassignment)
+            from .. import kvaffinity
+            self._sketch_pub = (
+                kvaffinity.encode_sketch_hex([0] * kvaffinity.SKETCH_WORDS),
+                0, 0)
+            self._sketch_dirty = False
         else:
+            self._trie = None
+            self._kv_exports = {}
             from ..batching import init_slot_cache
             self.cache = self._build(lambda: init_slot_cache(
                 self.config, len(self.slots), self._cache_len,
@@ -277,7 +308,8 @@ class _Batcher:
 
     def submit(self, prompt_row, max_new: int, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               stats_out: dict | None = None) -> list[int]:
+               stats_out: dict | None = None, kv_key: str = "",
+               kv_import: dict | None = None) -> list[int]:
         """Blocking: returns the stream for one sequence — greedy at
         temperature 0, else per-request sampling (the row picks its token
         via rowwise_pick inside the shared decode step; other rows'
@@ -339,6 +371,13 @@ class _Batcher:
                 # trace can stitch replica-side time in
                 "enq_at": time.monotonic(),
                 "done": threading.Event(), "out": None, "error": None}
+        # disaggregated handoff riders (paged mode only): a prefill-phase
+        # request exports its prompt KV under kv_key; a decode-phase
+        # request splices a fetched export in instead of re-prefilling
+        if kv_key and self._paged:
+            item["_kv_key"] = kv_key
+        if kv_import is not None and self._paged:
+            item["_kv_import"] = kv_import
         self.queue.put(item)
         # re-check AFTER the put: _fail_all may have drained the queue
         # between our _dead check and the put, leaving this item in a dead
@@ -472,9 +511,7 @@ class _Batcher:
                 # (their blocks free once nothing else references them).
                 # Without this a parked request could deadlock behind
                 # pinned prefixes that only admissions would ever evict.
-                while blocks is None and self._prefixes:
-                    _, ev = self._prefixes.popitem(last=False)
-                    self._alloc.free(ev["blocks"])
+                while blocks is None and self._evict_prefix():
                     blocks = self._alloc.alloc(total - len(shared))
                 if blocks is None:
                     if shared:
@@ -492,6 +529,17 @@ class _Batcher:
                 row[:len(row_blocks)] = row_blocks
                 self.cache["pages"] = self.cache["pages"].at[i].set(
                     jnp.array(row, jnp.int32))
+                # disaggregated handoff, decode side: splice the prefill
+                # replica's exported prompt KV into this slot's private
+                # blocks and skip re-prefilling those tokens. Mutually
+                # exclusive with local prefix sharing — a local hit is
+                # already zero-copy and strictly better.
+                imp = item.pop("_kv_import", None)
+                if imp is not None and not shared_tok:
+                    shared_tok = self._kv_inject(i, row_blocks, imp, item)
+                    if shared_tok:
+                        item["_restored"] = True
+                        self.kv_handoffs_in += 1
                 if shared_tok:
                     self.cache["lengths"] = self.cache["lengths"].at[
                         i].set(shared_tok)
@@ -507,6 +555,10 @@ class _Batcher:
                 self.queue_wait_count += 1
                 self.queue_wait_ms_total += item["wait_ms"]
                 self.last_queue_wait_ms = item["wait_ms"]
+                prev = self.queue_wait_ewma_ms
+                self.queue_wait_ewma_ms = (
+                    item["wait_ms"] if prev is None
+                    else 0.2 * item["wait_ms"] + 0.8 * prev)
             try:
                 rem = (item["prompt"][shared_tok:] if self._paged
                        else self._restore_prefix(i, item))
@@ -625,16 +677,15 @@ class _Batcher:
         shared tokens, which are FULL prompt blocks), and the follower's
         prefill starts at shared_tok — both inside private blocks."""
         best_blocks, best_tok, best_donor = [], 0, None
-        if self.prefix_cache and self._prefixes:
-            best_key, best_use = self._lcp_lookup(item)
-            if best_key is not None:
-                entry = self._prefixes[best_key]
-                n_blk = min(best_use // self.kv_block,
-                            len(entry["blocks"]))
-                if n_blk >= 1:
-                    self._prefixes.move_to_end(best_key)
-                    best_blocks = entry["blocks"][:n_blk]
-                    best_tok = n_blk * self.kv_block
+        if self._trie is not None:
+            key = self._prompt_key(item)
+            blocks, _ = self._trie.lookup(key)
+            # cap at len-1 blocks' worth: the last position's logits must
+            # come from a real forward (same rule as _usable_lcp)
+            n_blk = min(len(blocks), (len(key) - 1) // self.kv_block)
+            if n_blk >= 1:
+                best_blocks = blocks[:n_blk]
+                best_tok = n_blk * self.kv_block
         # in-flight donors: any occupied slot with a longer common prefix
         key = self._prompt_key(item)
         for j, sj in enumerate(self.slots):
@@ -665,19 +716,25 @@ class _Batcher:
 
         key = item.get("_key") or tuple(
             jax.device_get(item["prompt"]).tolist())
-        if key in self._prefixes:
-            self._prefixes.move_to_end(key)
-            return
         if self._paged:
+            # trie-indexed donation: the prompt's FULL blocks join the
+            # prefix trie (levels already indexed by an earlier prompt
+            # keep their existing blocks — insert reports only the new
+            # ones, and only those get the extra reference). No count
+            # bound: entries are LRU-evicted ONLY under pool pressure
+            # (_evict_prefix), so a quiet pool keeps everything warm.
+            if self._trie is None:
+                return
             n_store = len(key) // self.kv_block
             if n_store < 1:
                 return
-            blocks = self._slot_blocks[i][:n_store]
-            self._alloc.share(blocks)            # survive the slot release
-            self._prefixes[key] = {"blocks": blocks}
-            while len(self._prefixes) > self.prefix_cache:
-                _, ev = self._prefixes.popitem(last=False)
-                self._alloc.free(ev["blocks"])
+            new = self._trie.insert(key, self._slot_blocks[i][:n_store])
+            if new:
+                self._alloc.share(new)           # survive the slot release
+                self._sketch_dirty = True
+            return
+        if key in self._prefixes:
+            self._prefixes.move_to_end(key)
             return
         from ..batching import slot_extract_kv
         if len(key) < 8:
@@ -691,6 +748,105 @@ class _Batcher:
         self._prefixes[key] = {"bufs": bufs}
         while len(self._prefixes) > self.prefix_cache:
             self._prefixes.popitem(last=False)
+
+    def _evict_prefix(self) -> bool:
+        """Drop ONE stored prefix under pool pressure (paged: the trie's
+        LRU leaf — interior blocks back every prefix through them, so
+        leaf-first is the only safe order). True when something freed."""
+        if self._trie is not None:
+            freed = self._trie.evict_lru()
+            if not freed:
+                return False
+            self._alloc.free(freed)
+            self.prefix_evictions += 1
+            self._sketch_dirty = True
+            return True
+        if self._prefixes:
+            _, ev = self._prefixes.popitem(last=False)
+            self._alloc.free(ev["blocks"])
+            self.prefix_evictions += 1
+            return True
+        return False
+
+    # ---- KV handoff (prefill/decode disaggregation) ----
+
+    def _kv_export(self, i, item) -> None:
+        """Prefill phase done: checkpoint the prompt's KV so a decode
+        replica can fetch it via GET /kv. The device gather runs HERE —
+        the scheduler thread is the cache's only owner; the HTTP thread
+        serves the finished host copy. The prompt blocks are ALSO rc++'d
+        into the export entry: a same-replica decode still reuses them
+        zero-copy through the trie, and the TTL purge (not the fetch
+        peer's goodwill) frees them — the kill-mid-handoff sweep pins
+        that no crash between phases can leak pool blocks."""
+        from ..paging import paged_extract_blocks
+        key = self._prompt_key(item)
+        plen = len(key)
+        n_blk = -(-plen // self.kv_block)
+        blocks = self._slot_blocks[i][:n_blk]
+        self._alloc.share(blocks)
+        self._kv_exports[item["_kv_key"]] = {
+            "tokens": key, "len": plen, "blocks": blocks,
+            "bufs": paged_extract_blocks(self.cache, blocks),
+            "at": time.monotonic()}
+
+    def _kv_inject(self, i, row_blocks, imp, item) -> int:
+        """Splice a fetched export into this slot's private blocks;
+        returns resident token count (0 = mismatch, prefill instead).
+        The import may end in a PARTIAL block — fine: the suffix prefill
+        appends into that block's remaining positions, and every touched
+        block is this slot's own."""
+        from ..paging import paged_inject_blocks
+        key = self._prompt_key(item)
+        toks = tuple(imp.get("tokens") or ())
+        # the export must be a strict prefix: >= 1 suffix token keeps the
+        # first decode logits coming from a real forward
+        if not toks or len(toks) >= len(key) or key[:len(toks)] != toks:
+            return 0
+        n_blk = -(-len(toks) // self.kv_block)
+        if n_blk > len(row_blocks):
+            return 0
+        try:
+            self.cache = paged_inject_blocks(
+                self.cache, row_blocks[:n_blk], imp["bufs"])
+        except (KeyError, ValueError, TypeError):
+            return 0                 # malformed fetch -> full prefill
+        return len(toks)
+
+    def kv_take(self, key: str):
+        """HTTP thread: claim an export's host KV (once). Block frees
+        stay on the scheduler thread (_purge_kv_exports) — the allocator
+        has exactly one owner."""
+        if not key:
+            return None
+        e = self._kv_exports.get(key)
+        if e is None or e.get("taken"):
+            return None
+        e["taken"] = True
+        return e
+
+    def _purge_kv_exports(self) -> None:
+        """Scheduler tick: free taken/expired exports' block refs."""
+        if not self._kv_exports:
+            return
+        now = time.monotonic()
+        for k, e in list(self._kv_exports.items()):
+            if e.get("taken") or now - e["at"] > self._kv_export_ttl:
+                self._kv_exports.pop(k, None)
+                self._alloc.free(e["blocks"])
+
+    def _refresh_sketch(self) -> None:
+        """Rebuild the advertised prefix sketch from the trie (scheduler
+        thread; the HTTP thread reads the published tuple). Hashing a
+        leaf's full path covers all ancestor levels, so leaves suffice."""
+        from .. import kvaffinity
+        hashes: list = []
+        for prefix in self._trie.iter_leaf_prefixes():
+            hashes.extend(kvaffinity.chunk_hashes(prefix))
+        self._sketch_pub = (
+            kvaffinity.encode_sketch_hex(kvaffinity.build_sketch(hashes)),
+            len(self._trie), self._trie.leaf_count)
+        self._sketch_dirty = False
 
     def _prefill_piece(self, i, item, piece, first: bool):
         import jax
@@ -749,6 +905,8 @@ class _Batcher:
         import jax.numpy as jnp
 
         self._store_prefix(i, item)   # slot row holds the full prompt's KV
+        if self._paged and item.get("_kv_key"):
+            self._kv_export(i, item)  # disagg: park the prompt KV for /kv
         logits = item.pop("_last_logits")
         if item["temperature"] == 0.0:
             tok = int(jax.device_get(jnp.argmax(logits[0])))
@@ -929,9 +1087,13 @@ class _Batcher:
         import jax
         import jax.numpy as jnp
 
+        if self._paged:
+            self._purge_kv_exports()
         with self._chip_slice():
             self._admit()
             fed = self._prefill_tick()      # one prompt piece per tick
+        if self._trie is not None and self._sketch_dirty:
+            self._refresh_sketch()
         # decodable = prefill finished (mid-prefill slots sit out the
         # step: their lengths must not advance)
         active = [s is not None and s.get("stream") is not None
@@ -1225,7 +1387,8 @@ class _Server:
 
     def generate(self, tokens, max_new: int, temperature: float,
                  top_k: int = 0, top_p: float = 1.0,
-                 stats_out: dict | None = None):
+                 stats_out: dict | None = None, kv_key: str = "",
+                 kv_import: dict | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -1246,7 +1409,8 @@ class _Server:
                 return [self.batcher.submit(
                     prompt[0], int(max_new), temperature=float(temperature),
                     top_k=int(top_k), top_p=float(top_p),
-                    stats_out=stats_out)]
+                    stats_out=stats_out, kv_key=kv_key,
+                    kv_import=kv_import)]
             # a multi-row request would run generate() concurrently with
             # the batcher's slot decode on the same chip — two full KV
             # caches + programs live at once, an OOM on a chip where
@@ -1280,6 +1444,37 @@ class _Server:
                                key=jax.random.key(int.from_bytes(
                                    os.urandom(4), "big")))
         return jax.device_get(out).tolist()
+
+
+def _fetch_kv(source: str, key: str) -> "dict | None":
+    """Decode side of the disaggregated handoff: pull the prompt KV a
+    prefill replica exported (GET /kv on `source` = "host:port"). ANY
+    failure — peer gone, export expired, malformed payload — returns
+    None and the decode replica simply prefills from scratch; the
+    handoff is a fast path, never a correctness dependency."""
+    import base64
+    from http.client import HTTPConnection
+
+    import numpy as np
+    try:
+        host, _, port = source.rpartition(":")
+        conn = HTTPConnection(host or "127.0.0.1", int(port), timeout=5)
+        try:
+            conn.request("GET", "/kv?key=" + key)
+            payload = json.loads(conn.getresponse().read() or b"{}")
+        finally:
+            conn.close()
+        data = payload.get("data") or {}
+        if payload.get("code") != 200 or not data.get("tokens"):
+            return None
+        bufs = {
+            name: np.frombuffer(
+                base64.b64decode(d["b64"]),
+                dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+            for name, d in (data.get("bufs") or {}).items()}
+        return {"tokens": data["tokens"], "bufs": bufs}
+    except Exception:  # noqa: BLE001 — degrade to full prefill, always
+        return None
 
 
 def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
@@ -1317,6 +1512,16 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                 self.send_header("X-TDAPI-Active",
                                  str(sum(s is not None for s in b.slots)))
                 self.send_header("X-TDAPI-Queued", str(b.queued))
+                if b.queue_wait_ewma_ms is not None:
+                    self.send_header("X-TDAPI-Queue-Wait-EWMA-Ms",
+                                     str(round(b.queue_wait_ewma_ms, 3)))
+                # KV-affinity advertisement: the fronting worker/gateway
+                # folds the prefix sketch + occupancy off EVERY response
+                # into its routing state — zero extra round-trips
+                if b._trie is not None:
+                    sketch_hex, occ, _ = b._sketch_pub
+                    self.send_header("X-TDAPI-KV-Sketch", sketch_hex)
+                    self.send_header("X-TDAPI-KV-Occ", str(occ))
             for k, v in (extra or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1345,8 +1550,21 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                             "lastMs": (round(b.last_queue_wait_ms, 3)
                                        if b.last_queue_wait_ms is not None
                                        else None),
+                            "ewmaMs": (round(b.queue_wait_ewma_ms, 3)
+                                       if b.queue_wait_ewma_ms is not None
+                                       else None),
                         },
                     }
+                    if b._trie is not None:
+                        sketch_hex, occ, entries = b._sketch_pub
+                        data["batching"]["prefixCache"] = {
+                            "entries": entries,
+                            "blocks": occ,
+                            "evictions": b.prefix_evictions,
+                            "kvExports": len(b._kv_exports),
+                            "handoffsIn": b.kv_handoffs_in,
+                            "sketch": sketch_hex,
+                        }
                     if b._draft is not None:
                         data["batching"]["speculative"] = {
                             "gamma": b.gamma,
@@ -1369,6 +1587,30 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                             "freeBlocks": b._alloc.free_blocks,
                         }
                 self._send(200, "Success", data)
+            elif self.path.startswith("/kv?") or self.path == "/kv":
+                # disaggregated handoff fetch: a decode replica pulls the
+                # prompt KV a prefill replica exported (once; TTL-purged
+                # server-side, so a decode peer that dies mid-handoff
+                # can never pin pool blocks here)
+                import base64
+                from urllib.parse import parse_qs, urlparse
+                b = srv.batcher
+                key = (parse_qs(urlparse(self.path).query)
+                       .get("key") or [""])[0]
+                e = (b.kv_take(key)
+                     if b is not None and b._paged else None)
+                if e is None:
+                    self._send(404, "kv export not found", None)
+                    return
+                bufs = {
+                    name: {"dtype": arr.dtype.name,
+                           "shape": list(arr.shape),
+                           "b64": base64.b64encode(
+                               arr.tobytes()).decode()}
+                    for name, arr in e["bufs"].items()}
+                self._send(200, "Success",
+                           {"tokens": list(e["tokens"]), "len": e["len"],
+                            "bufs": bufs})
             else:
                 self._send(404, "route not found", None)
 
@@ -1414,10 +1656,26 @@ def _handler_for(srv: _Server, model_name: str, admit_queue: int = 0):
                     temperature = round(temperature * 20) / 20
                     top_p = round(top_p * 20) / 20 or 0.05
                     top_k = min(top_k, 128)
+                # disaggregated handoff contract (paged batcher only):
+                # X-TDAPI-Phase: prefill + X-TDAPI-KV-Key -> run ONLY the
+                # prefill (one token), export the prompt KV under the key;
+                # X-TDAPI-KV-Source + X-TDAPI-KV-Key -> fetch that export
+                # from the prefill replica and resume without re-prefill.
+                # Any fetch failure degrades to a plain full request.
+                hdr_key = self.headers.get("X-TDAPI-KV-Key") or ""
+                kv_src = self.headers.get("X-TDAPI-KV-Source") or ""
+                phase = self.headers.get("X-TDAPI-Phase") or ""
+                kv_key, kv_import = "", None
+                if hdr_key and b is not None and b._paged:
+                    if phase == "prefill":
+                        kv_key, max_new = hdr_key, 1
+                    elif kv_src:
+                        kv_import = _fetch_kv(kv_src, hdr_key)
                 stats: dict = {}
                 out = srv.generate(tokens, max_new, temperature,
                                    top_k=top_k, top_p=top_p,
-                                   stats_out=stats)
+                                   stats_out=stats, kv_key=kv_key,
+                                   kv_import=kv_import)
                 extra = None
                 if "queueWaitMs" in stats:
                     # per-request batcher queue wait: the span-event

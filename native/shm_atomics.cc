@@ -63,6 +63,47 @@ void shm_hist_observe(void* hist_base, int64_t bucket_idx,
   __atomic_add_fetch(p + n_buckets + 1, 1, __ATOMIC_SEQ_CST);
 }
 
+// Mini-seqlock publish of a small cell group (the per-replica KV
+// affinity sketch: occupancy word + Bloom words). Layout at `gen`:
+// [generation | cell0 | cell1 | ...]. Writers race — many workers can
+// observe the same replica's response headers concurrently — so the
+// odd-generation window doubles as a try-lock: if another publish is in
+// flight (gen odd) or the CAS loses, this publish is simply dropped.
+// Sketches are advisory routing hints; losing one update is cheaper
+// than any cross-process lock. Returns 1 when published, 0 when
+// skipped.
+int shm_cells_publish(void* gen, void* cells, const int64_t* vals,
+                      int64_t n) {
+  int64_t* g = static_cast<int64_t*>(gen);
+  int64_t e = __atomic_load_n(g, __ATOMIC_SEQ_CST);
+  if (e & 1) return 0;
+  if (!__atomic_compare_exchange_n(g, &e, e + 1, false, __ATOMIC_SEQ_CST,
+                                   __ATOMIC_SEQ_CST))
+    return 0;
+  int64_t* c = static_cast<int64_t*>(cells);
+  for (int64_t i = 0; i < n; i++)
+    __atomic_store_n(c + i, vals[i], __ATOMIC_SEQ_CST);
+  __atomic_store_n(g, e + 2, __ATOMIC_SEQ_CST);
+  return 1;
+}
+
+// Seqlock-consistent read of a cell group published by
+// shm_cells_publish. Returns 0 when `out` holds a consistent snapshot,
+// 1 when the read raced a publish (torn) — the caller treats torn as
+// "no sketch" and falls back to least-queued. One attempt, no retry
+// loop: the router reads these on the claim path and a stale miss is
+// cheaper than spinning.
+int shm_cells_read(void* gen, void* cells, int64_t* out, int64_t n) {
+  int64_t* g = static_cast<int64_t*>(gen);
+  int64_t e1 = __atomic_load_n(g, __ATOMIC_SEQ_CST);
+  if (e1 & 1) return 1;
+  int64_t* c = static_cast<int64_t*>(cells);
+  for (int64_t i = 0; i < n; i++)
+    out[i] = __atomic_load_n(c + i, __ATOMIC_SEQ_CST);
+  int64_t e2 = __atomic_load_n(g, __ATOMIC_SEQ_CST);
+  return e1 == e2 ? 0 : 1;
+}
+
 // Wait until the word's low 32 bits differ from `expected` or timeout_ms
 // elapses. Returns 0 on wake, 1 on timeout, 2 on value-already-changed,
 // -1 on error. The word lives in shared memory, so FUTEX_WAIT (not

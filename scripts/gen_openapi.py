@@ -768,7 +768,12 @@ def build_spec() -> dict:
              "slots": i("Assumed per-replica batcher slots until the "
                         "replica's /healthz advertises them (default 4)"),
              "readiness": s("http (poll replica /healthz; default) | "
-                            "running (trust substrate run state)")},
+                            "running (trust substrate run state)"),
+             "poolPolicy": s("shared (default; every replica serves both "
+                             "phases) | disaggregated (even replica idx = "
+                             "prefill pool, odd = decode pool; long-prompt "
+                             "requests run the two-phase KV handoff; "
+                             "docs/serving.md §KV-aware routing)")},
             required=["name", "image"],
             desc="POST /api/v1/gateways body (gateway.GatewayConfig)"),
         "GatewayReplica": obj(
@@ -777,7 +782,11 @@ def build_spec() -> dict:
              "hostPort": i(), "state": s("starting | ready | stopping | "
                                          "stopped | failed"),
              "slots": i("Batcher slots the gateway admits against"),
-             "inflight": i(), "chips": arr(i()), "failures": i()}),
+             "inflight": i(), "chips": arr(i()), "failures": i(),
+             "role": s("shared | prefill | decode (idx parity under "
+                       "poolPolicy=disaggregated)"),
+             "kvOcc": i("Prefix-cache blocks the replica last "
+                        "advertised (X-TDAPI-KV-Occ fold)")}),
         "GatewayStatus": obj(
             {"name": s(), "config": ref("GatewayCreate"),
              "replicas": arr(ref("GatewayReplica")),
@@ -787,6 +796,12 @@ def build_spec() -> dict:
                                       "SLO signal); null before traffic"},
              "requestsTotal": i(), "shedTotal": i(),
              "scaleUps": i(), "scaleDowns": i(),
+             "affinityHits": i("Requests the KV sketch steered off the "
+                               "bare least-queued pick"),
+             "affinityTokens": i("Prefill tokens those hits predicted "
+                                 "saved"),
+             "kvHandoffs": i("Completed prefill->decode disaggregated "
+                             "handoffs"),
              "lastScaleReadyMs": {
                  "type": "number", "nullable": True,
                  "description": "Last scale trigger -> replica READY "
@@ -1231,13 +1246,21 @@ def build_spec() -> dict:
                          "twin of the regulator's latency class)"}],
             tags=["gateway"],
             desc="Admitted when a ready replica has a free batcher slot "
-                 "(least-queued routing, FIFO admission); bypasses the "
-                 "mutation gate and idempotency middleware — serving "
-                 "traffic is not a control mutation. Sheds HTTP 429 + "
-                 "Retry-After when the gateway queue is full, HTTP 504 "
-                 "(envelope 504) when the per-request deadline passes "
-                 "before a slot frees; both feed the autoscaler. The "
-                 "replica's envelope is relayed verbatim.")},
+                 "(least-queued routing KV-affinity-scored: replicas "
+                 "advertising a Bloom-sketch hit on the prompt's prefix "
+                 "win queue ties, never a shorter queue; FIFO "
+                 "admission); bypasses the mutation gate and idempotency "
+                 "middleware — serving traffic is not a control "
+                 "mutation. Sheds HTTP 429 + Retry-After when the "
+                 "gateway queue is full, HTTP 504 (envelope 504) when "
+                 "the per-request deadline passes before a slot frees; "
+                 "both feed the autoscaler. The replica's envelope is "
+                 "relayed verbatim. Under poolPolicy=disaggregated, "
+                 "non-streamed prompts past TDAPI_GW_DISAGG_PROMPT "
+                 "tokens run the two-phase prefill->decode KV handoff "
+                 "(X-TDAPI-Phase / X-TDAPI-KV-Key / X-TDAPI-KV-Source "
+                 "replica headers; docs/serving.md §KV-aware routing), "
+                 "falling back to the shared path on any miss.")},
         f"{v1}/fleet/lease": {"post": op(
             "fleetJoin", "Join the fleet (or rejoin after expiry): "
             "acquire this member's TTL lease",

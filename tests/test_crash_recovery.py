@@ -18,7 +18,9 @@ Invariants checked after every crash + rebuild (ISSUE acceptance):
 - no open intents, and a second reconcile pass is a no-op.
 """
 
+import json
 import os
+import time
 
 import pytest
 
@@ -58,6 +60,7 @@ def crash(app):
     submitted (step-boundary determinism), release the WAL handle, run NO
     graceful flush. Returns the surviving backend."""
     faults.disarm_all()
+    app.gateways.stop_all()      # daemon death takes its threads with it
     app.wq.close()
     app.store.close()
     app.events.close()
@@ -182,6 +185,51 @@ def post_gwscale(app, stored):
     assert app.backend.list_names() == ["gwr0-1"]
     assert _has_mark(app, "gwr0-1")
     assert app.container_versions.get("gwr1") is None
+
+
+def setup_kvhandoff(app):
+    """A disaggregated gateway with both pools READY (idx 0 = prefill,
+    idx 1 = decode)."""
+    from gpu_docker_api_tpu.gateway import READY, GatewayConfig
+    app.gateways.create(GatewayConfig(
+        name="kgw", image="img", cmd=["serve"], minReplicas=2,
+        maxReplicas=2, readiness="running", scaleDownIdleS=3600,
+        deadlineMs=4000, maxQueue=16, poolPolicy="disaggregated"))
+    gw = app.gateways.get("kgw")
+    deadline = time.time() + 10
+    while time.time() < deadline and sum(
+            1 for r in gw.replicas.values() if r.state is READY) < 2:
+        time.sleep(0.02)
+    assert sum(1 for r in gw.replicas.values() if r.state is READY) == 2
+
+
+def scenario_kvhandoff(app):
+    """The disaggregated forward dies between the phases: prefill done,
+    prompt KV exported under the handoff key, decode never dispatched.
+    Data-plane only — no intent, no store write — so recovery is pure
+    adoption; the orphaned export is the replica TTL purge's problem
+    (pinned live in tests/test_kv_routing.py)."""
+    gw = app.gateways.get("kgw")
+    prompt = list(range(96))
+
+    def transport(port, method, path, body, timeout):
+        return 200, json.dumps(
+            {"code": 200, "msg": "ok",
+             "data": {"tokens": [prompt + [0]]}}).encode()
+
+    gw._transport = transport        # mock replicas aren't real servers
+    gw.forward(json.dumps({"tokens": [prompt], "max_new": 8}).encode())
+
+
+def post_kvhandoff(app, stored):
+    # both replicas survive the rebuild with their pools intact (roles
+    # derive from idx parity, no stored state to lose) and no claim
+    # leaked into the adopted roster
+    assert {"kgwr0", "kgwr1"} <= set(stored)
+    gw = app.gateways.get("kgw")
+    assert gw.cfg.poolPolicy == "disaggregated"
+    assert {r.role for r in gw.replicas.values()} == {"prefill", "decode"}
+    assert all(r.inflight == 0 for r in gw.replicas.values())
 
 
 def setup_replace(app):
@@ -491,6 +539,9 @@ SCENARIOS = [
                         post_vol_delete)),
     ("workqueue.", (None, scenario_run, post_run)),
     ("gwscale.", (setup_gwscale, scenario_gwscale, post_gwscale)),
+    # KV handoff (PR 18): a data-plane crash between the disaggregation
+    # phases — no intent to settle, recovery is adoption alone
+    ("kvhandoff.", (setup_kvhandoff, scenario_kvhandoff, post_kvhandoff)),
     # the two federation lease crashpoints have distinct recovery shapes
     # (orphaned fresh grant vs re-orphaned stolen grant) — own rows
     ("fed.after_acquire", (setup_fed_acquire, scenario_fed_acquire,

@@ -1,0 +1,568 @@
+"""KV-aware serving data plane sweep (`kvroute` marker; make
+verify-kvroute).
+
+Four layers:
+
+- kvaffinity primitives: incremental chunk hashing, Bloom sketch
+  membership with the consecutive-run rule, the queue-dominates scoring
+  contract, sketch hex wire format — both ends of every sketch exchange
+  (shm cells, response headers) must agree bit-for-bit;
+- the replica-side prefix index (batching.PrefixTrie) and the mock
+  model's full serving contract (sketch/occupancy headers, single-take
+  /kv export, handoff import, queue-wait EWMA) — the surfaces the bench
+  and the e2e cases drive;
+- router policy: the in-process Gateway and the worker tier's
+  WorkerRouter both order candidates by kvaffinity.score — warm wins a
+  queue tie, a visibly shorter queue always wins, TDAPI_GW_AFFINITY=0
+  restores pure least-queued — and the worker side does it from the shm
+  kv cells ONLY (pinned by the daemon-SIGKILL case: routing and
+  affinity continue with no daemon process at all);
+- prefill/decode disaggregation e2e over real mock replicas: the
+  two-phase handoff returns a byte-compatible single reply, the export
+  is single-take, and the kvhandoff.after_prefill crashpoint leaks
+  neither claims nor KV (TTL purge), after which the same request
+  completes whole.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gpu_docker_api_tpu import faults, kvaffinity
+from gpu_docker_api_tpu.batching import PrefixTrie
+from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.gateway import (
+    READY, Gateway, GatewayConfig, Replica,
+)
+
+pytestmark = pytest.mark.kvroute
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from gpu_docker_api_tpu.server import workers
+    _HAVE_WORKERS = workers.available()
+except Exception:  # noqa: BLE001 — no native core on this platform
+    workers = None
+    _HAVE_WORKERS = False
+
+needs_workers = pytest.mark.skipif(
+    not _HAVE_WORKERS,
+    reason="worker tier unavailable (no Linux SO_REUSEPORT / native core)")
+
+OK = b'{"code":200,"msg":"ok","data":{}}'
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+# ------------------------------------------------- kvaffinity primitives
+
+def test_chunk_hashes_prefix_property():
+    toks = list(range(200))
+    hs = kvaffinity.chunk_hashes(toks)
+    assert len(hs) == 6                       # 200 // 32 complete levels
+    # hashes are a pure function of the PREFIX: extending the prompt
+    # never changes earlier levels (incremental FNV, one pass)
+    assert kvaffinity.chunk_hashes(toks[:64]) == hs[:2]
+    # a partial trailing chunk is never hashed (can't be block-resident)
+    assert kvaffinity.chunk_hashes(toks[:63]) == hs[:1]
+    assert kvaffinity.chunk_hashes(list(range(31))) == []
+    assert (len(kvaffinity.chunk_hashes(list(range(1000))))
+            == kvaffinity.MAX_LEVELS)
+
+
+def test_hit_requires_consecutive_levels():
+    toks = list(range(128))                   # 4 levels
+    hs = kvaffinity.chunk_hashes(toks)
+    sk = kvaffinity.build_sketch(hs[:2])
+    assert kvaffinity.hit_tokens(sk, hs) == 2 * kvaffinity.CHUNK_TOKENS
+    # a deeper level WITHOUT its ancestors is a false positive by
+    # construction — the run must start at level 0
+    assert kvaffinity.hit_tokens(kvaffinity.build_sketch(hs[2:]), hs) == 0
+    assert kvaffinity.hit_tokens(None, hs) == 0
+    assert kvaffinity.hit_tokens(kvaffinity.build_sketch(hs), []) == 0
+
+
+def test_score_queue_strictly_dominates_hit():
+    deepest = kvaffinity.MAX_LEVELS * kvaffinity.CHUNK_TOKENS
+    # one unit of queue depth outweighs the deepest possible hit:
+    # affinity refines least-queued order, it never overrides it
+    assert kvaffinity.score(deepest, 1) > kvaffinity.score(0, 0)
+    # at equal depth the deeper hit wins (lower score)
+    assert kvaffinity.score(64, 2) < kvaffinity.score(0, 2)
+
+
+def test_sketch_hex_roundtrip_and_signed64():
+    words = [0x8000000000000001, 0, (1 << 64) - 1, 0x0123456789ABCDEF]
+    text = kvaffinity.encode_sketch_hex(words)
+    assert len(text) == kvaffinity.SKETCH_WORDS * 16
+    assert kvaffinity.decode_sketch_hex(text) == words
+    assert kvaffinity.decode_sketch_hex("") is None
+    assert kvaffinity.decode_sketch_hex(text[:-1]) is None
+    assert kvaffinity.decode_sketch_hex("zz" * 32) is None
+    for w in words:       # int64 shm-cell reinterpretation round-trips
+        assert kvaffinity.signed64(w) & ((1 << 64) - 1) == w
+
+
+def test_kvroute_catalog_registration():
+    """Every kvroute event op / metric family is in the obs/names.py
+    catalog (the tdlint untraced-op contract)."""
+    from gpu_docker_api_tpu.obs.names import EVENT_OPS, METRIC_NAMES
+    assert {"gateway.kv_handoff", "router.affinity_hit"} <= EVENT_OPS
+    assert {"tdapi_gw_affinity_hits_total",
+            "tdapi_gw_affinity_tokens_total",
+            "tdapi_kv_prefix_blocks",
+            "tdapi_kv_prefix_handoffs_total"} <= METRIC_NAMES
+
+
+# ------------------------------------------------ replica-side prefix trie
+
+def test_prefix_trie_sharing_lru_and_leaf_only_eviction():
+    t = PrefixTrie(4)
+    a = list(range(8))
+    assert t.insert(a, [10, 11]) == [10, 11]
+    b = a[:4] + [99, 98, 97, 96]
+    # the shared first block is NOT re-referenced: two prompts sharing a
+    # prefix share the physical block
+    assert t.insert(b, [10, 12]) == [12]
+    assert len(t) == 3 and t.leaf_count == 2
+    blocks, matched = t.lookup(a + [5])
+    assert blocks == [10, 11] and matched == 8
+    # the lookup refreshed a's path, so LRU eviction drops b's leaf —
+    # and ONLY a leaf (the shared interior block backs both prefixes)
+    assert t.evict_lru() == [12]
+    assert t.evict_lru() == [11]
+    assert t.clear() == [10]
+
+
+# ---------------------------------------------------- mock serving contract
+
+def _spawn_mock(workdir, *args):
+    """A real mock_model subprocess (its own cwd: READY_MARKER and
+    weights land there); returns (proc, port) once it serves."""
+    env = dict(os.environ, PORT="0", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m",
+         "gpu_docker_api_tpu.workloads.mock_model",
+         "--host", "127.0.0.1", *args],
+        cwd=str(workdir), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    port = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if "serving on" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port, "mock model never came up"
+    return proc, port
+
+
+def _post(port, data, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("POST", "/generate", json.dumps(data).encode(),
+                     {"Content-Type": "application/json",
+                      **(headers or {})})
+        r = conn.getresponse()
+        return r.status, r.getheaders(), json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.getheaders(), json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def _prefix_cache(port) -> dict:
+    _, _, hz = _get(port, "/healthz")
+    return hz["data"]["batching"]["prefixCache"]
+
+
+def test_mock_kv_contract_sketch_export_ewma(tmp_path):
+    proc, port = _spawn_mock(tmp_path, "--decode-ms", "1")
+    try:
+        toks = list(range(64))
+        status, hdrs, out = _post(
+            port, {"tokens": [toks], "max_new": 8},
+            {"X-TDAPI-Phase": "prefill", "X-TDAPI-KV-Key": "k1"})
+        assert status == 200
+        row = out["data"]["tokens"][0]
+        assert row == toks + [0]          # prefill phase forces max_new=1
+        h = {k.lower(): v for k, v in hdrs}
+        assert kvaffinity.decode_sketch_hex(h["x-tdapi-kv-sketch"]) \
+            is not None
+        assert int(h["x-tdapi-kv-occ"]) >= 1
+        # the export is the PROMPT KV, and it is single-take
+        st1, _, kv = _get(port, "/kv?key=k1")
+        assert st1 == 200 and kv["data"]["tokens"] == toks
+        st2, _, kv2 = _get(port, "/kv?key=k1")
+        assert st2 == 404 and kv2["code"] == 404
+        # healthz: smoothed queue wait + the prefix-cache block
+        _, _, hz = _get(port, "/healthz")
+        b = hz["data"]["batching"]
+        assert b["queueWait"]["ewmaMs"] is not None
+        assert b["prefixCache"]["entries"] >= 1
+        assert b["prefixCache"]["kvFetches"] == 1
+    finally:
+        proc.kill()
+        proc.wait(10)
+
+
+# ------------------------------------------------ in-process router policy
+
+def _bare_gateway(transport, **cfg_kw) -> Gateway:
+    kw = dict(name="g", image="img", deadlineMs=3000, maxQueue=8)
+    kw.update(cfg_kw)
+    return Gateway(GatewayConfig(**kw), services=None, intents=None,
+                   transport=transport)
+
+
+def _ready_replica(name, idx, port, slots=2) -> Replica:
+    r = Replica(name, idx)
+    r.state = READY
+    r.slots = slots
+    r.host_port = port
+    return r
+
+
+def test_gateway_pick_prefers_warm_on_queue_tie_and_folds_meta():
+    toks = list(range(64))
+    sketch = kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks))
+    meta = {"x-tdapi-kv-sketch": kvaffinity.encode_sketch_hex(sketch),
+            "x-tdapi-kv-occ": "5"}
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        return 200, OK, meta
+
+    gw = _bare_gateway(transport)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002)}
+    gw.replicas["b"].kv_sketch = sketch
+    status, _ = gw.forward(
+        json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200 and seen == [1002]       # warm replica won the tie
+    assert gw.affinity_hits == 1 and gw.affinity_tokens == 64
+    # the response's advertised sketch/occupancy folded into the handle
+    assert gw.replicas["b"].kv_occ == 5
+    assert gw.replicas["b"].kv_sketch == sketch
+
+
+def test_gateway_affinity_never_overrides_shorter_queue():
+    toks = list(range(64))
+    sketch = kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks))
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        return 200, OK
+
+    gw = _bare_gateway(transport)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002)}
+    gw.replicas["b"].kv_sketch = sketch
+    gw.replicas["b"].inflight = 1                 # warm but visibly busier
+    status, _ = gw.forward(
+        json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200 and seen == [1001]       # queue depth dominates
+    assert gw.affinity_hits == 0
+
+
+def test_gateway_affinity_env_disable(monkeypatch):
+    monkeypatch.setenv("TDAPI_GW_AFFINITY", "0")
+    toks = list(range(64))
+    sketch = kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks))
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        return 200, OK
+
+    gw = _bare_gateway(transport)                 # toggle read at init
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002)}
+    gw.replicas["b"].kv_sketch = sketch
+    status, _ = gw.forward(
+        json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200 and seen == [1001]       # pure least-queued order
+    assert gw.affinity_hits == 0
+
+
+def test_pool_policy_validation_roles_and_scale_parity():
+    cfg = GatewayConfig(name="g", image="img", poolPolicy="bogus")
+    with pytest.raises(ValueError):
+        cfg.validate()
+    GatewayConfig(name="g", image="img",
+                  poolPolicy="disaggregated").validate()
+    # roles derive from idx PARITY (crash-recoverable: adopt-by-name
+    # needs no stored role field)
+    assert Replica("gr0", 0).role == "prefill"
+    assert Replica("gr1", 1).role == "decode"
+    # pool-aware autoscaling grows the smaller pool, on its idx stride
+    gw = _bare_gateway(None, poolPolicy="disaggregated")
+    gw.replicas = {"gr0": _ready_replica("gr0", 0, 1001),
+                   "gr1": _ready_replica("gr1", 1, 1002),
+                   "gr2": _ready_replica("gr2", 2, 1003)}
+    assert gw._scale_parity() == 1                # decode pool is smaller
+    assert gw._next_idx(1) == 3
+    assert _bare_gateway(None)._scale_parity() is None
+
+
+# ----------------------------------------------- worker-tier router policy
+
+@pytest.fixture()
+def state():
+    st = workers.SharedRouterState(create=True)
+    yield st
+    st.close(unlink=True)
+
+
+def publish(st, replicas, max_queue=8, deadline_ms=3000, name="g"):
+    st.publish([{"name": name, "maxQueue": max_queue,
+                 "deadlineMs": deadline_ms, "replicas": replicas}])
+
+
+def rep(port, slots=4, ready=True):
+    return {"port": port, "slots": slots, "ready": ready}
+
+
+@needs_workers
+def test_kv_cells_roundtrip_and_torn_read(state):
+    words = [0x8000000000000001, 0x123456789ABCDEF0, (1 << 64) - 1, 0]
+    state.publish_replica_kv(0, 3, 42, words)
+    assert state.read_replica_kv(0, 3) == (42, words)
+    assert state.read_replica_kv(0, 2) is None    # nothing advertised
+    # a writer killed mid-publish parks the cell gen odd: ONE read
+    # attempt, None, never a spin — and the next publish heals it
+    state.publish_replica_kv(0, 0, 5, words)
+    off = workers._rep_kv_off(0, 0)
+    gen = state.load(off)
+    state.store(off, gen + 1)
+    assert state.read_replica_kv(0, 0) is None
+    state.store(off, gen + 2)
+    assert state.read_replica_kv(0, 0) == (5, words)
+
+
+@needs_workers
+def test_worker_scored_pick_prefers_warm_on_equal_queue(state):
+    toks = list(range(64))
+    body = json.dumps({"tokens": [toks], "max_new": 4}).encode()
+    publish(state, [rep(1001), rep(1002)])
+    state.publish_replica_kv(
+        0, 1, 2, kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks)))
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        return 200, OK
+
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    status, _ = r.forward("g", body)
+    assert status == 200 and seen == [1002]
+    c = state.gateway_counters(0)
+    assert c["affinityHits"] == 1 and c["affinityTokens"] == 64
+
+
+@needs_workers
+def test_worker_scored_pick_queue_depth_dominates(state):
+    toks = list(range(64))
+    body = json.dumps({"tokens": [toks], "max_new": 4}).encode()
+    publish(state, [rep(1001), rep(1002)])
+    state.publish_replica_kv(
+        0, 1, 2, kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks)))
+    state.add(workers._rep_cnt_off(0, 1), 1)      # warm replica busier
+    seen = []
+    r = workers.WorkerRouter(
+        state, 0,
+        transport=lambda port, *a: seen.append(port) or (200, OK))
+    status, _ = r.forward("g", body)
+    assert status == 200 and seen == [1001]
+    assert state.gateway_counters(0)["affinityHits"] == 0
+
+
+@needs_workers
+def test_worker_affinity_env_disable(state, monkeypatch):
+    monkeypatch.setenv("TDAPI_GW_AFFINITY", "0")
+    toks = list(range(64))
+    publish(state, [rep(1001), rep(1002)])
+    state.publish_replica_kv(
+        0, 1, 2, kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks)))
+    seen = []
+    r = workers.WorkerRouter(
+        state, 0,
+        transport=lambda port, *a: seen.append(port) or (200, OK))
+    status, _ = r.forward(
+        "g", json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200 and seen == [1001]       # pure least-queued order
+    assert state.gateway_counters(0)["affinityHits"] == 0
+
+
+@needs_workers
+def test_worker_folds_advertised_sketch_into_cells(state):
+    toks = list(range(64))
+    sketch = kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks))
+    publish(state, [rep(1001), rep(1002)])
+    calls = []
+
+    def transport(port, method, path, body, timeout):
+        calls.append(port)
+        if port == 1001 and body == b"{}":
+            # the warmup request fails over to 1002, whose response
+            # advertises its KV state (the 4-tuple kv element)
+            raise ConnectionRefusedError("warmup: 1001 down")
+        if port == 1002:
+            return 200, OK, 1.5, (7, sketch)
+        return 200, OK
+
+    r = workers.WorkerRouter(state, 0, transport=transport)
+    status, _ = r.forward("g", b"{}")    # retries onto 1002 -> kv folds
+    assert status == 200 and calls == [1001, 1002]
+    assert state.read_replica_kv(0, 1) == (7, sketch)
+    assert state.read_replica_kv(0, 0) is None
+    # the published cells steer the next prompt-bearing request
+    status, _ = r.forward(
+        "g", json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200 and calls[-1] == 1002
+    assert state.gateway_counters(0)["affinityHits"] == 1
+
+
+_CHILD = (
+    "import time\n"
+    "from gpu_docker_api_tpu.server import workers\n"
+    "from gpu_docker_api_tpu import kvaffinity\n"
+    "st = workers.SharedRouterState(create=True)\n"
+    "st.publish([{'name': 'g', 'maxQueue': 8, 'deadlineMs': 3000,\n"
+    "             'replicas': [\n"
+    "                 {'port': 1001, 'slots': 4, 'ready': True},\n"
+    "                 {'port': 1002, 'slots': 4, 'ready': True}]}])\n"
+    "toks = list(range(64))\n"
+    "st.publish_replica_kv(0, 1, 2,\n"
+    "    kvaffinity.build_sketch(kvaffinity.chunk_hashes(toks)))\n"
+    "print(st.name, flush=True)\n"
+    "time.sleep(60)\n")
+
+
+@needs_workers
+def test_affinity_routes_from_shm_after_daemon_sigkill():
+    """The zero-daemon-round-trips pin: a 'daemon' process publishes the
+    roster + kv sketches and is SIGKILLed; the worker router keeps
+    forwarding AND keeps applying affinity from the shm cells alone."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD], cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    name = proc.stdout.readline().strip()
+    assert name, "publisher never came up"
+    st = workers.SharedRouterState(name=name)
+    try:
+        proc.kill()
+        proc.wait(10)
+        seen = []
+        r = workers.WorkerRouter(
+            st, 0,
+            transport=lambda port, *a: seen.append(port) or (200, OK))
+        body = json.dumps(
+            {"tokens": [list(range(64))], "max_new": 4}).encode()
+        for _ in range(3):
+            status, _ = r.forward("g", body)
+            assert status == 200
+        assert seen == [1002, 1002, 1002]
+        assert st.gateway_counters(0)["affinityHits"] == 3
+    finally:
+        proc.kill()
+        st.created = True          # the creator died; this side unlinks
+        st.close(unlink=True)
+
+
+# ------------------------------------- disaggregation e2e over real mocks
+
+@pytest.fixture()
+def mock_pair(tmp_path):
+    procs = []
+
+    def spawn(sub):
+        d = tmp_path / sub
+        d.mkdir()
+        p, port = _spawn_mock(d, "--decode-ms", "2", "--kv-ttl", "1.0")
+        procs.append(p)
+        return port
+
+    yield spawn("pre"), spawn("dec")
+    for p in procs:
+        p.kill()
+        p.wait(10)
+
+
+def _disagg_gateway(pre_port, dec_port) -> Gateway:
+    gw = _bare_gateway(None, poolPolicy="disaggregated", deadlineMs=8000)
+    gw.replicas = {"gr0": _ready_replica("gr0", 0, pre_port, slots=4),
+                   "gr1": _ready_replica("gr1", 1, dec_port, slots=4)}
+    return gw
+
+
+def test_disagg_handoff_e2e(mock_pair):
+    pre_port, dec_port = mock_pair
+    gw = _disagg_gateway(pre_port, dec_port)
+    toks = list(range(96))
+    status, payload = gw.forward(
+        json.dumps({"tokens": [toks], "max_new": 4}).encode())
+    assert status == 200
+    row = json.loads(payload)["data"]["tokens"][0]
+    # byte-compatible with a single-shot reply: prompt + max_new tokens
+    assert row[:96] == toks and len(row) == 100
+    assert gw.kv_handoffs == 1
+    assert all(r.inflight == 0 for r in gw.replicas.values())
+    pre_pc, dec_pc = _prefix_cache(pre_port), _prefix_cache(dec_port)
+    assert pre_pc["kvFetches"] == 1 and pre_pc["kvExports"] == 0
+    assert dec_pc["handoffsIn"] == 1
+    # a short prompt stays below the bar: whole request, shared path
+    status, _ = gw.forward(
+        json.dumps({"tokens": [toks[:32]], "max_new": 2}).encode())
+    assert status == 200 and gw.kv_handoffs == 1
+
+
+def test_crash_mid_handoff_releases_claims_and_leaks_no_kv(mock_pair):
+    """kvhandoff.after_prefill: the daemon dies with the prompt KV
+    exported and the decode phase never dispatched. Both claims release
+    on the unwind, the orphaned export TTL-purges (zero leaked KV), and
+    the same request then completes whole."""
+    pre_port, dec_port = mock_pair
+    gw = _disagg_gateway(pre_port, dec_port)
+    body = json.dumps({"tokens": [list(range(96))],
+                       "max_new": 4}).encode()
+    faults.arm("kvhandoff.after_prefill")
+    with pytest.raises(InjectedCrash):
+        gw.forward(body)
+    faults.disarm_all()
+    assert all(r.inflight == 0 for r in gw.replicas.values())
+    assert _prefix_cache(pre_port)["kvExports"] == 1   # orphaned export
+    deadline = time.time() + 8
+    left = 1
+    while time.time() < deadline and left:
+        time.sleep(0.2)
+        left = _prefix_cache(pre_port)["kvExports"]
+    assert left == 0, "orphaned KV export never TTL-purged"
+    status, _ = gw.forward(body)
+    assert status == 200 and gw.kv_handoffs == 1

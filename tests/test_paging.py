@@ -201,20 +201,29 @@ def test_paged_prefix_sharing_zero_copy(tiny):
 
 @pytest.mark.slow
 def test_paged_prefix_eviction_returns_blocks(tiny):
-    """LRU eviction of a stored prefix drops its block references —
-    the pool never leaks."""
+    """The prefix trie has NO count bound — every completed prompt stays
+    warm until pool pressure — and pressure eviction drops LRU leaves'
+    block references: the pool never leaks."""
     cfg, params = tiny
     b = _Batcher(cfg, params, slots=1, max_len=32, kv_block=4,
-                 kv_pool_blocks=16, prefix_cache=1)
+                 kv_pool_blocks=12, prefix_cache=1)
     try:
         total = b._alloc.free_blocks
         for seed in range(3):                  # distinct prompts
             p = jax.random.randint(jax.random.key(seed), (8,), 0,
                                    cfg.vocab_size)
             b.submit(p, 4)
-        # exactly ONE stored prefix (2 blocks) outstanding
-        assert b._alloc.free_blocks == total - 2
-        assert len(b._prefixes) == 1
+        # ALL three prompts stay cached (2 blocks each): eviction is
+        # pressure-only, prefix_cache no longer bounds the entry count
+        assert b._alloc.free_blocks == total - 6
+        assert len(b._trie) == 6
+        # pressure: needs ceil((8+24)/4)=8 blocks > 5 free -> LRU leaves
+        # evict until the request fits, and their blocks come back
+        p = jax.random.randint(jax.random.key(9), (8,), 0,
+                               cfg.vocab_size)
+        want = np.asarray(generate(params, p[None], cfg, 24))[0].tolist()
+        assert b.submit(p, 24) == want
+        assert b.prefix_evictions >= 3
     finally:
         b.close()
 
@@ -377,12 +386,10 @@ def test_batcher_stress_mixed_traffic(tiny):
             if i in oracles:
                 assert got[i] == oracles[i], f"greedy stream {i} diverged"
             assert all(0 <= t < cfg.vocab_size for t in got[i])
-        # zero block leaks: only stored prefixes may stay live (stored
-        # entries can SHARE blocks — a longer prompt stored after reusing
-        # a shorter stored prefix aliases its blocks — so count uniques)
+        # zero block leaks: only trie-indexed prefixes may stay live,
+        # and every trie node holds exactly one distinct pool block
         live = (b.kv_pool_blocks - 1) - b._alloc.free_blocks
-        stored = {blk for e in b._prefixes.values() for blk in e["blocks"]}
-        assert live == len(stored)
+        assert live == len(b._trie)
     finally:
         b.close()
 
@@ -398,7 +405,7 @@ def test_pool_pressure_evicts_stored_prefixes(tiny):
     try:
         # store a prefix pinning 2 of the 7 usable blocks
         b.submit(jnp.array([5, 9, 2, 7, 11, 3, 1, 4], jnp.int32), 4)
-        assert len(b._prefixes) == 1
+        assert len(b._trie) == 2
         # needs ceil((9+16)/4)=7 blocks > 5 free -> must evict the store
         p = jax.random.randint(jax.random.key(1), (9,), 0, cfg.vocab_size)
         want = np.asarray(generate(params, p[None], cfg, 16))[0].tolist()

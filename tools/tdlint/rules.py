@@ -711,6 +711,10 @@ COUNTER_OFF_HELPERS = frozenset({
     "_gw_cnt_off", "_rep_cnt_off", "_wk_claim_off", "_wk_queued_off",
     "_wk_off",
     "_sh_gw_off", "_sh_cnt_off", "_sh_lat_off", "_sh_qw_off",
+    # PR 18 KV-affinity sketch cells (gen|occ|sketch words): written
+    # ONLY through the native shm_cells_publish CAS path — a raw-buffer
+    # write here is exactly the racy store atomic-region exists to catch
+    "_rep_kv_off",
 })
 COUNTER_OFF_NAMES = frozenset({"CNT_OFF", "WK_OFF", "SH_CNT_OFF"})
 #: the seqlock epoch word: a named offset constant (workers.py roster
