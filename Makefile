@@ -8,7 +8,8 @@ STATE ?= ./tpu-docker-api-state
 .PHONY: all native native-san test test-fast verify-crash verify-faults \
     verify-perf verify-retry verify-migrate verify-mt verify-races \
     verify-obs verify-gateway verify-gang verify-workers verify-tdcheck \
-    verify-fed verify-durability verify-kvroute bench serve serve-mock \
+    verify-fed verify-durability verify-kvroute verify-tail bench serve \
+    serve-mock \
     dryrun apidoc lint clean
 
 all: native
@@ -37,6 +38,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-fed     (federated control-plane sweep: -m fed)"
 	@echo "  make verify-durability (durable state plane sweep: -m durability)"
 	@echo "  make verify-kvroute (KV-aware serving sweep: -m kvroute)"
+	@echo "  make verify-tail    (tail-tolerant serving sweep: -m tail)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -83,6 +85,9 @@ verify-durability: native  ## durable state plane: WAL integrity, backup/restore
 
 verify-kvroute: native  ## KV-aware serving: affinity scoring/routing, disaggregation, zero-leak handoff
 	$(PY) -m pytest tests/ -q -m kvroute
+
+verify-tail: native     ## tail tolerance: ejection/probation, hedging, retry budgets, tier parity
+	$(PY) -m pytest tests/ -q -m tail
 
 lint: native            ## compile baseline + tdlint rules (stale pragmas fail) + rule/checker liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

@@ -2102,6 +2102,203 @@ def kv_routing_bench() -> dict:
         shutil.rmtree(state_dir, ignore_errors=True)
 
 
+def tail_bench() -> dict:
+    """Tail-tolerant serving (tailtolerance.py + gateway composition):
+    paired A/B of the SAME closed-loop workload against a 3-replica
+    fleet with exactly one GRAY replica — r2's env arms
+    TDAPI_FAULTS="<gw>r2.generate:jitter:J" so its mock sleeps a
+    heavy-tailed Pareto latency (median ~J, tail to 20xJ) on every
+    generate while staying READY and healthy-looking. Defended arm:
+    ejection + hedging on (defaults). Undefended arm:
+    TDAPI_GW_EJECT=0 TDAPI_GW_HEDGE=0 — plain least-queued, which keeps
+    feeding the gray replica whenever its queue ties the healthy ones.
+
+    Closed-loop 3-thread senders so the gray replica actually receives
+    traffic (a serial stream always ties at queue depth 0 and the
+    deterministic tie-break never leaves r0). Each arm runs an
+    unmeasured warmup first: the defended arm needs EJECT_MIN_COUNT
+    digest samples on the gray replica and an autoscaler tick before
+    the probation penalty steers around it — measuring from request 1
+    would price the detector's (by-design) reaction window, not the
+    steady state; the undefended arm gets the same warmup for pairing.
+
+    Reports (ISSUE 19 criteria — paired ratio is the contract, absolute
+    ms are CPU-contended container noise):
+    - tail_p99_ms_scale: defended p99 / undefended p99 (<= 0.5 —
+      ejection + hedging must at least halve the gray-fleet tail);
+    - tail_hedge_overhead_pct: hedges fired / requests served in the
+      defended arm (<= 5% — the token bucket's added-load cap, which
+      also prices the trickle probes: a probe that lands on the
+      still-gray replica outlives the digest-derived delay and gets
+      hedged to a healthy peer, so probation stays cheap).
+    """
+    import shutil
+    import threading
+
+    from gpu_docker_api_tpu.backend.process import ProcessBackend
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+    from gpu_docker_api_tpu.workloads.mock_model import launch_cmd
+
+    state_dir = tempfile.mkdtemp(prefix="tdapi-tail-")
+    backend = ProcessBackend(
+        os.path.join(state_dir, "backend"), warm_pool=3,
+        warm_preimport="gpu_docker_api_tpu.workloads.mock_model")
+    app = App(state_dir=state_dir, backend=backend, addr="127.0.0.1:0",
+              topology=make_topology("v4-16"), api_key="",
+              cpu_cores=max(os.cpu_count() or 1, 4))
+    app.start()
+    port = app.server.port
+
+    DECODE_MS, JITTER_S = 20.0, 0.12
+    SENDERS, WARMUP, MEASURE = 3, 120, 360
+    prompt = list(range(16))
+
+    def p99_of(vals):
+        vals = sorted(vals)
+        return (vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+                if vals else None)
+
+    def run_arm(tag: str, defended: bool) -> dict:
+        """Fresh gateway + fresh replicas per arm; r2 gray via its env
+        (the fault key is replica-name-scoped, so the shared env list
+        arms exactly one replica). Kill-switch envs are read at Gateway
+        construction, so they bracket the create call only."""
+        if not defended:
+            os.environ["TDAPI_GW_EJECT"] = "0"
+            os.environ["TDAPI_GW_HEDGE"] = "0"
+        try:
+            call(port, "POST", "/api/v1/gateways", {
+                "name": tag, "image": "python",
+                "cmd": launch_cmd(REPO, "--slots", "4",
+                                  "--decode-ms", str(DECODE_MS)),
+                "env": [f"TDAPI_FAULTS={tag}r2.generate:jitter:"
+                        f"{JITTER_S}"],
+                "minReplicas": 3, "maxReplicas": 3, "port": "8000",
+                "deadlineMs": 30000, "maxQueue": 64,
+                "scaleDownIdleS": 3600, "cooldownS": 1.0})
+        finally:
+            os.environ.pop("TDAPI_GW_EJECT", None)
+            os.environ.pop("TDAPI_GW_HEDGE", None)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            g = call(port, "GET", f"/api/v1/gateways/{tag}")["gateway"]
+            if g["readyReplicas"] >= 3:
+                break
+            time.sleep(0.05)
+        if g["readyReplicas"] < 3:
+            raise RuntimeError(f"{tag}: replicas never became ready")
+
+        body = json.dumps({"tokens": [prompt], "max_new": 2})
+        lock = threading.Lock()
+        lats: list = []
+        errbox = {"errors": 0}
+
+        def send_loop(n_requests: int, measured: bool) -> None:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            try:
+                for _ in range(n_requests):
+                    t1 = time.perf_counter()
+                    try:
+                        conn.request(
+                            "POST",
+                            f"/api/v1/gateways/{tag}/generate", body,
+                            {"Content-Type": "application/json"})
+                        out = json.loads(conn.getresponse().read())
+                        ok = out.get("code") == 200
+                    except Exception:  # noqa: BLE001 — count + fresh conn
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60)
+                        ok = False
+                    ms = (time.perf_counter() - t1) * 1e3
+                    with lock:
+                        if not ok:
+                            errbox["errors"] += 1
+                        elif measured:
+                            lats.append(ms)
+            finally:
+                conn.close()
+
+        def drive(total: int, measured: bool) -> None:
+            per = [total // SENDERS] * SENDERS
+            per[0] += total % SENDERS
+            ts = [threading.Thread(target=send_loop, args=(n, measured))
+                  for n in per]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(300)
+
+        t0 = time.perf_counter()
+        drive(WARMUP, measured=False)      # detector engages in here
+        drive(MEASURE, measured=True)
+        wall_s = time.perf_counter() - t0
+        g = call(port, "GET", f"/api/v1/gateways/{tag}")["gateway"]
+        tt = g.get("tailTolerance", {})
+        call(port, "DELETE", f"/api/v1/gateways/{tag}")
+        out = {
+            "ok": len(lats), "errors": errbox["errors"],
+            "p50_ms": (round(statistics.median(lats), 2)
+                       if lats else None),
+            "p99_ms": round(p99_of(lats), 2) if lats else None,
+            "rps": round((WARMUP + MEASURE) / wall_s, 1),
+            "ejections": tt.get("ejections", 0),
+            "probation_passes": tt.get("probationPasses", 0),
+            "hedges": tt.get("hedges", 0),
+            "hedge_wins": tt.get("hedgeWins", 0),
+            "requests_total": g.get("requestsTotal", 0),
+        }
+        log(f"tail[{'defended' if defended else 'undefended'}]: "
+            f"{out['ok']} ok / {out['errors']} errors, p50 "
+            f"{out['p50_ms']}ms p99 {out['p99_ms']}ms, "
+            f"{out['ejections']} ejections, {out['hedges']} hedges "
+            f"({out['hedge_wins']} wins)")
+        return out
+
+    try:
+        log(f"tail: 3 replicas, r2 gray (jitter median {JITTER_S}s, "
+            f"Pareto tail), {SENDERS} closed-loop senders, "
+            f"{WARMUP} warmup + {MEASURE} measured per arm")
+        dfd = run_arm("tla", defended=True)
+        und = run_arm("tlb", defended=False)
+        p99_scale = (round(dfd["p99_ms"] / und["p99_ms"], 3)
+                     if dfd["p99_ms"] and und["p99_ms"] else None)
+        hedge_pct = (round(100.0 * dfd["hedges"]
+                           / max(dfd["requests_total"], 1), 2)
+                     if dfd["requests_total"] else None)
+        log(f"tail: p99 scale {p99_scale} (<=0.5), hedge overhead "
+            f"{hedge_pct}% (<=5%)")
+        return {
+            "jitter_s": JITTER_S,
+            "decode_ms": DECODE_MS,
+            "requests_per_arm": WARMUP + MEASURE,
+            "defended": dfd,
+            "undefended": und,
+            "tail_p99_ms_scale": p99_scale,
+            "tail_hedge_overhead_pct": hedge_pct,
+            "criteria": {
+                "p99_scale_le_0_5": bool(p99_scale is not None
+                                         and p99_scale <= 0.5),
+                "hedge_overhead_le_5pct": bool(hedge_pct is not None
+                                               and hedge_pct <= 5.0),
+                "gray_replica_ejected": dfd["ejections"] > 0,
+                "informational": "CPU-contended container; the paired "
+                                 "ratio is the signal, absolute ms are "
+                                 "not (docs/serving.md §SLO bench)",
+            },
+        }
+    finally:
+        os.environ.pop("TDAPI_GW_EJECT", None)
+        os.environ.pop("TDAPI_GW_HEDGE", None)
+        try:
+            app.stop()
+        except Exception as e:  # noqa: BLE001
+            log(f"tail bench teardown: {type(e).__name__}: {e}")
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+
 def gateway_mp_bench() -> dict:
     """Multi-process SO_REUSEPORT data plane (server/workers.py): paired
     A/B of sustained generate RPS at workers=1 vs workers=4 against the
@@ -2923,6 +3120,10 @@ def main() -> None:
                 note="kv-routing bench (Zipf shared-prefix workload, "
                      "affinity vs least-queued paired A/B, disagg "
                      "handoff smoke)...")
+    run_section(extra, "tail", tail_bench,
+                note="tail-tolerance bench (one gray jitter-armed "
+                     "replica in a 3-fleet, defended vs "
+                     "TDAPI_GW_EJECT=0 TDAPI_GW_HEDGE=0 paired A/B)...")
     run_section(extra, "gateway_mp", gateway_mp_bench,
                 note="multi-process data-plane bench (SO_REUSEPORT "
                      "workers=1 vs 4, paired, same mock-model "
@@ -3061,6 +3262,10 @@ def build_summary(p50, platform, vs, extra) -> dict:
             "kv_tokens_s_scale": _dig("kv_routing", "kv_tokens_s_scale"),
             "kv_prefix_hit_rate": _dig("kv_routing",
                                        "kv_prefix_hit_rate"),
+            # ISSUE 19 headlines: tail-tolerance paired A/B
+            "tail_p99_ms_scale": _dig("tail", "tail_p99_ms_scale"),
+            "tail_hedge_overhead_pct": _dig("tail",
+                                            "tail_hedge_overhead_pct"),
             # ISSUE 15 headline: worker-tier telemetry plane overhead
             "gw_mp_obs_overhead_pct": _dig("obs_mp",
                                            "gw_mp_obs_overhead_pct"),

@@ -38,6 +38,7 @@ endpoint under each mode.
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 import time
@@ -135,6 +136,14 @@ CRASHPOINTS: dict[str, str] = {
     # must be released by the forward's own unwind (no stuck slot)
     "kvhandoff.after_prefill": "prefill done + prompt KV exported, decode "
                                "phase never dispatched",
+    # hedged requests (gateway.py _forward_hedged / workers.py): the
+    # hedge replica's slot is claimed and the hedge counters are about
+    # to move, but the duplicate call has not been dispatched — a crash
+    # here must leak no inflight claim in either tier (the in-process
+    # gateway's claim dies with the process; the worker's claim ledger
+    # is reconciled by the watchdog)
+    "hedge.in_flight": "hedge slot claimed, duplicate request not yet "
+                       "dispatched",
 }
 
 _lock = threading.Lock()
@@ -234,11 +243,25 @@ FAULT_MODES: dict[str, str] = {
     # kills it mid-protocol, exactly how an OOM kill lands.
     "daemon_kill": "SIGKILL this process at the first crossing (arg = N "
                    "crossings to let through first, default 0)",
+    # gray-failure injection (tail-tolerance e2e): a replica that is
+    # SLOW-but-alive, not dead. jitter draws a heavy-tailed (Pareto)
+    # latency per crossing with scale arg — most crossings add ~arg
+    # seconds, the tail adds many multiples — which is the co-tenant-
+    # interference shape ejection/hedging must catch. Persistent while
+    # armed: a gray replica stays gray until disarmed.
+    "jitter": "sleep a heavy-tailed random latency with scale arg "
+              "(default 0.05) on every crossing, then proceed",
+    # probabilistic flake: InjectedFault with probability arg per
+    # crossing — a replica that intermittently errors without ever
+    # hitting the consecutive-failure FAILED threshold. Persistent
+    # while armed.
+    "flaky": "raise InjectedFault with probability arg (default 0.5) "
+             "per crossing",
 }
 
 _DEFAULT_ARG = {"error_once": 1.0, "error_n": 1.0, "latency": 0.05,
                 "hang": 2.0, "drop_response": 1.0, "partition": 1.0,
-                "daemon_kill": 0.0}
+                "daemon_kill": 0.0, "jitter": 0.05, "flaky": 0.5}
 
 
 class _Fault:
@@ -331,6 +354,18 @@ def fault_gate(op: str) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
     if mode == "latency":
         time.sleep(arg)
+        return
+    if mode == "jitter":
+        # Pareto(α=2) scaled by arg: most crossings sleep ~arg, the tail
+        # sleeps many multiples — gray, not dead. Capped at 20×arg so an
+        # armed test still bounds its own runtime. The sleep runs OUTSIDE
+        # the lock like every other injected delay.
+        u = random.random() or 1e-9
+        time.sleep(min(arg / (u ** 0.5), arg * 20.0))
+        return
+    if mode == "flaky":
+        if random.random() < arg:
+            raise InjectedFault(op, mode)
         return
     if mode == "hang":
         time.sleep(arg)

@@ -44,6 +44,7 @@ import http.client
 import json
 import logging
 import os
+import queue
 import re
 import socket
 import threading
@@ -54,7 +55,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from . import faults, kvaffinity, xerrors
+from . import faults, kvaffinity, tailtolerance, xerrors
 from .dtos import ContainerRun
 from .intents import KIND_GATEWAY
 from .obs import metrics as obs_metrics
@@ -297,6 +298,26 @@ class Gateway:
         # trigger->READY latencies, newest last (bench/status: the event
         # ring under load evicts faster than a run can read it back)
         self.ready_hist: deque = deque(maxlen=64)
+        # tail tolerance (PR 19): gray-failure ejection + probation,
+        # hedged requests, and the retry budget — three policy objects
+        # (tailtolerance module) separable from this transport, each
+        # with its own kill switch. The latency store starts local; the
+        # worker tier swaps in its shm-backed twin so both tiers fold
+        # into, and decide from, the SAME published digests.
+        self._eject_on = tailtolerance.knob(tailtolerance.EJECT_ENV)
+        self._hedge_on = tailtolerance.knob(tailtolerance.HEDGE_ENV)
+        self._retry_budget_on = tailtolerance.knob(
+            tailtolerance.RETRY_BUDGET_ENV)
+        self.lat_store = tailtolerance.LocalLatencyStore()
+        self.probation = tailtolerance.ProbationTracker()
+        self.hedge = tailtolerance.HedgePolicy()
+        self.retry_budget = tailtolerance.RetryBudget()
+        self._fleet_median_ms: Optional[float] = None
+        self.ejections = 0
+        self.probation_passes = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.retry_budget_exhausted = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -496,6 +517,13 @@ class Gateway:
                 return out
             # fall through: pools not split yet, prefill failed, or the
             # request is unsuitable — the shared path serves it whole
+        hedge_delay = None
+        if self._hedge_on and not stream:
+            try:
+                hedge_delay = self.hedge.delay_s(self.lat_store.snapshot)
+            # tdlint: disable=silent-swallow -- the store may be mid-swap at worker-tier teardown; no delay just means no hedge
+            except Exception:  # noqa: BLE001
+                hedge_delay = None
         while True:
             r = self._claim(deadline, high=high, hashes=hashes)
             if r.last_hit > 0:
@@ -506,36 +534,170 @@ class Gateway:
                     self._affinity_event_at = now
                     self._record("router.affinity_hit", replica=r.name,
                                  hitTokens=r.last_hit)
-            left = deadline - time.monotonic()
-            meta: dict = {}
-            try:
-                if stream and self._transport is None:
-                    resp = self._request_stream(r.host_port, body,
-                                                max(left, 0.05))
-                    # the slot stays claimed while the body relays; the
-                    # generator releases it (and prices the latency) on
-                    # completion or client disconnect
-                    return resp.status, self._relay(r, resp, t0)
-                status, payload = self._call(
-                    r.host_port, "POST", "/generate", body,
-                    timeout=max(left, 0.05), meta=meta)
-            except Exception as e:  # noqa: BLE001 — replica gone/slow
-                self._release(r, error=True)
+            if stream and self._transport is None:
+                left = deadline - time.monotonic()
+                resp = self._request_stream(r.host_port, body,
+                                            max(left, 0.05))
+                # the slot stays claimed while the body relays; the
+                # generator releases it (and prices the latency) on
+                # completion or client disconnect
+                return resp.status, self._relay(r, resp, t0)
+            if hedge_delay is not None and self.hedge.peek():
+                out = self._forward_hedged(r, body, deadline,
+                                           hedge_delay, t0)
+            else:
+                out = self._forward_one(r, body, deadline, t0)
+            if isinstance(out, BaseException):
                 if time.monotonic() >= deadline:
                     raise xerrors.GatewayDeadlineError(
                         f"{self.cfg.name}: replicas unreachable "
-                        f"({type(e).__name__})")
+                        f"({type(out).__name__})")
+                # retry budget, not retry-until-deadline: a brownout
+                # that exhausts the bucket sheds 503 + Retry-After
+                # instead of multiplying its own load
+                if (self._retry_budget_on
+                        and not self.retry_budget.try_retry()):
+                    with self._cond:
+                        self.retry_budget_exhausted += 1
+                    raise xerrors.GatewayRetryBudgetError(
+                        f"{self.cfg.name}: retry budget exhausted "
+                        f"({type(out).__name__})")
                 continue                     # another replica, same FIFO
-            if meta:
-                self._note_replica_kv(r, meta)
-            ms = (time.monotonic() - t0) * 1e3
-            self._release(r, latency_ms=ms)
-            obs_metrics.GATEWAY_LATENCY.observe(ms, gateway=self.cfg.name)
+            status, payload = out
+            self.retry_budget.success()
+            self.hedge.feed()
             if stream:
                 # injected transports (tests, perf floor) are buffered
                 # by contract: relay the whole payload as one chunk
                 return status, iter((payload,))
             return status, payload
+
+    def _forward_one(self, r: Replica, body: bytes, deadline: float,
+                     t0: float):
+        """One un-hedged replica attempt. Returns (status, payload), or
+        the exception when the replica failed (the caller owns the
+        retry/shed decision). Folds the SERVICE time (post-claim, so
+        admission queueing never pollutes the gray-failure signal) into
+        the fleet latency digest on success."""
+        meta: dict = {}
+        t_send = time.monotonic()
+        try:
+            status, payload = self._call(
+                r.host_port, "POST", "/generate", body,
+                timeout=max(deadline - time.monotonic(), 0.05),
+                meta=meta)
+        # tdlint: disable=silent-swallow -- not swallowed: the exception is RETURNED and the retry loop records/raises it
+        except Exception as e:  # noqa: BLE001 — replica gone/slow
+            self._release(r, error=True)
+            return e
+        svc_ms = (time.monotonic() - t_send) * 1e3
+        if meta:
+            self._note_replica_kv(r, meta)
+        ms = (time.monotonic() - t0) * 1e3
+        self._release(r, latency_ms=ms, service_ms=svc_ms)
+        obs_metrics.GATEWAY_LATENCY.observe(ms, gateway=self.cfg.name)
+        return status, payload
+
+    def _pick_other(self, primary: Replica) -> Optional[Replica]:
+        """A DIFFERENT healthy ready replica with a free slot, for the
+        hedge (least-queued; probation replicas are never hedge targets
+        — duplicating onto a suspected-gray replica buys nothing).
+        Caller holds _cond and takes the inflight claim itself."""
+        best = None
+        best_score = 0
+        for o in self.replicas.values():
+            if o is primary or o.state is not READY:
+                continue
+            if o.inflight >= o.slots:
+                continue
+            if self._eject_on and self.probation.contains(o.name):
+                continue
+            s = kvaffinity.score(0, o.inflight)
+            if best is None or s < best_score:
+                best, best_score = o, s
+        return best
+
+    def _forward_hedged(self, r: Replica, body: bytes, deadline: float,
+                        hedge_delay: float, t0: float):
+        """Primary attempt plus — if it outlives the fleet-digest hedge
+        delay and the token bucket allows — one duplicate on a different
+        replica. First completion wins and returns; the losing call
+        cannot be cancelled mid-flight, so each attempt thread releases
+        ITS OWN claim on completion (release-on-completion IS the
+        loser-slot-released contract). The hedge claim is BaseException-
+        safe around the hedge.in_flight crashpoint: a crash between
+        claim and dispatch leaks no inflight (the sweep pins this).
+        Returns (status, payload), or the last exception when every
+        attempt failed."""
+        results: queue.Queue = queue.Queue()
+
+        def attempt(rep: Replica, is_hedge: bool) -> None:
+            meta: dict = {}
+            t_send = time.monotonic()
+            try:
+                status, payload = self._call(
+                    rep.host_port, "POST", "/generate", body,
+                    timeout=max(deadline - time.monotonic(), 0.05),
+                    meta=meta)
+            except BaseException as e:  # noqa: BLE001 — the claim must release whatever the transport threw
+                self._release(rep, error=True)
+                results.put((is_hedge, None, None, e))
+                if not isinstance(e, Exception):
+                    raise            # injected crashes stay fatal here
+                return
+            svc_ms = (time.monotonic() - t_send) * 1e3
+            if meta:
+                self._note_replica_kv(rep, meta)
+            ms = (time.monotonic() - t0) * 1e3
+            self._release(rep, latency_ms=ms, service_ms=svc_ms)
+            obs_metrics.GATEWAY_LATENCY.observe(ms,
+                                                gateway=self.cfg.name)
+            results.put((is_hedge, status, payload, None))
+
+        threading.Thread(target=attempt, args=(r, False),
+                         name=f"gw-{self.cfg.name}-fwd",
+                         daemon=True).start()
+        in_flight = 1
+        first = None
+        try:
+            first = results.get(timeout=hedge_delay)
+        except queue.Empty:
+            pass
+        if first is None and self.hedge.take():
+            with self._cond:
+                hr = self._pick_other(r)
+                if hr is not None:
+                    hr.inflight += 1
+            if hr is None:
+                self.hedge.put_back()    # nobody to hedge onto
+            else:
+                try:
+                    faults.crashpoint("hedge.in_flight")
+                except BaseException:
+                    self._release(hr)
+                    raise
+                with self._cond:
+                    self.hedges += 1
+                self._record("gateway.hedged", primary=r.name,
+                             hedge=hr.name)
+                threading.Thread(target=attempt, args=(hr, True),
+                                 name=f"gw-{self.cfg.name}-hedge",
+                                 daemon=True).start()
+                in_flight = 2
+        taken = 0
+        while True:
+            if first is None:
+                first = results.get()
+            taken += 1
+            is_hedge, status, payload, exc = first
+            first = None
+            if exc is None:
+                if is_hedge:
+                    with self._cond:
+                        self.hedge_wins += 1
+                return status, payload
+            if taken >= in_flight:
+                return exc           # every attempt failed
 
     def _forward_disagg(self, body: bytes, tokens: list,
                         hashes: Optional[list], deadline: float,
@@ -757,31 +919,69 @@ class Gateway:
         order, never overrides a visibly shorter queue). `pool` filters
         to one disaggregation pool by idx parity, degrading to the full
         roster when that pool has no capacity (availability over
-        purity)."""
-        cands = [r for r in self.replicas.values()
-                 if r.state is READY and r.inflight < r.slots]
+        purity).
+
+        Probation (gray-failure ejection) COMPOSES with the affinity
+        score rather than filtering: an ejected replica is penalized by
+        PENALTY_SCORE, so it serves only when every healthy replica is
+        saturated (availability over purity again) — except when its
+        trickle probe is due and it sits idle, in which case it wins
+        outright (the request IS the probe). FAILED replicas in
+        probation are candidates only as due idle probes: that is the
+        no-scale-cycle recovery path for transport strikes."""
+        eject_on = self._eject_on
+        cands = []
+        for r in self.replicas.values():
+            if r.inflight >= r.slots:
+                continue
+            if r.state is READY:
+                cands.append(r)
+            elif (eject_on and r.state is FAILED and r.inflight == 0
+                  and self.probation.contains(r.name)
+                  and self.probation.probe_due(r.name)):
+                cands.append(r)
         if pool is not None:
             pooled = [r for r in cands if r.role == pool]
             if pooled:
                 cands = pooled
         best = None
         best_score = best_hit = 0
+        best_probe = False
         for r in cands:
             hit = (kvaffinity.hit_tokens(r.kv_sketch, hashes)
                    if hashes else 0)
             s = kvaffinity.score(hit, r.inflight)
+            probe = False
+            if eject_on and self.probation.contains(r.name):
+                if r.inflight == 0 and self.probation.probe_due(r.name):
+                    probe = True
+                    s -= tailtolerance.PENALTY_SCORE
+                else:
+                    s += tailtolerance.PENALTY_SCORE
             if best is None or s < best_score:
                 best, best_score, best_hit = r, s, hit
+                best_probe = probe
         if best is not None:
             best.last_hit = best_hit
             if best_hit > 0:
                 self.affinity_hits += 1        # under _cond (callers)
                 self.affinity_tokens += best_hit
+            if best_probe:
+                self.probation.note_probe(best.name)
         return best
 
     def _release(self, r: Replica, latency_ms: Optional[float] = None,
-                 error: bool = False) -> None:
+                 error: bool = False,
+                 service_ms: Optional[float] = None) -> None:
+        """Release the claimed slot. `service_ms` (post-claim replica
+        time, admission queueing excluded) feeds the gray-failure
+        latency digest; for a replica in probation the completion is
+        also its probe verdict — N consecutive passes re-admit it (and
+        heal a transport-strike FAILED back to READY without waiting
+        for an autoscaler warm re-admission)."""
         down = False
+        readmitted = False
+        row = None
         with self._cond:
             r.inflight = max(r.inflight - 1, 0)
             # activity includes COMPLETIONS: stamping only arrivals made
@@ -789,20 +989,72 @@ class Gateway:
             # idle window the instant it finished, and the autoscaler
             # scaled the just-used replica away under the next burst
             self._last_request = time.monotonic()
+            in_prob = (self._eject_on
+                       and self.probation.contains(r.name))
             if error:
                 r.failures += 1
+                if in_prob:
+                    self.probation.verdict(r.name, ok=False)
                 if r.failures >= self.MAX_FAILURES and r.state is READY:
                     r.state = FAILED
                     down = True
+                    if self._eject_on:
+                        # FAILED is no longer terminal-until-scale: it
+                        # heals through the same probation/trickle-probe
+                        # path a latency ejection uses
+                        self.probation.eject(r.name, kind="failed")
             else:
                 r.failures = 0
                 if latency_ms is not None:
                     self._lat.append((time.monotonic(), latency_ms))
+                if service_ms is not None:
+                    # digest row = rank of idx, matching the sorted-by-
+                    # idx order router_state() publishes to the workers
+                    row = sum(1 for o in self.replicas.values()
+                              if o.idx < r.idx)
+                if in_prob:
+                    ok = (service_ms is None
+                          or self._probe_pass(service_ms))
+                    if self.probation.verdict(r.name, ok=ok):
+                        readmitted = True
+                        self.probation_passes += 1
+                        r.failures = 0
+                        if r.state is FAILED:
+                            r.state = READY
             self._cond.notify_all()
+        if row is not None and not readmitted:
+            try:
+                self.lat_store.fold(row, service_ms)
+            # tdlint: disable=silent-swallow -- the shm-backed store may be mid-teardown with the worker tier; a dropped sample is noise
+            except Exception:  # noqa: BLE001
+                pass
+        if readmitted:
+            if row is not None:
+                try:
+                    # drop the gray-era history so the next ejection
+                    # tick judges the healed replica on fresh samples
+                    self.lat_store.reset(row)
+                # tdlint: disable=silent-swallow -- same teardown race as the fold above
+                except Exception:  # noqa: BLE001
+                    pass
+            self._record("gateway.probation_pass", replica=r.name)
+            self._changed()
         if down:
             self._record("gateway.replica_down", replica=r.name,
                          code=500, failures=r.failures)
             self._changed()
+
+    def _probe_pass(self, service_ms: float) -> bool:
+        """A probation probe passes when its service time sits under the
+        same bar ejection uses (k × healthy-fleet median p95, floored),
+        as cached at the last ejection tick. With no baseline yet, any
+        completed request passes — the fleet has nothing to compare
+        against."""
+        med = self._fleet_median_ms
+        if med is None:
+            return True
+        return service_ms <= max(tailtolerance.EJECT_K * med,
+                                 tailtolerance.EJECT_FLOOR_MS)
 
     # --------------------------------------------------- the autoscaler
 
@@ -841,9 +1093,53 @@ class Gateway:
         while not self._stop.wait(self.TICK_S):
             try:
                 self._probe_starting()
+                self._eval_eject()
                 self._decide()
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("gateway %s autoscale tick", self.cfg.name)
+
+    def _eval_eject(self) -> None:
+        """Gray-failure ejection tick: run tailtolerance.eject_set over
+        the fleet latency digests (local, or shm-published when a worker
+        tier rebinds the store) and move outliers into probation. The
+        worker tier runs the SAME pure function over the SAME shm cells,
+        so both tiers make identical ejection decisions with zero daemon
+        round-trips."""
+        if not self._eject_on:
+            return
+        try:
+            snap = self.lat_store.snapshot()
+        # tdlint: disable=silent-swallow -- store mid-swap at worker-tier teardown: skip this tick, the next one sees the rebound store
+        except Exception:  # noqa: BLE001
+            return
+        newly = []
+        with self._cond:
+            reps = sorted(self.replicas.values(), key=lambda o: o.idx)
+            self.probation.prune({o.name for o in reps
+                                  if o.state in (READY, FAILED)})
+            ready = [(row, o) for row, o in enumerate(reps)
+                     if o.state is READY]
+            already = frozenset(o.name for _, o in ready
+                                if self.probation.contains(o.name))
+            stats = [(o.name, snap[row][2], snap[row][0])
+                     for row, o in ready if row in snap]
+            self._fleet_median_ms = tailtolerance.fleet_median_p95(
+                stats, already=already)
+            target = tailtolerance.eject_set(stats, already=already,
+                                             fleet=len(ready))
+            for name in target:
+                if self.probation.eject(name, kind="latency"):
+                    self.ejections += 1
+                    p95 = next(p for n, p, _ in stats if n == name)
+                    newly.append((name, p95))
+        for name, p95 in newly:
+            self._record(
+                "gateway.ejected", replica=name, p95Ms=round(p95, 3),
+                medianMs=(round(self._fleet_median_ms, 3)
+                          if self._fleet_median_ms is not None
+                          else None))
+        if newly:
+            self._changed()
 
     def _decide(self) -> None:
         s = self._signals()
@@ -1052,7 +1348,8 @@ class Gateway:
                 tpuCount=cfg.tpuCount, cpuCount=cfg.cpuCount,
                 memory=cfg.memory, priority=cfg.priority,
                 cmd=list(cfg.cmd),
-                env=list(cfg.env) + [f"TDAPI_GATEWAY={cfg.name}"],
+                env=list(cfg.env) + [f"TDAPI_GATEWAY={cfg.name}",
+                                     f"TDAPI_REPLICA={rname}"],
                 containerPorts=[cfg.port])
             resp = self._svc.run_container(req, clone_from=donor,
                                            share_avoid=avoid or None,
@@ -1090,6 +1387,7 @@ class Gateway:
             r.state = STARTING
             r.failures = 0
             r.started_at = time.monotonic()
+            self.probation.drop(r.name)    # fresh start, fresh record
         return {"replica": r.name, "container": resp["name"], "warm": True}
 
     def _adopt_response(self, r: Replica, resp: dict) -> None:
@@ -1132,6 +1430,7 @@ class Gateway:
             r.inflight = 0
             self.scale_downs += 1
             self._last_scale = time.monotonic()
+            self.probation.drop(rname)
         self._record("gateway.scale_down", replica=rname, reason=reason)
         self._changed()
 
@@ -1139,11 +1438,31 @@ class Gateway:
 
     def describe(self) -> dict:
         with self._cond:
-            reps = [r.describe() for r in
-                    sorted(self.replicas.values(), key=lambda r: r.idx)]
+            reps = []
+            for r in sorted(self.replicas.values(), key=lambda o: o.idx):
+                d = r.describe()
+                d["probation"] = (self._eject_on
+                                  and self.probation.contains(r.name))
+                reps.append(d)
             queued = self._queued
+            tail = {
+                "ejectEnabled": self._eject_on,
+                "hedgeEnabled": self._hedge_on,
+                "retryBudgetEnabled": self._retry_budget_on,
+                "probation": self.probation.describe(),
+                "ejections": self.ejections,
+                "probationPasses": self.probation_passes,
+                "hedges": self.hedges,
+                "hedgeWins": self.hedge_wins,
+                "retryBudgetExhausted": self.retry_budget_exhausted,
+                "retryTokens": round(self.retry_budget.tokens, 3),
+                "fleetMedianMs": (round(self._fleet_median_ms, 3)
+                                  if self._fleet_median_ms is not None
+                                  else None),
+            }
         p99 = self.p99_ms()
         return {
+            "tailTolerance": tail,
             "name": self.cfg.name,
             "config": self.cfg.to_json(),
             "replicas": reps,

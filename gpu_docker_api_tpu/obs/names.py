@@ -65,6 +65,12 @@ EVENT_OPS = frozenset({
     # scorer steered a request onto a prefix-warm replica
     "gateway.kv_handoff",
     "router.affinity_hit",
+    # tail-tolerant serving (PR 19): gray-failure ejection into
+    # probation, trickle-probe re-admission, and a dispatched hedge
+    # (duplicate request racing a slow primary)
+    "gateway.ejected",
+    "gateway.probation_pass",
+    "gateway.hedged",
     # multi-process data-plane worker tier (server/workers.py)
     "gateway.worker_respawn",
     # watchdog-reaped dead worker: flight-recorder segment + claim-
@@ -174,6 +180,13 @@ METRIC_NAMES = frozenset({
     "tdapi_gw_affinity_tokens_total",
     "tdapi_kv_prefix_blocks",
     "tdapi_kv_prefix_handoffs_total",
+    # tail tolerance (PR 19): gray-failure ejections, dispatched hedges
+    # and hedge wins, and retry-budget shed totals — in-process router +
+    # worker-tier shm counters, summed at scrape
+    "tdapi_gateway_ejections_total",
+    "tdapi_gateway_hedges_total",
+    "tdapi_gateway_hedge_wins_total",
+    "tdapi_gateway_retry_budget_exhausted_total",
     # cross-process telemetry plane: shared-memory metric shards of the
     # multi-process worker tier (obs/shm_metrics.py, summed at scrape by
     # the server/app.py collect callback). Declared in BOTH serving

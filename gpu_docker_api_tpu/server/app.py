@@ -985,6 +985,16 @@ class App:
             return Response(ResCode.GatewayTimeout, None, msg=str(e),
                             http_status=504,
                             headers={"Retry-After": "1"})
+        except xerrors.GatewayRetryBudgetError as e:
+            # retry-budget exhaustion sheds instead of amplifying a
+            # brownout: 503 with a Retry-After the client can honor
+            self.events.record("gateway.shed", target=req.params["name"],
+                               code=int(ResCode.BackendUnavailable),
+                               reason="retry_budget",
+                               request_id=req.request_id)
+            return Response(ResCode.BackendUnavailable, None, msg=str(e),
+                            http_status=503,
+                            headers={"Retry-After": str(e.retry_after)})
         except Exception:  # noqa: BLE001
             log.exception("gateway generate failed [%s]", req.request_id)
             return err(ResCode.GatewayRequestFailed)
@@ -1270,6 +1280,10 @@ class App:
                           "dropped": self.wq.dropped_count()},
             "workers": (self.workers.describe()
                         if self.workers is not None else None),
+            # per-gateway tail-tolerance posture: knobs, probation roster,
+            # ejection/hedge/retry-budget counters (gateway.py describe)
+            "gateways": {g["name"]: {"tailTolerance": g["tailTolerance"]}
+                         for g in self.gateways.list()},
             "reconcileActions": self.last_reconcile["actions"],
             "storeReadOnly": read_only,
             "replication": (self.replicator.describe()
@@ -1485,6 +1499,26 @@ class App:
             "tdapi_kv_prefix_handoffs_total",
             "disaggregated prefill->decode KV handoffs completed",
             labels=("gateway",), typ="counter")
+        # tail-tolerant serving (PR 19): gray-failure ejections by the
+        # in-process router's control loop; hedges/wins and retry-budget
+        # sheds are per-tier counters folded at scrape (same parity
+        # contract as the request counters above)
+        g_gw_eject = m.gauge(
+            "tdapi_gateway_ejections_total",
+            "replicas ejected into probation by the latency outlier "
+            "detector", labels=("gateway",), typ="counter")
+        g_gw_hedge = m.gauge(
+            "tdapi_gateway_hedges_total",
+            "hedged (duplicated) requests dispatched against a slow "
+            "primary", labels=("gateway",), typ="counter")
+        g_gw_hedge_win = m.gauge(
+            "tdapi_gateway_hedge_wins_total",
+            "hedged requests whose duplicate finished first",
+            labels=("gateway",), typ="counter")
+        g_gw_rb = m.gauge(
+            "tdapi_gateway_retry_budget_exhausted_total",
+            "requests shed 503 because the retry token bucket was empty",
+            labels=("gateway",), typ="counter")
         # multi-process data-plane worker tier (server/workers.py +
         # obs/shm_metrics.py). Declared UNCONDITIONALLY: family presence
         # must not depend on TDAPI_GW_WORKERS, or dashboards built in one
@@ -1609,8 +1643,9 @@ class App:
                     g.set(0)
             for g in (g_gw_rep, g_gw_q, g_gw_in, g_gw_req, g_gw_shed,
                       g_gw_scale, g_gw_aff, g_gw_aff_tok, g_kv_blocks,
-                      g_kv_handoff, g_wk_req, g_wk_shed, g_wk_dead,
-                      g_wk_retry):
+                      g_kv_handoff, g_gw_eject, g_gw_hedge,
+                      g_gw_hedge_win, g_gw_rb, g_wk_req, g_wk_shed,
+                      g_wk_dead, g_wk_retry):
                 g.reset()
             # worker-tier counts fold into the SAME gateway families the
             # in-process router feeds (metric-family parity: a dashboard
@@ -1645,6 +1680,16 @@ class App:
                                  + wk.get("affinityTokens", 0),
                                  gateway=name)
                 g_kv_handoff.set(gw.get("kvHandoffs", 0), gateway=name)
+                tt = gw.get("tailTolerance", {})
+                g_gw_eject.set(tt.get("ejections", 0), gateway=name)
+                g_gw_hedge.set(tt.get("hedges", 0)
+                               + wk.get("hedges", 0), gateway=name)
+                g_gw_hedge_win.set(tt.get("hedgeWins", 0)
+                                   + wk.get("hedgeWins", 0),
+                                   gateway=name)
+                g_gw_rb.set(tt.get("retryBudgetExhausted", 0)
+                            + wk.get("retryBudgetExhausted", 0),
+                            gateway=name)
                 for r in gw["replicas"]:
                     if r.get("kvOcc"):
                         g_kv_blocks.set(r["kvOcc"], gateway=name,

@@ -47,6 +47,7 @@ import glob
 import json
 import logging
 import os
+import queue
 import signal
 import socket
 import struct
@@ -57,7 +58,7 @@ from collections import deque
 from multiprocessing import get_context, shared_memory
 from typing import Callable, Optional
 
-from .. import kvaffinity
+from .. import faults, kvaffinity, tailtolerance
 from .._native import load
 from ..obs import shm_metrics
 from ..obs import trace
@@ -110,14 +111,23 @@ CONF_SZ = MAX_GATEWAYS * GW_CONF_SZ
 # counter region (atomics, NEVER seqlock-protected): per gateway
 #   gen | queued | relseq | requests_total | shed_total | wake_hint |
 #   affinity_hits_total | affinity_tokens_total |
+#   hedges_total | hedge_wins_total | retry_budget_exhausted_total |
+#   reserved |
 #   per replica: inflight | errors | kv_gen | kv_occ | sketch[KV_SKETCH]
+#                | lat_gen | lat_count | lat_ewma_us | lat_p95_us
 # The kv cells (gen + occ + sketch words) form a mini-seqlock group
 # (shm_cells_publish/read): workers fold each replica RESPONSE's
 # advertised prefix sketch in, and the claim path reads it for affinity
-# scoring — torn reads degrade to "no sketch", never retry.
+# scoring — torn reads degrade to "no sketch", never retry. The lat
+# cells are a second mini-seqlock group holding the replica's service-
+# time digest (tailtolerance.LatencyDigest.to_cells): BOTH router tiers
+# fold responses into it and BOTH run tailtolerance.eject_set over it,
+# which is what makes their gray-failure ejection decisions identical
+# with zero daemon round-trips.
 KV_SKETCH_WORDS = 4                    # = kvaffinity.SKETCH_WORDS
-GW_CNT_WORDS = 8
-REP_CNT_WORDS = 2 + 1 + 1 + KV_SKETCH_WORDS
+LAT_CELL_WORDS = 3                     # count | ewma_us | p95_us
+GW_CNT_WORDS = 12
+REP_CNT_WORDS = 2 + 1 + 1 + KV_SKETCH_WORDS + 1 + LAT_CELL_WORDS
 GW_CNT_SZ = 8 * (GW_CNT_WORDS + MAX_REPLICAS * REP_CNT_WORDS)
 CNT_OFF = CONF_OFF + CONF_SZ
 CNT_SZ = MAX_GATEWAYS * GW_CNT_SZ
@@ -146,6 +156,12 @@ def _rep_cnt_off(g: int, r: int) -> int:
 def _rep_kv_off(g: int, r: int) -> int:
     """Replica's kv cell group: gen word, then occ + sketch words."""
     return _rep_cnt_off(g, r) + 16
+
+
+def _rep_lat_off(g: int, r: int) -> int:
+    """Replica's latency-digest cell group: gen word, then the
+    count | ewma_us | p95_us digest cells."""
+    return _rep_cnt_off(g, r) + 8 * (2 + 1 + 1 + KV_SKETCH_WORDS)
 
 
 def _wk_off(w: int) -> int:
@@ -312,6 +328,10 @@ class SharedRouterState:
                     self.store(_gw_cnt_off(g) + 40, 0)    # wake_hint
                     self.store(_gw_cnt_off(g) + 48, 0)    # affinity_hits
                     self.store(_gw_cnt_off(g) + 56, 0)    # affinity_tokens
+                    self.store(_gw_cnt_off(g) + 64, 0)    # hedges
+                    self.store(_gw_cnt_off(g) + 72, 0)    # hedge_wins
+                    self.store(_gw_cnt_off(g) + 80, 0)    # budget_exhausted
+                    self.store(_gw_cnt_off(g) + 88, 0)    # reserved
                     for r in range(MAX_REPLICAS):
                         # inflight, errors, AND the kv sketch group —
                         # the new tenant must not inherit the old one's
@@ -386,6 +406,9 @@ class SharedRouterState:
                 "wakeHint": self.load(_gw_cnt_off(g) + 40),
                 "affinityHits": self.load(_gw_cnt_off(g) + 48),
                 "affinityTokens": self.load(_gw_cnt_off(g) + 56),
+                "hedges": self.load(_gw_cnt_off(g) + 64),
+                "hedgeWins": self.load(_gw_cnt_off(g) + 72),
+                "retryBudgetExhausted": self.load(_gw_cnt_off(g) + 80),
                 "inflight": [self.load(_rep_cnt_off(g, r))
                              for r in range(MAX_REPLICAS)]}
 
@@ -417,6 +440,37 @@ class SharedRouterState:
         if occ <= 0 and not any(words):
             return None
         return occ, words
+
+    def publish_replica_lat(self, g: int, r: int, cells) -> None:
+        """Publish one replica's latency-digest cells
+        (count | ewma_us | p95_us) through its mini-seqlock group.
+        Racing folders lose benignly — a dropped sample is noise."""
+        vals = (ctypes.c_int64 * LAT_CELL_WORDS)(*(int(c) for c in cells))
+        self.lib.shm_cells_publish(self.base + _rep_lat_off(g, r),
+                                   self.base + _rep_lat_off(g, r) + 8,
+                                   vals, LAT_CELL_WORDS)
+
+    def read_replica_lat(self, g: int, r: int):
+        """(count, ewma_us, p95_us) or None on a torn read / no samples.
+        One attempt, no retry: None degrades to 'no gray-failure signal
+        for this replica', which can only under-eject — always safe."""
+        out = (ctypes.c_int64 * LAT_CELL_WORDS)()
+        if self.lib.shm_cells_read(self.base + _rep_lat_off(g, r),
+                                   self.base + _rep_lat_off(g, r) + 8,
+                                   out, LAT_CELL_WORDS):
+            return None
+        if out[0] <= 0:
+            return None
+        return out[0], out[1], out[2]
+
+    def fold_replica_lat(self, g: int, r: int, ms: float) -> None:
+        """Read-modify-publish one service-time sample into the digest
+        cells (tailtolerance.fold_cells). Both tiers call this on every
+        response, which is what keeps their ejection inputs identical."""
+        from .. import tailtolerance
+        self.publish_replica_lat(
+            g, r, tailtolerance.fold_cells(self.read_replica_lat(g, r),
+                                           ms))
 
     def reconcile_worker(self, w: int) -> int:
         """Subtract a dead worker's held claims + queue tickets from the
@@ -516,6 +570,80 @@ class WorkerRouter:
         # least-queued (kvaffinity.score) — turning it off restores the
         # exact prior pick, which is also what the paired bench compares.
         self._affinity = os.environ.get("TDAPI_GW_AFFINITY", "1") != "0"
+        # tail tolerance (PR 19): the same policy objects the in-process
+        # Gateway runs, over the shm latency-digest cells — so both
+        # tiers make identical gray-failure decisions from the same
+        # state. The eject set is recomputed (not tracked): the worker
+        # tier's probation is pure shm-derived, matching the daemon's
+        # tracker because both call tailtolerance.eject_set over the
+        # same cells.
+        self._eject_on = tailtolerance.knob(tailtolerance.EJECT_ENV)
+        self._hedge_on = tailtolerance.knob(tailtolerance.HEDGE_ENV)
+        self._retry_on = tailtolerance.knob(
+            tailtolerance.RETRY_BUDGET_ENV)
+        self._eject_cache: dict[int, tuple] = {}
+        self._eject_lock = threading.Lock()
+        self._hedges: dict[int, tailtolerance.HedgePolicy] = {}
+        self._budgets: dict[int, tailtolerance.RetryBudget] = {}
+
+    def _hedge(self, g: int) -> tailtolerance.HedgePolicy:
+        h = self._hedges.get(g)
+        if h is None:
+            h = self._hedges.setdefault(g, tailtolerance.HedgePolicy())
+        return h
+
+    def _budget(self, g: int) -> tailtolerance.RetryBudget:
+        b = self._budgets.get(g)
+        if b is None:
+            b = self._budgets.setdefault(g, tailtolerance.RetryBudget())
+        return b
+
+    def _lat_snapshot(self, gw: dict) -> dict:
+        """{row: (count, ewma_ms, p95_ms)} from the shm digest cells —
+        the worker-side twin of LocalLatencyStore.snapshot()."""
+        st = self.state
+        g = gw["slot"]
+        snap = {}
+        for r in gw["replicas"]:
+            cells = st.read_replica_lat(g, r["idx"])
+            if cells is not None:
+                snap[r["idx"]] = (cells[0], cells[1] / 1e3,
+                                  cells[2] / 1e3)
+        return snap
+
+    def _ejected(self, gw: dict) -> frozenset:
+        """Rows currently score-penalized as gray, minus the row whose
+        deterministic trickle-probe window is open right now. Recomputed
+        from the shm digests every WORKER_PROBE_WINDOW_S — the worker
+        tier keeps no probation state, so its probation IS the
+        recomputed eject set: the same pure tailtolerance.eject_set over
+        the same shm-published cells the daemon gateway reads, hence
+        identical ejection decisions in both tiers."""
+        if not self._eject_on:
+            return frozenset()
+        g = gw["slot"]
+        now = time.monotonic()
+        with self._eject_lock:
+            hit = self._eject_cache.get(g)
+            if (hit is not None and hit[0] > now
+                    and hit[2] == self._roster_epoch):
+                ej = hit[1]
+            else:
+                ready = [r["idx"] for r in gw["replicas"]
+                         if r["ready"] and r["port"]]
+                snap = self._lat_snapshot(gw)
+                stats = [(row, snap[row][2], snap[row][0])
+                         for row in ready if row in snap]
+                ej = frozenset(tailtolerance.eject_set(
+                    stats, fleet=len(ready)))
+                self._eject_cache[g] = (
+                    now + tailtolerance.WORKER_PROBE_WINDOW_S, ej,
+                    self._roster_epoch)
+        if ej:
+            probe = tailtolerance.trickle_allow(sorted(ej), now)
+            if probe is not None:
+                ej = ej - {probe}
+        return ej
 
     def _view(self, g: int):
         """This worker's precomputed shard view for gateway slot `g`
@@ -600,6 +728,7 @@ class WorkerRouter:
         fails the request while a healthy one exists)."""
         st = self.state
         g = gw["slot"]
+        ejected = self._ejected(gw)
         ready = []
         for r in gw["replicas"]:
             if not r["ready"] or not r["port"] or r["idx"] in avoid:
@@ -610,7 +739,14 @@ class WorkerRouter:
                 kv = st.read_replica_kv(g, r["idx"])
                 if kv is not None:
                     hit = kvaffinity.hit_tokens(kv[1], hashes)
-            ready.append((kvaffinity.score(hit, inflight), hit, r))
+            score = kvaffinity.score(hit, inflight)
+            if r["idx"] in ejected:
+                # gray-failure probation: composed ON TOP of the
+                # affinity score, so an ejected replica serves only when
+                # every healthy one is saturated (availability over
+                # purity) — the same contract as Gateway._pick
+                score += tailtolerance.PENALTY_SCORE
+            ready.append((score, hit, r))
         ready.sort(key=lambda t: t[0])
         for _, hit, r in ready:
             off = _rep_cnt_off(g, r["idx"])
@@ -814,6 +950,10 @@ class WorkerRouter:
             # always-on cost stays off the untraced hot path
             self._note("req", gw=name)
         hashes = self._prefix_hashes(body) if self._affinity else None
+        hedge_delay = None
+        if self._hedge_on:
+            hedge_delay = self._hedge(g).delay_s(
+                lambda: self._lat_snapshot(gw))
         avoid: set = set()
         while True:
             if detailed:
@@ -825,34 +965,59 @@ class WorkerRouter:
                 c = self._claim(name, gw, deadline, high=high,
                                 avoid=frozenset(avoid), hashes=hashes)
             left = deadline - time.monotonic()
-            try:
-                with (trace.span("gateway.forward", target=name,
-                                 replica=c.rep, port=c.port)
-                      if detailed
-                      else contextlib.nullcontext(
-                          trace.current())) as fsp:
-                    status, payload, qwait, kv = self._call(
-                        c.port, body, timeout=max(left, 0.05))
-                    if fsp is not None and qwait is not None:
-                        # replica-side batcher queue wait, advertised on
-                        # the response: the replica's contribution to
-                        # this span's time, stitched without a replica-
-                        # side collector (root-level event when the
-                        # request is not client-traced)
-                        fsp.event("replica.queue_wait", ms=qwait)
-            except Exception as e:  # noqa: BLE001 — replica gone/slow
-                self._release(c)
-                st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)  # errors
+            exc = None
+            if hedge_delay is not None and self._hedge(g).peek():
+                out = self._forward_hedged(name, gw, c, body, deadline,
+                                           t0, hedge_delay, view)
+                if isinstance(out, BaseException):
+                    exc = out        # attempts released + counted errors
+                else:
+                    self._budget(g).success()
+                    self._hedge(g).feed()
+                    return out
+            else:
+                t_send = time.monotonic()
+                try:
+                    with (trace.span("gateway.forward", target=name,
+                                     replica=c.rep, port=c.port)
+                          if detailed
+                          else contextlib.nullcontext(
+                              trace.current())) as fsp:
+                        status, payload, qwait, kv = self._call(
+                            c.port, body, timeout=max(left, 0.05))
+                        if fsp is not None and qwait is not None:
+                            # replica-side batcher queue wait, advertised
+                            # on the response: the replica's contribution
+                            # to this span's time, stitched without a
+                            # replica-side collector (root-level event
+                            # when the request is not client-traced)
+                            fsp.event("replica.queue_wait", ms=qwait)
+                # tdlint: disable=silent-swallow -- not swallowed: exc feeds the retry path below, which notes/raises it
+                except Exception as e:  # noqa: BLE001 — replica gone/slow
+                    self._release(c)
+                    st.add(_rep_cnt_off(c.gslot, c.rep) + 8, 1)  # errors
+                    exc = e
+            if exc is not None:
                 if view is not None:
                     view.inc_retries()
                 self._note("retry", gw=name, replica=c.rep,
-                           error=type(e).__name__)
+                           error=type(exc).__name__)
                 if time.monotonic() >= deadline:
                     if view is not None:
                         view.inc_deadline()
                     raise xerrors.GatewayDeadlineError(
                         f"{name}: replicas unreachable "
-                        f"({type(e).__name__})")
+                        f"({type(exc).__name__})")
+                # retry budget, not retry-until-deadline: a brownout
+                # that exhausts the bucket sheds 503 + Retry-After
+                # instead of multiplying its own load
+                if (self._retry_on
+                        and not self._budget(g).try_retry()):
+                    st.add(_gw_cnt_off(g) + 80, 1)
+                    self._note("budget_shed", gw=name)
+                    raise xerrors.GatewayRetryBudgetError(
+                        f"{name}: retry budget exhausted "
+                        f"({type(exc).__name__})")
                 avoid.add(c.rep)
                 fresh = self._gateway(name)
                 if fresh is not None:
@@ -861,16 +1026,105 @@ class WorkerRouter:
                                      if r["ready"] and r["port"]):
                     avoid.clear()    # every replica failed once: retry all
                 continue
+            svc_ms = (time.monotonic() - t_send) * 1e3
             self._release(c)
-            if kv is not None and st.load(_gw_cnt_off(c.gslot)) == c.gen:
-                # fold the replica's advertised prefix sketch into its
-                # shm kv cells so EVERY worker's next claim sees it —
-                # this is the only write path; the route path never asks
-                # the daemon (or the replica) anything
-                st.publish_replica_kv(c.gslot, c.rep, kv[0], kv[1])
+            if st.load(_gw_cnt_off(c.gslot)) == c.gen:
+                # fold the replica's advertised prefix sketch + this
+                # response's service time into its shm cells so EVERY
+                # worker's (and the daemon's) next decision sees them —
+                # these are the only write paths; the route path never
+                # asks the daemon (or the replica) anything
+                st.fold_replica_lat(c.gslot, c.rep, svc_ms)
+                if kv is not None:
+                    st.publish_replica_kv(c.gslot, c.rep, kv[0], kv[1])
+            self._budget(g).success()
+            self._hedge(g).feed()
             if view is not None:
                 view.observe_latency((time.monotonic() - t0) * 1e3)
             return status, payload
+
+    def _forward_hedged(self, name: str, gw: dict, c: _Claim,
+                        body: bytes, deadline: float, t0: float,
+                        hedge_delay: float, view):
+        """Worker-tier hedge race — Gateway._forward_hedged's shape over
+        shm claims. The primary runs on its own thread; if it outlives
+        the digest-derived delay and the token bucket allows, ONE
+        duplicate is claimed (never onto the primary) and dispatched.
+        First completion wins; the loser cannot be cancelled mid-flight,
+        so each attempt thread releases its own claim on completion.
+        The hedge claim is BaseException-safe around the hedge.in_flight
+        crashpoint (the crash sweep pins no leaked claims). Returns
+        (status, payload), or the last exception when every attempt
+        failed — the caller owns the retry/shed decision."""
+        st = self.state
+        results: queue.Queue = queue.Queue()
+
+        def attempt(cl: _Claim, is_hedge: bool) -> None:
+            t_send = time.monotonic()
+            try:
+                status, payload, _qwait, kv = self._call(
+                    cl.port, body,
+                    timeout=max(deadline - time.monotonic(), 0.05))
+            except BaseException as e:  # noqa: BLE001 — the claim must release whatever the transport threw
+                self._release(cl)
+                st.add(_rep_cnt_off(cl.gslot, cl.rep) + 8, 1)  # errors
+                results.put((is_hedge, None, None, e))
+                if not isinstance(e, Exception):
+                    raise            # injected crashes stay fatal here
+                return
+            svc_ms = (time.monotonic() - t_send) * 1e3
+            self._release(cl)
+            if st.load(_gw_cnt_off(cl.gslot)) == cl.gen:
+                st.fold_replica_lat(cl.gslot, cl.rep, svc_ms)
+                if kv is not None:
+                    st.publish_replica_kv(cl.gslot, cl.rep,
+                                          kv[0], kv[1])
+            results.put((is_hedge, status, payload, None))
+
+        threading.Thread(target=attempt, args=(c, False),
+                         name=f"wk{self.widx}-fwd", daemon=True).start()
+        in_flight = 1
+        first = None
+        try:
+            first = results.get(timeout=hedge_delay)
+        except queue.Empty:
+            pass
+        hedge = self._hedge(c.gslot)
+        if first is None and hedge.take():
+            # never hedge onto the primary; ejected rows are score-
+            # penalized inside _try_claim, so a gray replica is the
+            # hedge target only when nothing else has capacity
+            hc = self._try_claim(gw, avoid=frozenset({c.rep}))
+            if hc is None:
+                hedge.put_back()     # nobody to hedge onto
+            else:
+                try:
+                    faults.crashpoint("hedge.in_flight")
+                except BaseException:
+                    self._release(hc)
+                    raise
+                st.add(_gw_cnt_off(c.gslot) + 64, 1)      # hedges
+                self._note("hedge", gw=name, primary=c.rep,
+                           replica=hc.rep)
+                threading.Thread(target=attempt, args=(hc, True),
+                                 name=f"wk{self.widx}-hedge",
+                                 daemon=True).start()
+                in_flight = 2
+        taken = 0
+        while True:
+            if first is None:
+                first = results.get()
+            taken += 1
+            is_hedge, status, payload, exc = first
+            first = None
+            if exc is None:
+                if is_hedge:
+                    st.add(_gw_cnt_off(c.gslot) + 72, 1)  # hedge_wins
+                if view is not None:
+                    view.observe_latency((time.monotonic() - t0) * 1e3)
+                return status, payload
+            if taken >= in_flight:
+                return exc           # every attempt failed
 
     # ---- HTTP handlers (the worker's route table) ------------------------
 
@@ -925,6 +1179,12 @@ class WorkerRouter:
                     raise xerrors.GatewayDeadlineError(
                         f"{name}: replicas unreachable "
                         f"({type(e).__name__})")
+                if (self._retry_on
+                        and not self._budget(c.gslot).try_retry()):
+                    st.add(_gw_cnt_off(c.gslot) + 80, 1)
+                    raise xerrors.GatewayRetryBudgetError(
+                        f"{name}: retry budget exhausted "
+                        f"({type(e).__name__})")
                 avoid.add(c.rep)
                 fresh = self._gateway(name)
                 if fresh is not None:
@@ -972,6 +1232,12 @@ class WorkerRouter:
         except xerrors.GatewayDeadlineError as e:
             return Response(ResCode.GatewayTimeout, None, msg=str(e),
                             http_status=504, headers={"Retry-After": "1"})
+        except xerrors.GatewayRetryBudgetError as e:
+            # budget exhaustion sheds instead of amplifying: 503 with a
+            # Retry-After the client can honor, never unbounded retries
+            return Response(ResCode.BackendUnavailable, None, msg=str(e),
+                            http_status=503,
+                            headers={"Retry-After": str(e.retry_after)})
         except Exception:  # noqa: BLE001 — the envelope absorbs it
             log.exception("worker %d: generate %s failed", self.widx, name)
             return err(ResCode.GatewayRequestFailed)
@@ -1065,6 +1331,56 @@ def _worker_main(host: str, port: int, shm_name: str, worker_idx: int,
         except Exception:  # noqa: BLE001
             pass
     os._exit(0)
+
+
+class ShmLatencyStore:
+    """Daemon-side latency store backed by the shm digest cells — the
+    drop-in twin of tailtolerance.LocalLatencyStore that WorkerTier
+    swaps into each live Gateway while the tier runs. The in-process
+    router then folds its responses into — and runs its ejection tick
+    over — the SAME cells every worker process uses, which is the
+    tier-parity contract: one signal, two readers, identical
+    decisions."""
+
+    def __init__(self, state: SharedRouterState, gateway: str):
+        self._state = state
+        self._gateway = gateway
+        self._slot: Optional[int] = None
+        self._n = 0
+        self._epoch = -1
+
+    def _resolve(self) -> Optional[int]:
+        """The gateway's current roster slot, re-read only when the
+        roster epoch moved (slot assignments are sticky)."""
+        epoch = self._state.load(HDR_OFF_EPOCH)
+        if epoch != self._epoch:
+            _, roster = self._state.read_roster()
+            ent = roster.get(self._gateway)
+            self._slot = ent["slot"] if ent is not None else None
+            self._n = len(ent["replicas"]) if ent is not None else 0
+            self._epoch = epoch
+        return self._slot
+
+    def fold(self, row: int, ms: float) -> None:
+        g = self._resolve()
+        if g is not None and 0 <= row < MAX_REPLICAS:
+            self._state.fold_replica_lat(g, row, ms)
+
+    def snapshot(self) -> dict:
+        g = self._resolve()
+        out: dict = {}
+        if g is None:
+            return out
+        for row in range(min(self._n, MAX_REPLICAS)):
+            cells = self._state.read_replica_lat(g, row)
+            if cells is not None:
+                out[row] = (cells[0], cells[1] / 1e3, cells[2] / 1e3)
+        return out
+
+    def reset(self, row: int) -> None:
+        g = self._resolve()
+        if g is not None and 0 <= row < MAX_REPLICAS:
+            self._state.publish_replica_lat(g, row, (0, 0, 0))
 
 
 class WorkerTier:
@@ -1213,8 +1529,9 @@ class WorkerTier:
                 if (self._poke.is_set()
                         or now - last_pub >= self.REPUBLISH_S):
                     self._poke.clear()
-                    reassigned = self.state.publish(
-                        self.gateways.router_states())
+                    states = self.gateways.router_states()
+                    reassigned = self.state.publish(states)
+                    self._bind_lat_stores(st["name"] for st in states)
                     # a reassigned roster slot must not hand its metric
                     # history to the new tenant gateway; the reset runs
                     # HERE, outside the roster's publish window, under
@@ -1229,6 +1546,43 @@ class WorkerTier:
                     self._tailer.poll()     # merge worker span spools
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("worker-tier watchdog tick")
+
+    def _bind_lat_stores(self, names) -> None:
+        """Swap each live Gateway's latency store for the shm-backed
+        twin (ShmLatencyStore) so both router tiers fold into — and
+        eject from — the same digest cells. Idempotent per gateway;
+        stop() swaps the local store back before the segment unmaps."""
+        for name in names:
+            try:
+                gw = self.gateways.get(name)
+            # tdlint: disable=silent-swallow -- the gateway was deleted between roster build and bind
+            except Exception:  # noqa: BLE001
+                continue
+            # fakes/minimal gateways without a latency store (policy-
+            # parity tests) just don't participate in digest publishing
+            store = getattr(gw, "lat_store", None)
+            if store is not None and not isinstance(store,
+                                                    ShmLatencyStore):
+                gw.lat_store = ShmLatencyStore(self.state, name)
+
+    def _unbind_lat_stores(self) -> None:
+        """Teardown half of _bind_lat_stores: every gateway falls back
+        to a fresh local store BEFORE the segment unmaps, so a fold
+        racing stop() lands in a live object, never a closed buffer."""
+        try:
+            states = self.gateways.router_states()
+        # tdlint: disable=silent-swallow -- manager already torn down; nothing left to unbind
+        except Exception:  # noqa: BLE001
+            return
+        for st in states:
+            try:
+                gw = self.gateways.get(st["name"])
+            # tdlint: disable=silent-swallow -- deleted mid-teardown
+            except Exception:  # noqa: BLE001
+                continue
+            if isinstance(getattr(gw, "lat_store", None),
+                          ShmLatencyStore):
+                gw.lat_store = tailtolerance.LocalLatencyStore()
 
     def _check_workers(self) -> None:
         for i, p in enumerate(self.procs):
@@ -1356,6 +1710,9 @@ class WorkerTier:
                     "inflight": sum(c["inflight"]),
                     "affinityHits": c["affinityHits"],
                     "affinityTokens": c["affinityTokens"],
+                    "hedges": c["hedges"],
+                    "hedgeWins": c["hedgeWins"],
+                    "retryBudgetExhausted": c["retryBudgetExhausted"],
                 }
         return out
 
@@ -1425,6 +1782,7 @@ class WorkerTier:
             self.gateways.on_change = None
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._unbind_lat_stores()
         if self.state is not None:
             self.state.store(HDR_OFF_SHUTDOWN, 1)
         for p in self.procs:

@@ -29,7 +29,7 @@ import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .. import kvaffinity
+from .. import faults, kvaffinity
 
 READY_MARKER = ".model_ready"
 
@@ -228,6 +228,18 @@ def _handler_for(st: _State, model: str):
                     raise ValueError("max_new must be >= 1")
             except (KeyError, TypeError, ValueError) as e:
                 self._send(400, f"bad request: {e}", None)
+                return
+            # replica-side fault gate, keyed by this replica's name: the
+            # tail-tolerance e2e arms TDAPI_FAULTS="<gw>r0.generate:
+            # jitter:0.05" in ONE replica's env to make exactly that
+            # replica gray (slow or flaky but alive) while its fleet
+            # peers stay healthy
+            try:
+                faults.fault_gate(
+                    os.environ.get("TDAPI_REPLICA", "replica")
+                    + ".generate")
+            except faults.InjectedFault as e:
+                self._send(500, f"injected replica fault: {e}", None)
                 return
             # disaggregated handoff contract (serve.py's): Phase:prefill
             # runs one token and exports the prompt "KV" under the key;

@@ -81,6 +81,20 @@ class GatewayDeadlineError(XError):
     sentinel = "gateway request deadline exceeded"
 
 
+class GatewayRetryBudgetError(XError):
+    """The gateway's retry token bucket is empty: a replica failure that
+    would previously retry-until-deadline is shed instead, because under
+    a brownout those retries multiply the very load that is browning the
+    fleet out. Routes map it to HTTP 503 + Retry-After; successes refill
+    the bucket, so the first recovered request re-opens retries."""
+
+    sentinel = "gateway retry budget exhausted"
+
+    def __init__(self, detail: str = "", retry_after: float = 1.0):
+        super().__init__(detail)
+        self.retry_after = retry_after
+
+
 # --- volume errors (reference internal/xerrors/volume.go) ---
 
 class VolumeExistedError(XError):

@@ -655,7 +655,10 @@ def build_spec() -> dict:
              "gateways": obj(
                  {}, additional=obj(
                      {"requestsTotal": i(), "shedTotal": i(),
-                      "queued": i(), "inflight": i()}),
+                      "queued": i(), "inflight": i(),
+                      "affinityHits": i(), "affinityTokens": i(),
+                      "hedges": i(), "hedgeWins": i(),
+                      "retryBudgetExhausted": i()}),
                  desc="Per-gateway data-plane counters from the shared "
                       "segment")},
             desc="Multi-process data-plane tier status "
@@ -669,6 +672,11 @@ def build_spec() -> dict:
              "workqueue": obj({"pending": i(), "dropped": i()}),
              "workers": {"allOf": [ref("WorkersBlock")],
                          "nullable": True},
+             "gateways": obj(
+                 {}, additional=obj(
+                     {"tailTolerance": ref("TailToleranceBlock")}),
+                 desc="Per-gateway tail-tolerance posture, keyed by "
+                      "gateway name"),
              "reconcileActions": i("Boot reconcile total; non-zero = the "
                                    "previous daemon died dirty"),
              "storeReadOnly": {"type": "string", "nullable": True,
@@ -786,7 +794,39 @@ def build_spec() -> dict:
              "role": s("shared | prefill | decode (idx parity under "
                        "poolPolicy=disaggregated)"),
              "kvOcc": i("Prefix-cache blocks the replica last "
-                        "advertised (X-TDAPI-KV-Occ fold)")}),
+                        "advertised (X-TDAPI-KV-Occ fold)"),
+             "probation": b("In the tail-tolerance probation set "
+                            "(score-penalized; serves trickle probes "
+                            "toward re-admission)")}),
+        "TailToleranceBlock": obj(
+            {"ejectEnabled": b("TDAPI_GW_EJECT != 0"),
+             "hedgeEnabled": b("TDAPI_GW_HEDGE != 0"),
+             "retryBudgetEnabled": b("TDAPI_GW_RETRY_BUDGET != 0"),
+             "probation": obj(
+                 {}, additional=obj(
+                     {"kind": s("latency (gray-failure ejection) | "
+                                "failed (transport-strike heal)"),
+                      "passes": i("Consecutive trickle-probe passes "
+                                  "toward re-admission")}),
+                 desc="Replicas currently in probation, keyed by name"),
+             "ejections": i("Replicas ejected by the latency outlier "
+                            "detector (cumulative)"),
+             "probationPasses": i("Probation re-admissions (cumulative)"),
+             "hedges": i("Hedged requests fired"),
+             "hedgeWins": i("Hedges whose duplicate finished first"),
+             "retryBudgetExhausted": i("Forwards shed 503 because the "
+                                       "retry budget ran dry"),
+             "retryTokens": {"type": "number",
+                             "description": "Current retry-budget token "
+                                            "level (capacity 16)"},
+             "fleetMedianMs": {"type": "number", "nullable": True,
+                               "description":
+                                   "Healthy-fleet median windowed p95 "
+                                   "(the ejection threshold's base); "
+                                   "null before enough samples"}},
+            desc="Per-gateway tail-tolerance posture: kill-switch "
+                 "state, probation roster, ejection/hedge/retry-budget "
+                 "counters (docs/serving.md §Tail tolerance)"),
         "GatewayStatus": obj(
             {"name": s(), "config": ref("GatewayCreate"),
              "replicas": arr(ref("GatewayReplica")),
@@ -802,6 +842,7 @@ def build_spec() -> dict:
                                  "saved"),
              "kvHandoffs": i("Completed prefill->decode disaggregated "
                              "handoffs"),
+             "tailTolerance": ref("TailToleranceBlock"),
              "lastScaleReadyMs": {
                  "type": "number", "nullable": True,
                  "description": "Last scale trigger -> replica READY "
@@ -1416,7 +1457,7 @@ def build_spec() -> dict:
         "openapi": "3.0.3",
         "info": {
             "title": "tpu-docker-api",
-            "version": "0.14.0",
+            "version": "0.15.0",
             "description":
                 "TPU-native container-orchestration REST API. Same "
                 "surface as gpu-docker-api (reference "

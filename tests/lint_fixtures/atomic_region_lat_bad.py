@@ -1,0 +1,26 @@
+"""Seeded violations for the latency-digest half of atomic-region: a
+replica's digest cells (gen | count | ewma_us | p95_us, addressed via
+_rep_lat_off) written through raw buffer paths instead of the native
+shm_cells_publish CAS path — a plain store tears against a concurrent
+folder and hands both router tiers a corrupt gray-failure signal."""
+
+import struct
+
+CNT_OFF = 4096
+
+
+def _rep_cnt_off(g, r):
+    return CNT_OFF + (g * 16 + r) * 12 * 8
+
+
+def _rep_lat_off(g, r):
+    return _rep_cnt_off(g, r) + 8 * 8
+
+
+class State:
+    def bad_pack(self, g, r):
+        struct.pack_into("<q", self.shm.buf, _rep_lat_off(g, r), 3)
+
+    def bad_slice(self, g, r):
+        off = _rep_lat_off(g, r)
+        self.shm.buf[off + 8:off + 16] = b"\x00" * 8

@@ -1,0 +1,27 @@
+"""Clean twin of atomic_region_lat_bad.py: the digest cells only ever
+move through the native CAS publish/read entry points (the gen word
+fences the group), exactly how workers.py publish_replica_lat /
+read_replica_lat access them."""
+
+CNT_OFF = 4096
+LAT_CELL_WORDS = 3
+
+
+def _rep_cnt_off(g, r):
+    return CNT_OFF + (g * 16 + r) * 12 * 8
+
+
+def _rep_lat_off(g, r):
+    return _rep_cnt_off(g, r) + 8 * 8
+
+
+class State:
+    def good_publish(self, g, r, vals):
+        self.lib.shm_cells_publish(self.base + _rep_lat_off(g, r),
+                                   self.base + _rep_lat_off(g, r) + 8,
+                                   vals, LAT_CELL_WORDS)
+
+    def good_read(self, g, r, out):
+        return self.lib.shm_cells_read(self.base + _rep_lat_off(g, r),
+                                       self.base + _rep_lat_off(g, r) + 8,
+                                       out, LAT_CELL_WORDS)

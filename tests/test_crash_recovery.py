@@ -232,6 +232,54 @@ def post_kvhandoff(app, stored):
     assert all(r.inflight == 0 for r in gw.replicas.values())
 
 
+def setup_hedge(app):
+    """A two-replica gateway, both READY, with a seeded fleet latency
+    digest (so the hedge delay derives) — the hedge.in_flight crashpoint
+    sits between the hedge slot claim and the duplicate dispatch."""
+    from gpu_docker_api_tpu.gateway import READY, GatewayConfig
+    app.gateways.create(GatewayConfig(
+        name="hgw", image="img", cmd=["serve"], minReplicas=2,
+        maxReplicas=2, readiness="running", scaleDownIdleS=3600,
+        deadlineMs=4000, maxQueue=16))
+    gw = app.gateways.get("hgw")
+    deadline = time.time() + 10
+    while time.time() < deadline and sum(
+            1 for r in gw.replicas.values() if r.state is READY) < 2:
+        time.sleep(0.02)
+    assert sum(1 for r in gw.replicas.values() if r.state is READY) == 2
+
+
+def scenario_hedge(app):
+    """Primary outlives the digest-derived hedge delay; the hedge path
+    claims a slot on the second replica and dies at hedge.in_flight —
+    AFTER the claim, BEFORE the duplicate dispatch. The guard releases
+    the claim on the way out, so no inflight leaks (post asserts it)."""
+    import threading
+    gw = app.gateways.get("hgw")
+    for row in (0, 1):
+        for _ in range(16):
+            gw.lat_store.fold(row, 10.0)     # median p95 -> ~15ms delay
+    hold = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        hold.wait(2)                         # primary: slower than delay
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw._transport = transport
+    try:
+        gw.forward(b"{}")
+    finally:
+        hold.set()
+
+
+def post_hedge(app, stored):
+    # data-plane only (no intent, no store write): recovery is adoption —
+    # both replicas back, and the crashed hedge leaked no inflight claim
+    assert {"hgwr0", "hgwr1"} <= set(stored)
+    gw = app.gateways.get("hgw")
+    assert all(r.inflight == 0 for r in gw.replicas.values())
+
+
 def setup_replace(app):
     run_demo(app)
     _mark(app, "demo-1")
@@ -542,6 +590,10 @@ SCENARIOS = [
     # KV handoff (PR 18): a data-plane crash between the disaggregation
     # phases — no intent to settle, recovery is adoption alone
     ("kvhandoff.", (setup_kvhandoff, scenario_kvhandoff, post_kvhandoff)),
+    # hedged requests (PR 19): a data-plane crash between the hedge slot
+    # claim and the duplicate dispatch — the claim releases on the way
+    # out, so recovery is adoption with zero leaked inflight
+    ("hedge.", (setup_hedge, scenario_hedge, post_hedge)),
     # the two federation lease crashpoints have distinct recovery shapes
     # (orphaned fresh grant vs re-orphaned stolen grant) — own rows
     ("fed.after_acquire", (setup_fed_acquire, scenario_fed_acquire,

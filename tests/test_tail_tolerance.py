@@ -1,0 +1,578 @@
+"""Tail-tolerant serving sweep (`tail` marker; make verify-tail).
+
+Four layers:
+
+- PRIMITIVES on injected clocks (no threads, no sleeps): the latency
+  digest's shm cell round-trip, the pure ejection decision (outlier
+  threshold, min-count gate, the <=50%-of-fleet cap under all-slow
+  fleets), the probation state machine (eject -> trickle probes -> N
+  consecutive passes re-admit, a failure resets the streak), the
+  deterministic worker-tier probe window, the hedge delay/token bucket,
+  and the retry budget;
+- GATEWAY integration on an injected transport: the ejection tick moves
+  the outlier into probation and the picker penalizes it, a
+  transport-strike FAILED replica heals back to READY through the same
+  probe path WITHOUT a scale cycle, hedged requests race first-wins with
+  the loser's slot released, and the retry budget sheds long before the
+  deadline;
+- WIRE: budget exhaustion answers HTTP 503 + Retry-After over live REST;
+- WORKER-TIER PARITY over shm: the stateless tier's recomputed eject set
+  equals tailtolerance.eject_set over the same published digest cells
+  (the decision both tiers share), and its hedge/budget counters land on
+  the gateway's shared-memory words.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gpu_docker_api_tpu import tailtolerance, xerrors
+from gpu_docker_api_tpu.gateway import (
+    FAILED, READY, Gateway, GatewayConfig, Replica,
+)
+from gpu_docker_api_tpu.tailtolerance import (
+    HedgePolicy, LatencyDigest, LocalLatencyStore, ProbationTracker,
+    RetryBudget, eject_set, fold_cells, trickle_allow,
+)
+
+pytestmark = pytest.mark.tail
+
+
+# ------------------------------------------------------------ primitives
+
+def test_latency_digest_fold_and_cell_roundtrip():
+    d = LatencyDigest()
+    d.observe(10.0)
+    # the first sample seeds both estimates
+    assert d.ewma_ms == 10.0 and d.p95_ms == 10.0 and d.count == 1
+    for _ in range(50):
+        d.observe(10.0)
+    # steady traffic: the p95 estimate stays near the service time
+    assert 0.0 <= d.p95_ms <= 30.0
+    p95_before = d.p95_ms
+    for _ in range(20):
+        d.observe(500.0)
+    # a latency regression drives the estimate up fast (19x step)
+    assert d.p95_ms > p95_before * 3
+    cells = d.to_cells()
+    back = LatencyDigest.from_cells(cells)
+    assert back.count == d.count
+    assert back.ewma_ms == pytest.approx(d.ewma_ms, abs=0.001)
+    assert back.p95_ms == pytest.approx(d.p95_ms, abs=0.001)
+    # fold_cells from nothing = first observation
+    c = fold_cells(None, 7.0)
+    assert LatencyDigest.from_cells(c).ewma_ms == pytest.approx(7.0)
+
+
+def test_eject_set_outlier_threshold_and_gates():
+    fast = [("a", 10.0, 100), ("b", 12.0, 100), ("c", 11.0, 100)]
+    # a 3x-median outlier ejects; the healthy rows don't
+    assert eject_set(fast + [("d", 400.0, 100)], fleet=4) == {"d"}
+    # under the min-count gate the outlier has no standing
+    assert eject_set(fast + [("d", 400.0, 3)], fleet=4) == set()
+    # a single-row "fleet" has nothing to be an outlier of
+    assert eject_set([("a", 1000.0, 100)], fleet=1) == set()
+    # sub-floor latencies never eject, whatever the ratio
+    tiny = [("a", 0.01, 100), ("b", 0.01, 100), ("c", 1.0, 100)]
+    assert eject_set(tiny, fleet=3) == set()
+
+
+def test_eject_cap_never_exceeded_under_all_slow_fleet():
+    """The <=50%-of-fleet cap: iterate ejection ticks over a fleet where
+    EVERY replica degrades, feeding each tick's result back as `already`
+    — probation membership must never pass int(cap * fleet)."""
+    n = 8
+    cap_abs = int(n * tailtolerance.EJECT_CAP)
+    in_probation: set = set()
+    # a rolling brownout: two more replicas degrade every tick, so an
+    # uncapped detector would eventually eject everyone — exactly the
+    # availability collapse the cap exists to prevent
+    for tick in range(10):
+        n_degraded = min(2 * (tick + 1), n)
+        stats = [(f"r{i}", 5000.0 + i if i < n_degraded else 10.0, 100)
+                 for i in range(n) if f"r{i}" not in in_probation]
+        out = eject_set(stats, already=frozenset(in_probation), fleet=n)
+        in_probation |= out
+        assert len(in_probation) <= cap_abs, (tick, in_probation)
+    assert len(in_probation) == cap_abs      # the cap BINDS, not just holds
+    # and at the cap, further ticks eject nobody
+    stats = [(f"r{i}", 9000.0, 100) for i in range(n)
+             if f"r{i}" not in in_probation]
+    assert eject_set(stats, already=frozenset(in_probation),
+                     fleet=n) == set()
+
+
+def test_probation_state_machine_on_injected_clock():
+    clock = [100.0]
+    p = ProbationTracker(now=lambda: clock[0])
+    assert p.eject("a", kind="latency") is True
+    assert p.eject("a") is False             # idempotent entry
+    assert p.contains("a") and p.kind("a") == "latency"
+    # freshly ejected: the replica just proved itself slow; no probe yet
+    assert not p.probe_due("a")
+    clock[0] += tailtolerance.PROBE_INTERVAL_S
+    assert p.probe_due("a")
+    p.note_probe("a")
+    assert not p.probe_due("a")              # interval restarts per probe
+    # N-1 passes then a failure: the streak resets, membership holds
+    for _ in range(tailtolerance.PROBE_PASSES - 1):
+        assert p.verdict("a", ok=True) is False
+    assert p.verdict("a", ok=False) is False
+    assert p.contains("a")
+    # N consecutive passes re-admit (entry gone)
+    for i in range(tailtolerance.PROBE_PASSES):
+        readmitted = p.verdict("a", ok=True)
+        assert readmitted is (i == tailtolerance.PROBE_PASSES - 1)
+    assert not p.contains("a")
+    # prune drops members whose replica left the eligible set
+    p.eject("gone")
+    p.prune({"kept"})
+    assert len(p) == 0
+
+
+def test_trickle_allow_deterministic_across_workers():
+    rows = [3, 5, 9]
+    w = tailtolerance.WORKER_PROBE_WINDOW_S
+    sp = tailtolerance.WORKER_PROBE_SPACING
+    # inside an open window: every worker (same now) picks the SAME row
+    now_open = (sp * 7) * w + 0.01
+    picked = trickle_allow(rows, now_open)
+    assert picked in rows
+    assert all(trickle_allow(rows, now_open + dt) == picked
+               for dt in (0.0, w * 0.4, w * 0.9))
+    # between windows: nobody probes (spacing-1 of every spacing windows)
+    assert trickle_allow(rows, (sp * 7 + 1) * w + 0.01) is None
+    # successive open windows rotate through the rows
+    seen = {trickle_allow(rows, (sp * i) * w + 0.01) for i in range(6)}
+    assert seen == set(rows)
+    assert trickle_allow([], now_open) is None
+
+
+def test_hedge_policy_delay_and_token_bucket():
+    clock = [0.0]
+    h = HedgePolicy(now=lambda: clock[0])
+    # no basis: too few samples, or a single-replica fleet
+    assert h.delay_s(lambda: {}) is None
+    clock[0] += HedgePolicy.REFRESH_S
+    assert h.delay_s(lambda: {0: (100, 10.0, 20.0)}) is None
+    clock[0] += HedgePolicy.REFRESH_S
+    snap = {0: (50, 10.0, 20.0), 1: (50, 12.0, 40.0)}
+    # delay = FACTOR x median p95, in seconds
+    assert h.delay_s(lambda: snap) == pytest.approx(
+        30.0 * HedgePolicy.FACTOR / 1e3)
+    # cached within REFRESH_S: a changed snapshot is not consulted
+    assert h.delay_s(lambda: {}) == pytest.approx(
+        30.0 * HedgePolicy.FACTOR / 1e3)
+    # bucket: BURST takes, then dry until fed; put_back refunds
+    for _ in range(int(HedgePolicy.BURST)):
+        assert h.take()
+    assert not h.peek() and not h.take()
+    h.put_back()
+    assert h.take()
+    for _ in range(int(1.0 / HedgePolicy.RATE)):
+        h.feed()                             # ~20 successes = 1 token
+    assert h.take()
+
+
+def test_retry_budget_spends_and_refills():
+    b = RetryBudget(capacity=3.0, refill=0.5)
+    assert [b.try_retry() for _ in range(4)] == [True, True, True, False]
+    b.success()
+    assert not b.try_retry()                 # 0.5 < a whole token
+    b.success()
+    assert b.try_retry() and not b.try_retry()
+    # refill never climbs past capacity
+    for _ in range(100):
+        b.success()
+    assert b.tokens == pytest.approx(3.0)
+
+
+# ---------------------------------------------- gateway on injected transport
+
+def _bare_gateway(transport, **cfg_kw) -> Gateway:
+    kw = dict(name="g", image="img", deadlineMs=2000, maxQueue=8)
+    kw.update(cfg_kw)
+    cfg = GatewayConfig(**kw)
+    return Gateway(cfg, services=None, intents=None, transport=transport)
+
+
+def _ready_replica(name, idx, port, slots=2) -> Replica:
+    r = Replica(name, idx)
+    r.state = READY
+    r.slots = slots
+    r.host_port = port
+    return r
+
+
+def _seed_digests(gw, rows, ms=10.0, n=20):
+    for row in rows:
+        for _ in range(n):
+            gw.lat_store.fold(row, ms)
+
+
+def test_gateway_ejection_tick_penalizes_outlier_and_probes_readmit():
+    """_eval_eject moves the slow replica into probation; the picker
+    then avoids it while healthy capacity exists, routes a trickle probe
+    when one comes due, and N fast probe completions re-admit it with
+    its gray-era digest history dropped."""
+    ports = []
+
+    def transport(port, method, path, body, timeout):
+        ports.append(port)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport)
+    clock = [1000.0]
+    gw.probation = ProbationTracker(now=lambda: clock[0])
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002),
+                   "c": _ready_replica("c", 2, 1003)}
+    _seed_digests(gw, rows=(0, 1), ms=10.0)
+    _seed_digests(gw, rows=(2,), ms=800.0)   # the gray replica (row 2)
+    gw._eval_eject()
+    assert gw.probation.contains("c") and gw.probation.kind("c") == "latency"
+    assert gw.ejections == 1
+    assert gw._fleet_median_ms == pytest.approx(10.0, rel=0.5)
+    # re-running the tick is idempotent (already-counted, no re-eject)
+    gw._eval_eject()
+    assert gw.ejections == 1
+    # routing: penalized — requests avoid "c" while a/b have slots
+    for _ in range(6):
+        gw.forward(b"{}")
+    assert 1003 not in ports
+    # a due probe on the idle ejected replica wins the pick outright
+    clock[0] += tailtolerance.PROBE_INTERVAL_S + 0.01
+    gw.forward(b"{}")
+    assert ports[-1] == 1003
+    # two more due probes (fast completions under the 3x-median bar,
+    # via the floor since median is ~10ms) re-admit and reset the row
+    for _ in range(tailtolerance.PROBE_PASSES - 1):
+        clock[0] += tailtolerance.PROBE_INTERVAL_S + 0.01
+        gw.forward(b"{}")
+    assert not gw.probation.contains("c")
+    assert gw.probation_passes == 1
+    assert 2 not in gw.lat_store.snapshot()  # gray-era history dropped
+
+
+def test_gateway_failed_replica_heals_without_scale_cycle():
+    """The PR 19 regression fix: a transport-strike FAILED replica used
+    to be terminal until an autoscaler stop/start recycled it. It now
+    heals through the probation probe path — back to READY with zero
+    scale events."""
+    dead = [True]
+    calls = []
+
+    def transport(port, method, path, body, timeout):
+        calls.append(port)
+        if port == 1001 and dead[0]:
+            raise ConnectionRefusedError("replica gone")
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport)
+    clock = [5000.0]
+    gw.probation = ProbationTracker(now=lambda: clock[0])
+    gw.replicas = {"sick": _ready_replica("sick", 0, 1001, slots=4),
+                   "live": _ready_replica("live", 1, 1002, slots=4)}
+    for _ in range(Gateway.MAX_FAILURES + 1):
+        status, _ = gw.forward(b"{}")
+        assert status == 200
+    assert gw.replicas["sick"].state is FAILED
+    assert gw.probation.kind("sick") == "failed"
+    # FAILED no longer serves (and is not probed before its interval)
+    calls.clear()
+    gw.forward(b"{}")
+    assert 1001 not in calls
+    # the replica recovers; due probes route to it and heal it
+    dead[0] = False
+    for _ in range(tailtolerance.PROBE_PASSES):
+        clock[0] += tailtolerance.PROBE_INTERVAL_S + 0.01
+        gw.forward(b"{}")
+    assert gw.replicas["sick"].state is READY
+    assert not gw.probation.contains("sick")
+    assert gw.scale_ups == 0 and gw.scale_downs == 0
+    # and it serves plain traffic again
+    calls.clear()
+    for _ in range(8):
+        gw.forward(b"{}")
+    assert 1001 in calls
+
+
+def test_gateway_hedge_first_wins_and_loser_slot_released():
+    """The primary outlives the digest-derived hedge delay; the
+    duplicate on the other replica finishes first and wins, the hedge
+    counters move, and BOTH slots are back (release-on-completion)."""
+    release_slow = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        if port == 1001:
+            release_slow.wait(5)
+            return 200, b'{"code":200,"msg":"slow","data":{}}'
+        return 200, b'{"code":200,"msg":"fast","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=8000)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002)}
+    _seed_digests(gw, rows=(0, 1), ms=10.0)  # hedge delay ~= 15ms
+    status, payload = gw.forward(b"{}")
+    assert status == 200 and b"fast" in payload
+    assert gw.hedges == 1 and gw.hedge_wins == 1
+    release_slow.set()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with gw._cond:
+            if all(r.inflight == 0 for r in gw.replicas.values()):
+                break
+        time.sleep(0.01)
+    with gw._cond:
+        assert all(r.inflight == 0 for r in gw.replicas.values())
+
+
+def test_gateway_hedge_bucket_empty_no_duplicate():
+    """A drained hedge token bucket means NO duplicate dispatches — the
+    ~5% added-load cap is the bucket, so an empty bucket must degrade to
+    plain forwarding, not queue hedges."""
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        time.sleep(0.05)                     # well past the ~15ms delay
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    gw = _bare_gateway(transport, deadlineMs=8000)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001),
+                   "b": _ready_replica("b", 1, 1002)}
+    _seed_digests(gw, rows=(0, 1), ms=10.0)
+    while gw.hedge.take():
+        pass                                 # drain the bucket
+    status, _ = gw.forward(b"{}")
+    assert status == 200
+    assert gw.hedges == 0 and len(seen) == 1
+
+
+def test_gateway_retry_budget_sheds_long_before_deadline():
+    """Replicas hard-down with a LONG deadline: the old behavior retried
+    until the deadline; the budget sheds as soon as the bucket drains,
+    and the counter moves."""
+    attempts = []
+
+    def transport(port, method, path, body, timeout):
+        attempts.append(port)
+        raise ConnectionRefusedError("down")
+
+    gw = _bare_gateway(transport, deadlineMs=60000)
+    gw.retry_budget = RetryBudget(capacity=3.0, refill=0.1)
+    gw.replicas = {"a": _ready_replica("a", 0, 1001, slots=4),
+                   "b": _ready_replica("b", 1, 1002, slots=4)}
+    t0 = time.monotonic()
+    with pytest.raises(xerrors.GatewayRetryBudgetError) as ei:
+        gw.forward(b"{}")
+    assert time.monotonic() - t0 < 5.0       # nowhere near the 60s deadline
+    assert len(attempts) == 4                # first try + 3 budgeted retries
+    assert gw.retry_budget_exhausted == 1
+    assert ei.value.retry_after > 0
+
+
+def test_gateway_describe_tail_block_and_kill_switches(monkeypatch):
+    gw = _bare_gateway(lambda *a: (200, b"{}"))
+    d = gw.describe()
+    tail = d["tailTolerance"]
+    assert tail["ejectEnabled"] and tail["hedgeEnabled"]
+    assert tail["retryBudgetEnabled"]
+    assert tail["ejections"] == 0 and tail["hedges"] == 0
+    assert tail["retryTokens"] == pytest.approx(RetryBudget.CAPACITY)
+    # kill switches: TDAPI_GW_*=0 disables each policy independently
+    monkeypatch.setenv(tailtolerance.EJECT_ENV, "0")
+    monkeypatch.setenv(tailtolerance.HEDGE_ENV, "0")
+    monkeypatch.setenv(tailtolerance.RETRY_BUDGET_ENV, "0")
+    gw2 = _bare_gateway(lambda *a: (200, b"{}"))
+    t2 = gw2.describe()["tailTolerance"]
+    assert not (t2["ejectEnabled"] or t2["hedgeEnabled"]
+                or t2["retryBudgetEnabled"])
+    # with ejection off, _eval_eject never moves anyone
+    gw2.replicas = {"a": _ready_replica("a", 0, 1001),
+                    "b": _ready_replica("b", 1, 1002)}
+    _seed_digests(gw2, rows=(0,), ms=10.0)
+    _seed_digests(gw2, rows=(1,), ms=900.0)
+    gw2._eval_eject()
+    assert len(gw2.probation) == 0 and gw2.ejections == 0
+
+
+def test_tail_catalog_registration():
+    from gpu_docker_api_tpu.obs.names import EVENT_OPS, METRIC_NAMES
+    assert {"gateway.ejected", "gateway.probation_pass",
+            "gateway.hedged"} <= EVENT_OPS
+    assert {"tdapi_gateway_ejections_total",
+            "tdapi_gateway_hedges_total",
+            "tdapi_gateway_hedge_wins_total",
+            "tdapi_gateway_retry_budget_exhausted_total"} <= METRIC_NAMES
+
+
+# ------------------------------------------------------------------- wire
+
+def test_budget_exhaustion_answers_503_with_retry_after(tmp_path):
+    """Over live REST: a browned-out gateway answers 503 + Retry-After
+    (bounded shed), never an unbounded retry loop."""
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    app = App(state_dir=str(tmp_path / "state"), backend="mock",
+              addr="127.0.0.1:0", port_range=(46400, 46500),
+              topology=make_topology("v4-16"), api_key="", cpu_cores=8,
+              store_maint_records=0)
+    app.start()
+    try:
+        app.gateways.create(GatewayConfig(
+            name="gw", image="img", cmd=["serve"], minReplicas=2,
+            maxReplicas=2, readiness="running", scaleDownIdleS=3600,
+            deadlineMs=60000, maxQueue=16))
+        gw = app.gateways.get("gw")
+        deadline = time.time() + 10
+        while time.time() < deadline and sum(
+                1 for r in gw.replicas.values()
+                if r.state is READY) < 2:
+            time.sleep(0.02)
+
+        def transport(port, method, path, body, timeout):
+            raise ConnectionRefusedError("brownout")
+
+        gw._transport = transport
+        gw.retry_budget = RetryBudget(capacity=2.0, refill=0.1)
+        req = urllib.request.Request(
+            f"http://{app.address}/api/v1/gateways/gw/generate",
+            method="POST", data=b'{"tokens": [[1]]}',
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert time.monotonic() - t0 < 10.0
+        err = ei.value
+        assert err.code == 503
+        assert err.headers.get("Retry-After") is not None
+        body = json.loads(err.read())
+        assert body["code"] == 503
+        # /healthz surfaces the tail-tolerance block per gateway
+        hz = json.loads(urllib.request.urlopen(
+            f"http://{app.address}/api/v1/healthz", timeout=10).read())
+        tail = hz["data"]["gateways"]["gw"]["tailTolerance"]
+        assert tail["retryBudgetExhausted"] >= 1
+    finally:
+        app.stop()
+
+
+# --------------------------------------------- worker-tier parity over shm
+
+workers = pytest.importorskip("gpu_docker_api_tpu.server.workers")
+
+needs_workers = pytest.mark.skipif(
+    not workers.available(),
+    reason="worker tier unavailable (no Linux SO_REUSEPORT / native core)")
+
+
+@pytest.fixture()
+def state():
+    st = workers.SharedRouterState(create=True)
+    yield st
+    st.close(unlink=True)
+
+
+def _publish(st, n_reps, slots=2, name="g", deadline_ms=3000):
+    st.publish([{"name": name, "maxQueue": 16, "deadlineMs": deadline_ms,
+                 "replicas": [{"port": 1001 + i, "slots": slots,
+                               "ready": True} for i in range(n_reps)]}])
+
+
+@needs_workers
+def test_worker_tier_eject_parity_with_shared_decision(state, monkeypatch):
+    """Both tiers run tailtolerance.eject_set over the same shm digest
+    cells. Fold a fleet with one gray row through the shm store, then
+    assert the worker router's recomputed eject set, the shm-backed
+    store's snapshot-driven decision (what a daemon gateway bound to the
+    tier would compute), and the pure function over raw cell reads all
+    agree. The trickle-probe carve-out is pinned separately
+    (test_trickle_allow_deterministic_across_workers) — silenced here so
+    an open probe window can't race the equality."""
+    monkeypatch.setattr(tailtolerance, "trickle_allow",
+                        lambda rows, now, **kw: None)
+    _publish(state, 4)
+    for r in range(4):
+        for _ in range(20):
+            state.fold_replica_lat(0, r, 700.0 if r == 3 else 10.0)
+    # the pure decision over raw cell reads
+    stats = []
+    for r in range(4):
+        cells = state.read_replica_lat(0, r)
+        assert cells is not None
+        stats.append((r, cells[2] / 1e3, cells[0]))
+    want = tailtolerance.eject_set(stats, fleet=4)
+    assert want == {3}
+    # worker tier: the router's recomputed probation
+    router = workers.WorkerRouter(state, 0,
+                                  transport=lambda *a: (200, b"{}"))
+    _, roster = state.read_roster()
+    assert router._ejected(roster["g"]) == want
+    # daemon tier: ShmLatencyStore.snapshot over the SAME cells feeds
+    # the same eject_set call gateway._eval_eject makes
+    shm_store = workers.ShmLatencyStore(state, "g")
+    snap = shm_store.snapshot()
+    gw_stats = [(row, snap[row][2], snap[row][0]) for row in sorted(snap)]
+    assert tailtolerance.eject_set(gw_stats, fleet=4) == want
+    # and the penalty is live: traffic avoids the gray replica while
+    # healthy slots exist
+    seen = []
+
+    def transport(port, method, path, body, timeout):
+        seen.append(port)
+        return 200, b'{"code":200,"msg":"ok","data":{}}'
+
+    router2 = workers.WorkerRouter(state, 0, transport=transport)
+    for _ in range(6):
+        router2.forward("g", b"{}")
+    assert 1004 not in seen
+
+
+@needs_workers
+def test_worker_tier_hedge_and_budget_counters_on_shm(state):
+    """The worker router's hedge increments the gateway's shared-memory
+    hedge words (daemon-visible), the duplicate wins first, and a
+    drained retry budget sheds GatewayRetryBudgetError with the shm
+    exhaustion counter bumped."""
+    release_slow = threading.Event()
+
+    def transport(port, method, path, body, timeout):
+        if port == 1001:
+            release_slow.wait(5)
+            return 200, b'{"code":200,"msg":"slow","data":{}}'
+        return 200, b'{"code":200,"msg":"fast","data":{}}'
+
+    _publish(state, 2, deadline_ms=8000)
+    for r in range(2):
+        for _ in range(20):
+            state.fold_replica_lat(0, r, 10.0)   # hedge delay ~= 15ms
+    router = workers.WorkerRouter(state, 0, transport=transport)
+    status, payload = router.forward("g", b"{}")
+    assert status == 200 and b"fast" in payload
+    release_slow.set()
+    c = state.gateway_counters(0)
+    assert c["hedges"] == 1 and c["hedgeWins"] == 1
+    deadline = time.time() + 5
+    while time.time() < deadline and sum(c["inflight"]) != 0:
+        time.sleep(0.01)
+        c = state.gateway_counters(0)
+    assert sum(c["inflight"]) == 0           # loser's claim released
+    # retry budget: hard-down replicas shed once the bucket drains
+    def down(port, method, path, body, timeout):
+        raise ConnectionRefusedError("down")
+
+    _publish(state, 2, deadline_ms=60000)
+    router2 = workers.WorkerRouter(state, 0, transport=down)
+    router2._budgets[0] = RetryBudget(capacity=2.0, refill=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(xerrors.GatewayRetryBudgetError):
+        router2.forward("g", b"{}")
+    assert time.monotonic() - t0 < 10.0
+    assert state.gateway_counters(0)["retryBudgetExhausted"] == 1

@@ -168,6 +168,18 @@ def test_atomic_region_shm_shard_fires_and_clean_twin_silent():
     assert _lint(["atomic_region_shm_ok.py"], ["atomic-region"]) == []
 
 
+def test_atomic_region_lat_digest_fires_and_clean_twin_silent():
+    """The PR 19 latency-digest extension: raw pack_into / slice writes
+    into _rep_lat_off cell groups fire; the CAS publish/read entry
+    points (the only legitimate access path) are silent."""
+    vs = _lint(["atomic_region_lat_bad.py"], ["atomic-region"])
+    assert len(vs) == 2
+    msgs = " | ".join(v.message for v in vs)
+    assert "struct.pack_into targeting a counter-region offset" in msgs
+    assert "raw buffer slice assignment into the counter region" in msgs
+    assert _lint(["atomic_region_lat_ok.py"], ["atomic-region"]) == []
+
+
 def test_claim_order_ignores_non_inflight_cells():
     """`_rep_cnt_off(...) + 8` is the errors cell, not the inflight
     claim — arithmetic on a helper must not be classified as the global

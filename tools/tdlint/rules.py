@@ -715,6 +715,10 @@ COUNTER_OFF_HELPERS = frozenset({
     # ONLY through the native shm_cells_publish CAS path — a raw-buffer
     # write here is exactly the racy store atomic-region exists to catch
     "_rep_kv_off",
+    # PR 19 latency-digest cells (gen|count|ewma_us|p95_us): same
+    # contract — every access goes through publish_replica_lat /
+    # read_replica_lat over the CAS path, never a raw buffer store
+    "_rep_lat_off",
 })
 COUNTER_OFF_NAMES = frozenset({"CNT_OFF", "WK_OFF", "SH_CNT_OFF"})
 #: the seqlock epoch word: a named offset constant (workers.py roster
