@@ -8,7 +8,8 @@ STATE ?= ./tpu-docker-api-state
 .PHONY: all native native-san test test-fast verify-crash verify-faults \
     verify-perf verify-retry verify-migrate verify-mt verify-races \
     verify-obs verify-gateway verify-gang verify-workers verify-tdcheck \
-    verify-fed verify-durability verify-kvroute verify-tail bench serve \
+    verify-fed verify-durability verify-kvroute verify-tail \
+    verify-placement bench serve \
     serve-mock \
     dryrun apidoc lint clean
 
@@ -39,6 +40,7 @@ test: native            ## full suite on the virtual 8-device CPU mesh
 	@echo "  make verify-durability (durable state plane sweep: -m durability)"
 	@echo "  make verify-kvroute (KV-aware serving sweep: -m kvroute)"
 	@echo "  make verify-tail    (tail-tolerant serving sweep: -m tail)"
+	@echo "  make verify-placement (placement + defrag sweep: -m placement)"
 	@echo "  make lint           (tdlint concurrency-invariant linter)"
 
 verify-crash:           ## crashpoint sweep: kill + rebuild at every step boundary
@@ -88,6 +90,9 @@ verify-kvroute: native  ## KV-aware serving: affinity scoring/routing, disaggreg
 
 verify-tail: native     ## tail tolerance: ejection/probation, hedging, retry budgets, tier parity
 	$(PY) -m pytest tests/ -q -m tail
+
+verify-placement: native  ## heterogeneity-aware placement: objectives, profiles, defrag-opens-gang
+	$(PY) -m pytest tests/ -q -m placement
 
 lint: native            ## compile baseline + tdlint rules (stale pragmas fail) + rule/checker liveness
 	$(PY) -m compileall -q gpu_docker_api_tpu tools tests bench.py

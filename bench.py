@@ -2932,6 +2932,113 @@ def durability_bench() -> dict:
     return out
 
 
+def placement_bench() -> dict:
+    """Heterogeneity-aware placement + defrag (docs/scheduling.md).
+
+    Goodput half: a mixed v4-32 + v5e-8 model-level fleet places an
+    interleaved stream of generation-affine workloads ("accel" profiles
+    3x better on v5e, "flat" profiles that collapse there) under
+    first_fit vs max_throughput — BOTH through the identical
+    enumerate->score->claim pipeline (first_fit is the constant-0
+    objective), so the ratio isolates the policy. Goodput = sum of each
+    workload's profile value on the generation it landed. Criterion:
+    placement_goodput_scale >= 1.3x.
+
+    Defrag half: a live App is driven into the canonical
+    fragmentation-blocked state (8 free chips, no free 8-box), the
+    8-chip gang is refused, one defrag run migrates the quiesce-enabled
+    blockers, and the gang admits. Headlines: defrag_gang_admit_ms
+    (refusal -> admitted), defrag_steps_lost (must be 0)."""
+    import shutil
+
+    from gpu_docker_api_tpu import xerrors
+    from gpu_docker_api_tpu.dtos import ContainerRun
+    from gpu_docker_api_tpu.meshplan import PlanSpec
+    from gpu_docker_api_tpu.placement import FleetModel
+    from gpu_docker_api_tpu.schedulers import TpuScheduler
+    from gpu_docker_api_tpu.server.app import App
+    from gpu_docker_api_tpu.topology import make_topology
+
+    out: dict = {}
+
+    # ---- policy-vs-first-fit goodput (model level) --------------------
+    PROFILES = {"accel": {"v4": 1.0, "v5e": 3.0},
+                "flat": {"v4": 1.0, "v5e": 0.2}}
+    # 12 x 2-chip jobs over 24 chips: capacity forces trade-offs
+    stream = [("flat" if i % 2 == 0 else "accel") for i in range(12)]
+
+    def goodput(policy: str) -> float:
+        fleet = FleetModel({
+            "v4": TpuScheduler(topology=make_topology("v4-32")),
+            "v5e": TpuScheduler(topology=make_topology("v5e-8")),
+        }, policy=policy)
+        total = 0.0
+        for i, kind in enumerate(stream):
+            prof = PROFILES[kind]
+            try:
+                pool, _chips = fleet.place(2, f"{kind}{i}", profile=prof)
+            except xerrors.TpuNotEnoughError:
+                continue
+            total += prof[fleet.pools[pool].topology.generation]
+        return total
+
+    ff = goodput("first_fit")
+    mt = goodput("max_throughput")
+    out["goodput"] = {
+        "jobs": len(stream),
+        "first_fit": round(ff, 3),
+        "max_throughput": round(mt, 3),
+        "placement_goodput_scale": round(mt / ff, 3) if ff else None,
+    }
+
+    # ---- defrag: fragmentation-blocked gang -> admitted ---------------
+    GANG_PLAN = {"dp": 2, "fsdp": 2, "tp": 2}
+    d = tempfile.mkdtemp(prefix="tdapi-placebench-")
+    app = App(state_dir=os.path.join(d, "state"), backend="mock",
+              addr="127.0.0.1:0", port_range=(49500, 49600),
+              topology=make_topology("v4-32"), api_key="", cpu_cores=16,
+              store_maint_records=0, placement_policy="max_throughput")
+    try:
+        for i in range(16):
+            app.replicasets.run_container(ContainerRun(
+                imageName="img", replicaSetName=f"t{i}", tpuCount=1,
+                env=["TDAPI_QUIESCE=1"]))
+        owner_of = {c: o for c, o in app.tpu.status.items() if o}
+        for c in (0, 1, 2, 3, 12, 13, 14, 15):
+            app.replicasets.delete_container(owner_of[c])
+        gang = ContainerRun(imageName="img", replicaSetName="gang",
+                            tpuCount=8, meshPlan=GANG_PLAN,
+                            env=["TDAPI_QUIESCE=1"])
+        refused = False
+        try:
+            app.replicasets.run_container(gang)
+        except xerrors.TpuNotEnoughError:
+            refused = True
+        t0 = time.perf_counter()
+        rep = app.defrag.run_for(8, PlanSpec.from_json(GANG_PLAN))
+        app.replicasets.run_container(gang)
+        admit_ms = (time.perf_counter() - t0) * 1e3
+        out["defrag"] = {
+            "gang_refused_pre_defrag": refused,
+            "opened": rep["opened"],
+            "migrations": len(rep["migrations"]),
+            "moved_chips": rep["movedChips"],
+            "defrag_gang_admit_ms": round(admit_ms, 1),
+            "defrag_steps_lost": rep["stepsLost"],
+        }
+    finally:
+        app.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+    log(f"placement: goodput first_fit {out['goodput']['first_fit']} vs "
+        f"max_throughput {out['goodput']['max_throughput']} "
+        f"({out['goodput']['placement_goodput_scale']}x, criterion "
+        f">= 1.3x); defrag opened={out['defrag']['opened']} "
+        f"admit {out['defrag']['defrag_gang_admit_ms']}ms, steps lost "
+        f"{out['defrag']['defrag_steps_lost']}")
+    return out
+
+
 def check_claims(extra: dict) -> dict:
     """Diff this run's extras against BASELINE.json's machine-readable
     claims table (the same numbers BASELINE.md publishes). Any ratio
@@ -3139,6 +3246,11 @@ def main() -> None:
                 note="durability bench (WAL CRC framing overhead, "
                      "snapshot throughput, live replication lag, "
                      "promote-on-loss heal latency)...")
+    run_section(extra, "placement", placement_bench,
+                note="placement bench (mixed v4+v5e fleet: policy vs "
+                     "first-fit goodput; defrag un-blocking a "
+                     "fragmentation-stuck gang with quiesced "
+                     "migrations)...")
     # gate on what the cold-start workloads ACTUALLY reached — a wedged
     # tunnel hangs `import jax` in this process too, so don't touch jax at
     # all unless a child just proved the accelerator path works (tpu_seen
@@ -3286,6 +3398,14 @@ def build_summary(p50, platform, vs, extra) -> dict:
             "repl_lag_ms_p99": _dig("durability", "repl",
                                     "repl_lag_ms_p99"),
             "promote_ms": _dig("durability", "promote", "promote_ms"),
+            # placement headlines (docs/scheduling.md): policy goodput
+            # over first-fit, defrag gang-admit latency, zero-loss proof
+            "placement_goodput_scale": _dig("placement", "goodput",
+                                            "placement_goodput_scale"),
+            "defrag_gang_admit_ms": _dig("placement", "defrag",
+                                         "defrag_gang_admit_ms"),
+            "defrag_steps_lost": _dig("placement", "defrag",
+                                      "defrag_steps_lost"),
             "claims_ok": _dig("claims", "ok"),
             "claims_failed": len(_dig("claims", "failed", default=[]) or []),
         },

@@ -111,6 +111,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override the schedulable core count (default: "
                         "probe /proc/cpuinfo; mock-backend fleets on "
                         "small hosts need more cores than exist)")
+    p.add_argument("--placement-policy", default=None, metavar="POLICY",
+                   help="score whole-chip grants with this placement "
+                        "objective (max_throughput | "
+                        "finish_time_fairness | cost | first_fit) "
+                        "instead of mechanism-layer first-fit (default: "
+                        "TDAPI_PLACEMENT_POLICY env, else off; "
+                        "docs/scheduling.md)")
+    p.add_argument("--defrag-interval", type=float, default=None,
+                   metavar="SEC",
+                   help="run the background defragmenter every SEC "
+                        "seconds over gang shapes the admission path "
+                        "refused on capacity (default: "
+                        "TDAPI_DEFRAG_INTERVAL env, else 0 = on-demand "
+                        "only via POST /api/v1/placement/defrag)")
     return p
 
 
@@ -240,7 +254,9 @@ def main(argv=None) -> int:
               fleet_host=args.fleet_host,
               fleet_ttl=args.fleet_ttl,
               repl_peer=args.repl_peer,
-              cpu_cores=args.cpu_cores)
+              cpu_cores=args.cpu_cores,
+              placement_policy=args.placement_policy,
+              defrag_interval=args.defrag_interval)
     app.start()
 
     status = app.tpu.get_status()

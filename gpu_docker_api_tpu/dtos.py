@@ -60,6 +60,13 @@ class ContainerRun:
     # no plan (the trivial single-chip shape) — every legacy request
     # deserializes here.
     meshPlan: Optional[dict] = None
+    # per-generation throughput profile: {generation: relative steps/s}
+    # (e.g. {"v4": 1.0, "v5e": 0.55}) — how THIS workload scales across
+    # the fleet's chip generations. {} = unprofiled: placement falls back
+    # to fitted observations, then the generation baseline
+    # (topology.GENERATION_SPECS). Scores placement only; never the grant
+    # mechanism.
+    profile: dict = field(default_factory=dict)
     binds: list[Bind] = field(default_factory=list)
     env: list[str] = field(default_factory=list)
     cmd: list[str] = field(default_factory=list)
@@ -76,6 +83,8 @@ class ContainerRun:
             memory=d.get("memory", "") or "",
             priority=d.get("priority", "") or "",
             meshPlan=d.get("meshPlan"),
+            profile={str(k): float(v)
+                     for k, v in (d.get("profile") or {}).items()},
             binds=[Bind.from_json(b) for b in d.get("binds", []) if b],
             env=list(d.get("env", []) or []),
             cmd=list(d.get("cmd", []) or []),
@@ -197,6 +206,10 @@ class ContainerSpec:
     # ICI-contiguous sub-mesh shaped for these factors, and the same dict
     # rides into the container as TDAPI_MESH_PLAN (tpu_env).
     mesh_plan: dict = field(default_factory=dict)
+    # declared throughput profile carried from ContainerRun.profile —
+    # persisted so a migrate/patch re-placement scores with the same
+    # profile the original run declared ({} = unprofiled)
+    profile: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return asdict(self)

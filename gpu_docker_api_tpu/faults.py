@@ -144,6 +144,14 @@ CRASHPOINTS: dict[str, str] = {
     # is reconciled by the watchdog)
     "hedge.in_flight": "hedge slot claimed, duplicate request not yet "
                        "dispatched",
+    # defragmenter (defrag.py Defragmenter.run_for): the umbrella defrag
+    # intent is journaled but recovery is carried by the per-tenant
+    # replace intents — a crash at either point must leave a world where
+    # re-running the defrag re-diagnoses live state, skips already-moved
+    # tenants, and opens the box with nothing leaked
+    "defrag.after_plan": "eviction plan journaled, no tenant migrated yet",
+    "defrag.after_migrate": "first tenant migrated (its replace committed), "
+                            "remaining evictions not yet run",
 }
 
 _lock = threading.Lock()

@@ -101,6 +101,16 @@ EVENT_OPS = frozenset({
     # rebuilt its replica from a full snapshot
     "store.read_only",
     "repl.resync",
+    # heterogeneity-aware placement + defragmenter (PR 20): a scored
+    # placement committed (placement.py FleetModel.place); a defrag run
+    # journaled its eviction plan, migrated one tenant, opened the box
+    # for a gang (admit), or refused (deny: not blocked / over budget /
+    # eviction failed) — defrag.py Defragmenter.run_for
+    "placement.place",
+    "defrag.plan",
+    "defrag.migrate",
+    "defrag.admit",
+    "defrag.deny",
 })
 
 #: every Prometheus metric family name the /metrics exposition may emit.
@@ -217,4 +227,23 @@ METRIC_NAMES = frozenset({
     "tdapi_repl_events_applied_total",
     "tdapi_repl_resyncs_total",
     "tdapi_repl_connected",
+    # heterogeneity-aware placement (PR 20): active policy (value 1,
+    # labeled), per-pool capacity/fragmentation views, and the
+    # score/commit counters (server/app.py collect callback over
+    # placement.FleetModel; zero-valued single-pool families when no
+    # policy is configured — family parity)
+    "tdapi_placement_policy",
+    "tdapi_placement_pools",
+    "tdapi_placement_free_chips",
+    "tdapi_placement_largest_free_box",
+    "tdapi_placement_fragmentation",
+    "tdapi_placement_scored_total",
+    "tdapi_placement_placements_total",
+    # defragmenter (defrag.py Defragmenter counters)
+    "tdapi_defrag_runs_total",
+    "tdapi_defrag_migrations_total",
+    "tdapi_defrag_moved_chips_total",
+    "tdapi_defrag_steps_lost_total",
+    "tdapi_defrag_denied_total",
+    "tdapi_defrag_last_run_ms",
 })

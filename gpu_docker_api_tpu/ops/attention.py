@@ -892,7 +892,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kj, vj = piece(k, j), piece(v, j)
             if causal and j == i:
                 o, l = flash_attention_lse(qi, kj, vj, causal=True,
-                                           window=window,
+                                           window=window, blk_q=stack_bq,
                                            interpret=interpret)
             elif window and offset > window - chunk:
                 # partially masked boundary chunk: offset band, einsum
@@ -901,6 +901,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 # past chunk wholly inside the window (or non-causal):
                 # full pair through the kernel
                 o, l = flash_attention_lse(qi, kj, vj, causal=False,
+                                           blk_q=stack_bq,
                                            interpret=interpret)
             outs.append(o)
             lses.append(l)

@@ -99,6 +99,11 @@ INFORMATIONAL_STEPS = frozenset({
     # so a fed-adjacent intent journaling them never trips the
     # unknown-step alarm.
     "fed.after_acquire", "fed.after_takeover",
+    # defrag umbrella intent (defrag.py): "planned" records the chosen
+    # box + eviction list for operators; replay branches on nothing —
+    # the per-tenant replace intents carry the real recovery and the
+    # next run re-diagnoses live state
+    "planned",
 })
 
 KNOWN_STEPS = CONSULTED_STEPS | INFORMATIONAL_STEPS
@@ -263,6 +268,7 @@ class Reconciler:
             "volume.delete": self._replay_volume_delete,
             "gateway.scale": self._replay_gateway_scale,
             "gateway.delete": self._replay_gateway_delete,
+            "defrag": self._replay_defrag,
         }.get(rec.op)
         if handler is None:
             # an op nobody here can replay means a NEWER (or corrupt)
@@ -335,6 +341,16 @@ class Reconciler:
         if ports:
             self.ports.restore(ports, owner)
             report["grantsFreed"]["ports"] += len(ports)
+
+    def _replay_defrag(self, rec: IntentRecord, report: dict) -> None:
+        """A defrag run died mid-eviction. The umbrella intent carries no
+        recovery of its own: every tenant move journaled its OWN replace
+        intent (replayed above like any interrupted replace), and the next
+        defrag run re-diagnoses live state — already-moved tenants no
+        longer occupy the box, so the re-run is a smaller plan, not a
+        repeat. Clearing the record (done by the caller) is the whole
+        replay."""
+        report["opsCompleted"].append(f"defrag-cleared:{rec.target}")
 
     def _replay_run(self, rec: IntentRecord, report: dict) -> None:
         """A run that never persisted its record is fully unwound; one that

@@ -147,7 +147,8 @@ class TpuScheduler(Scheduler):
 
     def apply(self, n: int, owner: str = "",
               reuse: Optional[list[int]] = None,
-              plan: Optional[PlanSpec] = None) -> list[int]:
+              plan: Optional[PlanSpec] = None,
+              avoid: Optional[set] = None) -> list[int]:
         """Grant n chips as an ICI-contiguous set; returns chip indices.
 
         owner: who holds the grant (restore is owner-checked).
@@ -164,6 +165,10 @@ class TpuScheduler(Scheduler):
         fragmented fallback, because the workload will reshape the grant
         row-major into exactly this mesh and a fragmented grant would put
         the chattiest collectives on multi-hop paths.
+        avoid: chips HARD-excluded from this placement (defrag.py's
+        migrate-away path: the re-grant must not land back on the box
+        being opened). Unlike apply_shares' soft anti-affinity, a grant
+        that cannot be placed off the avoid set fails.
         """
         if n <= 0:
             return []
@@ -172,6 +177,7 @@ class TpuScheduler(Scheduler):
         if plan is not None and plan.size != n:
             raise ValueError(f"plan {plan.to_json()} sized {plan.size} "
                              f"cannot shape a {n}-chip grant")
+        avoid = avoid or set()
         with trace.span("sched.tpu.apply", target=owner, n=n) as sp, \
                 self._granting("tpu"):
             # cordoned chips are invisible to placement — not free, and not
@@ -179,12 +185,13 @@ class TpuScheduler(Scheduler):
             # move the workload OFF them
             reusable = {i for i in (reuse or [])
                         if self.status.get(i) == owner
-                        and i not in self.cordoned}
+                        and i not in self.cordoned and i not in avoid}
             # chips carrying fractional shares are invisible to whole-chip
             # placement: granting one whole would oversubscribe its
             # co-tenants
             free = ({i for i, s in self.status.items()
                      if s is FREE and i not in self.cordoned
+                     and i not in avoid
                      and not self.shares.get(i)} | reusable)
             if len(free) < n:
                 raise xerrors.TpuNotEnoughError(
@@ -237,6 +244,37 @@ class TpuScheduler(Scheduler):
                     self.status[i] = owner
             self._persist()
 
+    def claim(self, chips: list[int], owner: str,
+              plan: Optional[PlanSpec] = None) -> list[int]:
+        """Grant EXACTLY `chips` to `owner` — the placement layer's commit
+        path: placement.py scores candidates over a fleet snapshot and
+        then claims the winning box verbatim, so the chips chosen by the
+        objective are the chips granted (re-running apply() could pick a
+        different box if the pool moved between score and grant). Every
+        chip must still be allocatable (free, not cordoned, not
+        share-split) or the whole claim fails atomically with
+        TpuNotEnoughError — the caller re-snapshots and re-scores."""
+        if not chips:
+            return []
+        if plan is not None and plan.is_trivial:
+            plan = None
+        if plan is not None and plan.size != len(chips):
+            raise ValueError(f"plan {plan.to_json()} sized {plan.size} "
+                             f"cannot shape a {len(chips)}-chip claim")
+        with trace.span("sched.tpu.claim", target=owner,
+                        chips=list(chips)), self._granting("tpu"):
+            stale = [i for i in chips
+                     if self.status.get(i) is not FREE
+                     or i in self.cordoned or self.shares.get(i)]
+            if stale:
+                raise xerrors.TpuNotEnoughError(
+                    f"claim of {sorted(chips)} lost chips {sorted(stale)} "
+                    f"between score and grant; re-score")
+            for i in chips:
+                self.status[i] = owner
+            self._persist()
+            return sorted(chips)
+
     # ---- fractional shares ----
 
     def _shares_used(self, chip: int) -> int:
@@ -244,7 +282,8 @@ class TpuScheduler(Scheduler):
 
     def apply_shares(self, quanta: int, owner: str,
                      prefer: Optional[int] = None,
-                     avoid: Optional[set] = None) -> int:
+                     avoid: Optional[set] = None,
+                     strict_avoid: bool = False) -> int:
         """Grant `quanta` shares (quanta/SHARE_QUANTA of a chip) on ONE
         chip; returns the chip index. Placement is bin-packing: the
         already-most-shared chip with capacity wins (fills partial chips
@@ -256,7 +295,10 @@ class TpuScheduler(Scheduler):
         regulator must not serialize all of a gateway's replicas), fall
         back to packing when it doesn't. Never a cordoned or
         whole-granted chip; the per-chip ledger can never exceed
-        SHARE_QUANTA. Raises TpuOversubscribedError when no chip fits."""
+        SHARE_QUANTA. Raises TpuOversubscribedError when no chip fits.
+        strict_avoid upgrades the avoid set to a HARD exclusion (the
+        defrag migrate-away path — a share re-granted inside the box
+        being opened would undo the eviction)."""
         if not 0 < quanta < SHARE_QUANTA:
             raise ValueError(f"share quanta must be 1..{SHARE_QUANTA - 1}, "
                              f"got {quanta}")
@@ -273,6 +315,11 @@ class TpuScheduler(Scheduler):
                     f"{len(self.cordoned)} cordoned)")
             if avoid:
                 spread = [i for i in cands if i not in avoid]
+                if strict_avoid and not spread:
+                    raise xerrors.TpuOversubscribedError(
+                        f"want {quanta}/{SHARE_QUANTA} of a chip off "
+                        f"{len(avoid)} avoided chip(s); no other chip has "
+                        f"that much free share capacity")
                 cands = spread or cands      # soft: packing beats failing
             if prefer in cands:
                 chip = prefer
@@ -477,6 +524,74 @@ class TpuScheduler(Scheduler):
         factors = plan.factors()
         return any(plan_fits_box(dims, factors)
                    for *_, dims in self._box_candidates(n))
+
+    def enumerate_candidates(self, n: int,
+                             plan: Optional[PlanSpec] = None) -> list[dict]:
+        """Every fully-free axis-aligned box of volume n as a scored-grant
+        candidate — the placement layer's read surface. first-fit's
+        _find_box keeps its own early-exit ranking; this returns the WHOLE
+        candidate set (plan-compatible boxes only, when a plan is given)
+        so pluggable objectives can rank them by something other than
+        compactness. Each dict carries the geometry facts an objective may
+        score on; chips are sorted row-major for a direct claim()."""
+        if n <= 0:
+            return []
+        if plan is not None and plan.is_trivial:
+            plan = None
+        factors = plan.factors() if plan is not None else None
+        inner = (plan.tp * plan.sp) if plan is not None else 1
+        with self._lock:
+            free = {i for i, s in self.status.items()
+                    if s is FREE and i not in self.cordoned
+                    and not self.shares.get(i)}
+            out = []
+            for idx, box, ext, sa, span, origin, dims in \
+                    self._box_candidates(n):
+                if factors is not None and not plan_fits_box(dims, factors):
+                    continue
+                if not box <= free:
+                    continue
+                out.append({
+                    "chips": list(idx),
+                    "dims": list(dims),
+                    "origin": list(origin),
+                    "span": span,
+                    "surface": sa,
+                    "extFree": sum(1 for e in ext if e in free),
+                    "hostSplits": self._inner_host_splits(idx, inner),
+                })
+            return out
+
+    def capacity_view(self) -> dict:
+        """Per-pool capacity summary for fleet-level placement: allocatable
+        whole chips + share quanta, the largest fully-free box, and a
+        fragmentation ratio (1 - largest_box/free_chips — 0 when all free
+        capacity is one box, →1 as free chips shatter). The defragmenter
+        triggers on exactly this signal: plan_feasible says the geometry
+        COULD host a gang, free chips suffice, yet largestFreeBox < n."""
+        with self._lock:
+            free = {i for i, s in self.status.items()
+                    if s is FREE and i not in self.cordoned
+                    and not self.shares.get(i)}
+            free_q = sum(self._allocatable_quanta(i) for i in self.status)
+            largest = 0
+            for n in range(len(free), 0, -1):
+                if any(box <= free
+                       for _, box, *_ in self._box_candidates(n)):
+                    largest = n
+                    break
+            return {
+                "generation": self.topology.generation,
+                "acceleratorType": self.topology.accelerator_type,
+                "totalChips": len(self.status),
+                "freeChips": len(free),
+                "freeQuanta": free_q,
+                "cordoned": len(self.cordoned),
+                "shareSplit": len(self.shares),
+                "largestFreeBox": largest,
+                "fragmentation": round(1.0 - largest / len(free), 4)
+                                 if free else 0.0,
+            }
 
     def _native_find_box(self, n: int, free: set[int]) -> Optional[list[int]]:
         """C++ box search. Returns None when the core doesn't apply (torus,

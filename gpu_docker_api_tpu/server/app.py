@@ -39,6 +39,8 @@ from ..obs.metrics import Registry
 from ..obs.recorder import FlightRecorder
 from ..obs.trace import TraceCollector
 from ..gateway import GatewayConfig, GatewayManager
+from ..defrag import Defragmenter
+from ..placement import DEFAULT_POLICY, POLICIES, FleetModel
 from ..reconcile import Reconciler
 from .. import regulator
 from ..schedulers import (
@@ -237,7 +239,9 @@ class App:
                  fleet_member: Optional[str] = None,
                  fleet_host: Optional[str] = None,
                  fleet_ttl: Optional[float] = None,
-                 repl_peer: Optional[str] = None):
+                 repl_peer: Optional[str] = None,
+                 placement_policy: Optional[str] = None,
+                 defrag_interval: Optional[float] = None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
 
@@ -442,6 +446,41 @@ class App:
                 self._repl_peer, os.path.join(state_dir, "replica"),
                 api_key=self._api_key, engine=store_engine,
                 events=self.events)
+        # heterogeneity-aware placement (placement.py) + defragmenter
+        # (defrag.py). The fleet model is ALWAYS built — GET /placement
+        # and the tdapi_placement_* gauges read it — but the scored
+        # enumerate→score→claim path only engages when a policy is
+        # configured (param or TDAPI_PLACEMENT_POLICY); unset keeps the
+        # mechanism layer's first-fit byte-for-byte, so single-daemon
+        # deployments pay nothing new.
+        policy = (placement_policy
+                  or os.environ.get("TDAPI_PLACEMENT_POLICY", "") or "")
+        if policy and policy not in POLICIES:
+            raise ValueError(f"unknown placement policy {policy!r}; "
+                             f"known: {sorted(POLICIES)}")
+        self.placement_policy = policy
+        self.placer = FleetModel(
+            {self.tpu.topology.generation: self.tpu},
+            policy=policy or DEFAULT_POLICY, events=self.events)
+        if policy:
+            self.replicasets.placer = self.placer
+
+        def _owns(name: str) -> bool:
+            # federation gate: on a fleet member, defrag may only migrate
+            # replicaSets THIS daemon owns — moving a peer's tenant would
+            # race its owner's mutations
+            m = self.fleet.member
+            return m is None or ("containers", name) in m.owned
+
+        self.defrag = Defragmenter(self.placer, self.replicasets,
+                                   events=self.events, owns=_owns)
+        if defrag_interval is None:
+            try:
+                defrag_interval = float(
+                    os.environ.get("TDAPI_DEFRAG_INTERVAL", "0") or 0)
+            except ValueError:
+                defrag_interval = 0.0
+        self._defrag_interval = defrag_interval
         # store.read_only event edge detector (one event per latch trip)
         self._ro_trips_seen = 0
         # SSE follower count (tdapi_events_stream_clients) — mutated from
@@ -505,6 +544,8 @@ class App:
         r.add("POST", f"{v1}/tpus/:id/cordon", self.h_cordon)
         r.add("POST", f"{v1}/tpus/:id/uncordon", self.h_uncordon)
         r.add("POST", f"{v1}/tpus/drain", self.h_drain)
+        r.add("GET", f"{v1}/placement", self.h_placement)
+        r.add("POST", f"{v1}/placement/defrag", self.h_defrag)
         r.add("GET", "/metrics", self.h_metrics)
         r.add("GET", "/openapi.json", self.h_openapi)
         r.add("GET", f"{v1}/resources/tpus", self.h_res_tpus)
@@ -683,6 +724,11 @@ class App:
         except xerrors.TpuOversubscribedError:
             return err(ResCode.ContainerTpuOversubscribed)
         except xerrors.TpuNotEnoughError:
+            # a capacity-refused gang may be fragmentation-blocked, which
+            # waiting never fixes — note it for the background defragmenter
+            if spec.meshPlan:
+                self.defrag.note_infeasible(int(spec.tpuCount),
+                                            spec.meshPlan)
             return err(ResCode.ContainerTpuNotEnough)
         except xerrors.CpuNotEnoughError:
             return err(ResCode.ContainerCpuNotEnough)
@@ -1325,6 +1371,42 @@ class App:
             log.exception("drain failed [%s]", req.request_id)
             return err(ResCode.ServerBusy)
 
+    def h_placement(self, req: Request) -> Response:
+        """GET /placement: active policy, per-pool capacity/fragmentation
+        views, profile ledgers, and the defragmenter's counters."""
+        out = self.placer.describe()
+        out["policyActive"] = bool(self.placement_policy)
+        return ok({"placement": out, "defrag": self.defrag.describe()})
+
+    def h_defrag(self, req: Request) -> Response:
+        """POST /placement/defrag {tpuCount, meshPlan?}: synchronously run
+        one defrag cycle for a fragmentation-blocked gang shape — the
+        operator-driven twin of the background loop."""
+        try:
+            body = req.json() or {}
+            n = int(body.get("tpuCount", body.get("n", 0)) or 0)
+            if n <= 0:
+                return err(ResCode.InvalidParams,
+                           "tpuCount must be a positive whole-chip count")
+            plan = (PlanSpec.from_json(body["meshPlan"])
+                    if body.get("meshPlan") else None)
+            if plan is not None and not plan.is_trivial \
+                    and plan.size != n:
+                return err(ResCode.InvalidParams,
+                           f"meshPlan sized {plan.size} cannot shape a "
+                           f"{n}-chip gang")
+        except (ValueError, TypeError, KeyError) as e:
+            return err(ResCode.InvalidParams, str(e))
+        try:
+            report = self.defrag.run_for(n, plan,
+                                         requester=req.request_id)
+        except xerrors.BackendUnavailableError as e:
+            return unavailable(e)
+        except Exception:  # noqa: BLE001
+            log.exception("defrag failed [%s]", req.request_id)
+            return err(ResCode.ServerBusy)
+        return ok({"defrag": report})
+
     def _build_registry(self) -> Registry:
         """App-local metrics registry: every inventory/queue/gate series
         whose truth lives on THIS App's components, refreshed by one
@@ -1554,6 +1636,41 @@ class App:
         if self.workers is not None:
             h_wk_qw.set_extern(self.workers.queue_wait_extern)
 
+        # heterogeneity-aware placement + defragmenter (PR 20): the
+        # families are declared unconditionally (family parity — a
+        # single-pool no-policy daemon exports zeros, not absences)
+        g_pl_pol = m.gauge("tdapi_placement_policy",
+                           "active placement objective (value 1, policy "
+                           "label; 0 when scoring is not engaged)",
+                           labels=("policy",))
+        g_pl_pools = m.gauge("tdapi_placement_pools")
+        g_pl_free = m.gauge("tdapi_placement_free_chips",
+                            "allocatable whole chips, per pool",
+                            labels=("pool",))
+        g_pl_box = m.gauge("tdapi_placement_largest_free_box",
+                           "largest fully-free ICI-contiguous box, per "
+                           "pool — the gang admission ceiling",
+                           labels=("pool",))
+        g_pl_frag = m.gauge("tdapi_placement_fragmentation",
+                            "1 - largestFreeBox/freeChips, per pool",
+                            labels=("pool",))
+        g_pl_scored = m.gauge("tdapi_placement_scored_total",
+                              "candidate boxes scored", typ="counter")
+        g_pl_placed = m.gauge("tdapi_placement_placements_total",
+                              "scored placements committed", typ="counter")
+        g_df_runs = m.gauge("tdapi_defrag_runs_total", typ="counter")
+        g_df_migs = m.gauge("tdapi_defrag_migrations_total",
+                            "tenants migrated to open gang boxes",
+                            typ="counter")
+        g_df_moved = m.gauge("tdapi_defrag_moved_chips_total", typ="counter")
+        g_df_lost = m.gauge("tdapi_defrag_steps_lost_total",
+                            "training steps lost across defrag migrations "
+                            "(0 while every move quiesces)", typ="counter")
+        g_df_den = m.gauge("tdapi_defrag_denied_total",
+                           "defrag runs refused (not blocked / over "
+                           "budget / eviction failed)", typ="counter")
+        g_df_ms = m.gauge("tdapi_defrag_last_run_ms")
+
         def collect() -> None:
             tpu = self.tpu.get_status()
             cpu = self.cpu.get_status()
@@ -1630,6 +1747,26 @@ class App:
             g_fed_exp.set(arb.expiries_total)
             g_fed_wev.set(self.hub.events_total)
             g_fed_whead.set(self.hub.head)
+            pl = self.placer.describe()
+            g_pl_pol.reset()
+            g_pl_pol.set(1 if self.placement_policy else 0,
+                         policy=pl["policy"])
+            g_pl_pools.set(len(pl["pools"]))
+            for g in (g_pl_free, g_pl_box, g_pl_frag):
+                g.reset()
+            for p in pl["pools"]:
+                g_pl_free.set(p["freeChips"], pool=p["name"])
+                g_pl_box.set(p["largestFreeBox"], pool=p["name"])
+                g_pl_frag.set(p["fragmentation"], pool=p["name"])
+            g_pl_scored.set(pl["scoredTotal"])
+            g_pl_placed.set(pl["placementsTotal"])
+            df = self.defrag.describe()
+            g_df_runs.set(df["runsTotal"])
+            g_df_migs.set(df["migrationsTotal"])
+            g_df_moved.set(df["movedChipsTotal"])
+            g_df_lost.set(df["stepsLostTotal"])
+            g_df_den.set(df["deniedTotal"])
+            g_df_ms.set(df["lastRunMs"])
             if self.replicator is not None:
                 rs = self.replicator.describe()
                 g_repl_hor.set(rs["horizon"])
@@ -1772,6 +1909,9 @@ class App:
             self.replicator.start()
         self._start_store_maintenance()
         self.health.start()   # no-op when health_interval <= 0
+        # background defrag loop: retries gang shapes the admission path
+        # noted as fragmentation-blocked (no-op when interval <= 0)
+        self.defrag.start(self._defrag_interval)
         log.info("tpu-docker-api listening on %s:%d (%d chips, backend ready)",
                  self.server.host, self.server.port, self.tpu.topology.num_chips)
 
@@ -1827,6 +1967,8 @@ class App:
                 obs_metrics.GATEWAY_LATENCY.set_extern(None)
             self.workers.stop()    # drain the data-plane tier first
         self.gateways.stop_all()   # autoscaler loops, before services go
+        self.defrag.stop()         # before services: a mid-run migrate
+                                   # must not race the queue close
         self.health.stop()
         if self._maint_stop is not None:
             # join, don't just signal: an in-flight maintain() racing past

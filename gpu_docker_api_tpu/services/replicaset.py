@@ -164,6 +164,12 @@ class ReplicaSetService:
         # gang reshard counter (mesh-shape changes committed through the
         # rolling replace) — exported as tdapi_reshards_total
         self.reshards_total = 0
+        # heterogeneity-aware placement hook (placement.FleetModel). None
+        # = legacy first-fit through self.tpu.apply; the App wires it when
+        # a placement policy is configured. Whole-chip grants then go
+        # enumerate→score→claim; fractional grants and the fragmented
+        # fallback stay on the mechanism layer.
+        self.placer = None
 
     @contextlib.contextmanager
     def _mutex(self, name: str):
@@ -247,9 +253,26 @@ class ReplicaSetService:
                                          quanta, name, avoid=share_avoid)],
                                      shares=quanta)
                 elif whole > 0:
-                    self._grant_tpus(spec,
-                                     self.tpu.apply(whole, name, plan=plan),
-                                     plan=store)
+                    # the declared profile persists on the spec so a later
+                    # migrate/patch re-placement scores with it
+                    spec.profile = dict(req.profile or {})
+                    chips = None
+                    if self.placer is not None:
+                        self.placer.declare_profile(name, req.profile)
+                        try:
+                            _pool, chips = self.placer.place(
+                                whole, name, plan=plan,
+                                profile=req.profile)
+                        except xerrors.TpuNotEnoughError:
+                            if plan is not None and not plan.is_trivial:
+                                raise
+                            # no fully-free box anywhere: plan-less grants
+                            # keep the mechanism layer's connected/
+                            # fragmented fallback
+                            chips = None
+                    if chips is None:
+                        chips = self.tpu.apply(whole, name, plan=plan)
+                    self._grant_tpus(spec, chips, plan=store)
                 if req.cpuCount > 0:
                     spec.cpuset = self.cpu.apply(req.cpuCount, name)
                     spec.cpu_count = req.cpuCount
@@ -922,76 +945,109 @@ class ReplicaSetService:
                     # fresh counts, which already exclude cordoned chips
                     result["skipped"].append(name)
                     continue
-                new_spec = ContainerSpec.from_json(old.spec.to_json())
-                # idemPartial: one drain request journals one intent PER
-                # replicaSet, so no single intent's completion means the
-                # REQUEST completed — a crash mid-drain must re-execute
-                # the keyed retry (re-drain skips already-migrated sets),
-                # never finalize the key as a fabricated full success
-                intent = self.intents.begin(
-                    "replace", name, via="drain", oldVersion=old.version,
-                    oldContainer=old.containerName,
-                    oldReleased=old.resourcesReleased, idemPartial=True)
-                migration_meta: dict = {}
-                fresh = False
                 try:
-                    if old.spec.tpu_shares:
-                        # fractional co-tenant on a cordoned chip: fresh
-                        # share grant (apply_shares excludes cordoned
-                        # chips); its exact old quanta release when the
-                        # replace commits — zero leaked shares per
-                        # migrated co-tenant. The grant is fresh even if
-                        # it lands back on the SAME chip (this drain's
-                        # cordon snapshot may have raced an uncordon) —
-                        # fresh_shares tells the release paths so. Set
-                        # AFTER apply_shares: a failed grant must leave
-                        # fresh False, or the unwind would release the
-                        # live old holding the copied spec still names.
-                        self._grant_tpus(new_spec, [self.tpu.apply_shares(
-                            old.spec.tpu_shares, name)],
-                            shares=old.spec.tpu_shares)
-                        fresh = True
-                    else:
-                        # a gang set migrates as a gang: the re-grant is
-                        # plan-shaped (apply excludes cordoned chips from
-                        # pool and reuse alike); plan-less stays plan-less
-                        dr_plan = (PlanSpec.from_spec(old.spec.mesh_plan)
-                                   if old.spec.mesh_plan else None)
-                        self._grant_tpus(new_spec, self.tpu.apply(
-                            len(old.spec.tpu_chips), name,
-                            reuse=list(old.spec.tpu_chips), plan=dr_plan),
-                            plan=dr_plan)
-                    intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
-                    info = self._rolling_replace(name, old, new_spec, intent,
-                                                 meta_out=migration_meta,
-                                                 fresh_shares=fresh)
+                    item = self._migrate_locked(name, old, via="drain")
                 except xerrors.BackendUnavailableError:
                     # breaker open: the WHOLE substrate is refusing — abort
                     # the drain (503 to the caller) instead of logging one
                     # doomed migration per replicaSet
-                    self._free_new_grants(name, new_spec, old.spec,
-                                          fresh_shares=fresh)
-                    intent.done()
                     raise
                 except Exception as e:  # noqa: BLE001 — drain the rest
-                    self._free_new_grants(name, new_spec, old.spec,
-                                          fresh_shares=fresh)
-                    intent.done()
                     log.exception("drain: migrating %s failed", name)
                     result["failed"][name] = str(e)
                     continue
-                intent.done()
-                result["drained"].append({
-                    "name": name, "version": info.version,
-                    "fromChips": sorted(old.spec.tpu_chips),
-                    "toChips": sorted(info.spec.tpu_chips),
-                    # zero-loss contract surface: quiesced=True means the
-                    # workload checkpointed its exact step before the move
-                    # (stepsLost 0); False means plain stop-and-replay
-                    # (stepsLost null — bounded by its checkpoint cadence)
-                    "quiesced": migration_meta.get("quiesced", False),
-                    "stepsLost": migration_meta.get("stepsLost")})
+                result["drained"].append(item)
         return result
+
+    def _migrate_locked(self, name: str, old: StoredContainerInfo,
+                        via: str, avoid: Optional[set] = None) -> dict:
+        """One journaled live migration through the rolling-replace
+        ladder — the shared mechanism under drain (via="drain": cordoned
+        chips are already invisible to the scheduler) and the
+        defragmenter (via="defrag": `avoid` carries the box being
+        opened, a HARD exclusion on the re-grant so the eviction cannot
+        land back inside it). Caller holds self._mutex(name) and has
+        loaded `old`. Returns the migration report item; on failure
+        unwinds fresh grants, closes the intent, and re-raises.
+
+        idemPartial: one drain/defrag request journals one intent PER
+        replicaSet, so no single intent's completion means the REQUEST
+        completed — a crash mid-sweep must re-execute the keyed retry
+        (a re-POST skips already-migrated sets), never finalize the key
+        as a fabricated full success."""
+        avoid = set(avoid or ())
+        new_spec = ContainerSpec.from_json(old.spec.to_json())
+        intent = self.intents.begin(
+            "replace", name, via=via, oldVersion=old.version,
+            oldContainer=old.containerName,
+            oldReleased=old.resourcesReleased, idemPartial=True)
+        migration_meta: dict = {}
+        fresh = False
+        try:
+            if old.spec.tpu_shares:
+                # fractional co-tenant: fresh share grant (apply_shares
+                # excludes cordoned chips; a defrag avoid set is strict —
+                # failing beats re-granting inside the box being opened);
+                # its exact old quanta release when the replace commits —
+                # zero leaked shares per migrated co-tenant. The grant is
+                # fresh even if it lands back on the SAME chip (a drain's
+                # cordon snapshot may have raced an uncordon) —
+                # fresh_shares tells the release paths so. Set AFTER
+                # apply_shares: a failed grant must leave fresh False, or
+                # the unwind would release the live old holding the
+                # copied spec still names.
+                self._grant_tpus(new_spec, [self.tpu.apply_shares(
+                    old.spec.tpu_shares, name,
+                    avoid=avoid or None, strict_avoid=bool(avoid))],
+                    shares=old.spec.tpu_shares)
+                fresh = True
+            else:
+                # a gang set migrates as a gang: the re-grant is
+                # plan-shaped (apply excludes cordoned + avoided chips
+                # from pool and reuse alike); plan-less stays plan-less
+                mig_plan = (PlanSpec.from_spec(old.spec.mesh_plan)
+                            if old.spec.mesh_plan else None)
+                self._grant_tpus(new_spec, self.tpu.apply(
+                    len(old.spec.tpu_chips), name,
+                    reuse=list(old.spec.tpu_chips), plan=mig_plan,
+                    avoid=avoid or None),
+                    plan=mig_plan)
+            intent.step("granted", sync=False, tpuChips=new_spec.tpu_chips)
+            info = self._rolling_replace(name, old, new_spec, intent,
+                                         meta_out=migration_meta,
+                                         fresh_shares=fresh)
+        except Exception:
+            self._free_new_grants(name, new_spec, old.spec,
+                                  fresh_shares=fresh)
+            intent.done()
+            raise
+        intent.done()
+        return {
+            "name": name, "version": info.version,
+            "fromChips": sorted(old.spec.tpu_chips),
+            "toChips": sorted(info.spec.tpu_chips),
+            # zero-loss contract surface: quiesced=True means the
+            # workload checkpointed its exact step before the move
+            # (stepsLost 0); False means plain stop-and-replay
+            # (stepsLost null — bounded by its checkpoint cadence)
+            "quiesced": migration_meta.get("quiesced", False),
+            "stepsLost": migration_meta.get("stepsLost")}
+
+    def migrate_replicaset(self, name: str, via: str = "defrag",
+                           avoid: Optional[set] = None) -> dict:
+        """Migrate ONE stored replicaSet off the `avoid` chips — the
+        defragmenter's eviction primitive, journaled exactly like a
+        drain migration. A stopped set (resources already released)
+        holds no chips and returns a no-op item; unknown names raise
+        NotExistInStoreError."""
+        with self._mutex(name):
+            old = self._stored_info(name)
+            if old.resourcesReleased:
+                return {"name": name, "version": old.version,
+                        "fromChips": [], "toChips": [],
+                        "quiesced": False, "stepsLost": None,
+                        "skipped": "resourcesReleased"}
+            return self._migrate_locked(name, old, via=via, avoid=avoid)
 
     # ---------------------------------------------------- stop / restart etc
 

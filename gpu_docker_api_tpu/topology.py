@@ -64,6 +64,66 @@ _KNOWN_SHAPES: dict[str, tuple[str, Coord]] = {
     "v6e-8": ("v6e", (2, 4, 1)),
 }
 
+# ---- cross-generation geometry + baselines (placement.py fleet model) ----
+# Fleet-level facts about each generation that hold for ANY slice of it:
+# mesh dimensionality, host granularity, and coarse per-chip baselines —
+# relative dense-training throughput and relative on-demand price, both
+# normalized to v4 = 1.0. The baselines deliberately stay coarse (public
+# per-generation peak-FLOPs / list-price ratios, not benchmarks): they only
+# seed placement scoring when a workload declares no profile and no fitted
+# observation exists; a declared ContainerRun.profile or a fitted
+# step-time observation always wins (placement.ThroughputProfile).
+GENERATION_SPECS: dict[str, dict] = {
+    "v2":  {"dims": 2, "chips_per_host": 8,
+            "rel_throughput": 0.25, "rel_cost": 0.40},
+    "v3":  {"dims": 2, "chips_per_host": 8,
+            "rel_throughput": 0.45, "rel_cost": 0.60},
+    "v4":  {"dims": 3, "chips_per_host": 4,
+            "rel_throughput": 1.00, "rel_cost": 1.00},
+    "v5e": {"dims": 2, "chips_per_host": 8,
+            "rel_throughput": 0.72, "rel_cost": 0.37},
+    "v5litepod": {"dims": 2, "chips_per_host": 8,
+                  "rel_throughput": 0.72, "rel_cost": 0.37},
+    "v5p": {"dims": 3, "chips_per_host": 4,
+            "rel_throughput": 2.10, "rel_cost": 1.30},
+    "v6e": {"dims": 2, "chips_per_host": 8,
+            "rel_throughput": 2.00, "rel_cost": 0.85},
+}
+
+
+def generation_spec(generation: str) -> dict:
+    """Cross-generation facts for `generation`; unknown generations fall
+    back to the v4 baseline (neutral 1.0 ratios) rather than raising —
+    a fleet snapshot must stay renderable when a newer daemon joined the
+    fleet with a generation this build has never heard of."""
+    return GENERATION_SPECS.get(generation, GENERATION_SPECS["v4"])
+
+
+def box_shapes_for(accelerator_type: str, n: int) -> list[Coord]:
+    """Distinct axis-aligned sub-box shapes of exactly n chips realizable
+    on `accelerator_type`'s slice mesh — the cross-generation feasibility
+    primitive: placement can ask "could a v5e-8 EVER host this gang?"
+    without instantiating a scheduler for the pool. Unknown types answer
+    [] (no geometry claims about hardware we cannot model)."""
+    known = _KNOWN_SHAPES.get(accelerator_type)
+    if known is None or n <= 0:
+        return []
+    topo = TpuTopology(accelerator_type=accelerator_type,
+                       generation=known[0], shape=known[1])
+    return sorted({dims for _, dims in topo.sub_boxes(n)})
+
+
+def plan_fits_generation(accelerator_type: str,
+                         factors: list[int]) -> bool:
+    """Whether ANY sub-box of `accelerator_type`'s mesh hosts the plan's
+    axis factors ICI-contiguously (geometry only, occupancy ignored) —
+    the cross-pool twin of TpuScheduler.plan_feasible."""
+    n = 1
+    for f in factors:
+        n *= f
+    return any(plan_fits_box(dims, factors)
+               for dims in box_shapes_for(accelerator_type, n))
+
 
 @dataclass
 class TpuTopology:

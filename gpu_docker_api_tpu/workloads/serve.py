@@ -1791,6 +1791,17 @@ def _serve_multihost(args, config) -> int:
             dcfg = named_config(args.family, args.draft_config)
             if dcfg.vocab_size != config.vocab_size:
                 raise SystemExit("draft and target must share a vocab")
+            # validated here, next to the --shard-kv check, so a draft
+            # whose head counts don't divide the target's tp dies with a
+            # pointed message instead of a raw mesh/sharding error out
+            # of Trainer.create
+            d_kv = getattr(dcfg, "n_kv_heads", 0) or dcfg.n_heads
+            if dcfg.n_heads % tp or d_kv % tp:
+                raise SystemExit(
+                    f"--draft-config {args.draft_config!r} needs n_heads "
+                    f"({dcfg.n_heads}) and n_kv_heads ({d_kv}) divisible "
+                    f"by the target's tp ({tp}); pick a draft config "
+                    f"with compatible head counts or lower --tp")
             dtrainer = Trainer.create(dcfg, MeshPlan.auto(n_dev, tp=tp))
             if args.draft_checkpoint:
                 abstract = dtrainer.abstract_state(jax.random.key(0))

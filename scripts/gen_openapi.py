@@ -305,7 +305,18 @@ def build_spec() -> dict:
              "cmd": arr(s(), "Container entrypoint command"),
              "containerPorts": arr(s(), "Container ports; each gets a "
                                         "host port from the port "
-                                        "scheduler")},
+                                        "scheduler"),
+             "profile": obj(
+                 {}, additional={"type": "number"},
+                 desc="Per-generation relative throughput, e.g. "
+                      "{\"v4\": 1.0, \"v5e\": 0.3} — how much a chip of "
+                      "each generation is worth to THIS workload. Used "
+                      "by the placement policy layer to score candidate "
+                      "boxes on a mixed fleet (docs/scheduling.md); "
+                      "unset generations fall back to fitted step-time "
+                      "observations, then the generation baselines. "
+                      "Ignored when no --placement-policy is "
+                      "configured.")},
             required=["imageName", "replicaSetName"],
             desc="POST /api/v1/replicaSet body (dtos.ContainerRun; "
                  "reference models/container.go ContainerRun)"),
@@ -733,6 +744,71 @@ def build_spec() -> dict:
                                 "drain proceeds")},
             desc="POST /tpus/drain payload (services/replicaset.py "
                  "drain_cordoned)"),
+        "PlacementPool": obj(
+            {"name": s("Pool name (the daemon's own slice is its "
+                       "generation)"),
+             "generation": s("TPU generation, e.g. v4 / v5e / v5p"),
+             "acceleratorType": s("e.g. v4-32"),
+             "totalChips": i(), "freeChips": i(),
+             "freeQuanta": i("Free quarter-chip share quanta"),
+             "cordoned": i(), "shareSplit": i("Chips split into shares"),
+             "largestFreeBox": i("Largest free ICI-contiguous box — the "
+                                 "biggest gang admissible right now"),
+             "fragmentation": {"type": "number",
+                               "description": "1 - largestFreeBox/"
+                                              "freeChips (0 = compact)"}},
+            desc="One pool's capacity view (schedulers/tpu.py "
+                 "capacity_view)"),
+        "PlacementStatus": obj(
+            {"policy": s("Active scoring objective"),
+             "policies": arr(s(), "Known objectives"),
+             "policyActive": b("False = scoring surface is up but "
+                               "run_container still uses mechanism-layer "
+                               "first-fit (no --placement-policy)"),
+             "pools": arr(ref("PlacementPool")),
+             "declaredProfiles": arr(s(), "Workloads with a declared "
+                                          "profile"),
+             "fittedProfiles": arr(s(), "Workloads with step-time "
+                                        "observations"),
+             "scoredTotal": i("Candidate boxes scored since boot"),
+             "placementsTotal": i("Scored placements committed")},
+            desc="GET /placement payload (placement.py "
+                 "FleetModel.describe; docs/scheduling.md)"),
+        "DefragStatus": obj(
+            {"budgetFloor": i("Migration budget floor (chips moved per "
+                              "run <= max(gang size, this); "
+                              "TDAPI_DEFRAG_BUDGET)"),
+             "pending": i("Fragmentation-blocked gang shapes queued for "
+                          "the background loop"),
+             "running": b("Background loop thread alive"),
+             "runsTotal": i(), "migrationsTotal": i(),
+             "movedChipsTotal": i(), "stepsLostTotal": i(),
+             "deniedTotal": i(),
+             "lastRunMs": {"type": "number"}},
+            desc="Defragmenter counters (defrag.py)"),
+        "DefragRequest": obj(
+            {"tpuCount": i("Gang size in whole chips (required)",
+                           minimum=1),
+             "meshPlan": ref("MeshPlan")},
+            required=["tpuCount"],
+            desc="POST /placement/defrag body: the gang shape to open a "
+                 "box for"),
+        "DefragReport": obj(
+            {"n": i("Requested gang size"),
+             "opened": b("True = an ICI-contiguous n-chip box is now "
+                         "free; re-POST the gang to admit it"),
+             "pool": s("Pool whose box was opened (on success)"),
+             "box": arr(i(), "The opened chips"),
+             "migrations": arr(ref("DrainItem")),
+             "movedChips": i("Chips migrated this run (<= budget)"),
+             "stepsLost": i("Training steps forfeited across all "
+                            "migrations — 0 when every evicted tenant "
+                            "quiesced"),
+             "denied": s("Refusal reason: not fragmentation-blocked / "
+                         "no eviction plan within budget / an eviction "
+                         "error")},
+            desc="One defrag run's report (defrag.py "
+                 "Defragmenter.run_for)"),
         "GatewayCreate": obj(
             {"name": s("Gateway name (required; no '-')"),
              "image": s("Replica image (required)"),
@@ -1212,6 +1288,35 @@ def build_spec() -> dict:
                  "already-migrated sets are skipped, failed ones "
                  "retried. App error 503 when the backend circuit is "
                  "open.")},
+        f"{v1}/placement": {"get": op(
+            "getPlacement", "Placement policy, per-pool capacity + "
+            "fragmentation views, and defragmenter counters",
+            envelope(obj({"placement": ref("PlacementStatus"),
+                          "defrag": ref("DefragStatus")})),
+            tags=["resource"],
+            desc="The heterogeneity-aware placement surface "
+                 "(docs/scheduling.md): which scoring objective is "
+                 "active (--placement-policy / TDAPI_PLACEMENT_POLICY; "
+                 "policyActive false = mechanism-layer first-fit), each "
+                 "pool's largest free ICI-contiguous box and "
+                 "fragmentation ratio, and the defragmenter's "
+                 "run/migration/denial counters.")},
+        f"{v1}/placement/defrag": {"post": op(
+            "runDefrag", "Synchronously open an ICI-contiguous box for "
+            "a fragmentation-blocked gang shape",
+            envelope(obj({"defrag": ref("DefragReport")})),
+            body=ref("DefragRequest"), tags=["resource"],
+            desc="The operator-driven twin of the background defrag "
+                 "loop: if the shape is geometry- and capacity-feasible "
+                 "but no free box exists, the cheapest set of small "
+                 "tenants is migrated off a candidate box via the "
+                 "quiesce -> CoW-move -> re-grant ladder (hard avoid on "
+                 "the box), under the migration budget. Idempotent: "
+                 "re-POSTing after a crash or partial run re-diagnoses "
+                 "live state and finishes the eviction; a shape that is "
+                 "not fragmentation-blocked is a clean deny, never a "
+                 "migration storm. App error 503 when the backend "
+                 "circuit is open.")},
         f"{v1}/reconcile": {"get": op(
             "reconcile", "Crash-recovery report from the boot-time "
             "reconciler; ?run=1 performs a fresh pass (admin; quiesce "

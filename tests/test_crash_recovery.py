@@ -31,6 +31,7 @@ from gpu_docker_api_tpu.dtos import (
     TpuPatch,
 )
 from gpu_docker_api_tpu.faults import InjectedCrash
+from gpu_docker_api_tpu.meshplan import PlanSpec
 from gpu_docker_api_tpu.server.app import App
 from gpu_docker_api_tpu.topology import make_topology
 
@@ -566,6 +567,44 @@ def post_repl_snapshot(app, stored):
     r.store.close()
 
 
+_GANG_PLAN = {"dp": 2, "fsdp": 2, "tp": 2}     # 8 chips
+
+
+def setup_defrag(app):
+    # 16 one-chip tenants fill the v4-32 mesh; stopping the tenants on
+    # the outer z-slabs (chips 0-3 and 12-15, index = x + 2y + 4z) frees
+    # 8 chips with NO free 8-box — an 8-gang is then geometry-feasible,
+    # capacity-feasible, and fragmentation-blocked: exactly the
+    # defragmenter's trigger state
+    for i in range(N_CHIPS):
+        app.replicasets.run_container(ContainerRun(
+            imageName="img", replicaSetName=f"t{i}", tpuCount=1))
+    owner_of = {c: o for c, o in app.tpu.status.items() if o}
+    for c in (0, 1, 2, 3, 12, 13, 14, 15):
+        app.replicasets.stop_container(owner_of[c])
+    cv = app.tpu.capacity_view()
+    assert cv["freeChips"] == 8 and cv["largestFreeBox"] < 8, cv
+
+
+def scenario_defrag(app):
+    app.defrag.run_for(8, PlanSpec.from_json(_GANG_PLAN))
+
+
+def post_defrag(app, stored):
+    # re-running the defrag is idempotent: tenants already moved by the
+    # crashed run no longer occupy the box (their replaces committed and
+    # were settled at boot), the remaining evictions complete, and the
+    # previously-infeasible gang admits on the opened box
+    rep = app.defrag.run_for(8, PlanSpec.from_json(_GANG_PLAN))
+    assert rep["opened"], rep
+    app.replicasets.run_container(ContainerRun(
+        imageName="img", replicaSetName="gang", tpuCount=8,
+        meshPlan=_GANG_PLAN))
+    app.wq.join()
+    gang = stored_containers(app)["gang"]
+    assert len(gang.spec.tpu_chips) == 8
+
+
 # crashpoint-name prefix -> (setup, mutate, extra post-assertions)
 SCENARIOS = [
     ("run.", (None, scenario_run, post_run)),
@@ -608,6 +647,11 @@ SCENARIOS = [
     # durability steps (maintain, then horizon sidecar)
     ("repl.after_snapshot", (setup_repl_snapshot, scenario_repl_snapshot,
                              post_repl_snapshot)),
+    # defragmenter (PR 20): the umbrella intent is informational — the
+    # per-tenant replace intents carry the real recovery, so both crash
+    # placements share one triple: re-run re-diagnoses from live state
+    ("defrag.after_plan", (setup_defrag, scenario_defrag, post_defrag)),
+    ("defrag.after_migrate", (setup_defrag, scenario_defrag, post_defrag)),
 ]
 
 

@@ -1,0 +1,426 @@
+"""Heterogeneity-aware placement + defragmenter sweep.
+
+Model half: the pure objective algebra (max-throughput routes by profile,
+cost prefers cheap generations, finish-time fairness discounts full
+pools), the declared <- fitted <- baseline profile merge, and a seeded
+randomized churn over a mixed v4-32 + v5e-8 ``FleetModel`` asserting the
+scored place/claim pipeline never double-grants or leaks.
+
+Live half: on a real ``App``, a seeded churn of run/patch/stop/delete is
+driven into the canonical fragmentation-blocked state (free chips
+suffice, no free box), then the defragmenter must restore the largest
+contiguous box, the previously-infeasible gang must admit, every
+migration must be quiesced with stepsLost == 0 (tenants opt in via
+TDAPI_QUIESCE=1), and the final bitmap must exactly match the store —
+zero leaks.
+
+`make verify-placement` runs exactly this marker.
+"""
+
+import json
+import random
+
+import pytest
+
+from gpu_docker_api_tpu import faults, xerrors
+from gpu_docker_api_tpu.defrag import Defragmenter
+from gpu_docker_api_tpu.dtos import (
+    ContainerRun, PatchRequest, StoredContainerInfo, TpuPatch)
+from gpu_docker_api_tpu.meshplan import PlanSpec
+from gpu_docker_api_tpu.placement import (
+    POLICIES, Candidate, FleetModel, obj_cost, obj_finish_time_fairness,
+    obj_first_fit, obj_max_throughput)
+from gpu_docker_api_tpu.schedulers import TpuScheduler
+from gpu_docker_api_tpu.schedulers.base import FREE
+from gpu_docker_api_tpu.server.app import App
+from gpu_docker_api_tpu.server.codes import ResCode
+from gpu_docker_api_tpu.server.http import Request
+from gpu_docker_api_tpu.topology import make_topology
+
+pytestmark = pytest.mark.placement
+
+GANG_PLAN = {"dp": 2, "fsdp": 2, "tp": 2}      # 8 chips
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm_all()
+    faults.disarm_faults()
+    yield
+    faults.disarm_all()
+    faults.disarm_faults()
+
+
+def make_fleet(policy="max_throughput"):
+    return FleetModel({
+        "v4": TpuScheduler(topology=make_topology("v4-32")),    # 16 chips
+        "v5e": TpuScheduler(topology=make_topology("v5e-8")),   # 8 chips
+    }, policy=policy)
+
+
+def make_app(tmp_path, policy="max_throughput"):
+    return App(state_dir=str(tmp_path / "state"), backend="mock",
+               addr="127.0.0.1:0", port_range=(48000, 48100),
+               topology=make_topology("v4-32"), api_key="", cpu_cores=16,
+               store_maint_records=0, placement_policy=policy)
+
+
+def stored_containers(app):
+    app.wq.join()
+    return {kv.key.rsplit("/", 1)[1]: StoredContainerInfo.deserialize(kv.value)
+            for kv in app.client.range("containers")}
+
+
+def assert_no_leaks(app):
+    """Scheduler bitmap == stored specs, both directions."""
+    stored = stored_containers(app)
+    exp = {}
+    for name, info in stored.items():
+        if info.resourcesReleased:
+            continue
+        for c in info.spec.tpu_chips:
+            exp[c] = name
+    got = {c: o for c, o in app.tpu.status.items() if o is not FREE}
+    assert got == exp, f"bitmap {got} != store {exp}"
+
+
+# ---- objective algebra (pure functions over snapshots) ----
+
+def test_max_throughput_routes_by_profile():
+    fleet = make_fleet()
+    snap = fleet.snapshot()
+    cands = fleet.candidates_for(2)
+    assert {c.pool for c in cands} == {"v4", "v5e"}
+    embed = {"profile": {"v4": 1.0, "v5e": 0.2}, "n": 2}
+    dense = {"profile": {"v4": 0.5, "v5e": 1.5}, "n": 2}
+    best_e = max(cands, key=lambda c: obj_max_throughput(snap, c, embed))
+    best_d = max(cands, key=lambda c: obj_max_throughput(snap, c, dense))
+    assert best_e.pool == "v4" and best_d.pool == "v5e"
+
+
+def test_cost_prefers_cheap_generation_for_flat_profile():
+    fleet = make_fleet()
+    snap = fleet.snapshot()
+    cands = fleet.candidates_for(2)
+    ctx = {"profile": {"v4": 1.0, "v5e": 1.0}, "n": 2}
+    best = max(cands, key=lambda c: obj_cost(snap, c, ctx))
+    assert best.pool == "v5e"          # same throughput at 0.37x the cost
+
+
+def test_fairness_discounts_nearly_full_pool():
+    fleet = make_fleet()
+    # fill v4 down to 2 free chips: the fast pool has no headroom left
+    fleet.pools["v4"].apply(14, "hog")
+    snap = fleet.snapshot()
+    cands = fleet.candidates_for(2)
+    ctx = {"profile": {"v4": 1.0, "v5e": 0.9}, "n": 2}
+    best_thr = max(cands, key=lambda c: obj_max_throughput(snap, c, ctx))
+    best_fair = max(cands,
+                    key=lambda c: obj_finish_time_fairness(snap, c, ctx))
+    assert best_thr.pool == "v4"       # raw throughput still says v4
+    assert best_fair.pool == "v5e"     # fairness routes around the queue
+
+
+def test_first_fit_policy_reproduces_naive_pick():
+    fleet = make_fleet(policy="first_fit")
+    pool, chips = fleet.place(2, "w0")
+    # deterministic tiebreak: lexically-first pool, lowest chips
+    assert pool == "v4" and chips == [0, 1]
+
+
+def test_objectives_are_pure():
+    """Objectives must not touch schedulers: scoring a synthetic candidate
+    against a synthetic snapshot works with no pools at all."""
+    from gpu_docker_api_tpu.placement import FleetSnapshot, PoolView
+    snap = FleetSnapshot(pools=(PoolView(
+        name="x", generation="v4", accelerator_type="v4-32",
+        total_chips=16, free_chips=16, free_quanta=64, cordoned=0,
+        share_split=0, largest_free_box=16, fragmentation=0.0),))
+    cand = Candidate(pool="x", generation="v4", chips=(0, 1), dims=(2, 1, 1),
+                     span=1, surface=10, ext_free=6, host_splits=0)
+    ctx = {"profile": {}, "n": 2}
+    for name, obj in sorted(POLICIES.items()):
+        s1, s2 = obj(snap, cand, ctx), obj(snap, cand, ctx)
+        assert s1 == s2, name          # deterministic, side-effect free
+    assert obj_first_fit(snap, cand, ctx) == 0.0
+
+
+# ---- profile merge: baselines <- fitted <- declared ----
+
+def test_profile_defaults_to_generation_baselines():
+    fleet = make_fleet()
+    prof = fleet.profile_for("w")
+    assert set(prof) == {"v4", "v5e"}
+    assert prof["v4"] == 1.0 and prof["v5e"] == pytest.approx(0.72)
+
+
+def test_single_generation_observations_never_perturb_baselines():
+    fleet = make_fleet()
+    for _ in range(8):
+        fleet.observe_step_time("w", "v4", 100.0)
+    assert fleet.profile_for("w")["v4"] == 1.0     # no cross-gen ratio yet
+
+
+def test_cross_generation_fit_reanchors_ratios():
+    fleet = make_fleet()
+    for _ in range(4):
+        fleet.observe_step_time("w", "v4", 100.0)   # 10 steps/s
+        fleet.observe_step_time("w", "v5e", 50.0)   # 20 steps/s
+    prof = fleet.profile_for("w")
+    # anchored at v5e (tie on samples -> lexically-max generation): the
+    # baseline frame keeps v5e at 0.72 and scales v4 by the observed ratio
+    assert prof["v5e"] == pytest.approx(0.72)
+    assert prof["v4"] == pytest.approx(0.36)
+
+
+def test_declared_profile_wins_over_fitted():
+    fleet = make_fleet()
+    for _ in range(4):
+        fleet.observe_step_time("w", "v4", 100.0)
+        fleet.observe_step_time("w", "v5e", 50.0)
+    fleet.declare_profile("w", {"v4": 3.0})
+    prof = fleet.profile_for("w")
+    assert prof["v4"] == 3.0 and prof["v5e"] == pytest.approx(0.72)
+
+
+# ---- place: score -> claim commit path ----
+
+def test_place_commits_scored_winner_and_counts():
+    fleet = make_fleet()
+    pool, chips = fleet.place(2, "dense",
+                              profile={"v4": 0.5, "v5e": 1.5})
+    assert pool == "v5e" and len(chips) == 2
+    assert fleet.pools["v5e"].status[chips[0]] == "dense"
+    assert fleet.placements_total == 1 and fleet.scored_total > 0
+    d = fleet.describe()
+    assert d["policy"] == "max_throughput"
+    assert {p["name"] for p in d["pools"]} == {"v4", "v5e"}
+
+
+def test_place_raises_when_no_pool_fits():
+    fleet = make_fleet()
+    with pytest.raises(xerrors.TpuNotEnoughError):
+        fleet.place(32, "huge")
+
+
+def test_place_respects_mesh_plan_geometry():
+    fleet = make_fleet()
+    plan = PlanSpec.from_json(GANG_PLAN)
+    pool, chips = fleet.place(8, "gang", plan=plan)
+    assert len(chips) == 8
+    assert fleet.pools[pool].topology.is_connected(chips)
+
+
+# ---- randomized churn: mixed-fleet placement invariants ----
+
+def test_churn_mixed_fleet_never_double_grants_or_leaks():
+    rng = random.Random(20)
+    fleet = make_fleet()
+    profiles = [None, {"v4": 1.0, "v5e": 0.3}, {"v4": 0.4, "v5e": 1.2}]
+    live = {}                           # owner -> (pool, chips)
+    seq = 0
+    for _ in range(120):
+        if live and rng.random() < 0.4:
+            owner = rng.choice(sorted(live))
+            pool, chips = live.pop(owner)
+            fleet.pools[pool].restore(chips, owner)
+        else:
+            seq += 1
+            owner = f"w{seq}"
+            try:
+                pool, chips = fleet.place(
+                    rng.choice([1, 1, 2, 4]), owner,
+                    profile=rng.choice(profiles),
+                    policy=rng.choice(sorted(POLICIES)))
+            except xerrors.TpuNotEnoughError:
+                continue
+            live[owner] = (pool, chips)
+        # invariant: each pool's bitmap is exactly the live grants
+        for pname, sched in fleet.pools.items():
+            exp = {c: o for o, (p, cs) in live.items()
+                   for c in cs if p == pname}
+            got = {c: o for c, o in sched.status.items() if o is not FREE}
+            assert got == exp
+    for owner, (pool, chips) in live.items():
+        fleet.pools[pool].restore(chips, owner)
+    for sched in fleet.pools.values():
+        cv = sched.capacity_view()
+        assert cv["freeChips"] == cv["totalChips"]
+        assert cv["largestFreeBox"] == cv["totalChips"]   # contiguity back
+        assert cv["fragmentation"] == 0.0
+
+
+# ---- defragmenter unit guards (model-level, no migrations needed) ----
+
+def _blocked_fleet():
+    """Single v4-32 pool with free chips {0..3, 12..15}: 8 free, no free
+    8-box (every 8-box crosses the occupied middle), one-chip tenants."""
+    fleet = FleetModel(
+        {"v4": TpuScheduler(topology=make_topology("v4-32"))})
+    sched = fleet.pools["v4"]
+    for i in range(16):
+        sched.claim([i], f"t{i}")
+    for i in (0, 1, 2, 3, 12, 13, 14, 15):
+        sched.restore([i], f"t{i}")
+    cv = sched.capacity_view()
+    assert cv["freeChips"] == 8 and cv["largestFreeBox"] < 8, cv
+    return fleet
+
+
+def test_defrag_diagnose_flags_fragmentation_blocked_pool():
+    fleet = _blocked_fleet()
+    d = Defragmenter(fleet, replicasets=None)
+    blocked = d.diagnose(8, PlanSpec.from_json(GANG_PLAN))
+    assert [b["pool"] for b in blocked] == ["v4"]
+    assert d.diagnose(4) == []          # a free 4-box exists
+    assert d.diagnose(16) == []         # genuinely out of capacity
+
+
+def test_defrag_eviction_plan_is_cheapest_and_budgeted():
+    fleet = _blocked_fleet()
+    d = Defragmenter(fleet, replicasets=None)
+    plan = d.plan_eviction("v4", 8, PlanSpec.from_json(GANG_PLAN))
+    assert plan is not None
+    assert plan["movedChips"] == 4      # 4 one-chip tenants off the box
+    assert len(plan["evict"]) == 4
+    # a budget below the cheapest plan denies instead of thrashing
+    tight = Defragmenter(fleet, replicasets=None, budget=3)
+    assert tight.plan_eviction("v4", 8) is None
+
+
+def test_defrag_respects_federation_ownership():
+    fleet = _blocked_fleet()
+    d = Defragmenter(fleet, replicasets=None, owns=lambda name: False)
+    assert d.plan_eviction("v4", 8) is None     # peers' tenants: hands off
+
+
+# ---- live churn: defrag restores contiguity, gang admits, zero loss ----
+
+def test_churn_then_defrag_admits_gang_with_zero_loss(tmp_path):
+    rng = random.Random(7)
+    app = make_app(tmp_path)
+    try:
+        seq = 0
+        live = []
+        for _ in range(40):
+            op = rng.choice(["run", "run", "run", "stop", "delete", "patch"])
+            if op == "run" or not live:
+                seq += 1
+                name = f"c{seq}"
+                try:
+                    app.replicasets.run_container(ContainerRun(
+                        imageName="img", replicaSetName=name,
+                        tpuCount=rng.choice([1, 1, 2]),
+                        env=["TDAPI_QUIESCE=1"]))
+                except xerrors.TpuNotEnoughError:
+                    continue
+                live.append(name)
+            elif op == "stop":
+                app.replicasets.stop_container(live.pop(
+                    rng.randrange(len(live))))
+            elif op == "delete":
+                app.replicasets.delete_container(live.pop(
+                    rng.randrange(len(live))))
+            else:
+                try:
+                    app.replicasets.patch_container(
+                        rng.choice(live), PatchRequest(
+                            tpuPatch=TpuPatch(tpuCount=rng.choice([1, 2]))))
+                except (xerrors.TpuNotEnoughError,
+                        xerrors.NoPatchRequiredError):
+                    continue
+            assert_no_leaks(app)
+        # drive into the canonical fragmentation-blocked state: clear the
+        # churn survivors, fill with 16 one-chip quiesce-enabled tenants,
+        # free the outer z-slabs (chips 0-3 and 12-15)
+        for name in live:
+            app.replicasets.delete_container(name)
+        for i in range(16):
+            app.replicasets.run_container(ContainerRun(
+                imageName="img", replicaSetName=f"t{i}", tpuCount=1,
+                env=["TDAPI_QUIESCE=1"]))
+        owner_of = {c: o for c, o in app.tpu.status.items() if o}
+        for c in (0, 1, 2, 3, 12, 13, 14, 15):
+            app.replicasets.delete_container(owner_of[c])
+        cv = app.tpu.capacity_view()
+        assert cv["freeChips"] == 8 and cv["largestFreeBox"] < 8, cv
+        plan = PlanSpec.from_json(GANG_PLAN)
+        with pytest.raises(xerrors.TpuNotEnoughError):
+            app.replicasets.run_container(ContainerRun(
+                imageName="img", replicaSetName="gang", tpuCount=8,
+                meshPlan=GANG_PLAN))
+        rep = app.defrag.run_for(8, plan)
+        assert rep["opened"], rep
+        # every migration quiesced at its exact step: zero training loss
+        assert rep["migrations"], "defrag must have moved tenants"
+        for item in rep["migrations"]:
+            assert item["quiesced"] is True
+            assert item["stepsLost"] == 0
+        assert rep["movedChips"] <= 8   # within the n-chip budget
+        # contiguity restored: the largest free box fits the gang again
+        assert app.tpu.capacity_view()["largestFreeBox"] >= 8
+        app.replicasets.run_container(ContainerRun(
+            imageName="img", replicaSetName="gang", tpuCount=8,
+            meshPlan=GANG_PLAN, env=["TDAPI_QUIESCE=1"]))
+        gang = stored_containers(app)["gang"]
+        assert len(gang.spec.tpu_chips) == 8
+        assert app.tpu.topology.is_connected(list(gang.spec.tpu_chips))
+        assert_no_leaks(app)
+        # a second run on the now-satisfied shape is a clean deny, not a
+        # migration storm
+        rep2 = app.defrag.run_for(8, plan)
+        assert rep2["denied"] == "not fragmentation-blocked"
+    finally:
+        app.stop()
+
+
+def test_run_container_notes_infeasible_gang_for_background_defrag(tmp_path):
+    app = make_app(tmp_path)
+    try:
+        for i in range(16):
+            app.replicasets.run_container(ContainerRun(
+                imageName="img", replicaSetName=f"t{i}", tpuCount=1))
+        owner_of = {c: o for c, o in app.tpu.status.items() if o}
+        for c in (0, 1, 2, 3, 12, 13, 14, 15):
+            app.replicasets.delete_container(owner_of[c])
+        req = Request("POST", "/api/v1/containers/run", {},
+                      json.dumps({"imageName": "img",
+                                  "replicaSetName": "gang",
+                                  "tpuCount": 8,
+                                  "meshPlan": GANG_PLAN}).encode(), {}, {})
+        resp = app.h_run(req)
+        assert int(resp.code) == int(ResCode.ContainerTpuNotEnough)
+        assert app.defrag.describe()["pending"] == 1
+    finally:
+        app.stop()
+
+
+def test_http_placement_surface_and_client_helpers(tmp_path):
+    from gpu_docker_api_tpu.client import ApiClient
+    app = make_app(tmp_path)
+    app.start()
+    c = ApiClient("127.0.0.1", app.server.port)
+    try:
+        st = c.placement_status()
+        assert st["policy"] == "max_throughput"
+        assert st["policyActive"] is True
+        assert st["pools"][0]["largestFreeBox"] == 16
+        assert c.defrag_status()["runsTotal"] == 0
+        for i in range(16):
+            app.replicasets.run_container(ContainerRun(
+                imageName="img", replicaSetName=f"t{i}", tpuCount=1,
+                env=["TDAPI_QUIESCE=1"]))
+        owner_of = {ch: o for ch, o in app.tpu.status.items() if o}
+        for ch in (0, 1, 2, 3, 12, 13, 14, 15):
+            app.replicasets.delete_container(owner_of[ch])
+        st = c.placement_status()
+        assert st["pools"][0]["freeChips"] == 8
+        assert st["pools"][0]["largestFreeBox"] < 8
+        assert st["pools"][0]["fragmentation"] > 0
+        rep = c.run_defrag(8, GANG_PLAN)
+        assert rep["opened"] is True and rep["stepsLost"] == 0
+        assert c.defrag_status()["runsTotal"] == 1
+        assert c.placement_status()["pools"][0]["largestFreeBox"] >= 8
+    finally:
+        c.close()
+        app.stop()
